@@ -1,0 +1,27 @@
+"""Test env: CPU backend with 8 virtual devices (SURVEY.md §4 item 3),
+so mesh/sharding tests run without TPU hardware and kernel tests are
+deterministic and fast. Must run before jax initializes a backend."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# This environment's sitecustomize force-registers the TPU ("axon")
+# backend and prepends it to jax_platforms, overriding the env var —
+# override it back so tests are CPU-deterministic and see 8 devices.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

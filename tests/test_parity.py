@@ -1,0 +1,118 @@
+"""Oracle parity tests (SURVEY.md §4 item 2): the batched device path
+must place pods exactly like the per-pod NumPy oracle under the shared
+deterministic tie-break — the north star's "placement parity with stock
+kube-scheduler" requirement, with the oracle standing in for stock."""
+
+import numpy as np
+import pytest
+
+from tpusched import Engine, EngineConfig
+from tpusched.oracle import Oracle
+from tpusched.synth import make_cluster
+
+
+def assert_parity(snap, cfg):
+    oracle_res = Oracle(snap, cfg).solve()
+    engine_res = Engine(cfg).solve(snap)
+    np.testing.assert_array_equal(
+        engine_res.assignment, oracle_res.assignment,
+        err_msg="placements diverge from oracle",
+    )
+    # oracle trims invalid pods from its order; device returns all P slots
+    # with invalid pods sunk to the end
+    n = len(oracle_res.order)
+    np.testing.assert_array_equal(engine_res.order[:n], oracle_res.order)
+    np.testing.assert_allclose(
+        engine_res.final_used, oracle_res.final_used, rtol=1e-5
+    )
+    # chosen scores agree to f32 tolerance (formulas are op-identical)
+    both = np.isfinite(oracle_res.chosen_score)
+    np.testing.assert_allclose(
+        engine_res.chosen_score[both], oracle_res.chosen_score[both],
+        rtol=1e-4, atol=1e-3,
+    )
+
+
+def test_parity_resources_only(rng):
+    snap, _ = make_cluster(rng, 40, 12, with_qos=False)
+    assert_parity(snap, EngineConfig())
+
+
+def test_parity_qos(rng):
+    snap, _ = make_cluster(rng, 40, 12, with_qos=True)
+    assert_parity(snap, EngineConfig())
+
+
+def test_parity_taints_tolerations(rng):
+    snap, _ = make_cluster(rng, 40, 12, taint_frac=0.5, toleration_frac=0.5)
+    assert_parity(snap, EngineConfig())
+
+
+def test_parity_selectors_affinity(rng):
+    snap, _ = make_cluster(rng, 40, 12, selector_frac=0.4, affinity_frac=0.4)
+    assert_parity(snap, EngineConfig())
+
+
+def test_parity_topology_spread(rng):
+    snap, _ = make_cluster(rng, 30, 12, spread_frac=0.6)
+    assert_parity(snap, EngineConfig())
+
+
+def test_parity_interpod_affinity(rng):
+    snap, _ = make_cluster(rng, 30, 12, interpod_frac=0.6)
+    assert_parity(snap, EngineConfig())
+
+
+def test_parity_kitchen_sink(rng):
+    snap, _ = make_cluster(
+        rng, 48, 16, taint_frac=0.3, toleration_frac=0.3, selector_frac=0.2,
+        affinity_frac=0.3, spread_frac=0.3, interpod_frac=0.3,
+    )
+    assert_parity(snap, EngineConfig())
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_parity_fuzz(seed):
+    """Property-style fuzz over random snapshots and random feature mixes."""
+    rng = np.random.default_rng(1000 + seed)
+    snap, _ = make_cluster(
+        rng,
+        n_pods=int(rng.integers(5, 60)),
+        n_nodes=int(rng.integers(3, 24)),
+        initial_utilization=float(rng.uniform(0.1, 0.6)),
+        taint_frac=float(rng.uniform(0, 0.5)),
+        toleration_frac=float(rng.uniform(0, 0.5)),
+        selector_frac=float(rng.uniform(0, 0.4)),
+        affinity_frac=float(rng.uniform(0, 0.4)),
+        spread_frac=float(rng.uniform(0, 0.4)),
+        interpod_frac=float(rng.uniform(0, 0.4)),
+    )
+    assert_parity(snap, EngineConfig())
+
+
+def test_parity_overcommitted_cluster(rng):
+    # More pods than capacity: many must be unschedulable (-1) identically.
+    snap, _ = make_cluster(rng, 64, 4, initial_utilization=0.7)
+    cfg = EngineConfig()
+    oracle_res = Oracle(snap, cfg).solve()
+    engine_res = Engine(cfg).solve(snap)
+    assert (oracle_res.assignment == -1).any()
+    np.testing.assert_array_equal(engine_res.assignment, oracle_res.assignment)
+
+
+def test_score_batch_matches_oracle_first_cycle(rng):
+    """ScoreBatch (no commits) must equal the oracle's first-cycle
+    feasible/score for every pod against the untouched snapshot."""
+    snap, _ = make_cluster(rng, 20, 10, taint_frac=0.3, affinity_frac=0.3,
+                           spread_frac=0.3, interpod_frac=0.3)
+    cfg = EngineConfig()
+    res = Engine(cfg).score(snap)
+    oracle = Oracle(snap, cfg)
+    used = np.asarray(snap.nodes.used)
+    for p in range(int(np.asarray(snap.pods.valid).sum())):
+        feasible, score = oracle.feasible_and_score(p, used)
+        np.testing.assert_array_equal(res.feasible[p], feasible, err_msg=f"pod {p}")
+        np.testing.assert_allclose(
+            res.scores[p][feasible], score[feasible], rtol=1e-4, atol=1e-3,
+            err_msg=f"pod {p}",
+        )

@@ -1,0 +1,130 @@
+"""Builder/interning/padding unit tests (SURVEY.md C1)."""
+
+import numpy as np
+import pytest
+
+from tpusched import Buckets, EngineConfig, SnapshotBuilder
+from tpusched.config import RESOURCE_PODS
+from tpusched.snapshot import (
+    MatchExpression,
+    NodeSelectorTerm,
+    Toleration,
+)
+
+
+def test_basic_build_shapes():
+    cfg = EngineConfig()
+    b = SnapshotBuilder(cfg)
+    b.add_node("n0", {"cpu": 4000, "memory": 16 << 30}, labels={"zone": "a"})
+    b.add_node("n1", {"cpu": 8000, "memory": 32 << 30}, labels={"zone": "b"})
+    b.add_pod("p0", {"cpu": 500, "memory": 1 << 30})
+    snap, meta = b.build()
+    N, R = snap.nodes.allocatable.shape
+    assert N >= 2 and R == len(cfg.resources)
+    assert snap.nodes.valid.sum() == 2
+    assert snap.pods.valid.sum() == 1
+    assert meta.node_names == ["n0", "n1"]
+    # pods resource auto-injected: request 1, allocatable default 110
+    r = cfg.resource_index(RESOURCE_PODS)
+    assert snap.pods.requests[0, r] == 1.0
+    assert snap.nodes.allocatable[0, r] == 110.0
+
+
+def test_padding_is_masked():
+    b = SnapshotBuilder(EngineConfig(), Buckets(pods=8, nodes=8))
+    b.add_node("n0", {"cpu": 1000, "memory": 1 << 30})
+    b.add_pod("p0", {"cpu": 100, "memory": 1 << 20})
+    snap, _ = b.build()
+    assert snap.nodes.valid.tolist() == [True] + [False] * 7
+    assert snap.pods.valid.tolist() == [True] + [False] * 7
+    assert (snap.nodes.label_pairs[1:] == -1).all()
+
+
+def test_bucket_autogrow():
+    b = SnapshotBuilder(EngineConfig(), Buckets(pods=8, nodes=8))
+    for i in range(20):
+        b.add_node(f"n{i}", {"cpu": 1000, "memory": 1 << 30})
+    b.add_pod("p0", {"cpu": 1})
+    snap, meta = b.build()
+    assert snap.nodes.valid.shape[0] == 32
+    assert meta.buckets.nodes == 32
+
+
+def test_label_interning_shared_between_nodes_and_pods():
+    b = SnapshotBuilder(EngineConfig())
+    b.add_node("n0", {"cpu": 1000}, labels={"disk": "ssd"})
+    b.add_pod("p0", {"cpu": 1}, labels={"disk": "ssd"})
+    snap, _ = b.build()
+    # same (key,value) pair id on node and pod
+    nid = snap.nodes.label_pairs[0][snap.nodes.label_pairs[0] >= 0]
+    pid = snap.pods.label_pairs[0][snap.pods.label_pairs[0] >= 0]
+    assert set(nid.tolist()) == set(pid.tolist())
+
+
+def test_running_pods_count_into_used():
+    cfg = EngineConfig()
+    b = SnapshotBuilder(cfg)
+    b.add_node("n0", {"cpu": 4000, "memory": 8 << 30})
+    b.add_running_pod("n0", {"cpu": 1500, "memory": 1 << 30})
+    snap, _ = b.build()
+    assert snap.nodes.used[0, cfg.resource_index("cpu")] == 1500.0
+    assert snap.nodes.used[0, cfg.resource_index(RESOURCE_PODS)] == 1.0
+    assert snap.running.node_idx[0] == 0
+
+
+def test_toleration_precompile():
+    b = SnapshotBuilder(EngineConfig())
+    b.add_node("n0", {"cpu": 1}, taints=[("dedicated", "batch", "NoSchedule")])
+    b.add_pod("tolerant", {"cpu": 1},
+              tolerations=[Toleration("dedicated", "Equal", "batch", "NoSchedule")])
+    b.add_pod("wildcard", {"cpu": 1}, tolerations=[Toleration("", "Exists")])
+    b.add_pod("wrong-value", {"cpu": 1},
+              tolerations=[Toleration("dedicated", "Equal", "web", "NoSchedule")])
+    b.add_pod("intolerant", {"cpu": 1})
+    snap, _ = b.build()
+    tid = snap.nodes.taint_ids[0, 0]
+    assert snap.pods.tolerated[0, tid]
+    assert snap.pods.tolerated[1, tid]
+    assert not snap.pods.tolerated[2, tid]
+    assert not snap.pods.tolerated[3, tid]
+
+
+def test_node_selector_becomes_required_term():
+    b = SnapshotBuilder(EngineConfig())
+    b.add_node("n0", {"cpu": 1}, labels={"disk": "ssd"})
+    b.add_pod("p0", {"cpu": 1}, node_selector={"disk": "ssd"})
+    snap, _ = b.build()
+    assert snap.pods.req_term_valid[0, 0]
+    assert (snap.pods.req_term_atoms[0, 0] >= 0).sum() == 1
+
+
+def test_empty_required_term_dropped():
+    # Upstream: an empty nodeSelectorTerm matches no objects.
+    b = SnapshotBuilder(EngineConfig())
+    b.add_node("n0", {"cpu": 1})
+    b.add_pod("p0", {"cpu": 1}, required_terms=[NodeSelectorTerm(())])
+    snap, _ = b.build()
+    assert not snap.pods.req_term_valid[0].any()
+
+
+def test_gang_registration():
+    b = SnapshotBuilder(EngineConfig())
+    b.add_node("n0", {"cpu": 10})
+    for i in range(3):
+        b.add_pod(f"g{i}", {"cpu": 1}, pod_group="job-a", pod_group_min_member=3)
+    snap, meta = b.build()
+    assert meta.group_names == ["job-a"]
+    assert (snap.pods.group[:3] == 0).all()
+    assert snap.group_min_member[0] == 3
+
+
+def test_gtlt_numeric_labels():
+    b = SnapshotBuilder(EngineConfig())
+    b.add_node("n0", {"cpu": 1}, labels={"gen": "7"})
+    b.add_node("n1", {"cpu": 1}, labels={"gen": "notanumber"})
+    b.add_pod("p0", {"cpu": 1}, required_terms=[
+        NodeSelectorTerm((MatchExpression("gen", "Gt", ("5",)),))
+    ])
+    snap, _ = b.build()
+    assert snap.nodes.label_nums[0, 0] == 7.0
+    assert np.isnan(snap.nodes.label_nums[1, 0])

@@ -1,0 +1,50 @@
+"""tpusched — a TPU-native batched cluster-scheduling engine.
+
+Re-implements the capabilities of the UFCG-LSD QoS-driven Kubernetes
+scheduler (reference: /root/reference/README.md:1, project
+"k8s-qos-driven-scheduler") as a batched constraint solver in JAX:
+instead of the per-pod Filter->Score loop of the kube-scheduler framework,
+the full pending-pods x candidate-nodes matrix is materialised on device,
+feasibility predicates become boolean masks, scoring plugins become fused
+vmap'd kernels, and placement commit is either an exactly-sequential
+lax.scan (parity mode) or a round-based batched commit (fast mode).
+
+See SURVEY.md for the layer map and component inventory this implements.
+"""
+
+from tpusched.config import (
+    Buckets,
+    EngineConfig,
+    PluginWeights,
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    RESOURCE_PODS,
+)
+from tpusched.snapshot import (
+    ClusterSnapshot,
+    NodeArrays,
+    PodArrays,
+    RunningPodArrays,
+    SnapshotBuilder,
+    AtomTable,
+)
+from tpusched.engine import Engine, SolveResult
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Buckets",
+    "EngineConfig",
+    "PluginWeights",
+    "RESOURCE_CPU",
+    "RESOURCE_MEMORY",
+    "RESOURCE_PODS",
+    "ClusterSnapshot",
+    "NodeArrays",
+    "PodArrays",
+    "RunningPodArrays",
+    "SnapshotBuilder",
+    "AtomTable",
+    "Engine",
+    "SolveResult",
+]

@@ -1,0 +1,207 @@
+"""Engine configuration: resource axes, static shape buckets, plugin weights.
+
+Mirrors the role of KubeSchedulerConfiguration in the reference ecosystem
+(SURVEY.md §5 "Config / flag system"): which plugins are enabled, their
+weights, QoS parameters, plus the TPU-specific knobs (bucket sizes, mesh
+shape) that have no upstream equivalent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Sequence
+
+# ---------------------------------------------------------------------------
+# Resource axes.
+#
+# The device-side resource dimension R is a fixed, configured list of
+# resource names. The first three are always present and always in this
+# order; extended resources (gpus, custom devices) append after.
+# "pods" is modelled as an ordinary resource with request == 1 for every
+# pod, which turns the node pod-count cap into the same <= comparison as
+# cpu/memory (upstream NodeResourcesFit semantics, SURVEY.md C2).
+# ---------------------------------------------------------------------------
+
+RESOURCE_CPU = "cpu"          # millicores
+RESOURCE_MEMORY = "memory"    # bytes
+RESOURCE_PODS = "pods"        # count; every pod requests exactly 1
+
+DEFAULT_RESOURCES: tuple[str, ...] = (RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_PODS)
+
+# Default per-resource weights for the LeastRequested score, matching the
+# upstream NodeResourcesFit default of cpu:1 memory:1 (the "pods" axis does
+# not participate in scoring upstream, weight 0).
+DEFAULT_SCORE_RESOURCE_WEIGHTS: Mapping[str, float] = {
+    RESOURCE_CPU: 1.0,
+    RESOURCE_MEMORY: 1.0,
+    RESOURCE_PODS: 0.0,
+}
+
+MAX_NODE_SCORE = 100.0  # upstream framework.MaxNodeScore
+
+# Taint effects (int8 codes on device).
+EFFECT_NO_SCHEDULE = 0
+EFFECT_PREFER_NO_SCHEDULE = 1
+EFFECT_NO_EXECUTE = 2
+TAINT_EFFECTS = ("NoSchedule", "PreferNoSchedule", "NoExecute")
+
+# Match-expression operators (int8 codes on device).
+OP_IN = 0
+OP_NOT_IN = 1
+OP_EXISTS = 2
+OP_DOES_NOT_EXIST = 3
+OP_GT = 4
+OP_LT = 5
+OPERATORS = ("In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt")
+
+# whenUnsatisfiable codes for topology spread.
+DO_NOT_SCHEDULE = 0
+SCHEDULE_ANYWAY = 1
+
+
+def _next_pow2(x: int) -> int:
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()
+
+
+@dataclasses.dataclass(frozen=True)
+class Buckets:
+    """Static device-side array sizes.
+
+    XLA compiles one program per distinct shape tuple, so all host-side
+    builders pad every axis up to these bucket sizes (SURVEY.md §7 hard
+    part 5: "bucket to powers of two and mask padding everywhere").
+    Padding rows/cols are masked so they can never win an argmax.
+    """
+
+    pods: int = 128            # P: pending pods
+    nodes: int = 128           # N: candidate nodes
+    running_pods: int = 256    # M: bound pods (preemption victims, affinity)
+    node_labels: int = 16      # LN: label (key,value) pairs per node
+    pod_labels: int = 8        # LP: label pairs per pod
+    node_taints: int = 4       # TN: taints per node
+    atoms: int = 64            # A: distinct match-expression atoms
+    atom_values: int = 8       # VA: values per In/NotIn atom
+    terms: int = 4             # T: nodeSelectorTerms per pod (OR)
+    term_atoms: int = 4        # AT: expressions per term (AND)
+    pref_terms: int = 4        # PT: preferred affinity terms per pod
+    topo_keys: int = 4         # TK: distinct topology keys in play
+    spread_constraints: int = 2  # C: topology-spread constraints per pod
+    affinity_terms: int = 2    # IT: inter-pod (anti)affinity terms per pod
+    pod_groups: int = 64       # G: distinct gangs (pod groups)
+    taint_vocab: int = 16      # VT: distinct taints across the cluster
+
+    @staticmethod
+    def fit(
+        n_pods: int,
+        n_nodes: int,
+        n_running: int = 0,
+        min_pods: int = 8,
+        min_nodes: int = 8,
+        **overrides: int,
+    ) -> "Buckets":
+        """Smallest power-of-two bucket set covering the given counts."""
+        base = Buckets(
+            pods=max(min_pods, _next_pow2(n_pods)),
+            nodes=max(min_nodes, _next_pow2(n_nodes)),
+            running_pods=max(8, _next_pow2(max(1, n_running))),
+        )
+        return dataclasses.replace(base, **overrides) if overrides else base
+
+
+@dataclasses.dataclass(frozen=True)
+class PluginWeights:
+    """Score-plugin weights, the analogue of the `weight` field on each
+    entry of a scheduler-framework plugin profile (SURVEY.md C5).
+
+    A weight of 0 disables the plugin's score contribution; filter
+    plugins are structural and always on (as upstream defaults them).
+    """
+
+    least_requested: float = 1.0        # NodeResourcesFit/LeastAllocated (C3)
+    balanced_allocation: float = 1.0    # NodeResourcesBalancedAllocation (C4)
+    node_affinity: float = 1.0          # preferred node affinity terms
+    taint_toleration: float = 1.0       # PreferNoSchedule taint counting
+    topology_spread: float = 2.0        # upstream default weight is 2
+    interpod_affinity: float = 1.0      # preferred pod (anti)affinity
+
+
+@dataclasses.dataclass(frozen=True)
+class QoSConfig:
+    """Parameters of the QoS-driven dynamic priority (SURVEY.md C10).
+
+    priority(pod, t) = base_priority + qos_gain * pressure where
+    pressure = clip(slo_target - observed_availability, 0, 1): how far the
+    pod is *below* its availability SLO. Pods further below their SLO pop
+    first and may preempt pods with positive slack (above their SLO).
+    """
+
+    qos_gain: float = 1000.0
+    # Pressure also interpolates per-pod plugin weights between the
+    # configured ("balanced") profile and a pure least-requested
+    # ("place me fast") profile: effective_w = (1-p)*w + p*w_urgent.
+    urgency_reweight: bool = True
+    # A preemptor must exceed a victim's slack by this margin.
+    preemption_margin: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    resources: tuple[str, ...] = DEFAULT_RESOURCES
+    score_resource_weights: Mapping[str, float] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_SCORE_RESOURCE_WEIGHTS)
+    )
+    weights: PluginWeights = dataclasses.field(default_factory=PluginWeights)
+    qos: QoSConfig = dataclasses.field(default_factory=QoSConfig)
+    # "parity" = exactly-sequential lax.scan commit (stock semantics);
+    # "fast" = round-based batched commit (same placements for
+    # non-contended snapshots, bounded rounds otherwise). SURVEY.md C11.
+    mode: str = "parity"
+    max_rounds: int = 16
+    # Deterministic tie-break: lowest node index among score maxima.
+    # (Upstream uses seeded roulette; both our paths and the oracle share
+    # this rule so parity is well-defined. SURVEY.md §7 hard part 2.)
+    tie_break: str = "first"
+    # Mesh shape for multi-device runs: (pods-axis, nodes-axis). (1,1)
+    # means single device.
+    mesh_shape: tuple[int, int] = (1, 1)
+
+    def resource_index(self, name: str) -> int:
+        return self.resources.index(name)
+
+    def score_weights_vector(self) -> list[float]:
+        return [float(self.score_resource_weights.get(r, 0.0)) for r in self.resources]
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "EngineConfig":
+        """Build from a YAML/JSON-decoded mapping (KubeSchedulerConfiguration
+        profile analogue); unknown keys rejected to catch typos."""
+        kw = {}
+        if "resources" in d:
+            kw["resources"] = tuple(d["resources"])
+        if "score_resource_weights" in d:
+            kw["score_resource_weights"] = dict(d["score_resource_weights"])
+        if "weights" in d:
+            kw["weights"] = PluginWeights(**d["weights"])
+        if "qos" in d:
+            kw["qos"] = QoSConfig(**d["qos"])
+        for k in ("mode", "max_rounds", "tie_break"):
+            if k in d:
+                kw[k] = d[k]
+        if "mesh_shape" in d:
+            kw["mesh_shape"] = tuple(d["mesh_shape"])
+        extra = set(d) - {
+            "resources", "score_resource_weights", "weights", "qos",
+            "mode", "max_rounds", "tie_break", "mesh_shape",
+        }
+        if extra:
+            raise ValueError(f"unknown EngineConfig keys: {sorted(extra)}")
+        return EngineConfig(**kw)
+
+
+def load_config(path: str) -> EngineConfig:
+    import yaml
+
+    with open(path) as f:
+        return EngineConfig.from_dict(yaml.safe_load(f) or {})

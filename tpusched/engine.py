@@ -1,0 +1,135 @@
+"""Engine: the solver driver (SURVEY.md §1.3 "Solver driver" layer).
+
+Owns the jitted solve paths and the host<->device boundary: snapshots
+come in as numpy pytrees (from SnapshotBuilder or the gRPC decoder),
+results come back as numpy. jax.jit's shape-keyed cache handles bucket
+changes; EngineConfig is closed over as compile-time constants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusched.config import EngineConfig
+from tpusched.kernels.assign import score_batch, solve_sequential
+from tpusched.kernels.atoms import atom_sat
+from tpusched.kernels.pairwise import member_label_sat_t
+from tpusched.snapshot import ClusterSnapshot
+
+
+@dataclasses.dataclass
+class SolveResult:
+    assignment: np.ndarray     # [P] int32 node index or -1
+    chosen_score: np.ndarray   # [P] f32 (-inf where unschedulable)
+    final_used: np.ndarray     # [N, R] f32
+    order: np.ndarray          # [P] int32 pop order
+    solve_seconds: float = 0.0
+
+
+@dataclasses.dataclass
+class ScoreBatchResult:
+    feasible: np.ndarray       # [P, N] bool
+    scores: np.ndarray         # [P, N] f32
+    solve_seconds: float = 0.0
+
+
+def _sat_tables(snap: ClusterSnapshot):
+    node_sat_t = atom_sat(
+        snap.atoms, snap.nodes.label_pairs, snap.nodes.label_keys,
+        snap.nodes.label_nums,
+    ).T
+    member_sat_t = member_label_sat_t(
+        snap, lambda lp, lk: atom_sat(snap.atoms, lp, lk, None)
+    )
+    return node_sat_t, member_sat_t
+
+
+class Engine:
+    def __init__(self, config: EngineConfig | None = None):
+        self.config = config or EngineConfig()
+        cfg = self.config
+        if cfg.mode != "parity":
+            raise NotImplementedError(
+                f"mode={cfg.mode!r}: only 'parity' (exact sequential) is "
+                "implemented; 'fast' (round-based batched commit) lands "
+                "with SURVEY.md §7 phase 3"
+            )
+        if cfg.tie_break != "first":
+            raise NotImplementedError(
+                f"tie_break={cfg.tie_break!r}: only 'first' is implemented"
+            )
+
+        def _solve(snap: ClusterSnapshot):
+            node_sat_t, member_sat_t = _sat_tables(snap)
+            return solve_sequential(cfg, snap, node_sat_t, member_sat_t)
+
+        def _score(snap: ClusterSnapshot):
+            node_sat_t, member_sat_t = _sat_tables(snap)
+            return score_batch(cfg, snap, node_sat_t, member_sat_t)
+
+        def _score_top1(snap: ClusterSnapshot):
+            feasible, scores = _score(snap)
+            masked = jnp.where(feasible, scores, -jnp.inf)
+            best = jnp.argmax(masked, axis=1).astype(jnp.int32)
+            any_feasible = jnp.any(feasible, axis=1)
+            best = jnp.where(any_feasible, best, -1)
+            return best, jnp.max(masked, axis=1), any_feasible
+
+        self._solve_jit = jax.jit(_solve)
+        self._score_jit = jax.jit(_score)
+        self._score_top1_jit = jax.jit(_score_top1)
+
+    # -- public API ---------------------------------------------------------
+
+    def solve(self, snap: ClusterSnapshot) -> SolveResult:
+        """Full batched scheduling: assign every pending pod (or -1).
+
+        Timing includes the device->host readback: on some backends
+        (axon tunnel) block_until_ready does not actually block, and the
+        host shim needs the assignments anyway — the D2H copy is part of
+        the schedule cycle."""
+        t0 = time.perf_counter()
+        assigned, chosen, used, order = self._solve_jit(snap)
+        out = SolveResult(
+            assignment=np.asarray(assigned),
+            chosen_score=np.asarray(chosen),
+            final_used=np.asarray(used),
+            order=np.asarray(order),
+        )
+        out.solve_seconds = time.perf_counter() - t0
+        return out
+
+    def score(self, snap: ClusterSnapshot) -> ScoreBatchResult:
+        """ScoreBatch: [P, N] feasibility + normalized weighted scores,
+        no commits (the Score-plugin backend of the north star)."""
+        t0 = time.perf_counter()
+        feasible, scores = self._score_jit(snap)
+        out = ScoreBatchResult(
+            feasible=np.asarray(feasible), scores=np.asarray(scores)
+        )
+        out.solve_seconds = time.perf_counter() - t0
+        return out
+
+    def score_top1(self, snap: ClusterSnapshot):
+        """Full [P, N] scoring on device, returning only each pod's best
+        node, its score, and feasibility — the decision-ready contract
+        the host shim binds on (full matrix stays on device)."""
+        t0 = time.perf_counter()
+        best, best_score, any_feasible = self._score_top1_jit(snap)
+        out = (
+            np.asarray(best), np.asarray(best_score), np.asarray(any_feasible)
+        )
+        return out + (time.perf_counter() - t0,)
+
+    def warmup(self, snap: ClusterSnapshot) -> None:
+        """Trigger compilation for this snapshot's bucket shapes."""
+        self._solve_jit(snap)
+
+    def put(self, snap: ClusterSnapshot) -> ClusterSnapshot:
+        """Explicit host->device transfer (otherwise implicit on call)."""
+        return jax.device_put(snap)

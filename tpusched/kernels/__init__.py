@@ -1,0 +1,10 @@
+"""Device-side kernels: the batched Filter/Score/Commit compute path.
+
+Each module batches one group of scheduler-framework plugins
+(SURVEY.md §1.3 "Kernels" layer):
+  atoms    — match-expression satisfaction tables (shared by everything)
+  filter   — feasibility predicates -> boolean masks (C2)
+  score    — scoring plugins -> [P, N] float matrices (C3-C5)
+  pairwise — topology spread + inter-pod affinity (C6, C7)
+  assign   — commit loops: sequential parity scan + batched rounds (C11)
+"""

@@ -1,0 +1,166 @@
+"""Commit loops (SURVEY.md C11) and the one-shot score matrix.
+
+`pod_cycle` is one scheduling cycle (Filter + Score + Normalize for one
+pod against all nodes) — the device analogue of the reference's
+`scheduleOne` body (SURVEY.md §3.1). The cycle splits into:
+
+  * a STATIC part (taints, node affinity, their scores, per-pod QoS
+    plugin weights) that depends only on the snapshot — computed once
+    for all pods as [P, N] matrices before any commit loop runs; and
+  * a DYNAMIC part (resource fit, LeastRequested, BalancedAllocation,
+    pairwise spread/affinity) that depends on node `used` and on where
+    earlier pods landed — recomputed per step/round.
+
+Two drivers wrap it:
+  * solve_sequential — EXACT stock semantics: a lax.scan over pods in
+    dynamic-priority order, each step updating node `used` before the
+    next pod scores (parity mode; SURVEY.md §7 hard part 1).
+  * score_batch — the ScoreBatch API of the north star: all pods scored
+    at once against the current snapshot (no commits), vmapped over the
+    pod axis — what a Go scheduler calls through the gRPC boundary for
+    NormalizeScore + Bind.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from tpusched.config import EngineConfig
+from tpusched.kernels import filter as kfilter
+from tpusched.kernels import pairwise as kpair
+from tpusched.kernels import score as kscore
+from tpusched.qos import effective_priority, effective_weights, pressure_of
+from tpusched.snapshot import ClusterSnapshot
+
+NEG_INF = -jnp.inf
+
+
+@struct.dataclass
+class StaticCtx:
+    """Snapshot-dependent but state-independent precomputation."""
+
+    mask: Any       # [P, N] bool: taints & node affinity & validity
+    aff_ok: Any     # [P, N] bool: node-affinity component alone (pairwise
+                    # kernels need it for spread domain eligibility)
+    score: Any      # [P, N] f32: w_na*NodeAffinity + w_tt*TaintToleration
+    w_lr: Any       # [P] f32 per-pod effective plugin weights (QoS)
+    w_ba: Any       # [P]
+    w_ts: Any       # [P]
+    w_ia: Any       # [P]
+    rw: Any         # [R] resource score weights
+
+
+def precompute_static(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t) -> StaticCtx:
+    nodes, pods = snap.nodes, snap.pods
+    aff_ok = kfilter.node_affinity_mask(
+        node_sat_t, pods.req_term_atoms, pods.req_term_valid
+    )
+    mask = (
+        aff_ok
+        & kfilter.taint_mask(nodes.taint_ids, snap.taint_effect, pods.tolerated)
+        & nodes.valid[None, :]
+        & pods.valid[:, None]
+    )
+    w = effective_weights(
+        cfg, pressure_of(pods.slo_target, pods.observed_avail)
+    )  # dict of [P] arrays
+    na = kscore.node_affinity_score(
+        node_sat_t, pods.pref_term_atoms, pods.pref_term_valid,
+        pods.pref_weight, nodes.valid,
+    )
+    tt = kscore.taint_toleration_score(
+        nodes.taint_ids, snap.taint_effect, pods.tolerated, nodes.valid
+    )
+    static_score = (
+        w["node_affinity"][:, None] * na + w["taint_toleration"][:, None] * tt
+    ).astype(jnp.float32)
+    return StaticCtx(
+        mask=mask, aff_ok=aff_ok, score=static_score,
+        w_lr=w["least_requested"], w_ba=w["balanced_allocation"],
+        w_ts=w["topology_spread"], w_ia=w["interpod_affinity"],
+        rw=jnp.asarray(cfg.score_weights_vector(), jnp.float32),
+    )
+
+
+def pod_cycle(cfg: EngineConfig, snap: ClusterSnapshot, member_sat_t,
+              static: StaticCtx, p, used, assigned):
+    """Dynamic Filter + Score for pod p (traced index): returns
+    (feasible [N] bool, total weighted score [N] f32). Grouping of the
+    score sum mirrors oracle.feasible_and_score exactly."""
+    nodes = snap.nodes
+    nvalid = nodes.valid
+    req = snap.pods.requests[p]
+
+    spread_ok, spread_pen, ia_ok, ia_raw = kpair.pod_pairwise(
+        snap, member_sat_t, p, assigned, static.aff_ok[p]
+    )
+    feasible = (
+        static.mask[p]
+        & kfilter.resource_fit(nodes.allocatable, used, req)
+        & spread_ok
+        & ia_ok
+    )
+    score = (
+        static.w_lr[p] * kscore.least_requested(nodes.allocatable, used, req, static.rw)
+        + static.w_ba[p] * kscore.balanced_allocation(nodes.allocatable, used, req, static.rw)
+        + static.score[p]
+        + static.w_ts[p] * kscore.inverse_normalize(spread_pen, nvalid)
+        + static.w_ia[p] * kscore.minmax_normalize(ia_raw, nvalid)
+    ).astype(jnp.float32)
+    return feasible, score
+
+
+def pop_order(cfg: EngineConfig, snap: ClusterSnapshot):
+    """Queue order (SURVEY.md C10): stable descending sort by dynamic
+    QoS priority; invalid pods sink to the end."""
+    pods = snap.pods
+    prio = effective_priority(
+        cfg, pods.base_priority, pods.slo_target, pods.observed_avail
+    )
+    key = jnp.where(pods.valid, prio, NEG_INF)
+    return jnp.argsort(-key, stable=True)
+
+
+def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
+                     node_sat_t, member_sat_t):
+    """Exact sequential commit: stock scheduleOne semantics on device."""
+    static = precompute_static(cfg, snap, node_sat_t)
+    P = snap.pods.valid.shape[0]
+    order = pop_order(cfg, snap)
+
+    def body(carry, p):
+        used, assigned = carry
+        feasible, score = pod_cycle(
+            cfg, snap, member_sat_t, static, p, used, assigned
+        )
+        masked = jnp.where(feasible, score, NEG_INF)
+        n = jnp.argmax(masked)  # tie-break: first max (EngineConfig.tie_break)
+        commit = jnp.any(feasible)
+        used = used.at[n].add(jnp.where(commit, snap.pods.requests[p], 0.0))
+        assigned = assigned.at[p].set(jnp.where(commit, n, -1).astype(jnp.int32))
+        return (used, assigned), jnp.where(commit, masked[n], NEG_INF)
+
+    init = (snap.nodes.used, jnp.full(P, -1, jnp.int32))
+    (used, assigned), chosen_in_order = jax.lax.scan(body, init, order)
+    chosen = jnp.full(P, NEG_INF, jnp.float32).at[order].set(chosen_in_order)
+    return assigned, chosen, used, order
+
+
+def score_batch(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
+                member_sat_t):
+    """One-shot [P, N] feasibility + scores against the current snapshot
+    (no commits): the ScoreBatch gRPC surface (SURVEY.md C12)."""
+    static = precompute_static(cfg, snap, node_sat_t)
+    P = snap.pods.valid.shape[0]
+    no_assigned = jnp.full(P, -1, jnp.int32)
+
+    def one(p):
+        return pod_cycle(
+            cfg, snap, member_sat_t, static, p, snap.nodes.used, no_assigned
+        )
+
+    return jax.vmap(one)(jnp.arange(P))

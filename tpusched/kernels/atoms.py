@@ -1,0 +1,81 @@
+"""Match-expression atom satisfaction (device side of SURVEY.md C2's
+label machinery).
+
+`SnapshotBuilder` interns every distinct matchExpression into an atom;
+this kernel evaluates all atoms against all label sets at once:
+
+    sat[x, a] = does label-set x satisfy atom a
+
+computed as pure broadcast-compare-reduce, which XLA fuses into a single
+pass — no per-atom Python, no dynamic shapes. The same kernel serves
+node labels (node affinity) and pod labels (spread / inter-pod selectors).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpusched.config import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+)
+from tpusched.snapshot import AtomTable
+
+
+def atom_sat(atoms: AtomTable, label_pairs, label_keys, label_nums=None):
+    """Returns [X, A] bool for label arrays of shape [X, L].
+
+    label_nums may be None for label sets that never face Gt/Lt atoms
+    (pod labels) — saves the numeric branch entirely.
+    """
+    lp = label_pairs[:, :, None]                     # [X, L, 1]
+    lk = label_keys[:, :, None]                      # [X, L, 1]
+    # In/NotIn: does any node pair id appear in the atom's value set?
+    pair_hit = (lp[:, :, :, None] == atoms.pairs[None, None, :, :])  # [X,L,A,V]
+    pair_hit &= (atoms.pairs >= 0)[None, None, :, :]
+    any_pair = jnp.any(pair_hit, axis=(1, 3))        # [X, A]
+    exists = jnp.any((lk == atoms.key[None, None, :]) & (lk >= 0), axis=1)  # [X, A]
+
+    if label_nums is not None:
+        matched = (lk == atoms.key[None, None, :]) & jnp.isfinite(label_nums)[:, :, None]
+        has_num = jnp.any(matched, axis=1)           # [X, A]
+        val = jnp.sum(jnp.where(matched, label_nums[:, :, None], 0.0), axis=1)
+        gt = has_num & (val > atoms.num[None, :])
+        lt = has_num & (val < atoms.num[None, :])
+    else:
+        gt = jnp.zeros(exists.shape, bool)
+        lt = jnp.zeros(exists.shape, bool)
+
+    op = atoms.op[None, :]
+    sat = jnp.select(
+        [op == OP_IN, op == OP_NOT_IN, op == OP_EXISTS,
+         op == OP_DOES_NOT_EXIST, op == OP_GT, op == OP_LT],
+        [any_pair, ~any_pair, exists, ~exists, gt, lt],
+        default=False,
+    )
+    return sat & atoms.valid[None, :]
+
+
+def gather_term_sat(sat_t, term_atoms):
+    """AND-combine atom satisfaction over a term's atom list.
+
+    sat_t: [A, X] (transposed atom table, X = nodes or pods)
+    term_atoms: [..., AT] int32 atom ids, -1 padded.
+    Returns [..., X] bool: every listed atom satisfied. Padded slots are
+    the AND identity (True); a term with zero atoms yields all-True and
+    must be masked by the caller's term-valid flag (empty terms match no
+    objects upstream — snapshot.py drops them at build)."""
+    gathered = sat_t[jnp.clip(term_atoms, 0, None)]          # [..., AT, X]
+    gathered = gathered | (term_atoms < 0)[..., None]
+    return jnp.all(gathered, axis=-2)
+
+
+def gather_selector_match(sat_t, sel_atoms, subject_valid):
+    """AND-combine selector atoms over pod label sets; a selector with
+    zero atoms matches ALL valid subjects (upstream empty label
+    selector). sel_atoms: [..., AT]; returns [..., X] bool."""
+    return gather_term_sat(sat_t, sel_atoms) & subject_valid

@@ -1,0 +1,70 @@
+"""Feasibility predicates as boolean masks (SURVEY.md C2).
+
+The reference's Filter extension point runs per (pod, node) in Go
+(SURVEY.md §3.1); here each predicate is one broadcasted array op over
+the full [P, N] matrix. All functions take pre-broadcast snapshot arrays
+and return [P, N] bool (or [N] bool for the single-pod variants used by
+the sequential parity scan).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpusched.config import (
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+)
+from tpusched.kernels.atoms import gather_term_sat
+from tpusched.snapshot import ClusterSnapshot
+
+
+def resource_fit(alloc, used, requests):
+    """NodeResourcesFit: forall r: used + req <= alloc.
+    alloc/used: [N, R]; requests: [P, R] -> [P, N] (or [R] -> [N])."""
+    if requests.ndim == 1:
+        return jnp.all(used + requests[None, :] <= alloc, axis=-1)
+    return jnp.all(
+        used[None, :, :] + requests[:, None, :] <= alloc[None, :, :], axis=-1
+    )
+
+
+def taint_mask(node_taint_ids, taint_effect, tolerated):
+    """TaintToleration filter: every NoSchedule/NoExecute taint tolerated.
+    node_taint_ids: [N, TN] (-1 pad); taint_effect: [VT];
+    tolerated: [P, VT] -> [P, N]  (or [VT] -> [N])."""
+    tid = jnp.clip(node_taint_ids, 0, None)
+    eff = taint_effect[tid]                              # [N, TN]
+    hard = (node_taint_ids >= 0) & (
+        (eff == EFFECT_NO_SCHEDULE) | (eff == EFFECT_NO_EXECUTE)
+    )
+    if tolerated.ndim == 1:
+        tol = tolerated[tid]                             # [N, TN]
+        return jnp.all(~hard | tol, axis=-1)
+    tol = tolerated[:, tid]                              # [P, N, TN]
+    return jnp.all(~hard[None] | tol, axis=-1)
+
+
+def node_affinity_mask(node_sat_t, req_term_atoms, req_term_valid):
+    """Required node affinity + nodeSelector: OR over terms, AND within.
+    node_sat_t: [A, N]; req_term_atoms: [P, T, AT] or [T, AT];
+    returns [P, N] or [N]. A pod with zero valid terms matches all."""
+    term_ok = gather_term_sat(node_sat_t, req_term_atoms)     # [..., T, N]
+    term_ok &= req_term_valid[..., None]
+    has_req = jnp.any(req_term_valid, axis=-1)                # [...]
+    any_term = jnp.any(term_ok, axis=-2)                      # [..., N]
+    return jnp.where(has_req[..., None], any_term, True)
+
+
+def full_static_mask(snap: ClusterSnapshot, node_sat_t):
+    """All non-pairwise, state-independent predicates for all pods:
+    taints & node affinity & node validity -> [P, N]. Resource fit is
+    state-dependent (used changes as pods commit) and pairwise terms are
+    handled in kernels/pairwise.py."""
+    m = taint_mask(snap.nodes.taint_ids, snap.taint_effect, snap.pods.tolerated)
+    m &= node_affinity_mask(
+        node_sat_t, snap.pods.req_term_atoms, snap.pods.req_term_valid
+    )
+    m &= snap.nodes.valid[None, :]
+    m &= snap.pods.valid[:, None]
+    return m

@@ -1,0 +1,113 @@
+"""PodTopologySpread + InterPodAffinity kernels (SURVEY.md C6, C7).
+
+These are the pairwise constraints: where pod p may land depends on where
+*other* pods (running + already-committed pending) sit. Members are the
+concatenation [running | pending], with pending membership switched on as
+pods commit — so the same kernel serves both the sequential parity scan
+(assigned grows step by step) and one-shot ScoreBatch (assigned = none).
+
+Domain counting uses scatter-adds into an [N]-sized domain-count buffer
+(domain ids are interned per topology key by SnapshotBuilder and are
+always < number of nodes), which keeps every shape static.
+
+`pod_pairwise` evaluates ONE pod p (traced index) against all nodes; the
+batched/ring variant for large P lands in phase 4 (SURVEY.md §2.3 SP/CP
+row: block the [P, P] matrix and rotate pod blocks with lax.ppermute).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpusched.config import DO_NOT_SCHEDULE
+from tpusched.kernels.atoms import gather_selector_match
+from tpusched.snapshot import ClusterSnapshot
+
+
+def member_arrays(snap: ClusterSnapshot, assigned):
+    """Member (running + pending) node index and validity.
+    assigned: [P] int32 node or -1. Returns ([M+P] int32, [M+P] bool)."""
+    node = jnp.concatenate([snap.running.node_idx, assigned])
+    valid = jnp.concatenate([snap.running.valid, assigned >= 0])
+    return node, valid
+
+
+def member_label_sat_t(snap: ClusterSnapshot, sat_fn):
+    """[A, M+P] atom satisfaction over member pod labels; static across a
+    solve (labels never change), so computed once and closed over."""
+    lp = jnp.concatenate([snap.running.label_pairs, snap.pods.label_pairs])
+    lk = jnp.concatenate([snap.running.label_keys, snap.pods.label_keys])
+    return sat_fn(lp, lk).T
+
+
+def _domain_counts(member_dom_ok, match, n_buckets):
+    """Scatter-count matching members into their domains: [N] f32."""
+    dom = jnp.clip(member_dom_ok, 0, None)
+    contrib = (match & (member_dom_ok >= 0)).astype(jnp.float32)
+    return jnp.zeros(n_buckets, jnp.float32).at[dom].add(contrib)
+
+
+def pod_pairwise(
+    snap: ClusterSnapshot,
+    member_sat_t,          # [A, M+P]
+    p,                     # traced pod index
+    assigned,              # [P] int32
+    node_affinity_ok_p,    # [N] bool — pod p's required-affinity mask
+):
+    """Returns (spread_ok [N], spread_penalty [N], ia_ok [N], ia_raw [N])
+    for pod p given currently-committed members."""
+    nodes, pods = snap.nodes, snap.pods
+    dom = nodes.domain                                   # [N, TK]
+    N = dom.shape[0]
+    member_node, member_valid = member_arrays(snap, assigned)
+    # Member's domain per topology key: [M+P, TK] (-1 when member or its
+    # node lacks the key).
+    mdom = jnp.where(
+        (member_node >= 0)[:, None],
+        dom[jnp.clip(member_node, 0, None)],
+        -1,
+    )
+
+    spread_ok = jnp.ones(N, bool)
+    spread_penalty = jnp.zeros(N, jnp.float32)
+    C = pods.ts_key.shape[1]
+    for c in range(C):  # static unroll; C is a small bucket
+        valid_c = pods.ts_valid[p, c]
+        key = jnp.clip(pods.ts_key[p, c], 0, None)
+        match = gather_selector_match(
+            member_sat_t, pods.ts_sel_atoms[p, c], member_valid
+        )
+        counts = _domain_counts(mdom[:, key], match, N)
+        has_key = dom[:, key] >= 0
+        node_count = counts[jnp.clip(dom[:, key], 0, None)]
+        eligible = nodes.valid & node_affinity_ok_p & has_key
+        min_count = jnp.min(jnp.where(eligible, node_count, jnp.inf))
+        min_count = jnp.where(jnp.any(eligible), min_count, 0.0)
+        max_count = jnp.max(jnp.where(has_key, node_count, 0.0))
+        dns = pods.ts_when[p, c] == DO_NOT_SCHEDULE
+        ok_c = has_key & (node_count + 1.0 - min_count <= pods.ts_max_skew[p, c])
+        spread_ok &= jnp.where(valid_c & dns, ok_c, True)
+        pen_c = jnp.where(has_key, node_count, max_count)
+        spread_penalty += jnp.where(valid_c & ~dns, pen_c, 0.0)
+
+    ia_ok = jnp.ones(N, bool)
+    ia_raw = jnp.zeros(N, jnp.float32)
+    IT = pods.ia_key.shape[1]
+    for t in range(IT):
+        valid_t = pods.ia_valid[p, t]
+        key = jnp.clip(pods.ia_key[p, t], 0, None)
+        match = gather_selector_match(
+            member_sat_t, pods.ia_sel_atoms[p, t], member_valid
+        )
+        counts = _domain_counts(mdom[:, key], match, N)
+        has_key = dom[:, key] >= 0
+        node_has = has_key & (counts[jnp.clip(dom[:, key], 0, None)] > 0)
+        anti = pods.ia_anti[p, t]
+        req = pods.ia_required[p, t]
+        ok_t = jnp.where(anti, ~node_has, node_has)
+        ia_ok &= jnp.where(valid_t & req, ok_t, True)
+        w = jnp.where(anti, -pods.ia_weight[p, t], pods.ia_weight[p, t])
+        ia_raw += jnp.where(
+            valid_t & ~req & node_has, w, 0.0
+        )
+    return spread_ok, spread_penalty, ia_ok, ia_raw

@@ -1,0 +1,104 @@
+"""Scoring plugins as fused [P, N] kernels (SURVEY.md C3-C5).
+
+Each function mirrors one upstream Score plugin; formulas are written
+with the exact op order of oracle.py so parity holds bitwise in f32.
+Normalization helpers implement the NormalizeScore extension point
+(per-pod rescale across nodes) with padded nodes masked out.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpusched.config import EFFECT_PREFER_NO_SCHEDULE, MAX_NODE_SCORE
+from tpusched.kernels.atoms import gather_term_sat
+
+
+def least_requested(alloc, used, requests, resource_weights):
+    """NodeResourcesFit/LeastAllocated (C3):
+    sum_r w_r * (alloc - used - req) * 100 / alloc / sum_r w_r.
+    alloc/used: [N, R]; requests: [P, R] or [R]; resource_weights: [R]."""
+    if requests.ndim == 1:
+        free = alloc - used - requests[None, :]
+    else:
+        free = alloc[None] - used[None] - requests[:, None, :]
+    per_r = jnp.where(alloc > 0, free * MAX_NODE_SCORE / alloc, 0.0)
+    per_r = jnp.where(per_r < 0, 0.0, per_r)
+    wsum = jnp.maximum(resource_weights.sum(), 1e-9)
+    return jnp.sum(per_r * resource_weights, axis=-1) / wsum
+
+
+def balanced_allocation(alloc, used, requests, resource_weights):
+    """NodeResourcesBalancedAllocation (C4): (1 - stddev(fractions)) * 100
+    over resources with positive score weight."""
+    if requests.ndim == 1:
+        tot = used + requests[None, :]
+    else:
+        tot = used[None] + requests[:, None, :]
+    frac = jnp.where(alloc > 0, tot / alloc, 1.0)
+    frac = jnp.clip(frac, 0.0, 1.0)
+    sel = (resource_weights > 0).astype(frac.dtype)
+    k = jnp.maximum(sel.sum(), 1.0)
+    mean = jnp.sum(frac * sel, axis=-1, keepdims=True) / k
+    var = jnp.sum(((frac - mean) ** 2) * sel, axis=-1) / k
+    return (1.0 - jnp.sqrt(var)) * MAX_NODE_SCORE
+
+
+def node_affinity_score(node_sat_t, pref_term_atoms, pref_term_valid,
+                        pref_weight, node_valid):
+    """Preferred node affinity: sum of satisfied term weights, then
+    DefaultNormalizeScore (max -> 100) per pod."""
+    term_ok = gather_term_sat(node_sat_t, pref_term_atoms)    # [..., PT, N]
+    term_ok &= pref_term_valid[..., None]
+    raw = jnp.sum(pref_weight[..., None] * term_ok, axis=-2)  # [..., N]
+    return default_normalize(raw, node_valid)
+
+
+def taint_toleration_score(node_taint_ids, taint_effect, tolerated, node_valid):
+    """Count intolerable PreferNoSchedule taints, inverse-normalized."""
+    tid = jnp.clip(node_taint_ids, 0, None)
+    soft = (node_taint_ids >= 0) & (taint_effect[tid] == EFFECT_PREFER_NO_SCHEDULE)
+    if tolerated.ndim == 1:
+        intol = soft & ~tolerated[tid]
+    else:
+        intol = soft[None] & ~tolerated[:, tid]
+    count = jnp.sum(intol, axis=-1).astype(jnp.float32)       # [..., N]
+    mx = jnp.max(jnp.where(node_valid, count, 0.0), axis=-1, keepdims=True)
+    return jnp.where(
+        mx > 0, (mx - count) * MAX_NODE_SCORE / jnp.maximum(mx, 1e-9), MAX_NODE_SCORE
+    )
+
+
+# -- NormalizeScore helpers (C5) --------------------------------------------
+
+
+def default_normalize(raw, node_valid):
+    """Upstream DefaultNormalizeScore: scale so the max becomes 100;
+    all-zero (or no valid nodes) -> 0."""
+    mx = jnp.max(jnp.where(node_valid, raw, 0.0), axis=-1, keepdims=True)
+    return jnp.where(mx > 0, raw * MAX_NODE_SCORE / jnp.maximum(mx, 1e-9), 0.0)
+
+
+def inverse_normalize(penalty, node_valid):
+    """Lower penalty -> higher score; all-equal -> 100 (spread score)."""
+    big = jnp.where(node_valid, penalty, -jnp.inf)
+    sml = jnp.where(node_valid, penalty, jnp.inf)
+    mx = jnp.max(big, axis=-1, keepdims=True)
+    mn = jnp.min(sml, axis=-1, keepdims=True)
+    return jnp.where(
+        mx > mn,
+        (mx - penalty) * MAX_NODE_SCORE / jnp.maximum(mx - mn, 1e-9),
+        MAX_NODE_SCORE,
+    )
+
+
+def minmax_normalize(raw, node_valid):
+    """Upstream InterPodAffinity normalize: (raw-min)/(max-min)*100,
+    max==min -> 0."""
+    big = jnp.where(node_valid, raw, -jnp.inf)
+    sml = jnp.where(node_valid, raw, jnp.inf)
+    mx = jnp.max(big, axis=-1, keepdims=True)
+    mn = jnp.min(sml, axis=-1, keepdims=True)
+    return jnp.where(
+        mx > mn, (raw - mn) * MAX_NODE_SCORE / jnp.maximum(mx - mn, 1e-9), 0.0
+    )

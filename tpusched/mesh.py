@@ -1,0 +1,81 @@
+"""Device mesh + sharding layout (SURVEY.md C14).
+
+The 2D mesh maps the problem's two big axes onto hardware
+(SURVEY.md §2.3): pending pods shard over the 'p' axis (the DP
+analogue), candidate nodes over the 'n' axis (the TP analogue). The
+[P, N] feasibility/score matrices shard PS('p','n'); per-pod reductions
+over nodes (argmax, NormalizeScore max) become cross-'n' XLA collectives
+inserted by the SPMD partitioner; nothing is hand-scheduled.
+
+Multi-host: jax.distributed.initialize() before make_mesh() and the same
+code spans slices — ICI within a slice, DCN across (SURVEY.md §5
+"Distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+from tpusched.snapshot import (
+    AtomTable,
+    ClusterSnapshot,
+    NodeArrays,
+    PodArrays,
+    RunningPodArrays,
+)
+
+POD_AXIS = "p"
+NODE_AXIS = "n"
+
+
+def make_mesh(shape: tuple[int, int] | None = None, devices=None) -> Mesh:
+    """Mesh of shape (p, n). Default: all devices on the 'p' axis (pod
+    sharding scales first; node-axis sharding pays collective cost on
+    every per-pod reduction)."""
+    devices = devices if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devices), 1)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, (POD_AXIS, NODE_AXIS))
+
+
+def _spec_for(path: str, mesh: Mesh) -> NamedSharding:
+    p = PS(POD_AXIS)
+    n = PS(NODE_AXIS)
+    rep = PS()
+    table = {"pods": p, "nodes": n}
+    return NamedSharding(mesh, table.get(path, rep))
+
+
+def snapshot_shardings(mesh: Mesh, snap: ClusterSnapshot) -> ClusterSnapshot:
+    """Pytree of NamedShardings matching the snapshot's structure:
+    pod-major arrays shard on 'p', node-major on 'n', vocab tables
+    (atoms, taint effects, groups, running pods) replicate."""
+
+    def build(sub, path):
+        return jax.tree.map(lambda _: _spec_for(path, mesh), sub)
+
+    return ClusterSnapshot(
+        nodes=build(snap.nodes, "nodes"),
+        pods=build(snap.pods, "pods"),
+        running=build(snap.running, "rep"),
+        atoms=build(snap.atoms, "rep"),
+        taint_effect=_spec_for("rep", mesh),
+        group_min_member=_spec_for("rep", mesh),
+    )
+
+
+def shard_snapshot(mesh: Mesh, snap: ClusterSnapshot) -> ClusterSnapshot:
+    """device_put the snapshot with the standard layout."""
+    return jax.device_put(snap, snapshot_shardings(mesh, snap))
+
+
+def matrix_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [P, N] result matrices."""
+    return NamedSharding(mesh, PS(POD_AXIS, NODE_AXIS))
+
+
+def pod_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PS(POD_AXIS))

@@ -1,0 +1,466 @@
+"""Stock-semantics oracle: a deliberately naive per-pod NumPy scheduler.
+
+This is the parity reference demanded by SURVEY.md §4 item 2: it replays
+the reference scheduler's one-pod-at-a-time cycle (SURVEY.md §3.1
+`scheduleOne`) — pop highest dynamic-priority pod, Filter every node,
+Score, NormalizeScore, weighted sum, pick the max, commit to the cache —
+in plain NumPy with zero batching tricks. The batched TPU engine must
+produce identical placements (parity mode: bit-identical; fast mode:
+identical on non-contended snapshots).
+
+Semantics notes (each mirrors an upstream plugin, SURVEY.md C2-C7):
+  * NodeResourcesFit filter: forall r: used_r + req_r <= allocatable_r.
+  * TaintToleration filter: every NoSchedule/NoExecute taint tolerated.
+  * NodeAffinity filter: OR over nodeSelectorTerms, AND within a term;
+    nodeSelector is ANDed into every term. Operators In/NotIn/Exists/
+    DoesNotExist/Gt/Lt with apimachinery labels.Requirement semantics
+    (NotIn/DoesNotExist match when the key is absent).
+  * LeastRequested score: sum_r w_r * (alloc - used - req)*100/alloc / sum w.
+  * BalancedAllocation score: (1 - stddev of utilisation fractions) * 100.
+  * NodeAffinity score: sum of satisfied preferred-term weights,
+    default-normalized to [0,100] per pod across nodes.
+  * TaintToleration score: intolerable PreferNoSchedule taint count,
+    inverse-normalized to [0,100].
+  * PodTopologySpread: DoNotSchedule -> filter (count[dom]+1-min <= maxSkew);
+    ScheduleAnyway -> inverse-normalized penalty score. Nodes missing the
+    topology key are infeasible for DoNotSchedule constraints.
+  * InterPodAffinity: required (anti-)affinity -> filter against running
+    AND previously-assigned pending pods; preferred terms -> +-weight,
+    upstream-normalized. (Symmetric anti-affinity of *running* pods is
+    modelled via RunningPodArrays in a later phase; see SURVEY.md C7.)
+  * Dynamic QoS priority (C10): effective = base + gain*pressure,
+    pressure = clip(slo - observed_avail, 0, 1); pop order is stable
+    descending.
+
+Tie-break: lowest node index among score maxima (EngineConfig.tie_break
+"first" — deterministic so parity is well-defined; upstream's seeded
+roulette is not reproduced, SURVEY.md §7 hard part 2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from tpusched.config import (
+    DO_NOT_SCHEDULE,
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    EFFECT_PREFER_NO_SCHEDULE,
+    EngineConfig,
+    MAX_NODE_SCORE,
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    SCHEDULE_ANYWAY,
+)
+from tpusched.qos import effective_priority, pressure_of, effective_weights
+from tpusched.snapshot import ClusterSnapshot
+
+
+@dataclasses.dataclass
+class OracleResult:
+    assignment: np.ndarray       # [P] int32 node index or -1
+    order: np.ndarray            # [P] int32 pop order (indices into pods)
+    chosen_score: np.ndarray     # [P] f32 score of the chosen node (-inf if none)
+    final_used: np.ndarray       # [N, R] f32 node used after all commits
+
+
+def _np(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+class Oracle:
+    def __init__(self, snap: ClusterSnapshot, config: EngineConfig):
+        self.snap = snap
+        self.cfg = config
+        self.nodes = snap.nodes
+        self.pods = snap.pods
+        self._atom_sat_nodes = None
+
+    # -- atoms over node labels --------------------------------------------
+
+    def atom_sat_nodes(self) -> np.ndarray:
+        """[A, N] bool: does node n satisfy match-expression atom a."""
+        if self._atom_sat_nodes is not None:
+            return self._atom_sat_nodes
+        at = self.snap.atoms
+        key, op, pairs, num, avalid = map(_np, (at.key, at.op, at.pairs, at.num, at.valid))
+        lp, lk, ln = map(_np, (self.nodes.label_pairs, self.nodes.label_keys,
+                               self.nodes.label_nums))
+        A, N = key.shape[0], lp.shape[0]
+        sat = np.zeros((A, N), bool)
+        for a in range(A):
+            if not avalid[a]:
+                continue
+            sat[a] = _atom_sat_row(key[a], op[a], pairs[a], num[a], lp, lk, ln)
+        self._atom_sat_nodes = sat
+        return sat
+
+    def atom_sat_over(self, lp: np.ndarray, lk: np.ndarray) -> np.ndarray:
+        """[A, X] bool atom satisfaction over arbitrary label sets (pods)."""
+        at = self.snap.atoms
+        key, op, pairs, num, avalid = map(_np, (at.key, at.op, at.pairs, at.num, at.valid))
+        A, X = key.shape[0], lp.shape[0]
+        sat = np.zeros((A, X), bool)
+        ln = np.full(lp.shape, np.nan, np.float32)
+        for a in range(A):
+            if avalid[a]:
+                sat[a] = _atom_sat_row(key[a], op[a], pairs[a], num[a], lp, lk, ln)
+        return sat
+
+    # -- filters ------------------------------------------------------------
+
+    def resource_fit(self, p: int, used: np.ndarray) -> np.ndarray:
+        alloc = _np(self.nodes.allocatable)
+        req = _np(self.pods.requests)[p]
+        return np.all(used + req <= alloc, axis=1)
+
+    def taints_ok(self, p: int) -> np.ndarray:
+        tids = _np(self.nodes.taint_ids)          # [N, TN]
+        effect = _np(self.snap.taint_effect)      # [VT]
+        tol = _np(self.pods.tolerated)[p]         # [VT]
+        N = tids.shape[0]
+        ok = np.ones(N, bool)
+        for n in range(N):
+            for t in tids[n]:
+                if t < 0:
+                    continue
+                if effect[t] in (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE) and not tol[t]:
+                    ok[n] = False
+        return ok
+
+    def node_affinity_ok(self, p: int) -> np.ndarray:
+        sat = self.atom_sat_nodes()                      # [A, N]
+        atoms = _np(self.pods.req_term_atoms)[p]         # [T, AT]
+        tvalid = _np(self.pods.req_term_valid)[p]        # [T]
+        N = sat.shape[1]
+        if not tvalid.any():
+            return np.ones(N, bool)
+        ok = np.zeros(N, bool)
+        for t in range(atoms.shape[0]):
+            if not tvalid[t]:
+                continue
+            term_ok = np.ones(N, bool)
+            for a in atoms[t]:
+                if a >= 0:
+                    term_ok &= sat[a]
+            ok |= term_ok
+        return ok
+
+    # -- scores (each returns [N] f32 in [0, 100]) --------------------------
+
+    def score_least_requested(self, p: int, used: np.ndarray) -> np.ndarray:
+        alloc = _np(self.nodes.allocatable)
+        req = _np(self.pods.requests)[p]
+        w = np.asarray(self.cfg.score_weights_vector(), np.float32)
+        wsum = w.sum()
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per_r = np.where(
+                alloc > 0, (alloc - used - req) * MAX_NODE_SCORE / alloc, 0.0
+            )
+        per_r = np.where(per_r < 0, 0.0, per_r)  # over-requested -> 0 (upstream)
+        return (per_r * w).sum(axis=1).astype(np.float32) / max(wsum, 1e-9)
+
+    def score_balanced(self, p: int, used: np.ndarray) -> np.ndarray:
+        alloc = _np(self.nodes.allocatable)
+        req = _np(self.pods.requests)[p]
+        # Masked-sum formulation identical to kernels/score.py
+        # balanced_allocation so parity holds bitwise.
+        sel = (np.asarray(self.cfg.score_weights_vector(), np.float32) > 0).astype(np.float32)
+        k = max(sel.sum(), 1.0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            frac = np.where(alloc > 0, (used + req) / alloc, 1.0)
+        frac = np.clip(frac, 0.0, 1.0)
+        mean = (frac * sel).sum(axis=1, keepdims=True) / k
+        var = (((frac - mean) ** 2) * sel).sum(axis=1) / k
+        return ((1.0 - np.sqrt(var)) * MAX_NODE_SCORE).astype(np.float32)
+
+    def score_node_affinity(self, p: int) -> np.ndarray:
+        sat = self.atom_sat_nodes()
+        atoms = _np(self.pods.pref_term_atoms)[p]
+        tvalid = _np(self.pods.pref_term_valid)[p]
+        weight = _np(self.pods.pref_weight)[p]
+        N = sat.shape[1]
+        raw = np.zeros(N, np.float32)
+        for t in range(atoms.shape[0]):
+            if not tvalid[t]:
+                continue
+            term_ok = np.ones(N, bool)
+            for a in atoms[t]:
+                if a >= 0:
+                    term_ok &= sat[a]
+            raw += weight[t] * term_ok
+        return _default_normalize(raw, _np(self.nodes.valid))
+
+    def score_taint_toleration(self, p: int) -> np.ndarray:
+        tids = _np(self.nodes.taint_ids)
+        effect = _np(self.snap.taint_effect)
+        tol = _np(self.pods.tolerated)[p]
+        N = tids.shape[0]
+        count = np.zeros(N, np.float32)
+        for n in range(N):
+            for t in tids[n]:
+                if t >= 0 and effect[t] == EFFECT_PREFER_NO_SCHEDULE and not tol[t]:
+                    count[n] += 1
+        nvalid = _np(self.nodes.valid)
+        mx = count[nvalid].max() if nvalid.any() else 0.0
+        if mx <= 0:
+            return np.full(N, MAX_NODE_SCORE, np.float32)
+        return ((mx - count) * MAX_NODE_SCORE / mx).astype(np.float32)
+
+    # -- pairwise: topology spread + inter-pod affinity ---------------------
+
+    def _match_counts(self, sel_atoms: np.ndarray, extra_lp, extra_lk) -> np.ndarray:
+        """[X] bool: which of running+assigned pods match the selector.
+        A selector with zero atoms matches everything (upstream empty
+        label selector)."""
+        run = self.snap.running
+        lp = np.concatenate([_np(run.label_pairs)] + extra_lp, axis=0)
+        lk = np.concatenate([_np(run.label_keys)] + extra_lk, axis=0)
+        valid = np.concatenate(
+            [_np(run.valid)] + [np.ones(len(x), bool) for x in extra_lp]
+        )
+        sat = self.atom_sat_over(lp, lk)
+        match = valid.copy()
+        for a in sel_atoms:
+            if a >= 0:
+                match &= sat[a]
+        return match
+
+    def spread_ok_and_penalty(
+        self, p: int, assigned_nodes: list[int], assigned_pods: list[int]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (feasible [N] bool, penalty [N] f32) for all spread
+        constraints of pod p given already-committed pending pods."""
+        nodes, pods = self.nodes, self.pods
+        dom = _np(nodes.domain)                       # [N, TK]
+        nvalid = _np(nodes.valid)
+        N = dom.shape[0]
+        ok = np.ones(N, bool)
+        penalty = np.zeros(N, np.float32)
+        tsk = _np(pods.ts_key)[p]
+        tsv = _np(pods.ts_valid)[p]
+        if not tsv.any():
+            return ok, penalty
+        plp, plk = _np(pods.label_pairs), _np(pods.label_keys)
+        extra_lp = [plp[assigned_pods]] if assigned_pods else []
+        extra_lk = [plk[assigned_pods]] if assigned_pods else []
+        run_nodes = _np(self.snap.running.node_idx)
+        member_nodes = np.concatenate(
+            [run_nodes, np.asarray(assigned_nodes, np.int32)]
+        ) if assigned_pods else run_nodes
+        # Eligible nodes for domain discovery: honor the pod's own node
+        # affinity (upstream NodeAffinityPolicy: Honor default).
+        eligible = nvalid & self.node_affinity_ok(p)
+        for c in range(tsk.shape[0]):
+            if not tsv[c]:
+                continue
+            key = tsk[c]
+            has_key = dom[:, key] >= 0
+            match = self._match_counts(_np(pods.ts_sel_atoms)[p, c], extra_lp, extra_lk)
+            # count matching member pods per domain of this topo key
+            member_dom = np.where(member_nodes >= 0, dom[member_nodes, key], -1)
+            n_dom = int(dom[:, key].max()) + 1 if has_key.any() else 0
+            counts = np.zeros(max(n_dom, 1), np.float32)
+            for md, m in zip(member_dom, match):
+                if m and md >= 0:
+                    counts[md] += 1
+            elig_doms = np.unique(dom[eligible & has_key, key]) if (eligible & has_key).any() else np.array([], np.int64)
+            min_count = counts[elig_doms].min() if elig_doms.size else 0.0
+            node_count = np.where(has_key, counts[np.clip(dom[:, key], 0, None)], np.inf)
+            if _np(pods.ts_when)[p, c] == DO_NOT_SCHEDULE:
+                ok &= has_key & (node_count + 1 - min_count <= _np(pods.ts_max_skew)[p, c])
+            else:
+                penalty += np.where(has_key, node_count, counts.max() if n_dom else 0.0)
+        return ok, penalty
+
+    def interpod_ok_and_raw(
+        self, p: int, assigned_nodes: list[int], assigned_pods: list[int]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """(feasible [N] bool, preferred raw score [N] f32) over the pod's
+        inter-pod (anti-)affinity terms."""
+        nodes, pods = self.nodes, self.pods
+        dom = _np(nodes.domain)
+        N = dom.shape[0]
+        ok = np.ones(N, bool)
+        raw = np.zeros(N, np.float32)
+        iav = _np(pods.ia_valid)[p]
+        if not iav.any():
+            return ok, raw
+        plp, plk = _np(pods.label_pairs), _np(pods.label_keys)
+        extra_lp = [plp[assigned_pods]] if assigned_pods else []
+        extra_lk = [plk[assigned_pods]] if assigned_pods else []
+        run_nodes = _np(self.snap.running.node_idx)
+        member_nodes = np.concatenate(
+            [run_nodes, np.asarray(assigned_nodes, np.int32)]
+        ) if assigned_pods else run_nodes
+        for t in range(iav.shape[0]):
+            if not iav[t]:
+                continue
+            key = _np(pods.ia_key)[p, t]
+            match = self._match_counts(_np(pods.ia_sel_atoms)[p, t], extra_lp, extra_lk)
+            member_dom = np.where(member_nodes >= 0, dom[member_nodes, key], -1)
+            # domain -> has matching pod?
+            has_key = dom[:, key] >= 0
+            n_dom = int(dom[:, key].max()) + 1 if has_key.any() else 0
+            dom_has = np.zeros(max(n_dom, 1), bool)
+            for md, m in zip(member_dom, match):
+                if m and md >= 0:
+                    dom_has[md] = True
+            node_has = has_key & dom_has[np.clip(dom[:, key], 0, None)]
+            anti = _np(pods.ia_anti)[p, t]
+            if _np(pods.ia_required)[p, t]:
+                # Required affinity: node's domain must contain a match
+                # (nodes missing the key fail). Required anti-affinity:
+                # node's domain must NOT contain a match (missing key ok).
+                ok &= (~node_has if anti else node_has)
+            else:
+                w = _np(pods.ia_weight)[p, t]
+                raw += np.where(node_has, -w if anti else w, 0.0)
+        return ok, raw
+
+    # -- the per-pod cycle ---------------------------------------------------
+
+    def feasible_and_score(
+        self, p: int, used: np.ndarray,
+        assigned_nodes: list[int] | None = None,
+        assigned_pods: list[int] | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """One scheduling cycle's Filter + Score for pod p: returns
+        (feasible [N] bool, total weighted score [N] f32)."""
+        assigned_nodes = assigned_nodes or []
+        assigned_pods = assigned_pods or []
+        nvalid = _np(self.nodes.valid)
+        spread_ok, spread_penalty = self.spread_ok_and_penalty(
+            p, assigned_nodes, assigned_pods
+        )
+        ia_ok, ia_raw = self.interpod_ok_and_raw(p, assigned_nodes, assigned_pods)
+        feasible = (
+            nvalid
+            & self.resource_fit(p, used)
+            & self.taints_ok(p)
+            & self.node_affinity_ok(p)
+            & spread_ok
+            & ia_ok
+        )
+        w = effective_weights(
+            self.cfg,
+            pressure_of(_np(self.pods.slo_target)[p], _np(self.pods.observed_avail)[p]),
+        )
+        # Grouping mirrors kernels/assign.py pod_cycle (static NodeAffinity
+        # + TaintToleration term parenthesised together) for f32 parity.
+        static = (
+            w["node_affinity"] * self.score_node_affinity(p)
+            + w["taint_toleration"] * self.score_taint_toleration(p)
+        ).astype(np.float32)
+        score = (
+            w["least_requested"] * self.score_least_requested(p, used)
+            + w["balanced_allocation"] * self.score_balanced(p, used)
+            + static
+            + w["topology_spread"] * _inverse_normalize(spread_penalty, nvalid)
+            + w["interpod_affinity"] * _upstream_normalize(ia_raw, nvalid)
+        ).astype(np.float32)
+        return feasible, score
+
+    def solve(self) -> OracleResult:
+        pods, nodes = self.pods, self.nodes
+        pvalid = _np(pods.valid)
+        P = pvalid.shape[0]
+        used = _np(nodes.used).copy()
+        prio = effective_priority(
+            self.cfg, _np(pods.base_priority), _np(pods.slo_target),
+            _np(pods.observed_avail),
+        )
+        # Stable descending pop order over valid pods (SURVEY.md §3.1
+        # queue.Pop of max dynamic priority; ties by submission order =
+        # pod index).
+        order = np.argsort(-np.where(pvalid, prio, -np.inf), kind="stable")
+        order = order[pvalid[order]]
+        assignment = np.full(P, -1, np.int32)
+        chosen_score = np.full(P, -np.inf, np.float32)
+        assigned_nodes: list[int] = []
+        assigned_pods: list[int] = []
+        for p in order:
+            feasible, score = self.feasible_and_score(
+                int(p), used, assigned_nodes, assigned_pods
+            )
+            if not feasible.any():
+                continue
+            masked = np.where(feasible, score, -np.inf)
+            n = int(np.argmax(masked))  # first max = tie_break "first"
+            assignment[p] = n
+            chosen_score[p] = masked[n]
+            used[n] += _np(pods.requests)[p]
+            assigned_nodes.append(n)
+            assigned_pods.append(int(p))
+        return OracleResult(
+            assignment=assignment,
+            order=order.astype(np.int32),
+            chosen_score=chosen_score,
+            final_used=used,
+        )
+
+
+# ---------------------------------------------------------------------------
+
+
+def _atom_sat_row(key, op, pairs, num, lp, lk, ln) -> np.ndarray:
+    """Satisfaction of one atom over label arrays lp/lk/ln of shape [X, L]."""
+    pair_set = pairs[pairs >= 0]
+    any_pair = np.isin(lp, pair_set).any(axis=1) if pair_set.size else np.zeros(lp.shape[0], bool)
+    exists = (lk == key).any(axis=1)
+    if op == OP_IN:
+        return any_pair
+    if op == OP_NOT_IN:
+        return ~any_pair
+    if op == OP_EXISTS:
+        return exists
+    if op == OP_DOES_NOT_EXIST:
+        return ~exists
+    # Gt / Lt: numeric value of the matching key; absent or unparsable
+    # (NaN) labels never satisfy. Formulation mirrors kernels/atoms.py
+    # exactly so oracle and device agree bitwise.
+    matched = (lk == key) & np.isfinite(ln)
+    has = matched.any(axis=1)
+    val = np.where(matched, ln, 0.0).sum(axis=1)
+    if op == OP_GT:
+        return has & (val > num)
+    if op == OP_LT:
+        return has & (val < num)
+    raise ValueError(f"bad op {op}")
+
+
+def _default_normalize(raw: np.ndarray, nvalid: np.ndarray) -> np.ndarray:
+    """Upstream DefaultNormalizeScore: scale so max becomes 100."""
+    mx = raw[nvalid].max() if nvalid.any() else 0.0
+    if mx <= 0:
+        return np.zeros_like(raw)
+    return (raw * MAX_NODE_SCORE / mx).astype(np.float32)
+
+
+def _inverse_normalize(penalty: np.ndarray, nvalid: np.ndarray) -> np.ndarray:
+    """Lower penalty -> higher score; all-equal -> 100."""
+    if not nvalid.any():
+        return np.zeros_like(penalty)
+    mx = penalty[nvalid].max()
+    mn = penalty[nvalid].min()
+    if mx <= mn:
+        return np.full_like(penalty, MAX_NODE_SCORE)
+    return ((mx - penalty) * MAX_NODE_SCORE / (mx - mn)).astype(np.float32)
+
+
+def _upstream_normalize(raw: np.ndarray, nvalid: np.ndarray) -> np.ndarray:
+    """Upstream InterPodAffinity normalize: (raw-min)/(max-min)*100,
+    all-zero -> 0."""
+    if not nvalid.any():
+        return np.zeros_like(raw)
+    mx = raw[nvalid].max()
+    mn = raw[nvalid].min()
+    if mx == mn:
+        return np.zeros_like(raw)
+    return ((raw - mn) * MAX_NODE_SCORE / (mx - mn)).astype(np.float32)

@@ -1,0 +1,72 @@
+"""Dynamic QoS priority (SURVEY.md C10).
+
+The reference project's defining feature (its name is
+"k8s-qos-driven-scheduler", /root/reference/README.md:1): pod priority is
+not the static pod.spec.priority but a *dynamic* function of how far the
+pod is from its availability SLO. Pods below their SLO ("under pressure")
+pop from the queue first and may preempt pods with positive slack.
+
+Formulas shared by the oracle and the device kernels:
+    pressure(pod)  = clip(slo_target - observed_availability, 0, 1)
+    priority(pod)  = base_priority + qos_gain * pressure
+    slack(victim)  = observed_availability - slo_target   (>0 = cheap victim)
+
+Pressure also optionally reweights score plugins per pod
+(QoSConfig.urgency_reweight): a pod far below its SLO cares about being
+placed *now* (pure LeastRequested = emptiest node) rather than about
+long-term balance, so its effective plugin weights interpolate toward an
+urgent profile holding all weight on least_requested.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpusched.config import EngineConfig
+
+
+def pressure_of(slo_target, observed_avail):
+    """Works on numpy and jax arrays alike (pure ufunc arithmetic)."""
+    return (slo_target - observed_avail).clip(0.0, 1.0)
+
+
+def effective_priority(cfg: EngineConfig, base_priority, slo_target, observed_avail):
+    return base_priority + cfg.qos.qos_gain * pressure_of(slo_target, observed_avail)
+
+
+def slack_of(slo_target, observed_avail):
+    return observed_avail - slo_target
+
+
+_PLUGINS = (
+    "least_requested",
+    "balanced_allocation",
+    "node_affinity",
+    "taint_toleration",
+    "topology_spread",
+    "interpod_affinity",
+)
+
+
+def base_weights(cfg: EngineConfig) -> dict[str, float]:
+    return {p: float(getattr(cfg.weights, p)) for p in _PLUGINS}
+
+
+def effective_weights(cfg: EngineConfig, pressure) -> dict:
+    """Per-pod plugin weights. With urgency_reweight, interpolate between
+    the configured profile and an all-least-requested urgent profile by
+    QoS pressure. `pressure` may be a scalar or a [P] array; weights
+    broadcast accordingly."""
+    w = base_weights(cfg)
+    if not cfg.qos.urgency_reweight:
+        return {k: v + 0.0 * pressure if _is_array(pressure) else v
+                for k, v in w.items()}
+    total = sum(w.values())
+    urgent = {p: (total if p == "least_requested" else 0.0) for p in _PLUGINS}
+    return {
+        p: (1.0 - pressure) * w[p] + pressure * urgent[p] for p in _PLUGINS
+    }
+
+
+def _is_array(x) -> bool:
+    return hasattr(x, "shape") and getattr(x, "shape", ()) != ()

@@ -1,0 +1,653 @@
+"""ClusterSnapshot: the cluster state as a struct-of-arrays pytree (C1).
+
+This is the device-side mirror of the reference scheduler's cluster cache
+(SURVEY.md §1.2 L2: informer-fed snapshot of nodes + assumed pods). Every
+string the scheduler reasons about — label keys, (key,value) pairs, taints,
+match-expression atoms, topology keys — is interned on the host into an
+integer vocabulary by `SnapshotBuilder`, so the device sees only dense,
+padded, statically-shaped int/float arrays. That is what lets the whole
+Filter->Score->Commit cycle compile to a single XLA program.
+
+Encoding invariants (relied on by every kernel):
+  * -1 is the universal padding id in any id array.
+  * `valid` masks mark live rows; padded rows must never win an argmax.
+  * A nodeSelectorTerm with zero atoms is invalid (upstream: an empty
+    term matches no objects); a pod with zero valid required terms has no
+    required node affinity (matches all nodes).
+  * A pod/label selector (topology spread, inter-pod affinity) with a set
+    valid flag but zero atoms matches ALL pods (upstream: empty label
+    selector matches everything).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+from flax import struct
+
+from tpusched.config import (
+    Buckets,
+    EngineConfig,
+    OPERATORS,
+    RESOURCE_PODS,
+    TAINT_EFFECTS,
+    DO_NOT_SCHEDULE,
+    SCHEDULE_ANYWAY,
+    _next_pow2,
+)
+
+
+# ---------------------------------------------------------------------------
+# Host-side spec structures (the "pod spec" surface a caller fills in).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchExpression:
+    """One matchExpressions entry: key op values (upstream semantics,
+    SURVEY.md C2): In / NotIn / Exists / DoesNotExist / Gt / Lt."""
+
+    key: str
+    op: str
+    values: tuple[str, ...] = ()
+
+    def __post_init__(self):
+        if self.op not in OPERATORS:
+            raise ValueError(f"bad operator {self.op!r}; want one of {OPERATORS}")
+        if self.op in ("Gt", "Lt") and len(self.values) != 1:
+            raise ValueError(f"{self.op} needs exactly one value")
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeSelectorTerm:
+    expressions: tuple[MatchExpression, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class PreferredTerm:
+    weight: float
+    term: NodeSelectorTerm
+
+
+@dataclasses.dataclass(frozen=True)
+class Toleration:
+    key: str = ""           # "" + Exists tolerates everything
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""        # "" matches all effects
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologySpreadConstraint:
+    topology_key: str
+    max_skew: int
+    when_unsatisfiable: str  # DoNotSchedule | ScheduleAnyway
+    # Label selector over pods, as match expressions (matchLabels entries
+    # become In expressions with a single value).
+    selector: tuple[MatchExpression, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class PodAffinityTerm:
+    topology_key: str
+    selector: tuple[MatchExpression, ...] = ()
+    anti: bool = False
+    required: bool = True
+    weight: float = 1.0      # only used when required=False
+
+
+def selector_from_labels(labels: Mapping[str, str]) -> tuple[MatchExpression, ...]:
+    """matchLabels -> equivalent In expressions (upstream conversion)."""
+    return tuple(MatchExpression(k, "In", (v,)) for k, v in sorted(labels.items()))
+
+
+# ---------------------------------------------------------------------------
+# Device-side pytrees.
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class AtomTable:
+    """Distinct match-expression atoms across the snapshot.
+
+    atom_sat[a, n] (computed on device, kernels/atoms.py) answers "does
+    node n satisfy atom a"; pods then reference atoms by id. Pod-label
+    selectors reuse the same table against pod labels."""
+
+    key: Any        # [A] int32  key id (-1 pad)
+    op: Any         # [A] int8   OP_* code
+    pairs: Any      # [A, VA] int32  (key,value)-pair ids for In/NotIn
+    num: Any        # [A] f32    numeric bound for Gt/Lt
+    valid: Any      # [A] bool
+
+
+@struct.dataclass
+class NodeArrays:
+    allocatable: Any   # [N, R] f32
+    used: Any          # [N, R] f32 (requests of bound pods)
+    label_pairs: Any   # [N, LN] int32 (-1 pad)
+    label_keys: Any    # [N, LN] int32 (-1 pad)
+    label_nums: Any    # [N, LN] f32 (numeric label value or NaN)
+    taint_ids: Any     # [N, TN] int32 into taint vocab (-1 pad)
+    domain: Any        # [N, TK] int32 topology-domain id per topo key (-1 none)
+    valid: Any         # [N] bool
+
+
+@struct.dataclass
+class PodArrays:
+    requests: Any        # [P, R] f32
+    base_priority: Any   # [P] f32 (pod.spec.priority analogue)
+    slo_target: Any      # [P] f32 availability SLO in [0,1]
+    observed_avail: Any  # [P] f32 observed availability in [0,1]
+    tolerated: Any       # [P, VT] bool (precompiled toleration vs taint vocab)
+    label_pairs: Any     # [P, LP] int32
+    label_keys: Any      # [P, LP] int32
+    # Required node affinity: OR over terms, AND over atoms within a term.
+    req_term_atoms: Any  # [P, T, AT] int32 atom ids (-1 pad)
+    req_term_valid: Any  # [P, T] bool
+    # Preferred node affinity.
+    pref_term_atoms: Any  # [P, PT, AT] int32
+    pref_term_valid: Any  # [P, PT] bool
+    pref_weight: Any      # [P, PT] f32
+    # Topology spread constraints.
+    ts_key: Any          # [P, C] int32 index into topo keys (-1 pad)
+    ts_max_skew: Any     # [P, C] f32
+    ts_when: Any         # [P, C] int8 DO_NOT_SCHEDULE | SCHEDULE_ANYWAY
+    ts_sel_atoms: Any    # [P, C, AT] int32 selector atoms over pod labels
+    ts_valid: Any        # [P, C] bool
+    # Inter-pod (anti-)affinity terms.
+    ia_key: Any          # [P, IT] int32 topo key index
+    ia_sel_atoms: Any    # [P, IT, AT] int32 selector atoms over pod labels
+    ia_anti: Any         # [P, IT] bool
+    ia_required: Any     # [P, IT] bool
+    ia_weight: Any       # [P, IT] f32
+    ia_valid: Any        # [P, IT] bool
+    # Gang scheduling.
+    group: Any           # [P] int32 pod-group id (-1 = none)
+    valid: Any           # [P] bool
+
+
+@struct.dataclass
+class RunningPodArrays:
+    node_idx: Any     # [M] int32 (-1 pad)
+    requests: Any     # [M, R] f32
+    priority: Any     # [M] f32
+    slack: Any        # [M] f32 observed_avail - slo (positive = cheap victim)
+    label_pairs: Any  # [M, LP] int32
+    label_keys: Any   # [M, LP] int32
+    valid: Any        # [M] bool
+
+
+@struct.dataclass
+class ClusterSnapshot:
+    nodes: NodeArrays
+    pods: PodArrays
+    running: RunningPodArrays
+    atoms: AtomTable
+    taint_effect: Any     # [VT] int8
+    group_min_member: Any  # [G] int32 (0 for unused slots)
+
+
+@dataclasses.dataclass
+class SnapshotMeta:
+    """Host-side decode tables (index -> name); not shipped to device."""
+
+    node_names: list[str]
+    pod_names: list[str]
+    n_nodes: int
+    n_pods: int
+    n_running: int
+    buckets: Buckets
+    group_names: list[str]
+
+
+# ---------------------------------------------------------------------------
+# Builder: interning + padding.
+# ---------------------------------------------------------------------------
+
+
+def _try_float(s: str) -> float:
+    try:
+        return float(s)
+    except (TypeError, ValueError):
+        return float("nan")
+
+
+class SnapshotBuilder:
+    """Accumulates node/pod records and emits a padded ClusterSnapshot.
+
+    All interning happens in build() so records may arrive in any order
+    and buckets can be auto-fitted to the observed counts."""
+
+    def __init__(self, config: EngineConfig, buckets: Buckets | None = None):
+        self.config = config
+        self.buckets = buckets
+        self._nodes: list[dict] = []
+        self._pods: list[dict] = []
+        self._running: list[dict] = []
+        self._groups: dict[str, int] = {}  # name -> min_member
+
+    # -- record intake ------------------------------------------------------
+
+    def add_node(
+        self,
+        name: str,
+        allocatable: Mapping[str, float],
+        labels: Mapping[str, str] | None = None,
+        taints: Sequence[tuple[str, str, str]] = (),
+        used: Mapping[str, float] | None = None,
+    ) -> None:
+        alloc = dict(allocatable)
+        alloc.setdefault(RESOURCE_PODS, 110.0)  # upstream kubelet default
+        self._nodes.append(
+            dict(name=name, allocatable=alloc, labels=dict(labels or {}),
+                 taints=list(taints), used=dict(used or {}))
+        )
+
+    def add_pod(
+        self,
+        name: str,
+        requests: Mapping[str, float],
+        priority: float = 0.0,
+        slo_target: float = 0.0,
+        observed_avail: float = 1.0,
+        labels: Mapping[str, str] | None = None,
+        node_selector: Mapping[str, str] | None = None,
+        required_terms: Sequence[NodeSelectorTerm] = (),
+        preferred_terms: Sequence[PreferredTerm] = (),
+        tolerations: Sequence[Toleration] = (),
+        topology_spread: Sequence[TopologySpreadConstraint] = (),
+        pod_affinity: Sequence[PodAffinityTerm] = (),
+        pod_group: str | None = None,
+        pod_group_min_member: int = 0,
+    ) -> None:
+        req = dict(requests)
+        req.setdefault(RESOURCE_PODS, 1.0)
+        if pod_group is not None:
+            prev = self._groups.get(pod_group, 0)
+            self._groups[pod_group] = max(prev, int(pod_group_min_member))
+        self._pods.append(
+            dict(name=name, requests=req, priority=float(priority),
+                 slo_target=float(slo_target), observed_avail=float(observed_avail),
+                 labels=dict(labels or {}),
+                 node_selector=dict(node_selector or {}),
+                 required_terms=list(required_terms),
+                 preferred_terms=list(preferred_terms),
+                 tolerations=list(tolerations),
+                 topology_spread=list(topology_spread),
+                 pod_affinity=list(pod_affinity),
+                 pod_group=pod_group)
+        )
+
+    def add_running_pod(
+        self,
+        node: str,
+        requests: Mapping[str, float],
+        priority: float = 0.0,
+        slack: float = 0.0,
+        labels: Mapping[str, str] | None = None,
+        count_into_used: bool = True,
+    ) -> None:
+        req = dict(requests)
+        req.setdefault(RESOURCE_PODS, 1.0)
+        self._running.append(
+            dict(node=node, requests=req, priority=float(priority),
+                 slack=float(slack), labels=dict(labels or {}),
+                 count_into_used=count_into_used)
+        )
+
+    # -- build --------------------------------------------------------------
+
+    def build(self) -> tuple[ClusterSnapshot, SnapshotMeta]:
+        cfg = self.config
+        R = len(cfg.resources)
+        n_nodes, n_pods, n_running = len(self._nodes), len(self._pods), len(self._running)
+
+        # Interning tables.
+        key_ids: dict[str, int] = {}
+        pair_ids: dict[tuple[str, str], int] = {}
+        taint_ids: dict[tuple[str, str, str], int] = {}
+        atom_ids: dict[tuple, int] = {}
+        atoms: list[tuple[int, int, tuple[int, ...], float]] = []
+        topo_keys: list[str] = []
+        domain_ids: list[dict[str, int]] = []  # per topo key: value -> id
+
+        def kid(k: str) -> int:
+            return key_ids.setdefault(k, len(key_ids))
+
+        def pid(k: str, v: str) -> int:
+            return pair_ids.setdefault((k, v), len(pair_ids))
+
+        def tid(k: str, v: str, effect: str) -> int:
+            if effect not in TAINT_EFFECTS:
+                raise ValueError(f"bad taint effect {effect!r}")
+            return taint_ids.setdefault((k, v, effect), len(taint_ids))
+
+        def topo_idx(k: str) -> int:
+            if k not in topo_keys:
+                topo_keys.append(k)
+                domain_ids.append({})
+            return topo_keys.index(k)
+
+        def aid(expr: MatchExpression) -> int:
+            op = OPERATORS.index(expr.op)
+            k = kid(expr.key)
+            if expr.op in ("In", "NotIn"):
+                pids = tuple(sorted(pid(expr.key, v) for v in expr.values))
+                num = float("nan")
+            elif expr.op in ("Gt", "Lt"):
+                pids = ()
+                num = float(expr.values[0])
+            else:
+                pids = ()
+                num = float("nan")
+            sig = (k, op, pids, num)
+            if sig not in atom_ids:
+                atom_ids[sig] = len(atoms)
+                atoms.append(sig)
+            return atom_ids[sig]
+
+        # First pass: intern everything referenced by pods so vocab sizes
+        # are known before arrays are allocated.
+        pod_compiled = []
+        for p in self._pods:
+            terms = [NodeSelectorTerm(tuple(
+                MatchExpression(k, "In", (v,)) for k, v in sorted(p["node_selector"].items())
+            ))] if p["node_selector"] else []
+            # nodeSelector ANDs with required affinity: encode nodeSelector
+            # as an extra atom set ANDed into every required term (or a
+            # standalone single term when no affinity terms exist).
+            sel_atoms = [aid(e) for t in terms for e in t.expressions]
+            req_terms = []
+            for t in p["required_terms"]:
+                if not t.expressions:
+                    continue  # empty term matches no objects -> drop (cannot satisfy)
+                req_terms.append([aid(e) for e in t.expressions] + sel_atoms)
+            if not req_terms and sel_atoms:
+                req_terms = [sel_atoms]
+            pref_terms = [
+                ([aid(e) for e in pt.term.expressions], float(pt.weight))
+                for pt in p["preferred_terms"] if pt.term.expressions
+            ]
+            ts = [
+                dict(key=topo_idx(c.topology_key), max_skew=float(c.max_skew),
+                     when=DO_NOT_SCHEDULE if c.when_unsatisfiable == "DoNotSchedule" else SCHEDULE_ANYWAY,
+                     atoms=[aid(e) for e in c.selector])
+                for c in p["topology_spread"]
+            ]
+            ia = [
+                dict(key=topo_idx(t.topology_key), atoms=[aid(e) for e in t.selector],
+                     anti=t.anti, required=t.required, weight=float(t.weight))
+                for t in p["pod_affinity"]
+            ]
+            pod_compiled.append(dict(req_terms=req_terms, pref_terms=pref_terms, ts=ts, ia=ia))
+
+        # Intern node labels/taints.
+        for nrec in self._nodes:
+            for k, v in nrec["labels"].items():
+                kid(k); pid(k, v)
+            for (k, v, e) in nrec["taints"]:
+                tid(k, v, e)
+        for rrec in self._running:
+            for k, v in rrec["labels"].items():
+                kid(k); pid(k, v)
+        for p in self._pods:
+            for k, v in p["labels"].items():
+                kid(k); pid(k, v)
+
+        # Buckets.
+        bk = self.buckets
+        if bk is None:
+            bk = Buckets.fit(n_pods, n_nodes, n_running)
+        need = dict(
+            node_labels=max((len(n["labels"]) for n in self._nodes), default=0),
+            pod_labels=max(
+                [len(p["labels"]) for p in self._pods]
+                + [len(r["labels"]) for r in self._running] or [0]
+            ),
+            node_taints=max((len(n["taints"]) for n in self._nodes), default=0),
+            atoms=len(atoms),
+            atom_values=max((len(a[2]) for a in atoms), default=0),
+            terms=max((len(pc["req_terms"]) for pc in pod_compiled), default=0),
+            term_atoms=max(
+                [len(t) for pc in pod_compiled for t in pc["req_terms"]]
+                + [len(t[0]) for pc in pod_compiled for t in pc["pref_terms"]]
+                + [len(c["atoms"]) for pc in pod_compiled for c in pc["ts"]]
+                + [len(t["atoms"]) for pc in pod_compiled for t in pc["ia"]] or [0]
+            ),
+            pref_terms=max((len(pc["pref_terms"]) for pc in pod_compiled), default=0),
+            topo_keys=len(topo_keys),
+            spread_constraints=max((len(pc["ts"]) for pc in pod_compiled), default=0),
+            affinity_terms=max((len(pc["ia"]) for pc in pod_compiled), default=0),
+            pod_groups=len(self._groups),
+            taint_vocab=len(taint_ids),
+        )
+        grow = {
+            f: max(getattr(bk, f), _ceil_bucket(v))
+            for f, v in need.items() if v > getattr(bk, f)
+        }
+        if grow:
+            bk = dataclasses.replace(bk, **grow)
+        if n_pods > bk.pods or n_nodes > bk.nodes or n_running > bk.running_pods:
+            bk = dataclasses.replace(
+                bk,
+                pods=max(bk.pods, _ceil_bucket(n_pods)),
+                nodes=max(bk.nodes, _ceil_bucket(n_nodes)),
+                running_pods=max(bk.running_pods, _ceil_bucket(n_running)),
+            )
+
+        P, N, M = bk.pods, bk.nodes, bk.running_pods
+
+        # Atom table arrays.
+        atom_key = np.full(bk.atoms, -1, np.int32)
+        atom_op = np.zeros(bk.atoms, np.int8)
+        atom_pairs = np.full((bk.atoms, bk.atom_values), -1, np.int32)
+        atom_num = np.full(bk.atoms, np.nan, np.float32)
+        atom_valid = np.zeros(bk.atoms, bool)
+        for i, (k, op, pids, num) in enumerate(atoms):
+            atom_key[i] = k
+            atom_op[i] = op
+            atom_pairs[i, : len(pids)] = pids
+            atom_num[i] = num
+            atom_valid[i] = True
+
+        # Node arrays.
+        node_alloc = np.zeros((N, R), np.float32)
+        node_used = np.zeros((N, R), np.float32)
+        node_lp = np.full((N, bk.node_labels), -1, np.int32)
+        node_lk = np.full((N, bk.node_labels), -1, np.int32)
+        node_ln = np.full((N, bk.node_labels), np.nan, np.float32)
+        node_t = np.full((N, bk.node_taints), -1, np.int32)
+        node_dom = np.full((N, max(bk.topo_keys, 1)), -1, np.int32)
+        node_valid = np.zeros(N, bool)
+        node_index = {}
+        for i, nrec in enumerate(self._nodes):
+            node_index[nrec["name"]] = i
+            node_valid[i] = True
+            for r, rn in enumerate(cfg.resources):
+                node_alloc[i, r] = float(nrec["allocatable"].get(rn, 0.0))
+                node_used[i, r] = float(nrec["used"].get(rn, 0.0))
+            for j, (k, v) in enumerate(sorted(nrec["labels"].items())):
+                node_lk[i, j] = key_ids[k]
+                node_lp[i, j] = pair_ids[(k, v)]
+                node_ln[i, j] = _try_float(v)
+            for j, (k, v, e) in enumerate(nrec["taints"]):
+                node_t[i, j] = taint_ids[(k, v, e)]
+            for ti, tk in enumerate(topo_keys):
+                if tk in nrec["labels"]:
+                    v = nrec["labels"][tk]
+                    node_dom[i, ti] = domain_ids[ti].setdefault(v, len(domain_ids[ti]))
+
+        # Taint effect table.
+        vt = bk.taint_vocab
+        taint_effect = np.zeros(vt, np.int8)
+        for (k, v, e), t in taint_ids.items():
+            taint_effect[t] = TAINT_EFFECTS.index(e)
+
+        # Pod arrays.
+        pods = _PodArraysNP(bk, R)
+        group_list = sorted(self._groups)
+        group_idx = {g: i for i, g in enumerate(group_list)}
+        for i, (p, pc) in enumerate(zip(self._pods, pod_compiled)):
+            pods.valid[i] = True
+            for r, rn in enumerate(cfg.resources):
+                pods.requests[i, r] = float(p["requests"].get(rn, 0.0))
+            pods.base_priority[i] = p["priority"]
+            pods.slo_target[i] = p["slo_target"]
+            pods.observed_avail[i] = p["observed_avail"]
+            for j, (k, v) in enumerate(sorted(p["labels"].items())):
+                pods.label_keys[i, j] = key_ids[k]
+                pods.label_pairs[i, j] = pair_ids[(k, v)]
+            # Tolerations precompiled against the taint vocab.
+            for (tk, tv, te), t in taint_ids.items():
+                pods.tolerated[i, t] = any(
+                    _tolerates(tol, tk, tv, te) for tol in p["tolerations"]
+                )
+            for t, term in enumerate(pc["req_terms"]):
+                pods.req_term_valid[i, t] = True
+                pods.req_term_atoms[i, t, : len(term)] = term
+            for t, (term, w) in enumerate(pc["pref_terms"]):
+                pods.pref_term_valid[i, t] = True
+                pods.pref_term_atoms[i, t, : len(term)] = term
+                pods.pref_weight[i, t] = w
+            for c, con in enumerate(pc["ts"]):
+                pods.ts_valid[i, c] = True
+                pods.ts_key[i, c] = con["key"]
+                pods.ts_max_skew[i, c] = con["max_skew"]
+                pods.ts_when[i, c] = con["when"]
+                pods.ts_sel_atoms[i, c, : len(con["atoms"])] = con["atoms"]
+            for t, term in enumerate(pc["ia"]):
+                pods.ia_valid[i, t] = True
+                pods.ia_key[i, t] = term["key"]
+                pods.ia_sel_atoms[i, t, : len(term["atoms"])] = term["atoms"]
+                pods.ia_anti[i, t] = term["anti"]
+                pods.ia_required[i, t] = term["required"]
+                pods.ia_weight[i, t] = term["weight"]
+            if p["pod_group"] is not None:
+                pods.group[i] = group_idx[p["pod_group"]]
+
+        group_min = np.zeros(bk.pod_groups, np.int32)
+        for g, name in enumerate(group_list):
+            group_min[g] = self._groups[name]
+
+        # Running pods.
+        run_node = np.full(M, -1, np.int32)
+        run_req = np.zeros((M, R), np.float32)
+        run_prio = np.zeros(M, np.float32)
+        run_slack = np.zeros(M, np.float32)
+        run_lp = np.full((M, bk.pod_labels), -1, np.int32)
+        run_lk = np.full((M, bk.pod_labels), -1, np.int32)
+        run_valid = np.zeros(M, bool)
+        for i, rrec in enumerate(self._running):
+            ni = node_index[rrec["node"]]
+            run_node[i] = ni
+            run_valid[i] = True
+            for r, rn in enumerate(cfg.resources):
+                run_req[i, r] = float(rrec["requests"].get(rn, 0.0))
+                if rrec["count_into_used"]:
+                    node_used[ni, r] += float(rrec["requests"].get(rn, 0.0))
+            run_prio[i] = rrec["priority"]
+            run_slack[i] = rrec["slack"]
+            for j, (k, v) in enumerate(sorted(rrec["labels"].items())):
+                run_lk[i, j] = key_ids[k]
+                run_lp[i, j] = pair_ids[(k, v)]
+
+        snap = ClusterSnapshot(
+            nodes=NodeArrays(
+                allocatable=node_alloc, used=node_used, label_pairs=node_lp,
+                label_keys=node_lk, label_nums=node_ln, taint_ids=node_t,
+                domain=node_dom, valid=node_valid,
+            ),
+            pods=PodArrays(
+                requests=pods.requests, base_priority=pods.base_priority,
+                slo_target=pods.slo_target, observed_avail=pods.observed_avail,
+                tolerated=pods.tolerated, label_pairs=pods.label_pairs,
+                label_keys=pods.label_keys, req_term_atoms=pods.req_term_atoms,
+                req_term_valid=pods.req_term_valid,
+                pref_term_atoms=pods.pref_term_atoms,
+                pref_term_valid=pods.pref_term_valid, pref_weight=pods.pref_weight,
+                ts_key=pods.ts_key, ts_max_skew=pods.ts_max_skew,
+                ts_when=pods.ts_when, ts_sel_atoms=pods.ts_sel_atoms,
+                ts_valid=pods.ts_valid, ia_key=pods.ia_key,
+                ia_sel_atoms=pods.ia_sel_atoms, ia_anti=pods.ia_anti,
+                ia_required=pods.ia_required, ia_weight=pods.ia_weight,
+                ia_valid=pods.ia_valid, group=pods.group, valid=pods.valid,
+            ),
+            running=RunningPodArrays(
+                node_idx=run_node, requests=run_req, priority=run_prio,
+                slack=run_slack, label_pairs=run_lp, label_keys=run_lk,
+                valid=run_valid,
+            ),
+            atoms=AtomTable(key=atom_key, op=atom_op, pairs=atom_pairs,
+                            num=atom_num, valid=atom_valid),
+            taint_effect=taint_effect,
+            group_min_member=group_min,
+        )
+        meta = SnapshotMeta(
+            node_names=[n["name"] for n in self._nodes],
+            pod_names=[p["name"] for p in self._pods],
+            n_nodes=n_nodes, n_pods=n_pods, n_running=n_running,
+            buckets=bk, group_names=group_list,
+        )
+        return snap, meta
+
+
+class _PodArraysNP:
+    """Scratch numpy buffers for PodArrays during build."""
+
+    def __init__(self, bk: Buckets, R: int):
+        P = bk.pods
+        self.requests = np.zeros((P, R), np.float32)
+        self.base_priority = np.zeros(P, np.float32)
+        self.slo_target = np.zeros(P, np.float32)
+        self.observed_avail = np.ones(P, np.float32)
+        self.tolerated = np.zeros((P, bk.taint_vocab), bool)
+        self.label_pairs = np.full((P, bk.pod_labels), -1, np.int32)
+        self.label_keys = np.full((P, bk.pod_labels), -1, np.int32)
+        self.req_term_atoms = np.full((P, bk.terms, bk.term_atoms), -1, np.int32)
+        self.req_term_valid = np.zeros((P, bk.terms), bool)
+        self.pref_term_atoms = np.full((P, bk.pref_terms, bk.term_atoms), -1, np.int32)
+        self.pref_term_valid = np.zeros((P, bk.pref_terms), bool)
+        self.pref_weight = np.zeros((P, bk.pref_terms), np.float32)
+        self.ts_key = np.full((P, bk.spread_constraints), -1, np.int32)
+        self.ts_max_skew = np.zeros((P, bk.spread_constraints), np.float32)
+        self.ts_when = np.zeros((P, bk.spread_constraints), np.int8)
+        self.ts_sel_atoms = np.full(
+            (P, bk.spread_constraints, bk.term_atoms), -1, np.int32
+        )
+        self.ts_valid = np.zeros((P, bk.spread_constraints), bool)
+        self.ia_key = np.full((P, bk.affinity_terms), -1, np.int32)
+        self.ia_sel_atoms = np.full((P, bk.affinity_terms, bk.term_atoms), -1, np.int32)
+        self.ia_anti = np.zeros((P, bk.affinity_terms), bool)
+        self.ia_required = np.zeros((P, bk.affinity_terms), bool)
+        self.ia_weight = np.zeros((P, bk.affinity_terms), np.float32)
+        self.ia_valid = np.zeros((P, bk.affinity_terms), bool)
+        self.group = np.full(P, -1, np.int32)
+        self.valid = np.zeros(P, bool)
+
+
+def _ceil_bucket(x: int) -> int:
+    return _next_pow2(max(x, 1))
+
+
+def _tolerates(tol: Toleration, tk: str, tv: str, te: str) -> bool:
+    """Upstream toleration matching (SURVEY.md C2 TaintToleration):
+    empty key + Exists tolerates everything; key must match otherwise;
+    Exists ignores value, Equal compares it; empty effect matches all."""
+    if tol.operator not in ("Exists", "Equal"):
+        raise ValueError(f"bad toleration operator {tol.operator!r}")
+    if tol.key == "":
+        if tol.operator != "Exists":
+            return False
+        key_ok = True
+    else:
+        key_ok = tol.key == tk
+    if not key_ok:
+        return False
+    if tol.operator == "Equal" and tol.value != tv:
+        return False
+    if tol.effect and tol.effect != te:
+        return False
+    return True

@@ -1,0 +1,199 @@
+"""Synthetic cluster snapshot generators (SURVEY.md §4 items 1/6).
+
+Plays the role of upstream scheduler_perf's fake-node/fake-pod fixtures:
+scale and property tests need thousands of nodes with no real cluster.
+Each generator returns a (ClusterSnapshot, SnapshotMeta) pair via
+SnapshotBuilder, so the synthetic data exercises the same interning and
+padding paths as real input.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpusched.config import Buckets, EngineConfig
+from tpusched.snapshot import (
+    MatchExpression,
+    NodeSelectorTerm,
+    PodAffinityTerm,
+    PreferredTerm,
+    SnapshotBuilder,
+    Toleration,
+    TopologySpreadConstraint,
+)
+
+ZONES = ("zone-a", "zone-b", "zone-c", "zone-d")
+NODE_CLASSES = (
+    # (cpu millicores, memory bytes)
+    (4000, 16 << 30),
+    (8000, 32 << 30),
+    (16000, 64 << 30),
+    (32000, 128 << 30),
+)
+
+
+def make_cluster(
+    rng: np.random.Generator,
+    n_pods: int,
+    n_nodes: int,
+    config: EngineConfig | None = None,
+    buckets: Buckets | None = None,
+    initial_utilization: float = 0.3,
+    n_running_per_node: int = 2,
+    with_qos: bool = True,
+    taint_frac: float = 0.0,
+    toleration_frac: float = 0.0,
+    selector_frac: float = 0.0,
+    affinity_frac: float = 0.0,
+    spread_frac: float = 0.0,
+    interpod_frac: float = 0.0,
+    gang_frac: float = 0.0,
+    gang_size: int = 4,
+):
+    """General-purpose random cluster. Fractions control what share of
+    pods/nodes carry each constraint type, so the same generator covers
+    BASELINE configs 1-5 (resource-only through gangs)."""
+    config = config or EngineConfig()
+    b = SnapshotBuilder(config, buckets)
+
+    zones = [ZONES[i % len(ZONES)] for i in range(n_nodes)]
+    for i in range(n_nodes):
+        cpu, mem = NODE_CLASSES[rng.integers(len(NODE_CLASSES))]
+        labels = {
+            "topology.kubernetes.io/zone": zones[i],
+            "kubernetes.io/hostname": f"node-{i}",
+            "disktype": "ssd" if rng.random() < 0.5 else "hdd",
+            "tier": str(rng.integers(0, 4)),
+        }
+        taints = []
+        if rng.random() < taint_frac:
+            taints.append(("dedicated", "batch", "NoSchedule"))
+        if rng.random() < taint_frac / 2:
+            taints.append(("maintenance", "true", "PreferNoSchedule"))
+        b.add_node(
+            f"node-{i}",
+            allocatable={"cpu": float(cpu), "memory": float(mem)},
+            labels=labels,
+            taints=taints,
+        )
+
+    # Background running pods establishing initial utilization + labels
+    # for pairwise constraints.
+    apps = ("web", "db", "cache", "batch")
+    for i in range(n_nodes):
+        for j in range(n_running_per_node):
+            cpu, mem = NODE_CLASSES[0]
+            b.add_running_pod(
+                node=f"node-{i}",
+                requests={
+                    "cpu": float(rng.integers(100, 1 + int(cpu * initial_utilization))),
+                    "memory": float(rng.integers(1 << 28, 1 + int(mem * initial_utilization))),
+                },
+                priority=float(rng.integers(0, 100)),
+                slack=float(rng.uniform(-0.2, 0.3)),
+                labels={"app": apps[int(rng.integers(len(apps)))]},
+            )
+
+    for i in range(n_pods):
+        app = apps[int(rng.integers(len(apps)))]
+        kwargs: dict = {}
+        if rng.random() < toleration_frac:
+            kwargs["tolerations"] = [Toleration("dedicated", "Equal", "batch", "NoSchedule")]
+        if rng.random() < selector_frac:
+            kwargs["node_selector"] = {"disktype": "ssd"}
+        if rng.random() < affinity_frac:
+            kwargs["required_terms"] = [
+                NodeSelectorTerm((MatchExpression("tier", "In", ("0", "1", "2")),))
+            ]
+            kwargs["preferred_terms"] = [
+                PreferredTerm(
+                    weight=float(rng.integers(1, 100)),
+                    term=NodeSelectorTerm((MatchExpression("disktype", "In", ("ssd",)),)),
+                )
+            ]
+        if rng.random() < spread_frac:
+            kwargs["topology_spread"] = [
+                TopologySpreadConstraint(
+                    topology_key="topology.kubernetes.io/zone",
+                    max_skew=2,
+                    when_unsatisfiable=(
+                        "DoNotSchedule" if rng.random() < 0.5 else "ScheduleAnyway"
+                    ),
+                    selector=(MatchExpression("app", "In", (app,)),),
+                )
+            ]
+        if rng.random() < interpod_frac:
+            anti = rng.random() < 0.5
+            kwargs["pod_affinity"] = [
+                PodAffinityTerm(
+                    topology_key="topology.kubernetes.io/zone",
+                    selector=(MatchExpression("app", "In", ("db" if not anti else app,)),),
+                    anti=anti,
+                    required=bool(rng.random() < 0.3),
+                    weight=float(rng.integers(1, 100)),
+                )
+            ]
+        if gang_frac > 0 and rng.random() < gang_frac:
+            kwargs["pod_group"] = f"gang-{i // gang_size}"
+            kwargs["pod_group_min_member"] = gang_size
+        slo = float(rng.choice([0.0, 0.9, 0.95, 0.99])) if with_qos else 0.0
+        b.add_pod(
+            f"pod-{i}",
+            requests={
+                "cpu": float(rng.integers(100, 4000)),
+                "memory": float(rng.integers(1 << 28, 8 << 30)),
+            },
+            priority=float(rng.integers(0, 1000)),
+            slo_target=slo,
+            observed_avail=float(rng.uniform(0.5, 1.0)),
+            labels={"app": app},
+            **kwargs,
+        )
+    return b.build()
+
+
+# -- BASELINE.json config presets (SURVEY.md §6) ----------------------------
+
+
+def config1_kind_like(rng: np.random.Generator, **kw):
+    """QoS-weighted LeastRequested: 100 pods x 10 nodes
+    (BASELINE.json:"configs"[0]; kind-cluster scale)."""
+    return make_cluster(rng, 100, 10, with_qos=True, **kw)
+
+
+def config2_scale(rng: np.random.Generator, n_pods: int = 10_000, n_nodes: int = 5_000, **kw):
+    """NodeResourcesFit + BalancedAllocation at 10k x 5k
+    (BASELINE.json:"configs"[1])."""
+    return make_cluster(rng, n_pods, n_nodes, n_running_per_node=1, **kw)
+
+
+def config3_pairwise(rng: np.random.Generator, n_pods: int = 2_000, n_nodes: int = 500, **kw):
+    """PodTopologySpread + InterPodAffinity (BASELINE.json:"configs"[2])."""
+    kw.setdefault("spread_frac", 0.5)
+    kw.setdefault("interpod_frac", 0.5)
+    return make_cluster(rng, n_pods, n_nodes, **kw)
+
+
+def config4_gangs(rng: np.random.Generator, n_groups: int = 1_000, gang_size: int = 4,
+                  n_nodes: int = 1_000, **kw):
+    """Gang/coscheduling bin-pack: 1k pod-groups all-or-nothing
+    (BASELINE.json:"configs"[3]).
+
+    NOTE: generates the gang *data* (pods.group / group_min_member);
+    all-or-nothing enforcement in the engine lands with SURVEY.md §7
+    phase 5 — until then the solver places members independently."""
+    return make_cluster(
+        rng, n_groups * gang_size, n_nodes, gang_frac=1.0, gang_size=gang_size, **kw
+    )
+
+
+def config5_preemption(rng: np.random.Generator, n_pods: int = 1_000, n_nodes: int = 200, **kw):
+    """Multi-tenant preemption pressure: cluster near-full so most pending
+    pods need victims (BASELINE.json:"configs"[4]).
+
+    NOTE: generates the pressure workload (running pods with QoS slack);
+    the preemption solver itself lands with SURVEY.md §7 phase 5 — until
+    then infeasible pods simply stay unscheduled."""
+    kw.setdefault("initial_utilization", 0.9)
+    kw.setdefault("n_running_per_node", 8)
+    return make_cluster(rng, n_pods, n_nodes, **kw)
