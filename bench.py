@@ -87,7 +87,7 @@ def main():
     fn = {
         "score": lambda: engine._score_jit(snap),
         "score_top1": lambda: engine._score_top1_jit(snap),
-        "solve": lambda: engine._solve_jit(snap),
+        "solve": lambda: engine._solve_packed_jit(snap),
     }[args.what]
     materialize(fn())
     log(f"compile+first-run {time.perf_counter() - t0:.1f}s")

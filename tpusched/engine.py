@@ -68,6 +68,18 @@ class Engine:
             node_sat_t, member_sat_t = _sat_tables(snap)
             return solve_sequential(cfg, snap, node_sat_t, member_sat_t)
 
+        def _solve_packed(snap: ClusterSnapshot):
+            # One flat f32 output = ONE device->host fetch. The transport
+            # (axon tunnel here, gRPC in deployment) pays a fixed round
+            # trip per fetched buffer, which dwarfs the payload cost —
+            # same lesson as SURVEY.md §7 hard part 6. Indices are exact
+            # in f32 (< 2^24).
+            assigned, chosen, used, order = _solve(snap)
+            return jnp.concatenate([
+                assigned.astype(jnp.float32), chosen,
+                order.astype(jnp.float32), used.reshape(-1),
+            ])
+
         def _score(snap: ClusterSnapshot):
             node_sat_t, member_sat_t = _sat_tables(snap)
             return score_batch(cfg, snap, node_sat_t, member_sat_t)
@@ -78,9 +90,13 @@ class Engine:
             best = jnp.argmax(masked, axis=1).astype(jnp.int32)
             any_feasible = jnp.any(feasible, axis=1)
             best = jnp.where(any_feasible, best, -1)
-            return best, jnp.max(masked, axis=1), any_feasible
+            return jnp.stack([
+                best.astype(jnp.float32), jnp.max(masked, axis=1),
+                any_feasible.astype(jnp.float32),
+            ])
 
         self._solve_jit = jax.jit(_solve)
+        self._solve_packed_jit = jax.jit(_solve_packed)
         self._score_jit = jax.jit(_score)
         self._score_top1_jit = jax.jit(_score_top1)
 
@@ -94,12 +110,14 @@ class Engine:
         host shim needs the assignments anyway — the D2H copy is part of
         the schedule cycle."""
         t0 = time.perf_counter()
-        assigned, chosen, used, order = self._solve_jit(snap)
+        buf = np.asarray(self._solve_packed_jit(snap))
+        P = snap.pods.valid.shape[0]
+        N, R = snap.nodes.used.shape
         out = SolveResult(
-            assignment=np.asarray(assigned),
-            chosen_score=np.asarray(chosen),
-            final_used=np.asarray(used),
-            order=np.asarray(order),
+            assignment=buf[:P].astype(np.int32),
+            chosen_score=buf[P : 2 * P],
+            order=buf[2 * P : 3 * P].astype(np.int32),
+            final_used=buf[3 * P :].reshape(N, R),
         )
         out.solve_seconds = time.perf_counter() - t0
         return out
@@ -120,15 +138,17 @@ class Engine:
         node, its score, and feasibility — the decision-ready contract
         the host shim binds on (full matrix stays on device)."""
         t0 = time.perf_counter()
-        best, best_score, any_feasible = self._score_top1_jit(snap)
-        out = (
-            np.asarray(best), np.asarray(best_score), np.asarray(any_feasible)
+        buf = np.asarray(self._score_top1_jit(snap))
+        return (
+            buf[0].astype(np.int32), buf[1], buf[2] > 0,
+            time.perf_counter() - t0,
         )
-        return out + (time.perf_counter() - t0,)
 
     def warmup(self, snap: ClusterSnapshot) -> None:
-        """Trigger compilation for this snapshot's bucket shapes."""
-        self._solve_jit(snap)
+        """Trigger compilation of the serving paths (solve + score_top1)
+        for this snapshot's bucket shapes."""
+        self._solve_packed_jit(snap)
+        self._score_top1_jit(snap)
 
     def put(self, snap: ClusterSnapshot) -> ClusterSnapshot:
         """Explicit host->device transfer (otherwise implicit on call)."""

@@ -397,10 +397,16 @@ class SnapshotBuilder:
             for k, v in p["labels"].items():
                 kid(k); pid(k, v)
 
-        # Buckets.
+        # Buckets: start minimal (size-0 feature axes, whose kernels the
+        # tracer drops entirely) and grow only to observed need, so
+        # snapshots without taints/affinity/etc. don't pay those kernels.
+        # CAVEAT: a feature appearing for the first time changes bucket
+        # shapes and forces a full recompile; serving paths that must not
+        # stall mid-cycle should pass explicit Buckets with floors for
+        # every feature the cluster might use.
         bk = self.buckets
         if bk is None:
-            bk = Buckets.fit(n_pods, n_nodes, n_running)
+            bk = Buckets.minimal(n_pods, n_nodes, n_running)
         need = dict(
             node_labels=max((len(n["labels"]) for n in self._nodes), default=0),
             pod_labels=max(
@@ -460,7 +466,7 @@ class SnapshotBuilder:
         node_lk = np.full((N, bk.node_labels), -1, np.int32)
         node_ln = np.full((N, bk.node_labels), np.nan, np.float32)
         node_t = np.full((N, bk.node_taints), -1, np.int32)
-        node_dom = np.full((N, max(bk.topo_keys, 1)), -1, np.int32)
+        node_dom = np.full((N, bk.topo_keys), -1, np.int32)
         node_valid = np.zeros(N, bool)
         node_index = {}
         for i, nrec in enumerate(self._nodes):
