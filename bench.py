@@ -63,7 +63,8 @@ def main():
     ap.add_argument("--nodes", type=int, default=5_000)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--what", choices=["score", "score_top1", "solve"],
-                    default="score_top1")
+                    default="solve")
+    ap.add_argument("--mode", choices=["fast", "parity"], default="fast")
     args = ap.parse_args()
 
     import jax
@@ -80,7 +81,7 @@ def main():
     log(f"snapshot built in {time.perf_counter() - t0:.1f}s "
         f"buckets=({meta.buckets.pods}x{meta.buckets.nodes})")
 
-    engine = Engine(EngineConfig())
+    engine = Engine(EngineConfig(mode=args.mode))
     snap = engine.put(snap)
 
     t0 = time.perf_counter()

@@ -1,0 +1,121 @@
+"""Fast-mode (round-based batched commit) tests: validity properties on
+contended snapshots, exact sequential parity on non-interacting ones,
+and bounded round counts (SURVEY.md §7 hard parts 1/3)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from tpusched import Engine, EngineConfig
+from tpusched.oracle import Oracle, validate_assignment
+from tpusched.synth import make_cluster
+from tpusched.snapshot import SnapshotBuilder
+
+
+def fast_cfg():
+    return EngineConfig(mode="fast")
+
+
+def check_valid(snap, res, cfg):
+    violations = validate_assignment(
+        snap, cfg, res.assignment, commit_key=res.commit_key
+    )
+    assert violations == [], violations
+
+
+def test_fast_valid_resources_only(rng):
+    snap, _ = make_cluster(rng, 60, 12)
+    cfg = fast_cfg()
+    res = Engine(cfg).solve(snap)
+    check_valid(snap, res, cfg)
+    assert res.rounds < 20
+
+
+def test_fast_valid_overcommitted(rng):
+    snap, _ = make_cluster(rng, 64, 4, initial_utilization=0.7)
+    cfg = fast_cfg()
+    res = Engine(cfg).solve(snap)
+    check_valid(snap, res, cfg)
+    assert (res.assignment == -1).any()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fast_valid_fuzz(seed):
+    rng = np.random.default_rng(2000 + seed)
+    snap, _ = make_cluster(
+        rng,
+        n_pods=int(rng.integers(10, 60)),
+        n_nodes=int(rng.integers(4, 20)),
+        taint_frac=float(rng.uniform(0, 0.5)),
+        toleration_frac=float(rng.uniform(0, 0.5)),
+        selector_frac=float(rng.uniform(0, 0.4)),
+        affinity_frac=float(rng.uniform(0, 0.4)),
+        spread_frac=float(rng.uniform(0, 0.5)),
+        interpod_frac=float(rng.uniform(0, 0.5)),
+    )
+    cfg = fast_cfg()
+    res = Engine(cfg).solve(snap)
+    check_valid(snap, res, cfg)
+
+
+def test_fast_matches_sequential_when_pinned(rng):
+    """Pods pinned to distinct nodes via nodeSelector: decisions cannot
+    interact, so fast mode must equal the oracle exactly."""
+    cfg = fast_cfg()
+    b = SnapshotBuilder(cfg)
+    for i in range(8):
+        b.add_node(f"n{i}", {"cpu": 4000, "memory": 16 << 30},
+                   labels={"slot": str(i)})
+    for i in range(8):
+        b.add_pod(f"p{i}", {"cpu": 500, "memory": 1 << 30},
+                  node_selector={"slot": str(i)})
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+    assert res.rounds <= 3  # one productive round + termination check
+
+
+def test_fast_places_as_many_as_oracle(rng):
+    """On plain resource workloads fast mode should not lose placements
+    vs sequential (it can only reorder who gets which node)."""
+    snap, _ = make_cluster(rng, 48, 12)
+    cfg = fast_cfg()
+    res = Engine(cfg).solve(snap)
+    ora = Oracle(snap, cfg).solve()
+    assert (res.assignment >= 0).sum() >= (ora.assignment >= 0).sum() - 2
+
+
+def test_fast_gang_fields_ignored_until_phase5(rng):
+    # gangs present should not break fast mode (enforcement later)
+    snap, _ = make_cluster(rng, 32, 8, gang_frac=0.5, gang_size=4)
+    cfg = fast_cfg()
+    res = Engine(cfg).solve(snap)
+    check_valid(snap, res, cfg)
+
+
+def test_fast_required_self_affinity_first_pod():
+    """First pod of a self-affine group must schedule (upstream special
+    case), and followers co-locate with it — in both modes."""
+    for mode in ("parity", "fast"):
+        cfg = EngineConfig(mode=mode)
+        b = SnapshotBuilder(cfg)
+        for i in range(4):
+            b.add_node(f"n{i}", {"cpu": 4000, "memory": 16 << 30},
+                       labels={"zone": "ab"[i % 2]})
+        from tpusched.snapshot import MatchExpression, PodAffinityTerm
+        for i in range(3):
+            b.add_pod(
+                f"w{i}", {"cpu": 100, "memory": 1 << 28},
+                labels={"app": "w"},
+                pod_affinity=[PodAffinityTerm(
+                    "zone", (MatchExpression("app", "In", ("w",)),)
+                )],
+            )
+        snap, _ = b.build()
+        res = Engine(cfg).solve(snap)
+        zones = np.asarray(snap.nodes.domain)[:, 0]
+        placed = res.assignment[:3]
+        assert (placed >= 0).all(), f"{mode}: self-affine pods unplaced"
+        assert len(set(zones[placed].tolist())) == 1, f"{mode}: not co-located"

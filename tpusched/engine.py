@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpusched.config import EngineConfig
-from tpusched.kernels.assign import score_batch, solve_sequential
+from tpusched.kernels.assign import score_batch, solve_rounds, solve_sequential
 from tpusched.kernels.atoms import atom_sat
 from tpusched.kernels.pairwise import member_label_sat_t
 from tpusched.snapshot import ClusterSnapshot
@@ -28,6 +28,10 @@ class SolveResult:
     chosen_score: np.ndarray   # [P] f32 (-inf where unschedulable)
     final_used: np.ndarray     # [N, R] f32
     order: np.ndarray          # [P] int32 pop order
+    # [P] commit key: pods with smaller keys committed strictly earlier
+    # (parity: pop-order position; fast: round index). -1 = unplaced.
+    commit_key: np.ndarray | None = None
+    rounds: int = 0            # commit rounds (fast mode; P for parity)
     solve_seconds: float = 0.0
 
 
@@ -53,12 +57,8 @@ class Engine:
     def __init__(self, config: EngineConfig | None = None):
         self.config = config or EngineConfig()
         cfg = self.config
-        if cfg.mode != "parity":
-            raise NotImplementedError(
-                f"mode={cfg.mode!r}: only 'parity' (exact sequential) is "
-                "implemented; 'fast' (round-based batched commit) lands "
-                "with SURVEY.md §7 phase 3"
-            )
+        if cfg.mode not in ("parity", "fast"):
+            raise ValueError(f"mode={cfg.mode!r}: want 'parity' or 'fast'")
         if cfg.tie_break != "first":
             raise NotImplementedError(
                 f"tie_break={cfg.tie_break!r}: only 'first' is implemented"
@@ -66,7 +66,15 @@ class Engine:
 
         def _solve(snap: ClusterSnapshot):
             node_sat_t, member_sat_t = _sat_tables(snap)
-            return solve_sequential(cfg, snap, node_sat_t, member_sat_t)
+            if cfg.mode == "fast":
+                return solve_rounds(cfg, snap, node_sat_t, member_sat_t)
+            a, c, u, o = solve_sequential(cfg, snap, node_sat_t, member_sat_t)
+            # parity commit key = position in pop order (strictly serial)
+            P = a.shape[0]
+            rank = jnp.zeros(P, jnp.int32).at[o].set(
+                jnp.arange(P, dtype=jnp.int32)
+            )
+            return a, c, u, o, rank, jnp.int32(P)
 
         def _solve_packed(snap: ClusterSnapshot):
             # One flat f32 output = ONE device->host fetch. The transport
@@ -74,10 +82,11 @@ class Engine:
             # trip per fetched buffer, which dwarfs the payload cost —
             # same lesson as SURVEY.md §7 hard part 6. Indices are exact
             # in f32 (< 2^24).
-            assigned, chosen, used, order = _solve(snap)
+            assigned, chosen, used, order, commit_key, rounds = _solve(snap)
             return jnp.concatenate([
                 assigned.astype(jnp.float32), chosen,
-                order.astype(jnp.float32), used.reshape(-1),
+                order.astype(jnp.float32), commit_key.astype(jnp.float32),
+                used.reshape(-1), rounds.astype(jnp.float32)[None],
             ])
 
         def _score(snap: ClusterSnapshot):
@@ -117,7 +126,9 @@ class Engine:
             assignment=buf[:P].astype(np.int32),
             chosen_score=buf[P : 2 * P],
             order=buf[2 * P : 3 * P].astype(np.int32),
-            final_used=buf[3 * P :].reshape(N, R),
+            commit_key=buf[3 * P : 4 * P].astype(np.int32),
+            final_used=buf[4 * P : 4 * P + N * R].reshape(N, R),
+            rounds=int(buf[-1]),
         )
         out.solve_seconds = time.perf_counter() - t0
         return out

@@ -1,24 +1,34 @@
-"""Commit loops (SURVEY.md C11) and the one-shot score matrix.
+"""Commit loops (SURVEY.md C11) and the batched score matrix.
 
-`pod_cycle` is one scheduling cycle (Filter + Score + Normalize for one
-pod against all nodes) — the device analogue of the reference's
-`scheduleOne` body (SURVEY.md §3.1). The cycle splits into:
+The scheduling cycle (Filter + Score + Normalize; the device analogue of
+the reference's `scheduleOne` body, SURVEY.md §3.1) splits into:
 
   * a STATIC part (taints, node affinity, their scores, per-pod QoS
-    plugin weights) that depends only on the snapshot — computed once
-    for all pods as [P, N] matrices before any commit loop runs; and
+    plugin weights, signature label-match tables) computed once per
+    snapshot — StaticCtx;
   * a DYNAMIC part (resource fit, LeastRequested, BalancedAllocation,
-    pairwise spread/affinity) that depends on node `used` and on where
-    earlier pods landed — recomputed per step/round.
+    pairwise terms from domain counts) that depends on node `used` and
+    the [S, N] signature counts.
 
-Two drivers wrap it:
-  * solve_sequential — EXACT stock semantics: a lax.scan over pods in
-    dynamic-priority order, each step updating node `used` before the
-    next pod scores (parity mode; SURVEY.md §7 hard part 1).
+Three drivers:
+  * solve_sequential — EXACT stock semantics (parity mode): lax.scan
+    over pods in dynamic-priority order; each step updates `used` and
+    the domain counts before the next pod scores.
+  * solve_rounds — fast mode: optimistic batched rounds. Every pending
+    pod scores against round-start state; commits are resolved per node
+    by a priority-ordered capacity prefix scan; committed pods with
+    pairwise constraints are re-validated against end-of-round counts
+    (self-excluded) and violators are rolled back and marked
+    "conservative" — a conservative pod only commits in a round where it
+    is the globally highest-priority pending pod, which makes its check
+    state exactly sequential. Terminates when a round makes no progress.
+    Matches sequential placements whenever pods' decisions don't interact
+    (the common case); under contention it stays *valid* (capacity
+    respected; pairwise constraints hold against commit-time state) but
+    may order contended pods differently (SURVEY.md §7 hard parts 1/3).
   * score_batch — the ScoreBatch API of the north star: all pods scored
-    at once against the current snapshot (no commits), vmapped over the
-    pod axis — what a Go scheduler calls through the gRPC boundary for
-    NormalizeScore + Bind.
+    at once, no commits — what a Go scheduler calls through the gRPC
+    boundary for NormalizeScore + Bind.
 """
 
 from __future__ import annotations
@@ -44,9 +54,10 @@ class StaticCtx:
     """Snapshot-dependent but state-independent precomputation."""
 
     mask: Any       # [P, N] bool: taints & node affinity & validity
-    aff_ok: Any     # [P, N] bool: node-affinity component alone (pairwise
-                    # kernels need it for spread domain eligibility)
+    aff_ok: Any     # [P, N] bool: node-affinity component alone (spread
+                    # domain eligibility honors it)
     score: Any      # [P, N] f32: w_na*NodeAffinity + w_tt*TaintToleration
+    sig_match: Any  # [S, M+P] bool: signature selector label matches
     w_lr: Any       # [P] f32 per-pod effective plugin weights (QoS)
     w_ba: Any       # [P]
     w_ts: Any       # [P]
@@ -54,7 +65,8 @@ class StaticCtx:
     rw: Any         # [R] resource score weights
 
 
-def precompute_static(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t) -> StaticCtx:
+def precompute_static(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
+                      member_sat_t) -> StaticCtx:
     nodes, pods = snap.nodes, snap.pods
     aff_ok = kfilter.node_affinity_mask(
         node_sat_t, pods.req_term_atoms, pods.req_term_valid
@@ -80,23 +92,56 @@ def precompute_static(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t) -> S
     ).astype(jnp.float32)
     return StaticCtx(
         mask=mask, aff_ok=aff_ok, score=static_score,
+        sig_match=kpair.sig_member_match(snap, member_sat_t),
         w_lr=w["least_requested"], w_ba=w["balanced_allocation"],
         w_ts=w["topology_spread"], w_ia=w["interpod_affinity"],
         rw=jnp.asarray(cfg.score_weights_vector(), jnp.float32),
     )
 
 
-def pod_cycle(cfg: EngineConfig, snap: ClusterSnapshot, member_sat_t,
-              static: StaticCtx, p, used, assigned):
-    """Dynamic Filter + Score for pod p (traced index): returns
-    (feasible [N] bool, total weighted score [N] f32). Grouping of the
-    score sum mirrors oracle.feasible_and_score exactly."""
+def batched_cycle(cfg: EngineConfig, snap: ClusterSnapshot,
+                  static: StaticCtx, used, counts,
+                  exclude_self_node=None):
+    """Full [P, N] Filter + Score against the given state. Score-sum
+    grouping mirrors oracle.feasible_and_score exactly."""
+    nodes = snap.nodes
+    nvalid = nodes.valid
+    base_feasible = static.mask & kfilter.resource_fit(
+        nodes.allocatable, used, snap.pods.requests
+    )
+    base_score = (
+        static.w_lr[:, None]
+        * kscore.least_requested(nodes.allocatable, used, snap.pods.requests, static.rw)
+        + static.w_ba[:, None]
+        * kscore.balanced_allocation(nodes.allocatable, used, snap.pods.requests, static.rw)
+        + static.score
+    )
+    if snap.sigs.key.shape[0] == 0:
+        # No pairwise constraints anywhere (trace-time fact): penalty is
+        # 0 everywhere -> inverse_normalize == 100, raw 0 -> minmax == 0,
+        # matching the oracle's formulas exactly without [P, N] work.
+        score = base_score + static.w_ts[:, None] * 100.0
+        return base_feasible, score.astype(jnp.float32)
+    spread_ok, spread_pen, ia_ok, ia_raw = kpair.pairwise_from_counts(
+        snap, counts, static.aff_ok, static.sig_match, exclude_self_node
+    )
+    feasible = base_feasible & spread_ok & ia_ok
+    score = (
+        base_score
+        + static.w_ts[:, None] * kscore.inverse_normalize(spread_pen, nvalid)
+        + static.w_ia[:, None] * kscore.minmax_normalize(ia_raw, nvalid)
+    ).astype(jnp.float32)
+    return feasible, score
+
+
+def pod_cycle(cfg: EngineConfig, snap: ClusterSnapshot, static: StaticCtx,
+              p, used, counts):
+    """Single-pod [N] Filter + Score (sequential scan body)."""
     nodes = snap.nodes
     nvalid = nodes.valid
     req = snap.pods.requests[p]
-
-    spread_ok, spread_pen, ia_ok, ia_raw = kpair.pod_pairwise(
-        snap, member_sat_t, p, assigned, static.aff_ok[p]
+    spread_ok, spread_pen, ia_ok, ia_raw = kpair.pairwise_row(
+        snap, counts, static.sig_match, p, static.aff_ok[p]
     )
     feasible = (
         static.mask[p]
@@ -128,24 +173,26 @@ def pop_order(cfg: EngineConfig, snap: ClusterSnapshot):
 def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
                      node_sat_t, member_sat_t):
     """Exact sequential commit: stock scheduleOne semantics on device."""
-    static = precompute_static(cfg, snap, node_sat_t)
+    static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
     P = snap.pods.valid.shape[0]
     order = pop_order(cfg, snap)
+    counts0 = kpair.sig_counts(
+        snap, static.sig_match, jnp.full(P, -1, jnp.int32)
+    )
 
     def body(carry, p):
-        used, assigned = carry
-        feasible, score = pod_cycle(
-            cfg, snap, member_sat_t, static, p, used, assigned
-        )
+        used, assigned, counts = carry
+        feasible, score = pod_cycle(cfg, snap, static, p, used, counts)
         masked = jnp.where(feasible, score, NEG_INF)
         n = jnp.argmax(masked)  # tie-break: first max (EngineConfig.tie_break)
         commit = jnp.any(feasible)
         used = used.at[n].add(jnp.where(commit, snap.pods.requests[p], 0.0))
+        counts = kpair.counts_add_pod(snap, counts, static.sig_match, p, n, commit)
         assigned = assigned.at[p].set(jnp.where(commit, n, -1).astype(jnp.int32))
-        return (used, assigned), jnp.where(commit, masked[n], NEG_INF)
+        return (used, assigned, counts), jnp.where(commit, masked[n], NEG_INF)
 
-    init = (snap.nodes.used, jnp.full(P, -1, jnp.int32))
-    (used, assigned), chosen_in_order = jax.lax.scan(body, init, order)
+    init = (snap.nodes.used, jnp.full(P, -1, jnp.int32), counts0)
+    (used, assigned, _), chosen_in_order = jax.lax.scan(body, init, order)
     chosen = jnp.full(P, NEG_INF, jnp.float32).at[order].set(chosen_in_order)
     return assigned, chosen, used, order
 
@@ -154,13 +201,247 @@ def score_batch(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
                 member_sat_t):
     """One-shot [P, N] feasibility + scores against the current snapshot
     (no commits): the ScoreBatch gRPC surface (SURVEY.md C12)."""
-    static = precompute_static(cfg, snap, node_sat_t)
+    static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
     P = snap.pods.valid.shape[0]
-    no_assigned = jnp.full(P, -1, jnp.int32)
+    counts0 = kpair.sig_counts(
+        snap, static.sig_match, jnp.full(P, -1, jnp.int32)
+    )
+    return batched_cycle(cfg, snap, static, snap.nodes.used, counts0)
 
-    def one(p):
-        return pod_cycle(
-            cfg, snap, member_sat_t, static, p, snap.nodes.used, no_assigned
+
+def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
+                 node_sat_t, member_sat_t):
+    """Fast mode: optimistic batched rounds with validate-and-rollback.
+    Returns (assigned, chosen, used, order, rounds)."""
+    static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
+    pods, nodes = snap.pods, snap.nodes
+    P = pods.valid.shape[0]
+    N = nodes.valid.shape[0]
+    order = pop_order(cfg, snap)
+    rank = jnp.zeros(P, jnp.int32).at[order].set(jnp.arange(P, dtype=jnp.int32))
+    has_pair = jnp.any(pods.ts_valid, axis=1) | jnp.any(pods.ia_valid, axis=1)
+    counts0 = kpair.sig_counts(
+        snap, static.sig_match, jnp.full(P, -1, jnp.int32)
+    )
+    BIG = jnp.int32(2**31 - 1)
+    max_rounds = 2 * P + 8
+
+    def cond(state):
+        progress, r = state[-2], state[-1]
+        return progress & (r < max_rounds)
+
+    K = min(8, N)
+
+    def body(state):
+        used, assigned, counts, conservative, chosen, round_of, _, r = state
+        pending = assigned == -1
+
+        feasible, score = batched_cycle(cfg, snap, static, used, counts)
+        feasible &= pending[:, None]
+        masked = jnp.where(feasible, score, NEG_INF)
+        want = jnp.any(feasible, axis=1)
+
+        # Conservative pods commit only when globally first among wanting
+        # pending pods (their check state is then exactly sequential).
+        first_rank = jnp.min(jnp.where(want, rank, BIG))
+        allowed = want & (~conservative | (rank == first_rank))
+
+        # Load-balancing scores give every pod nearly the SAME global
+        # node ranking, so per-pod argmax/top-K concentrates all commits
+        # on the few best nodes and serializes rounds. Deal pods into
+        # the ranked node list by estimated slot capacity instead: the
+        # q-th pending pod (by priority) targets the node where the
+        # cumulative slot estimate first exceeds q. Pods whose dealt
+        # node is infeasible for them (taints/affinity/constraints) fall
+        # back to their own top-K; the capacity-prefix commit below
+        # corrects any estimate error, and misses retry next round.
+        allowed_col = allowed[:, None]
+        n_allowed = jnp.maximum(allowed.sum(), 1)
+        desir = jnp.sum(
+            jnp.where(feasible & allowed_col, score, 0.0), axis=0
+        ) / n_allowed                                            # [N]
+        desir = jnp.where(
+            jnp.any(feasible & allowed_col, axis=0), desir, NEG_INF
+        )
+        node_order = jnp.argsort(-desir)                         # [N]
+        remaining = jnp.maximum(nodes.allocatable - used, 0.0)   # [N, R]
+        remaining = jnp.where(
+            jnp.isfinite(desir)[:, None], remaining, 0.0
+        )
+        # Deal by request MASS, per resource: the q-th pod (priority
+        # order) lands on the first ranked node whose cumulative
+        # remaining capacity covers the cumulative demand of pods
+        # 0..q, for every resource. Handles heterogeneous request
+        # sizes far better than mean-slot estimates.
+        q_perm = jnp.argsort(jnp.where(allowed, rank, BIG))
+        q_of = jnp.zeros(P, jnp.int32).at[q_perm].set(
+            jnp.arange(P, dtype=jnp.int32)
+        )
+        dem_sorted = jnp.where(
+            allowed[q_perm][:, None], pods.requests[q_perm], 0.0
+        )
+        cum_dem = jnp.cumsum(dem_sorted, axis=0)                 # [P, R]
+        my_dem = cum_dem[q_of]                                   # [P, R] own-incl.
+        cum_rem = jnp.cumsum(remaining[node_order], axis=0)      # [N, R]
+        R = cum_rem.shape[1]
+        pos = jnp.zeros(P, jnp.int32)
+        for r in range(R):
+            pos = jnp.maximum(
+                pos,
+                jnp.searchsorted(
+                    cum_rem[:, r], my_dem[:, r], side="left"
+                ).astype(jnp.int32),
+            )
+        dealt = node_order[jnp.clip(pos, 0, N - 1)].astype(jnp.int32)
+        dealt_ok = jnp.take_along_axis(
+            feasible, dealt[:, None], axis=1
+        )[:, 0]
+        # Candidate list: dealt node first (when feasible), then the
+        # pod's own top-K by score; K capacity sub-iterations.
+        topv, topi = jax.lax.top_k(masked, K)                    # [P, K]
+        dealt_score = jnp.take_along_axis(masked, dealt[:, None], axis=1)
+        topi = jnp.concatenate(
+            [jnp.where(dealt_ok, dealt, topi[:, 0])[:, None], topi], axis=1
+        )
+        topv = jnp.concatenate(
+            [jnp.where(dealt_ok, dealt_score[:, 0], topv[:, 0])[:, None], topv],
+            axis=1,
         )
 
-    return jax.vmap(one)(jnp.arange(P))
+        KC = K + 1  # dealt candidate + top-K fallbacks
+
+        def sub_cond(sub_state):
+            used_j, choice_j, ptr = sub_state
+            ptr_c = jnp.clip(ptr, 0, KC - 1)
+            cand_ok = jnp.take_along_axis(topv, ptr_c[:, None], axis=1)[:, 0] > NEG_INF
+            return jnp.any(allowed & (choice_j < 0) & (ptr < KC) & cand_ok)
+
+        def sub(sub_state):
+            used_j, choice_j, ptr = sub_state
+            ptr_c = jnp.clip(ptr, 0, KC - 1)
+            cand = jnp.take_along_axis(topi, ptr_c[:, None], axis=1)[:, 0]
+            cand_ok = jnp.take_along_axis(topv, ptr_c[:, None], axis=1)[:, 0] > NEG_INF
+            active = allowed & (choice_j < 0) & (ptr < KC) & cand_ok
+            # Capacity-prefix conflict resolution per node, in priority
+            # order: sort by (candidate node, rank); within each node's
+            # segment commit the longest prefix whose cumulative
+            # requests fit the node's remaining capacity.
+            cand_m = jnp.where(active, cand, N)  # inactive -> sentinel seg
+            perm = jnp.lexsort((rank, cand_m))
+            cand_s = cand_m[perm]
+            act_s = active[perm]
+            req_s = jnp.where(act_s[:, None], pods.requests[perm], 0.0)
+            cum = jnp.cumsum(req_s, axis=0)                      # [P, R]
+            idx = jnp.arange(P, dtype=jnp.int32)
+            boundary = jnp.concatenate(
+                [jnp.ones(1, bool), cand_s[1:] != cand_s[:-1]]
+            )
+            seg_start = jax.lax.cummax(jnp.where(boundary, idx, 0))
+            offset = jnp.where(
+                (seg_start > 0)[:, None],
+                cum[jnp.clip(seg_start - 1, 0, None)], 0.0,
+            )
+            within = cum - offset                                # incl. own
+            cap_node = jnp.clip(cand_s, 0, N - 1)
+            fits = jnp.all(
+                used_j[cap_node] + within <= nodes.allocatable[cap_node],
+                axis=-1,
+            ) & act_s
+            bad = act_s & ~fits
+            last_bad = jax.lax.cummax(jnp.where(bad, idx, -1))
+            prefix_ok = last_bad < seg_start
+            commit_s = fits & prefix_ok
+            commit_j = jnp.zeros(P, bool).at[perm].set(commit_s)
+            nofit = jnp.zeros(P, bool).at[perm].set(bad)
+            used_j = used_j.at[jnp.clip(cand, 0, N - 1)].add(
+                jnp.where(commit_j[:, None], pods.requests, 0.0)
+            )
+            choice_j = jnp.where(commit_j, cand, choice_j)
+            # Only pods whose own node is full advance their pointer;
+            # prefix-blocked pods retry the same node next sub-step.
+            # Progress: every sub-step either commits or advances a
+            # pointer, and pointers are bounded by KC, so the while
+            # terminates; it usually exits after 2-3 steps.
+            ptr = jnp.where(
+                nofit, ptr + 1, jnp.where(commit_j, KC, ptr)
+            )
+            return used_j, choice_j, ptr
+
+        used2, choice, _ = jax.lax.while_loop(
+            sub_cond, sub,
+            (used, jnp.full(P, -1, jnp.int32), jnp.zeros(P, jnp.int32)),
+        )
+        commit = choice >= 0
+        chosen_val = jnp.take_along_axis(
+            masked, jnp.clip(choice, 0, N - 1)[:, None], axis=1
+        )[:, 0]
+        if snap.sigs.key.shape[0] == 0:
+            # No pairwise constraints (trace-time): counts are empty and
+            # no commit can violate anything — skip validation wholesale.
+            assigned2 = jnp.where(commit, choice, assigned)
+            chosen2 = jnp.where(commit, chosen_val, chosen)
+            round_of2 = jnp.where(commit, r, round_of)
+            all_done = jnp.all((assigned2 >= 0) | ~pods.valid)
+            progress = jnp.any(commit) & ~all_done
+            return (used2, assigned2, counts, conservative, chosen2,
+                    round_of2, progress, r + 1)
+        counts2 = kpair.counts_commit_pods(
+            snap, counts, static.sig_match, choice, commit
+        )
+
+        # Validate committed pairwise pods against end-of-round counts
+        # (self-excluded); roll back violators and mark conservative.
+        # Iterated to a fixpoint: a revert can strip the match that
+        # satisfied another same-round pod's positive affinity, so each
+        # pass re-checks the still-kept pods until no new violations
+        # (each pass reverts >= 1 pod, so it terminates).
+        def vcond(vs):
+            _, _, _, again = vs
+            return again
+
+        def vbody(vs):
+            counts_v, used_v, kept_v, _ = vs
+            spread_ok2, _, ia_ok2, _ = kpair.pairwise_from_counts(
+                snap, counts_v, static.aff_ok, static.sig_match,
+                exclude_self_node=jnp.where(kept_v, choice, -1),
+            )
+            ok_at_choice = jnp.take_along_axis(
+                spread_ok2 & ia_ok2,
+                jnp.clip(choice, 0, N - 1)[:, None], axis=1,
+            )[:, 0]
+            new_viol = kept_v & has_pair & ~ok_at_choice
+            used_v = used_v.at[jnp.clip(choice, 0, N - 1)].add(
+                -jnp.where(new_viol[:, None], pods.requests, 0.0)
+            )
+            counts_v = kpair.counts_commit_pods(
+                snap, counts_v, static.sig_match, choice, new_viol, sign=-1.0
+            )
+            return counts_v, used_v, kept_v & ~new_viol, jnp.any(new_viol)
+
+        any_pair_committed = jnp.any(commit & has_pair)
+        counts3, used3, kept, _ = jax.lax.while_loop(
+            vcond, vbody, (counts2, used2, commit, any_pair_committed)
+        )
+        viol = commit & ~kept
+        assigned2 = jnp.where(kept, choice, assigned)
+        chosen2 = jnp.where(kept, chosen_val, chosen)
+        new_conservative = viol & ~conservative
+        conservative2 = conservative | viol
+        round_of2 = jnp.where(kept, r, round_of)
+        all_done = jnp.all((assigned2 >= 0) | ~pods.valid)
+        progress = (jnp.any(kept) | jnp.any(new_conservative)) & ~all_done
+        return (used3, assigned2, counts3, conservative2, chosen2,
+                round_of2, progress, r + 1)
+
+    init = (
+        nodes.used, jnp.full(P, -1, jnp.int32), counts0,
+        jnp.zeros(P, bool), jnp.full(P, NEG_INF, jnp.float32),
+        jnp.full(P, -1, jnp.int32), jnp.array(True), jnp.int32(0),
+    )
+    used, assigned, _, _, chosen, round_of, _, rounds = jax.lax.while_loop(
+        cond, body, init
+    )
+    # Commit key for external validity audits: pods committed in earlier
+    # rounds precede later ones; within a round all commits share a key
+    # (the engine validated them against end-of-round state).
+    return assigned, chosen, used, order, round_of, rounds

@@ -1,18 +1,25 @@
 """PodTopologySpread + InterPodAffinity kernels (SURVEY.md C6, C7).
 
-These are the pairwise constraints: where pod p may land depends on where
-*other* pods (running + already-committed pending) sit. Members are the
-concatenation [running | pending], with pending membership switched on as
-pods commit — so the same kernel serves both the sequential parity scan
-(assigned grows step by step) and one-shot ScoreBatch (assigned = none).
+Pairwise constraints: where pod p may land depends on where *other* pods
+(running + already-committed pending) sit. The scalable formulation works
+per SIGNATURE, not per pod: SnapshotBuilder interns every distinct
+(topology key, pod-label selector) pair into a SigTable entry, and the
+kernels maintain
 
-Domain counting uses scatter-adds into an [N]-sized domain-count buffer
-(domain ids are interned per topology key by SnapshotBuilder and are
-always < number of nodes), which keeps every shape static.
+    counts[s, d] = number of matching member pods in domain d of
+                   signature s's topology key
 
-`pod_pairwise` evaluates ONE pod p (traced index) against all nodes; the
-batched/ring variant for large P lands in phase 4 (SURVEY.md §2.3 SP/CP
-row: block the [P, P] matrix and rotate pod blocks with lax.ppermute).
+as an [S, N] matrix (domain ids are < number of nodes by construction).
+Counting is ONE scatter over members per evaluation — independent of P —
+and per-pod constraint checks are gathers from counts. Commit loops
+update counts incrementally as pods place (counts_commit_pods /
+counts_add_pod) instead of recounting members.
+
+Members are the concatenation [running | pending]; a pending pod's
+member column activates when it commits. Self-exclusion: a pod's own
+contribution must not count toward its own constraint check (upstream
+checks before adding the pod) — `exclude_self_node` handles that for
+post-commit validation.
 """
 
 from __future__ import annotations
@@ -20,16 +27,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from tpusched.config import DO_NOT_SCHEDULE
-from tpusched.kernels.atoms import gather_selector_match
+from tpusched.kernels.atoms import gather_term_sat
 from tpusched.snapshot import ClusterSnapshot
-
-
-def member_arrays(snap: ClusterSnapshot, assigned):
-    """Member (running + pending) node index and validity.
-    assigned: [P] int32 node or -1. Returns ([M+P] int32, [M+P] bool)."""
-    node = jnp.concatenate([snap.running.node_idx, assigned])
-    valid = jnp.concatenate([snap.running.valid, assigned >= 0])
-    return node, valid
 
 
 def member_label_sat_t(snap: ClusterSnapshot, sat_fn):
@@ -40,74 +39,227 @@ def member_label_sat_t(snap: ClusterSnapshot, sat_fn):
     return sat_fn(lp, lk).T
 
 
-def _domain_counts(member_dom_ok, match, n_buckets):
-    """Scatter-count matching members into their domains: [N] f32."""
-    dom = jnp.clip(member_dom_ok, 0, None)
-    contrib = (match & (member_dom_ok >= 0)).astype(jnp.float32)
-    return jnp.zeros(n_buckets, jnp.float32).at[dom].add(contrib)
+def sig_member_match(snap: ClusterSnapshot, member_sat_t):
+    """[S, M+P] bool: does member x's label set match signature s's
+    selector. Label-only (validity applied at count time). A signature
+    with zero atoms matches everything (upstream empty label selector)."""
+    match = gather_term_sat(member_sat_t, snap.sigs.atoms)   # [S, M+P]
+    return match & snap.sigs.valid[:, None]
 
 
-def pod_pairwise(
-    snap: ClusterSnapshot,
-    member_sat_t,          # [A, M+P]
-    p,                     # traced pod index
-    assigned,              # [P] int32
-    node_affinity_ok_p,    # [N] bool — pod p's required-affinity mask
-):
-    """Returns (spread_ok [N], spread_penalty [N], ia_ok [N], ia_raw [N])
-    for pod p given currently-committed members."""
-    nodes, pods = snap.nodes, snap.pods
-    dom = nodes.domain                                   # [N, TK]
-    N = dom.shape[0]
-    member_node, member_valid = member_arrays(snap, assigned)
-    # Member's domain per topology key: [M+P, TK] (-1 when member or its
-    # node lacks the key).
-    mdom = jnp.where(
-        (member_node >= 0)[:, None],
-        dom[jnp.clip(member_node, 0, None)],
-        -1,
+def sig_domains(snap: ClusterSnapshot):
+    """[S, N] int32: domain id of node n under signature s's topology
+    key; -1 where the node lacks the key (or the sig slot is padding)."""
+    dom = snap.nodes.domain                                  # [N, TK]
+    key = jnp.clip(snap.sigs.key, 0, None)
+    dom_s = dom[:, key].T if dom.shape[1] else jnp.full(
+        (snap.sigs.key.shape[0], dom.shape[0]), -1, jnp.int32
     )
+    return jnp.where(snap.sigs.valid[:, None], dom_s, -1)
 
-    spread_ok = jnp.ones(N, bool)
-    spread_penalty = jnp.zeros(N, jnp.float32)
+
+def sig_counts(snap: ClusterSnapshot, sig_match, assigned):
+    """[S, N] f32 domain counts from scratch for the given assignment
+    state (used at loop init and in tests; loops update incrementally)."""
+    node = jnp.concatenate([snap.running.node_idx, assigned])
+    valid = jnp.concatenate([snap.running.valid, assigned >= 0])
+    dom_s = sig_domains(snap)                                # [S, N]
+    S, N = dom_s.shape
+    mdom = jnp.where(
+        valid[None, :], dom_s[:, jnp.clip(node, 0, None)], -1
+    )                                                        # [S, M+P]
+    contrib = (sig_match & valid[None, :] & (mdom >= 0)).astype(jnp.float32)
+    rows = jnp.broadcast_to(jnp.arange(S)[:, None], mdom.shape)
+    return jnp.zeros((S, N), jnp.float32).at[
+        rows, jnp.clip(mdom, 0, None)
+    ].add(contrib)
+
+
+def counts_commit_pods(snap: ClusterSnapshot, counts, sig_match, choice,
+                       commit_mask, sign=1.0):
+    """Add (sign=+1) or roll back (sign=-1) the contribution of pending
+    pods committed to choice[p] where commit_mask[p]."""
+    M = snap.running.valid.shape[0]
+    dom_s = sig_domains(snap)                                # [S, N]
+    pod_dom = dom_s[:, jnp.clip(choice, 0, None)]            # [S, P]
+    contrib = (
+        sig_match[:, M:] & commit_mask[None, :] & (pod_dom >= 0)
+    ).astype(jnp.float32) * sign
+    S = dom_s.shape[0]
+    rows = jnp.broadcast_to(jnp.arange(S)[:, None], pod_dom.shape)
+    return counts.at[rows, jnp.clip(pod_dom, 0, None)].add(contrib)
+
+
+def counts_add_pod(snap: ClusterSnapshot, counts, sig_match, p, n, on):
+    """Incremental update for one pod p committing to node n (traced
+    scalars); `on` gates the add (False -> no-op). Used by the
+    sequential scan."""
+    M = snap.running.valid.shape[0]
+    dom_s = sig_domains(snap)                                # [S, N]
+    S = dom_s.shape[0]
+    dom_n = dom_s[:, n]                                      # [S]
+    col = sig_match[:, M + p]                                # [S]
+    contrib = (col & (dom_n >= 0) & on).astype(jnp.float32)
+    return counts.at[jnp.arange(S), jnp.clip(dom_n, 0, None)].add(contrib)
+
+
+# ---------------------------------------------------------------------------
+# Constraint evaluation from counts.
+# ---------------------------------------------------------------------------
+
+
+def _self_adj(snap, sig_match, dom_s, s, exclude_self_node, pod_idx):
+    """Count adjustments removing each pod's own contribution when it is
+    assumed placed on exclude_self_node[p] (post-commit validation:
+    upstream checks a pod's constraints BEFORE adding the pod itself).
+    Returns (adj [P, N] f32, active [P] f32) — per-node and total."""
+    if exclude_self_node is None:
+        return 0.0, 0.0
+    M = snap.running.valid.shape[0]
+    esn = exclude_self_node                                   # [P]
+    own_dom = dom_s[s, jnp.clip(esn, 0, None)]                # [P]
+    self_match = sig_match[s, M + pod_idx]                    # [P]
+    active = (self_match & (esn >= 0) & (own_dom >= 0))       # [P]
+    adj = (
+        active[:, None] & (dom_s[s] == own_dom[:, None])
+    ).astype(jnp.float32)
+    return adj, active.astype(jnp.float32)
+
+
+def pairwise_from_counts(snap: ClusterSnapshot, counts, aff_ok,
+                         sig_match=None, exclude_self_node=None):
+    """Batched [P, N] evaluation of all spread/inter-pod constraints from
+    the current domain counts.
+
+    aff_ok: [P, N] required-node-affinity mask (spread domain-discovery
+    honors it: upstream NodeAffinityPolicy Honor).
+    exclude_self_node: optional [P] int32 — for post-commit validation,
+    remove pod p's own contribution assuming it sits on that node
+    (requires sig_match).
+
+    Returns (spread_ok, spread_penalty, ia_ok, ia_raw), each [P, N].
+    """
+    if exclude_self_node is not None and sig_match is None:
+        raise ValueError("exclude_self_node requires sig_match")
+    nodes, pods = snap.nodes, snap.pods
+    dom_s = sig_domains(snap)                                # [S, N]
+    node_count_sig = jnp.take_along_axis(
+        counts, jnp.clip(dom_s, 0, None), axis=1
+    )                                                        # [S, N]
+    has_key_sig = dom_s >= 0
+    max_count_sig = jnp.max(
+        jnp.where(has_key_sig, node_count_sig, 0.0), axis=1
+    )                                                        # [S]
+    P = pods.valid.shape[0]
+    N = nodes.valid.shape[0]
+    pod_idx = jnp.arange(P)
+
+    spread_ok = jnp.ones((P, N), bool)
+    spread_pen = jnp.zeros((P, N), jnp.float32)
     C = pods.ts_key.shape[1]
     for c in range(C):  # static unroll; C is a small bucket
-        valid_c = pods.ts_valid[p, c]
-        key = jnp.clip(pods.ts_key[p, c], 0, None)
-        match = gather_selector_match(
-            member_sat_t, pods.ts_sel_atoms[p, c], member_valid
+        s = jnp.clip(pods.ts_sig[:, c], 0, None)             # [P]
+        valid_c = pods.ts_valid[:, c]
+        adj, _ = _self_adj(snap, sig_match, dom_s, s, exclude_self_node, pod_idx)
+        nc = node_count_sig[s] - adj                         # [P, N]
+        hk = has_key_sig[s]
+        eligible = nodes.valid[None, :] & aff_ok & hk
+        min_c = jnp.min(jnp.where(eligible, nc, jnp.inf), axis=1)
+        min_c = jnp.where(jnp.any(eligible, axis=1), min_c, 0.0)
+        dns = pods.ts_when[:, c] == DO_NOT_SCHEDULE
+        ok_c = hk & (
+            nc + 1.0 - min_c[:, None] <= pods.ts_max_skew[:, c][:, None]
         )
-        counts = _domain_counts(mdom[:, key], match, N)
-        has_key = dom[:, key] >= 0
-        node_count = counts[jnp.clip(dom[:, key], 0, None)]
-        eligible = nodes.valid & node_affinity_ok_p & has_key
-        min_count = jnp.min(jnp.where(eligible, node_count, jnp.inf))
-        min_count = jnp.where(jnp.any(eligible), min_count, 0.0)
-        max_count = jnp.max(jnp.where(has_key, node_count, 0.0))
+        spread_ok &= jnp.where((valid_c & dns)[:, None], ok_c, True)
+        mx = jnp.where(
+            hk, nc, max_count_sig[s][:, None]
+        )
+        spread_pen += jnp.where((valid_c & ~dns)[:, None], mx, 0.0)
+
+    ia_ok = jnp.ones((P, N), bool)
+    ia_raw = jnp.zeros((P, N), jnp.float32)
+    IT = pods.ia_key.shape[1]
+    M = snap.running.valid.shape[0]
+    total_sig = counts.sum(axis=1)                           # [S]
+    for t in range(IT):
+        s = jnp.clip(pods.ia_sig[:, t], 0, None)
+        valid_t = pods.ia_valid[:, t]
+        adj, active = _self_adj(snap, sig_match, dom_s, s,
+                                exclude_self_node, pod_idx)
+        nc = node_count_sig[s] - adj
+        hk = has_key_sig[s]
+        node_has = hk & (nc > 0)
+        anti = pods.ia_anti[:, t]
+        req = pods.ia_required[:, t]
+        # Upstream special case for required positive affinity: if no
+        # pod anywhere matches the selector but the incoming pod matches
+        # its own selector, any node with the topology key satisfies.
+        if sig_match is not None:
+            self_match = sig_match[s, M + pod_idx]           # [P]
+            all_zero = (total_sig[s] - active) <= 0          # [P]
+            pos_ok = node_has | ((all_zero & self_match)[:, None] & hk)
+        else:
+            pos_ok = node_has
+        ok_t = jnp.where(anti[:, None], ~node_has, pos_ok)
+        ia_ok &= jnp.where((valid_t & req)[:, None], ok_t, True)
+        w = jnp.where(anti, -pods.ia_weight[:, t], pods.ia_weight[:, t])
+        ia_raw += jnp.where(
+            (valid_t & ~req)[:, None] & node_has, w[:, None], 0.0
+        )
+    return spread_ok, spread_pen, ia_ok, ia_raw
+
+
+def pairwise_row(snap: ClusterSnapshot, counts, sig_match, p, aff_ok_p):
+    """Single-pod [N] variant for the sequential scan: same math as
+    pairwise_from_counts restricted to traced pod index p (no
+    self-exclusion needed: the scan checks before committing)."""
+    nodes, pods = snap.nodes, snap.pods
+    dom_s = sig_domains(snap)                                # [S, N]
+    node_count_sig = jnp.take_along_axis(
+        counts, jnp.clip(dom_s, 0, None), axis=1
+    )
+    has_key_sig = dom_s >= 0
+    max_count_sig = jnp.max(
+        jnp.where(has_key_sig, node_count_sig, 0.0), axis=1
+    )
+    N = nodes.valid.shape[0]
+
+    spread_ok = jnp.ones(N, bool)
+    spread_pen = jnp.zeros(N, jnp.float32)
+    C = pods.ts_key.shape[1]
+    for c in range(C):
+        s = jnp.clip(pods.ts_sig[p, c], 0, None)
+        valid_c = pods.ts_valid[p, c]
+        nc = node_count_sig[s]                               # [N]
+        hk = has_key_sig[s]
+        eligible = nodes.valid & aff_ok_p & hk
+        min_c = jnp.min(jnp.where(eligible, nc, jnp.inf))
+        min_c = jnp.where(jnp.any(eligible), min_c, 0.0)
         dns = pods.ts_when[p, c] == DO_NOT_SCHEDULE
-        ok_c = has_key & (node_count + 1.0 - min_count <= pods.ts_max_skew[p, c])
+        ok_c = hk & (nc + 1.0 - min_c <= pods.ts_max_skew[p, c])
         spread_ok &= jnp.where(valid_c & dns, ok_c, True)
-        pen_c = jnp.where(has_key, node_count, max_count)
-        spread_penalty += jnp.where(valid_c & ~dns, pen_c, 0.0)
+        pen_c = jnp.where(hk, nc, max_count_sig[s])
+        spread_pen += jnp.where(valid_c & ~dns, pen_c, 0.0)
 
     ia_ok = jnp.ones(N, bool)
     ia_raw = jnp.zeros(N, jnp.float32)
     IT = pods.ia_key.shape[1]
+    M = snap.running.valid.shape[0]
     for t in range(IT):
+        s = jnp.clip(pods.ia_sig[p, t], 0, None)
         valid_t = pods.ia_valid[p, t]
-        key = jnp.clip(pods.ia_key[p, t], 0, None)
-        match = gather_selector_match(
-            member_sat_t, pods.ia_sel_atoms[p, t], member_valid
-        )
-        counts = _domain_counts(mdom[:, key], match, N)
-        has_key = dom[:, key] >= 0
-        node_has = has_key & (counts[jnp.clip(dom[:, key], 0, None)] > 0)
+        nc = node_count_sig[s]
+        hk = has_key_sig[s]
+        node_has = hk & (nc > 0)
         anti = pods.ia_anti[p, t]
         req = pods.ia_required[p, t]
-        ok_t = jnp.where(anti, ~node_has, node_has)
+        # Same required-positive-affinity self-match special case as
+        # pairwise_from_counts.
+        all_zero = counts[s].sum() <= 0
+        self_match = sig_match[s, M + p]
+        pos_ok = node_has | (all_zero & self_match & hk)
+        ok_t = jnp.where(anti, ~node_has, pos_ok)
         ia_ok &= jnp.where(valid_t & req, ok_t, True)
         w = jnp.where(anti, -pods.ia_weight[p, t], pods.ia_weight[p, t])
-        ia_raw += jnp.where(
-            valid_t & ~req & node_has, w, 0.0
-        )
-    return spread_ok, spread_penalty, ia_ok, ia_raw
+        ia_raw += jnp.where(valid_t & ~req & node_has, w, 0.0)
+    return spread_ok, spread_pen, ia_ok, ia_raw

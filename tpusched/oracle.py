@@ -316,9 +316,24 @@ class Oracle:
             anti = _np(pods.ia_anti)[p, t]
             if _np(pods.ia_required)[p, t]:
                 # Required affinity: node's domain must contain a match
-                # (nodes missing the key fail). Required anti-affinity:
-                # node's domain must NOT contain a match (missing key ok).
-                ok &= (~node_has if anti else node_has)
+                # (nodes missing the key fail). Upstream special case: if
+                # NO pod in the cluster matches the selector but the
+                # incoming pod matches its own selector, the term is
+                # satisfied on any node with the key (lets the first pod
+                # of a self-affine group schedule). Required
+                # anti-affinity: no match in the domain (missing key ok).
+                if anti:
+                    ok &= ~node_has
+                else:
+                    self_sat = self.atom_sat_over(
+                        plp[p : p + 1], plk[p : p + 1]
+                    )[:, 0]
+                    self_match = bool(_np(pods.valid)[p])
+                    for a in _np(pods.ia_sel_atoms)[p, t]:
+                        if a >= 0:
+                            self_match = self_match and bool(self_sat[a])
+                    all_zero = not match.any()
+                    ok &= node_has | (all_zero & self_match & has_key)
             else:
                 w = _np(pods.ia_weight)[p, t]
                 raw += np.where(node_has, -w if anti else w, 0.0)
@@ -404,6 +419,65 @@ class Oracle:
             chosen_score=chosen_score,
             final_used=used,
         )
+
+
+def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
+                        assignment: np.ndarray,
+                        commit_key: np.ndarray | None = None) -> list[str]:
+    """Independent validity audit of any assignment (used to check the
+    fast mode's guarantees): capacity respected, static predicates hold,
+    and every placed pod's DoNotSchedule-spread / required inter-pod
+    constraints hold against its commit-time state.
+
+    commit_key [P]: pods with a strictly smaller key committed earlier.
+    A pod is checked against members committed at key <= its own
+    (excluding itself) — upstream semantics check only the incoming pod,
+    so later commits may legally raise an earlier pod's skew; the fast
+    mode additionally guarantees validity against same-key (same-round)
+    commits, which this reproduces. With commit_key=None the check is
+    against the FINAL state (strictly stronger; holds for parity mode
+    only in the absence of retroactive skew).
+
+    Returns human-readable violation strings (empty = valid)."""
+    ora = Oracle(snap, cfg)
+    pods, nodes = snap.pods, snap.nodes
+    assignment = np.asarray(assignment)
+    placed = [
+        (p, int(n)) for p, n in enumerate(assignment)
+        if n >= 0 and _np(pods.valid)[p]
+    ]
+    out = []
+    used = _np(nodes.used).copy()
+    for p, n in placed:
+        used[n] += _np(pods.requests)[p]
+    over = used > _np(nodes.allocatable) + 1e-3
+    for n in np.argwhere(over.any(axis=1)).ravel():
+        if _np(nodes.valid)[n]:
+            out.append(f"node {n}: capacity exceeded {used[n]}")
+    for p, n in placed:
+        if not _np(nodes.valid)[n]:
+            out.append(f"pod {p}: placed on invalid node {n}")
+            continue
+        if not ora.taints_ok(p)[n]:
+            out.append(f"pod {p}: node {n} has untolerated taint")
+        if not ora.node_affinity_ok(p)[n]:
+            out.append(f"pod {p}: node {n} fails required node affinity")
+        if commit_key is None:
+            others = [(q, m) for q, m in placed if q != p]
+        else:
+            others = [
+                (q, m) for q, m in placed
+                if q != p and commit_key[q] <= commit_key[p]
+            ]
+        others_n = [m for _, m in others]
+        others_p = [q for q, _ in others]
+        sp_ok, _ = ora.spread_ok_and_penalty(p, others_n, others_p)
+        if not sp_ok[n]:
+            out.append(f"pod {p}: node {n} violates DoNotSchedule spread")
+        ia_ok, _ = ora.interpod_ok_and_raw(p, others_n, others_p)
+        if not ia_ok[n]:
+            out.append(f"pod {p}: node {n} violates required pod affinity")
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -124,6 +124,21 @@ class AtomTable:
 
 
 @struct.dataclass
+class SigTable:
+    """Distinct (topology key, pod-label selector) signatures across all
+    topology-spread and inter-pod-affinity constraints (SURVEY.md C6/C7).
+
+    Domain counting is done once per signature — counts[s, d] = matching
+    member pods in domain d of sig s's topology key — instead of once per
+    pod, which is what makes pairwise constraints scale: pods reference
+    signatures by id (pods.ts_sig / pods.ia_sig) and just gather."""
+
+    key: Any     # [S] int32 topology-key index
+    atoms: Any   # [S, AT] int32 selector atom ids (-1 pad; none = match all)
+    valid: Any   # [S] bool
+
+
+@struct.dataclass
 class NodeArrays:
     allocatable: Any   # [N, R] f32
     used: Any          # [N, R] f32 (requests of bound pods)
@@ -156,10 +171,12 @@ class PodArrays:
     ts_max_skew: Any     # [P, C] f32
     ts_when: Any         # [P, C] int8 DO_NOT_SCHEDULE | SCHEDULE_ANYWAY
     ts_sel_atoms: Any    # [P, C, AT] int32 selector atoms over pod labels
+    ts_sig: Any          # [P, C] int32 signature id (-1 pad)
     ts_valid: Any        # [P, C] bool
     # Inter-pod (anti-)affinity terms.
     ia_key: Any          # [P, IT] int32 topo key index
     ia_sel_atoms: Any    # [P, IT, AT] int32 selector atoms over pod labels
+    ia_sig: Any          # [P, IT] int32 signature id (-1 pad)
     ia_anti: Any         # [P, IT] bool
     ia_required: Any     # [P, IT] bool
     ia_weight: Any       # [P, IT] f32
@@ -186,6 +203,7 @@ class ClusterSnapshot:
     pods: PodArrays
     running: RunningPodArrays
     atoms: AtomTable
+    sigs: SigTable
     taint_effect: Any     # [VT] int8
     group_min_member: Any  # [G] int32 (0 for unused slots)
 
@@ -349,6 +367,19 @@ class SnapshotBuilder:
                 atoms.append(sig)
             return atom_ids[sig]
 
+        # Pairwise-constraint signatures: one (topo key, selector) entry
+        # per distinct combination, so domain counting happens per
+        # signature, not per pod (see SigTable).
+        sig_ids: dict[tuple, int] = {}
+        sigs: list[tuple[int, tuple[int, ...]]] = []
+
+        def sid(key_idx: int, atoms_list: list[int]) -> int:
+            sig = (key_idx, tuple(sorted(atoms_list)))
+            if sig not in sig_ids:
+                sig_ids[sig] = len(sigs)
+                sigs.append(sig)
+            return sig_ids[sig]
+
         # First pass: intern everything referenced by pods so vocab sizes
         # are known before arrays are allocated.
         pod_compiled = []
@@ -377,11 +408,15 @@ class SnapshotBuilder:
                      atoms=[aid(e) for e in c.selector])
                 for c in p["topology_spread"]
             ]
+            for c in ts:
+                c["sig"] = sid(c["key"], c["atoms"])
             ia = [
                 dict(key=topo_idx(t.topology_key), atoms=[aid(e) for e in t.selector],
                      anti=t.anti, required=t.required, weight=float(t.weight))
                 for t in p["pod_affinity"]
             ]
+            for t in ia:
+                t["sig"] = sid(t["key"], t["atoms"])
             pod_compiled.append(dict(req_terms=req_terms, pref_terms=pref_terms, ts=ts, ia=ia))
 
         # Intern node labels/taints.
@@ -429,6 +464,7 @@ class SnapshotBuilder:
             affinity_terms=max((len(pc["ia"]) for pc in pod_compiled), default=0),
             pod_groups=len(self._groups),
             taint_vocab=len(taint_ids),
+            signatures=len(sigs),
         )
         grow = {
             f: max(getattr(bk, f), _ceil_bucket(v))
@@ -492,6 +528,15 @@ class SnapshotBuilder:
         for (k, v, e), t in taint_ids.items():
             taint_effect[t] = TAINT_EFFECTS.index(e)
 
+        # Signature table.
+        sig_key = np.full(bk.signatures, -1, np.int32)
+        sig_atoms_arr = np.full((bk.signatures, bk.term_atoms), -1, np.int32)
+        sig_valid = np.zeros(bk.signatures, bool)
+        for s, (k, alist) in enumerate(sigs):
+            sig_key[s] = k
+            sig_atoms_arr[s, : len(alist)] = alist
+            sig_valid[s] = True
+
         # Pod arrays.
         pods = _PodArraysNP(bk, R)
         group_list = sorted(self._groups)
@@ -524,10 +569,12 @@ class SnapshotBuilder:
                 pods.ts_max_skew[i, c] = con["max_skew"]
                 pods.ts_when[i, c] = con["when"]
                 pods.ts_sel_atoms[i, c, : len(con["atoms"])] = con["atoms"]
+                pods.ts_sig[i, c] = con["sig"]
             for t, term in enumerate(pc["ia"]):
                 pods.ia_valid[i, t] = True
                 pods.ia_key[i, t] = term["key"]
                 pods.ia_sel_atoms[i, t, : len(term["atoms"])] = term["atoms"]
+                pods.ia_sig[i, t] = term["sig"]
                 pods.ia_anti[i, t] = term["anti"]
                 pods.ia_required[i, t] = term["required"]
                 pods.ia_weight[i, t] = term["weight"]
@@ -576,8 +623,9 @@ class SnapshotBuilder:
                 pref_term_valid=pods.pref_term_valid, pref_weight=pods.pref_weight,
                 ts_key=pods.ts_key, ts_max_skew=pods.ts_max_skew,
                 ts_when=pods.ts_when, ts_sel_atoms=pods.ts_sel_atoms,
-                ts_valid=pods.ts_valid, ia_key=pods.ia_key,
-                ia_sel_atoms=pods.ia_sel_atoms, ia_anti=pods.ia_anti,
+                ts_sig=pods.ts_sig, ts_valid=pods.ts_valid,
+                ia_key=pods.ia_key, ia_sel_atoms=pods.ia_sel_atoms,
+                ia_sig=pods.ia_sig, ia_anti=pods.ia_anti,
                 ia_required=pods.ia_required, ia_weight=pods.ia_weight,
                 ia_valid=pods.ia_valid, group=pods.group, valid=pods.valid,
             ),
@@ -588,6 +636,7 @@ class SnapshotBuilder:
             ),
             atoms=AtomTable(key=atom_key, op=atom_op, pairs=atom_pairs,
                             num=atom_num, valid=atom_valid),
+            sigs=SigTable(key=sig_key, atoms=sig_atoms_arr, valid=sig_valid),
             taint_effect=taint_effect,
             group_min_member=group_min,
         )
@@ -623,9 +672,11 @@ class _PodArraysNP:
         self.ts_sel_atoms = np.full(
             (P, bk.spread_constraints, bk.term_atoms), -1, np.int32
         )
+        self.ts_sig = np.full((P, bk.spread_constraints), -1, np.int32)
         self.ts_valid = np.zeros((P, bk.spread_constraints), bool)
         self.ia_key = np.full((P, bk.affinity_terms), -1, np.int32)
         self.ia_sel_atoms = np.full((P, bk.affinity_terms, bk.term_atoms), -1, np.int32)
+        self.ia_sig = np.full((P, bk.affinity_terms), -1, np.int32)
         self.ia_anti = np.zeros((P, bk.affinity_terms), bool)
         self.ia_required = np.zeros((P, bk.affinity_terms), bool)
         self.ia_weight = np.zeros((P, bk.affinity_terms), np.float32)

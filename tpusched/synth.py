@@ -78,17 +78,37 @@ def make_cluster(
         )
 
     # Background running pods establishing initial utilization + labels
-    # for pairwise constraints.
+    # for pairwise constraints. Requests draw from the node's REMAINING
+    # capacity so the initial state is never request-overcommitted (a
+    # real scheduler would have enforced that).
     apps = ("web", "db", "cache", "batch")
+    remaining = {}  # node -> [cpu, mem] left
+    node_caps = {}
+    for nrec in b._nodes:
+        node_caps[nrec["name"]] = (
+            nrec["allocatable"]["cpu"], nrec["allocatable"]["memory"]
+        )
+        remaining[nrec["name"]] = [
+            nrec["allocatable"]["cpu"], nrec["allocatable"]["memory"]
+        ]
     for i in range(n_nodes):
+        name = f"node-{i}"
+        cap_cpu, cap_mem = node_caps[name]
         for j in range(n_running_per_node):
-            cpu, mem = NODE_CLASSES[0]
+            rem = remaining[name]
+            want_cpu = int(cap_cpu * initial_utilization / max(n_running_per_node, 1))
+            want_mem = int(cap_mem * initial_utilization / max(n_running_per_node, 1))
+            cpu_req = float(rng.integers(100, max(101, want_cpu + 1)))
+            mem_req = float(rng.integers(1 << 28, max((1 << 28) + 1, want_mem + 1)))
+            cpu_req = min(cpu_req, max(rem[0] - 100.0, 0.0))
+            mem_req = min(mem_req, max(rem[1] - float(1 << 28), 0.0))
+            if cpu_req <= 0 or mem_req <= 0:
+                continue
+            rem[0] -= cpu_req
+            rem[1] -= mem_req
             b.add_running_pod(
-                node=f"node-{i}",
-                requests={
-                    "cpu": float(rng.integers(100, 1 + int(cpu * initial_utilization))),
-                    "memory": float(rng.integers(1 << 28, 1 + int(mem * initial_utilization))),
-                },
+                node=name,
+                requests={"cpu": cpu_req, "memory": mem_req},
                 priority=float(rng.integers(0, 100)),
                 slack=float(rng.uniform(-0.2, 0.3)),
                 labels={"app": apps[int(rng.integers(len(apps)))]},
