@@ -175,7 +175,11 @@ class EngineConfig:
     # "fast" = round-based batched commit (same placements for
     # non-contended snapshots, bounded rounds otherwise). SURVEY.md C11.
     mode: str = "parity"
-    max_rounds: int = 16
+    # Cap on fast-mode commit rounds; 0 = auto (2*P+8, enough for the
+    # worst case of one conservative commit per round). A positive cap
+    # trades completeness for bounded latency: pods still pending at the
+    # cap stay unassigned for the batch.
+    max_rounds: int = 0
     # Deterministic tie-break: lowest node index among score maxima.
     # (Upstream uses seeded roulette; both our paths and the oracle share
     # this rule so parity is well-defined. SURVEY.md §7 hard part 2.)

@@ -224,7 +224,10 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         snap, static.sig_match, jnp.full(P, -1, jnp.int32)
     )
     BIG = jnp.int32(2**31 - 1)
-    max_rounds = 2 * P + 8
+    # Round bound: worst case is one conservative pod committing per
+    # round, so the auto bound is O(P); cfg.max_rounds > 0 caps it lower
+    # (pods still pending at the cap stay unassigned that batch).
+    max_rounds = cfg.max_rounds if cfg.max_rounds > 0 else 2 * P + 8
 
     def cond(state):
         progress, r = state[-2], state[-1]
@@ -283,13 +286,12 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         cum_dem = jnp.cumsum(dem_sorted, axis=0)                 # [P, R]
         my_dem = cum_dem[q_of]                                   # [P, R] own-incl.
         cum_rem = jnp.cumsum(remaining[node_order], axis=0)      # [N, R]
-        R = cum_rem.shape[1]
         pos = jnp.zeros(P, jnp.int32)
-        for r in range(R):
+        for ri in range(cum_rem.shape[1]):
             pos = jnp.maximum(
                 pos,
                 jnp.searchsorted(
-                    cum_rem[:, r], my_dem[:, r], side="left"
+                    cum_rem[:, ri], my_dem[:, ri], side="left"
                 ).astype(jnp.int32),
             )
         dealt = node_order[jnp.clip(pos, 0, N - 1)].astype(jnp.int32)

@@ -18,13 +18,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
-from tpusched.snapshot import (
-    AtomTable,
-    ClusterSnapshot,
-    NodeArrays,
-    PodArrays,
-    RunningPodArrays,
-)
+from tpusched.snapshot import ClusterSnapshot
 
 POD_AXIS = "p"
 NODE_AXIS = "n"
@@ -62,6 +56,7 @@ def snapshot_shardings(mesh: Mesh, snap: ClusterSnapshot) -> ClusterSnapshot:
         pods=build(snap.pods, "pods"),
         running=build(snap.running, "rep"),
         atoms=build(snap.atoms, "rep"),
+        sigs=build(snap.sigs, "rep"),
         taint_effect=_spec_for("rep", mesh),
         group_min_member=_spec_for("rep", mesh),
     )
