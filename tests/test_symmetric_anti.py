@@ -1,0 +1,224 @@
+"""Symmetric required anti-affinity (SURVEY.md C7 completion): an
+EXISTING member's required anti-affinity term repels incoming pods that
+match its selector — running pods and earlier-committed pending pods
+alike — in oracle, parity, and fast modes."""
+
+import numpy as np
+import pytest
+
+from tpusched import Engine, EngineConfig
+from tpusched.oracle import Oracle, validate_assignment
+from tpusched.snapshot import MatchExpression, PodAffinityTerm, SnapshotBuilder
+from tpusched.synth import make_cluster
+
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def _nodes(b, n=4, zones=("a", "b")):
+    for i in range(n):
+        b.add_node(f"n{i}", {"cpu": 4000, "memory": 16 << 30},
+                   labels={ZONE: zones[i % len(zones)]})
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_running_pod_anti_repels_incoming(mode):
+    """A running pod in zone a with anti-affinity against app=web must
+    keep web pods out of zone a entirely."""
+    cfg = EngineConfig(mode=mode)
+    b = SnapshotBuilder(cfg)
+    _nodes(b)
+    b.add_running_pod(
+        "n0", {"cpu": 100, "memory": 1 << 28}, labels={"app": "db"},
+        pod_affinity=[PodAffinityTerm(
+            ZONE, (MatchExpression("app", "In", ("web",)),),
+            anti=True, required=True,
+        )],
+    )
+    b.add_pod("w", {"cpu": 100, "memory": 1 << 28}, labels={"app": "web"})
+    b.add_pod("x", {"cpu": 100, "memory": 1 << 28}, labels={"app": "cache"})
+    snap, meta = b.build()
+    res = Engine(cfg).solve(snap)
+    zones = np.asarray(snap.nodes.domain)[:, 0]
+    assert res.assignment[0] >= 0, "web pod should still fit in zone b"
+    assert zones[res.assignment[0]] != zones[0], "web pod landed in poisoned zone"
+    assert res.assignment[1] >= 0, "unmatched pod unaffected"
+    ora = Oracle(snap, cfg).solve()
+    if mode == "parity":
+        np.testing.assert_array_equal(res.assignment, ora.assignment)
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_pending_anti_holder_repels_later_pod(mode):
+    """A higher-priority pending pod with required anti-affinity commits
+    first; a later pod matching its selector must avoid its domain."""
+    cfg = EngineConfig(mode=mode)
+    b = SnapshotBuilder(cfg)
+    _nodes(b)
+    b.add_pod(
+        "holder", {"cpu": 100, "memory": 1 << 28}, priority=100,
+        labels={"app": "db"},
+        pod_affinity=[PodAffinityTerm(
+            ZONE, (MatchExpression("app", "In", ("web",)),),
+            anti=True, required=True,
+        )],
+    )
+    b.add_pod("web1", {"cpu": 100, "memory": 1 << 28}, priority=1,
+              labels={"app": "web"})
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    zones = np.asarray(snap.nodes.domain)[:, 0]
+    assert res.assignment[0] >= 0 and res.assignment[1] >= 0
+    assert zones[res.assignment[0]] != zones[res.assignment[1]], (
+        "web pod must not share the holder's zone"
+    )
+    ora = Oracle(snap, cfg).solve()
+    if mode == "parity":
+        np.testing.assert_array_equal(res.assignment, ora.assignment)
+    violations = validate_assignment(snap, cfg, res.assignment,
+                                     commit_key=res.commit_key)
+    assert violations == [], violations
+
+
+def test_holder_on_keyless_node_poisons_nothing():
+    """Anti-affinity holder on a node lacking the topology key has no
+    domain, so it cannot repel anyone (upstream semantics)."""
+    cfg = EngineConfig()
+    b = SnapshotBuilder(cfg)
+    b.add_node("keyless", {"cpu": 4000, "memory": 16 << 30})
+    b.add_node("n1", {"cpu": 4000, "memory": 16 << 30}, labels={ZONE: "a"})
+    b.add_running_pod(
+        "keyless", {"cpu": 100, "memory": 1 << 28},
+        pod_affinity=[PodAffinityTerm(
+            ZONE, (MatchExpression("app", "In", ("web",)),),
+            anti=True, required=True,
+        )],
+    )
+    b.add_pod("w", {"cpu": 100, "memory": 1 << 28}, labels={"app": "web"})
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert res.assignment[0] >= 0
+    np.testing.assert_array_equal(
+        res.assignment, Oracle(snap, cfg).solve().assignment
+    )
+
+
+def test_empty_selector_anti_repels_everyone():
+    """An anti term with an empty selector matches ALL pods: its zone is
+    closed to every incoming pod."""
+    cfg = EngineConfig()
+    b = SnapshotBuilder(cfg)
+    _nodes(b)
+    b.add_running_pod(
+        "n0", {"cpu": 100, "memory": 1 << 28},
+        pod_affinity=[PodAffinityTerm(ZONE, (), anti=True, required=True)],
+    )
+    b.add_pod("p", {"cpu": 100, "memory": 1 << 28}, labels={"app": "anything"})
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    zones = np.asarray(snap.nodes.domain)[:, 0]
+    assert res.assignment[0] >= 0
+    assert zones[res.assignment[0]] != zones[0]
+    np.testing.assert_array_equal(
+        res.assignment, Oracle(snap, cfg).solve().assignment
+    )
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_parity_fuzz_with_running_anti(seed):
+    rng = np.random.default_rng(7000 + seed)
+    snap, _ = make_cluster(
+        rng,
+        n_pods=int(rng.integers(10, 50)),
+        n_nodes=int(rng.integers(4, 16)),
+        interpod_frac=float(rng.uniform(0, 0.5)),
+        spread_frac=float(rng.uniform(0, 0.4)),
+        run_anti_frac=float(rng.uniform(0.1, 0.5)),
+        keyless_node_frac=float(rng.uniform(0, 0.3)),
+    )
+    cfg = EngineConfig()
+    res = Engine(cfg).solve(snap)
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fast_valid_fuzz_with_running_anti(seed):
+    rng = np.random.default_rng(8000 + seed)
+    snap, _ = make_cluster(
+        rng,
+        n_pods=int(rng.integers(10, 50)),
+        n_nodes=int(rng.integers(4, 16)),
+        interpod_frac=float(rng.uniform(0, 0.5)),
+        run_anti_frac=float(rng.uniform(0.1, 0.5)),
+    )
+    cfg = EngineConfig(mode="fast")
+    res = Engine(cfg).solve(snap)
+    violations = validate_assignment(snap, cfg, res.assignment,
+                                     commit_key=res.commit_key)
+    assert violations == [], violations
+
+
+def test_run_anti_selector_atoms_size_bucket():
+    """Regression: when ONLY running pods carry selectors, the
+    term_atoms bucket must still grow to fit them (it used to be sized
+    from pending-pod terms alone, truncating run-anti selectors into
+    match-everything selectors or crashing on multi-atom ones)."""
+    cfg = EngineConfig()
+    b = SnapshotBuilder(cfg)
+    _nodes(b)
+    b.add_running_pod(
+        "n0", {"cpu": 100, "memory": 1 << 28},
+        pod_affinity=[PodAffinityTerm(
+            ZONE,
+            (MatchExpression("app", "In", ("web",)),
+             MatchExpression("tier", "In", ("1",))),
+            anti=True, required=True,
+        )],
+    )
+    # no pending pod has any term: term_atoms need comes from run-anti only
+    b.add_pod("w", {"cpu": 100, "memory": 1 << 28},
+              labels={"app": "web", "tier": "1"})
+    b.add_pod("c", {"cpu": 100, "memory": 1 << 28}, labels={"app": "cache"})
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    zones = np.asarray(snap.nodes.domain)[:, 0]
+    # matching pod repelled from zone a; non-matching pod free to go anywhere
+    assert res.assignment[0] >= 0 and zones[res.assignment[0]] != zones[0]
+    oracle = Oracle(snap, cfg)
+    np.testing.assert_array_equal(res.assignment, oracle.solve().assignment)
+    assert oracle.symmetric_anti_ok(1, [], [])[0], (
+        "non-matching pod must not be repelled"
+    )
+
+
+def test_keyless_member_counts_for_all_zero_special_case():
+    """ADVICE.md low: a pod matching a required positive affinity
+    selector sitting on a KEY-LESS node must disable the 'no pod matches
+    anywhere' special case (oracle uses match.any(); device must use
+    match_tot, not domain counts)."""
+    cfg = EngineConfig()
+    for mode in ("parity", "fast"):
+        cfg = EngineConfig(mode=mode)
+        b = SnapshotBuilder(cfg)
+        b.add_node("keyless", {"cpu": 4000, "memory": 16 << 30})
+        b.add_node("n1", {"cpu": 4000, "memory": 16 << 30}, labels={ZONE: "a"})
+        # the only app=db pod sits on the key-less node
+        b.add_running_pod("keyless", {"cpu": 100, "memory": 1 << 28},
+                          labels={"app": "db"})
+        # incoming pod requires affinity to app=db within zone; it also
+        # matches its own selector? No — it is app=web. Since a matching
+        # pod EXISTS (on the key-less node), the special case must NOT
+        # fire, and no node has a matching pod in-domain -> unschedulable.
+        b.add_pod(
+            "w", {"cpu": 100, "memory": 1 << 28}, labels={"app": "web"},
+            pod_affinity=[PodAffinityTerm(
+                ZONE, (MatchExpression("app", "In", ("db",)),),
+                anti=False, required=True,
+            )],
+        )
+        snap, _ = b.build()
+        res = Engine(cfg).solve(snap)
+        ora = Oracle(snap, cfg).solve()
+        assert ora.assignment[0] == -1, "oracle: special case must not fire"
+        assert res.assignment[0] == -1, f"{mode}: device disagrees with oracle"

@@ -65,6 +65,18 @@ def _next_pow2(x: int) -> int:
     return 1 << (x - 1).bit_length()
 
 
+def _next_bucket(x: int) -> int:
+    """Bucket size policy: powers of two up to 2048, then multiples of
+    1024. Pure pow2 pads a 10k x 5k problem to 16384 x 8192 — 2.7x the
+    arithmetic and HBM traffic for nothing. Multiples of 1024 keep the
+    distinct-shape count (recompiles) bounded while capping padding
+    overhead at ~10% for large axes; 1024-alignment also keeps the lane
+    dimension a multiple of the TPU tile (8x128)."""
+    if x <= 2048:
+        return _next_pow2(x)
+    return ((x + 1023) // 1024) * 1024
+
+
 @dataclasses.dataclass(frozen=True)
 class Buckets:
     """Static device-side array sizes.
@@ -102,11 +114,12 @@ class Buckets:
         min_nodes: int = 8,
         **overrides: int,
     ) -> "Buckets":
-        """Smallest power-of-two bucket set covering the given counts."""
+        """Smallest bucket set covering the given counts (pow2 up to
+        2048, multiples of 1024 above — see _next_bucket)."""
         base = Buckets(
-            pods=max(min_pods, _next_pow2(n_pods)),
-            nodes=max(min_nodes, _next_pow2(n_nodes)),
-            running_pods=max(8, _next_pow2(max(1, n_running))),
+            pods=max(min_pods, _next_bucket(n_pods)),
+            nodes=max(min_nodes, _next_bucket(n_nodes)),
+            running_pods=max(8, _next_bucket(max(1, n_running))),
         )
         return dataclasses.replace(base, **overrides) if overrides else base
 

@@ -100,7 +100,7 @@ def precompute_static(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
 
 
 def batched_cycle(cfg: EngineConfig, snap: ClusterSnapshot,
-                  static: StaticCtx, used, counts,
+                  static: StaticCtx, used, pair_st,
                   exclude_self_node=None):
     """Full [P, N] Filter + Score against the given state. Score-sum
     grouping mirrors oracle.feasible_and_score exactly."""
@@ -123,7 +123,7 @@ def batched_cycle(cfg: EngineConfig, snap: ClusterSnapshot,
         score = base_score + static.w_ts[:, None] * 100.0
         return base_feasible, score.astype(jnp.float32)
     spread_ok, spread_pen, ia_ok, ia_raw = kpair.pairwise_from_counts(
-        snap, counts, static.aff_ok, static.sig_match, exclude_self_node
+        snap, pair_st, static.aff_ok, static.sig_match, exclude_self_node
     )
     feasible = base_feasible & spread_ok & ia_ok
     score = (
@@ -135,13 +135,13 @@ def batched_cycle(cfg: EngineConfig, snap: ClusterSnapshot,
 
 
 def pod_cycle(cfg: EngineConfig, snap: ClusterSnapshot, static: StaticCtx,
-              p, used, counts):
+              p, used, pair_st):
     """Single-pod [N] Filter + Score (sequential scan body)."""
     nodes = snap.nodes
     nvalid = nodes.valid
     req = snap.pods.requests[p]
     spread_ok, spread_pen, ia_ok, ia_raw = kpair.pairwise_row(
-        snap, counts, static.sig_match, p, static.aff_ok[p]
+        snap, pair_st, static.sig_match, p, static.aff_ok[p]
     )
     feasible = (
         static.mask[p]
@@ -176,22 +176,20 @@ def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
     static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
     P = snap.pods.valid.shape[0]
     order = pop_order(cfg, snap)
-    counts0 = kpair.sig_counts(
-        snap, static.sig_match, jnp.full(P, -1, jnp.int32)
-    )
+    st0 = kpair.pair_state_init(snap, static.sig_match)
 
     def body(carry, p):
-        used, assigned, counts = carry
-        feasible, score = pod_cycle(cfg, snap, static, p, used, counts)
+        used, assigned, st = carry
+        feasible, score = pod_cycle(cfg, snap, static, p, used, st)
         masked = jnp.where(feasible, score, NEG_INF)
         n = jnp.argmax(masked)  # tie-break: first max (EngineConfig.tie_break)
         commit = jnp.any(feasible)
         used = used.at[n].add(jnp.where(commit, snap.pods.requests[p], 0.0))
-        counts = kpair.counts_add_pod(snap, counts, static.sig_match, p, n, commit)
+        st = kpair.pair_state_add_pod(snap, st, static.sig_match, p, n, commit)
         assigned = assigned.at[p].set(jnp.where(commit, n, -1).astype(jnp.int32))
-        return (used, assigned, counts), jnp.where(commit, masked[n], NEG_INF)
+        return (used, assigned, st), jnp.where(commit, masked[n], NEG_INF)
 
-    init = (snap.nodes.used, jnp.full(P, -1, jnp.int32), counts0)
+    init = (snap.nodes.used, jnp.full(P, -1, jnp.int32), st0)
     (used, assigned, _), chosen_in_order = jax.lax.scan(body, init, order)
     chosen = jnp.full(P, NEG_INF, jnp.float32).at[order].set(chosen_in_order)
     return assigned, chosen, used, order
@@ -202,11 +200,8 @@ def score_batch(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
     """One-shot [P, N] feasibility + scores against the current snapshot
     (no commits): the ScoreBatch gRPC surface (SURVEY.md C12)."""
     static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
-    P = snap.pods.valid.shape[0]
-    counts0 = kpair.sig_counts(
-        snap, static.sig_match, jnp.full(P, -1, jnp.int32)
-    )
-    return batched_cycle(cfg, snap, static, snap.nodes.used, counts0)
+    st0 = kpair.pair_state_init(snap, static.sig_match)
+    return batched_cycle(cfg, snap, static, snap.nodes.used, st0)
 
 
 def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
@@ -220,9 +215,24 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
     order = pop_order(cfg, snap)
     rank = jnp.zeros(P, jnp.int32).at[order].set(jnp.arange(P, dtype=jnp.int32))
     has_pair = jnp.any(pods.ts_valid, axis=1) | jnp.any(pods.ia_valid, axis=1)
-    counts0 = kpair.sig_counts(
-        snap, static.sig_match, jnp.full(P, -1, jnp.int32)
-    )
+    st0 = kpair.pair_state_init(snap, static.sig_match)
+    # A pod with NO constraints of its own can still be displaced by
+    # symmetric anti-affinity: it must revalidate if any live anti term
+    # (running holders via st0.anti — domain-aware, so key-less holders
+    # don't count — or pending holders, whose node is unknown yet) has a
+    # selector matching it.
+    S = snap.sigs.key.shape[0]
+    if S:
+        M = snap.running.valid.shape[0]
+        anti_possible = st0.anti.sum(axis=1) > 0
+        for t in range(pods.ia_key.shape[1]):
+            s_t = jnp.clip(pods.ia_sig[:, t], 0, None)
+            hold = kpair._pod_anti_holds(snap, t) & pods.valid
+            anti_possible = anti_possible.at[s_t].max(hold)
+        sym_target = jnp.any(
+            static.sig_match[:, M:] & anti_possible[:, None], axis=0
+        )
+        has_pair = has_pair | sym_target
     BIG = jnp.int32(2**31 - 1)
     # Round bound: worst case is one conservative pod committing per
     # round, so the auto bound is O(P); cfg.max_rounds > 0 caps it lower
@@ -236,10 +246,10 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
     K = min(8, N)
 
     def body(state):
-        used, assigned, counts, conservative, chosen, round_of, _, r = state
+        used, assigned, pair_st, conservative, chosen, round_of, _, r = state
         pending = assigned == -1
 
-        feasible, score = batched_cycle(cfg, snap, static, used, counts)
+        feasible, score = batched_cycle(cfg, snap, static, used, pair_st)
         feasible &= pending[:, None]
         masked = jnp.where(feasible, score, NEG_INF)
         want = jnp.any(feasible, axis=1)
@@ -385,10 +395,10 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             round_of2 = jnp.where(commit, r, round_of)
             all_done = jnp.all((assigned2 >= 0) | ~pods.valid)
             progress = jnp.any(commit) & ~all_done
-            return (used2, assigned2, counts, conservative, chosen2,
+            return (used2, assigned2, pair_st, conservative, chosen2,
                     round_of2, progress, r + 1)
-        counts2 = kpair.counts_commit_pods(
-            snap, counts, static.sig_match, choice, commit
+        st2 = kpair.pair_state_commit(
+            snap, pair_st, static.sig_match, choice, commit
         )
 
         # Validate committed pairwise pods against end-of-round counts
@@ -402,9 +412,9 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             return again
 
         def vbody(vs):
-            counts_v, used_v, kept_v, _ = vs
+            st_v, used_v, kept_v, _ = vs
             spread_ok2, _, ia_ok2, _ = kpair.pairwise_from_counts(
-                snap, counts_v, static.aff_ok, static.sig_match,
+                snap, st_v, static.aff_ok, static.sig_match,
                 exclude_self_node=jnp.where(kept_v, choice, -1),
             )
             ok_at_choice = jnp.take_along_axis(
@@ -415,14 +425,14 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             used_v = used_v.at[jnp.clip(choice, 0, N - 1)].add(
                 -jnp.where(new_viol[:, None], pods.requests, 0.0)
             )
-            counts_v = kpair.counts_commit_pods(
-                snap, counts_v, static.sig_match, choice, new_viol, sign=-1.0
+            st_v = kpair.pair_state_commit(
+                snap, st_v, static.sig_match, choice, new_viol, sign=-1.0
             )
-            return counts_v, used_v, kept_v & ~new_viol, jnp.any(new_viol)
+            return st_v, used_v, kept_v & ~new_viol, jnp.any(new_viol)
 
         any_pair_committed = jnp.any(commit & has_pair)
-        counts3, used3, kept, _ = jax.lax.while_loop(
-            vcond, vbody, (counts2, used2, commit, any_pair_committed)
+        st3, used3, kept, _ = jax.lax.while_loop(
+            vcond, vbody, (st2, used2, commit, any_pair_committed)
         )
         viol = commit & ~kept
         assigned2 = jnp.where(kept, choice, assigned)
@@ -432,11 +442,11 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         round_of2 = jnp.where(kept, r, round_of)
         all_done = jnp.all((assigned2 >= 0) | ~pods.valid)
         progress = (jnp.any(kept) | jnp.any(new_conservative)) & ~all_done
-        return (used3, assigned2, counts3, conservative2, chosen2,
+        return (used3, assigned2, st3, conservative2, chosen2,
                 round_of2, progress, r + 1)
 
     init = (
-        nodes.used, jnp.full(P, -1, jnp.int32), counts0,
+        nodes.used, jnp.full(P, -1, jnp.int32), st0,
         jnp.zeros(P, bool), jnp.full(P, NEG_INF, jnp.float32),
         jnp.full(P, -1, jnp.int32), jnp.array(True), jnp.int32(0),
     )

@@ -4,16 +4,25 @@ Pairwise constraints: where pod p may land depends on where *other* pods
 (running + already-committed pending) sit. The scalable formulation works
 per SIGNATURE, not per pod: SnapshotBuilder interns every distinct
 (topology key, pod-label selector) pair into a SigTable entry, and the
-kernels maintain
+kernels maintain a PairState of three arrays:
 
-    counts[s, d] = number of matching member pods in domain d of
-                   signature s's topology key
+    counts[s, d]    = matching member pods in domain d of signature s's
+                      topology key (spread counts / affinity presence)
+    anti[s, d]      = members HOLDING a required anti-affinity term with
+                      signature s in domain d (symmetric anti-affinity:
+                      an existing pod's required anti term repels
+                      incoming pods matching its selector)
+    match_tot[s]    = members matching s's selector ANYWHERE, including
+                      nodes that lack the topology key (drives the
+                      upstream "no pod matches the selector" special
+                      case for required positive affinity)
 
-as an [S, N] matrix (domain ids are < number of nodes by construction).
-Counting is ONE scatter over members per evaluation — independent of P —
-and per-pod constraint checks are gathers from counts. Commit loops
-update counts incrementally as pods place (counts_commit_pods /
-counts_add_pod) instead of recounting members.
+Counting is a handful of scatters over members per evaluation —
+independent of P — and per-pod constraint checks are gathers from the
+state. The symmetric-anti check for all pods at once is a single
+[P, S] x [S, N] matmul (MXU-friendly). Commit loops update the state
+incrementally as pods place (pair_state_commit / pair_state_add_pod)
+instead of recounting members.
 
 Members are the concatenation [running | pending]; a pending pod's
 member column activates when it commits. Self-exclusion: a pod's own
@@ -24,11 +33,21 @@ post-commit validation.
 
 from __future__ import annotations
 
+from typing import Any
+
 import jax.numpy as jnp
+from flax import struct
 
 from tpusched.config import DO_NOT_SCHEDULE
 from tpusched.kernels.atoms import gather_term_sat
 from tpusched.snapshot import ClusterSnapshot
+
+
+@struct.dataclass
+class PairState:
+    counts: Any     # [S, N] f32 selector-match counts per domain
+    anti: Any       # [S, N] f32 required-anti-term HOLDER counts per domain
+    match_tot: Any  # [S] f32 selector-match counts over all members
 
 
 def member_label_sat_t(snap: ClusterSnapshot, sat_fn):
@@ -75,8 +94,48 @@ def sig_counts(snap: ClusterSnapshot, sig_match, assigned):
     ].add(contrib)
 
 
-def counts_commit_pods(snap: ClusterSnapshot, counts, sig_match, choice,
-                       commit_mask, sign=1.0):
+def _anti_counts_running(snap: ClusterSnapshot, dom_s):
+    """[S, N] f32: required-anti-term holders among RUNNING pods per
+    domain of each term's signature."""
+    S, N = dom_s.shape
+    asig = snap.running.anti_sig                             # [M, J]
+    out = jnp.zeros((S, N), jnp.float32)
+    if asig.shape[1] == 0 or S == 0:
+        return out
+    node = snap.running.node_idx                             # [M]
+    sclip = jnp.clip(asig, 0, None)
+    dom_m = dom_s[sclip, jnp.clip(node, 0, None)[:, None]]   # [M, J]
+    ok = (
+        (asig >= 0) & (node >= 0)[:, None]
+        & snap.running.valid[:, None] & (dom_m >= 0)
+    )
+    return out.at[sclip, jnp.clip(dom_m, 0, None)].add(ok.astype(jnp.float32))
+
+
+def pair_state_init(snap: ClusterSnapshot, sig_match) -> PairState:
+    """State with no pending pods committed: counts from running pods."""
+    P = snap.pods.valid.shape[0]
+    dom_s = sig_domains(snap)
+    M = snap.running.valid.shape[0]
+    match_tot = jnp.sum(
+        (sig_match[:, :M] & snap.running.valid[None, :]).astype(jnp.float32),
+        axis=1,
+    )
+    return PairState(
+        counts=sig_counts(snap, sig_match, jnp.full(P, -1, jnp.int32)),
+        anti=_anti_counts_running(snap, dom_s),
+        match_tot=match_tot,
+    )
+
+
+def _pod_anti_holds(snap: ClusterSnapshot, t: int):
+    """[P] bool: pod holds a live required anti term in ia slot t."""
+    pods = snap.pods
+    return pods.ia_valid[:, t] & pods.ia_anti[:, t] & pods.ia_required[:, t]
+
+
+def pair_state_commit(snap: ClusterSnapshot, st: PairState, sig_match,
+                      choice, commit_mask, sign=1.0) -> PairState:
     """Add (sign=+1) or roll back (sign=-1) the contribution of pending
     pods committed to choice[p] where commit_mask[p]."""
     M = snap.running.valid.shape[0]
@@ -87,10 +146,23 @@ def counts_commit_pods(snap: ClusterSnapshot, counts, sig_match, choice,
     ).astype(jnp.float32) * sign
     S = dom_s.shape[0]
     rows = jnp.broadcast_to(jnp.arange(S)[:, None], pod_dom.shape)
-    return counts.at[rows, jnp.clip(pod_dom, 0, None)].add(contrib)
+    counts = st.counts.at[rows, jnp.clip(pod_dom, 0, None)].add(contrib)
+    match_tot = st.match_tot + sign * jnp.sum(
+        (sig_match[:, M:] & commit_mask[None, :]).astype(jnp.float32), axis=1
+    )
+    anti = st.anti
+    for t in range(snap.pods.ia_key.shape[1]):
+        s = jnp.clip(snap.pods.ia_sig[:, t], 0, None)        # [P]
+        dom_p = dom_s[s, jnp.clip(choice, 0, None)]          # [P]
+        on = _pod_anti_holds(snap, t) & commit_mask & (dom_p >= 0)
+        anti = anti.at[s, jnp.clip(dom_p, 0, None)].add(
+            on.astype(jnp.float32) * sign
+        )
+    return PairState(counts=counts, anti=anti, match_tot=match_tot)
 
 
-def counts_add_pod(snap: ClusterSnapshot, counts, sig_match, p, n, on):
+def pair_state_add_pod(snap: ClusterSnapshot, st: PairState, sig_match,
+                       p, n, on) -> PairState:
     """Incremental update for one pod p committing to node n (traced
     scalars); `on` gates the add (False -> no-op). Used by the
     sequential scan."""
@@ -100,11 +172,21 @@ def counts_add_pod(snap: ClusterSnapshot, counts, sig_match, p, n, on):
     dom_n = dom_s[:, n]                                      # [S]
     col = sig_match[:, M + p]                                # [S]
     contrib = (col & (dom_n >= 0) & on).astype(jnp.float32)
-    return counts.at[jnp.arange(S), jnp.clip(dom_n, 0, None)].add(contrib)
+    counts = st.counts.at[jnp.arange(S), jnp.clip(dom_n, 0, None)].add(contrib)
+    match_tot = st.match_tot + (col & on).astype(jnp.float32)
+    anti = st.anti
+    for t in range(snap.pods.ia_key.shape[1]):
+        s = jnp.clip(snap.pods.ia_sig[p, t], 0, None)        # scalar
+        dom_pn = dom_s[s, n]
+        hold = _pod_anti_holds(snap, t)[p] & on & (dom_pn >= 0)
+        anti = anti.at[s, jnp.clip(dom_pn, 0, None)].add(
+            hold.astype(jnp.float32)
+        )
+    return PairState(counts=counts, anti=anti, match_tot=match_tot)
 
 
 # ---------------------------------------------------------------------------
-# Constraint evaluation from counts.
+# Constraint evaluation from the state.
 # ---------------------------------------------------------------------------
 
 
@@ -112,24 +194,58 @@ def _self_adj(snap, sig_match, dom_s, s, exclude_self_node, pod_idx):
     """Count adjustments removing each pod's own contribution when it is
     assumed placed on exclude_self_node[p] (post-commit validation:
     upstream checks a pod's constraints BEFORE adding the pod itself).
-    Returns (adj [P, N] f32, active [P] f32) — per-node and total."""
+    Returns (adj [P, N] f32, active [P] f32, active_tot [P] f32) — the
+    per-node domain-count adjustment, its row-mask, and the match_tot
+    adjustment (which ignores domains: match_tot counts key-less members
+    too)."""
     if exclude_self_node is None:
-        return 0.0, 0.0
+        return 0.0, 0.0, 0.0
     M = snap.running.valid.shape[0]
     esn = exclude_self_node                                   # [P]
     own_dom = dom_s[s, jnp.clip(esn, 0, None)]                # [P]
     self_match = sig_match[s, M + pod_idx]                    # [P]
-    active = (self_match & (esn >= 0) & (own_dom >= 0))       # [P]
+    committed = self_match & (esn >= 0)
+    active = committed & (own_dom >= 0)                       # [P]
     adj = (
         active[:, None] & (dom_s[s] == own_dom[:, None])
     ).astype(jnp.float32)
-    return adj, active.astype(jnp.float32)
+    return adj, active.astype(jnp.float32), committed.astype(jnp.float32)
 
 
-def pairwise_from_counts(snap: ClusterSnapshot, counts, aff_ok,
+def symmetric_anti_block(snap: ClusterSnapshot, st: PairState, sig_match,
+                         exclude_self_node=None):
+    """[P, N] bool: node n is in a domain containing a holder of a
+    required anti-affinity term whose selector matches pod p (upstream
+    symmetric anti-affinity). One [P, S] x [S, N] matmul."""
+    dom_s = sig_domains(snap)                                # [S, N]
+    M = snap.running.valid.shape[0]
+    anti_at = jnp.take_along_axis(
+        st.anti, jnp.clip(dom_s, 0, None), axis=1
+    )                                                        # [S, N]
+    anti_at = jnp.where(dom_s >= 0, anti_at, 0.0)
+    matchers = sig_match[:, M:].astype(jnp.float32)          # [S, P]
+    blocked_cnt = matchers.T @ anti_at                       # [P, N]
+    if exclude_self_node is not None:
+        pods = snap.pods
+        esn = exclude_self_node
+        pod_idx = jnp.arange(pods.valid.shape[0])
+        for t in range(pods.ia_key.shape[1]):
+            s = jnp.clip(pods.ia_sig[:, t], 0, None)         # [P]
+            own_dom = dom_s[s, jnp.clip(esn, 0, None)]       # [P]
+            self_match = sig_match[s, M + pod_idx]           # [P]
+            active = (
+                _pod_anti_holds(snap, t) & self_match
+                & (esn >= 0) & (own_dom >= 0)
+            )
+            sub = active[:, None] & (dom_s[s] == own_dom[:, None])
+            blocked_cnt = blocked_cnt - sub.astype(jnp.float32)
+    return blocked_cnt > 0.5
+
+
+def pairwise_from_counts(snap: ClusterSnapshot, st: PairState, aff_ok,
                          sig_match=None, exclude_self_node=None):
     """Batched [P, N] evaluation of all spread/inter-pod constraints from
-    the current domain counts.
+    the current pair state.
 
     aff_ok: [P, N] required-node-affinity mask (spread domain-discovery
     honors it: upstream NodeAffinityPolicy Honor).
@@ -142,6 +258,7 @@ def pairwise_from_counts(snap: ClusterSnapshot, counts, aff_ok,
     if exclude_self_node is not None and sig_match is None:
         raise ValueError("exclude_self_node requires sig_match")
     nodes, pods = snap.nodes, snap.pods
+    counts = st.counts
     dom_s = sig_domains(snap)                                # [S, N]
     node_count_sig = jnp.take_along_axis(
         counts, jnp.clip(dom_s, 0, None), axis=1
@@ -160,7 +277,8 @@ def pairwise_from_counts(snap: ClusterSnapshot, counts, aff_ok,
     for c in range(C):  # static unroll; C is a small bucket
         s = jnp.clip(pods.ts_sig[:, c], 0, None)             # [P]
         valid_c = pods.ts_valid[:, c]
-        adj, _ = _self_adj(snap, sig_match, dom_s, s, exclude_self_node, pod_idx)
+        adj, _, _ = _self_adj(snap, sig_match, dom_s, s, exclude_self_node,
+                              pod_idx)
         nc = node_count_sig[s] - adj                         # [P, N]
         hk = has_key_sig[s]
         eligible = nodes.valid[None, :] & aff_ok & hk
@@ -180,23 +298,24 @@ def pairwise_from_counts(snap: ClusterSnapshot, counts, aff_ok,
     ia_raw = jnp.zeros((P, N), jnp.float32)
     IT = pods.ia_key.shape[1]
     M = snap.running.valid.shape[0]
-    total_sig = counts.sum(axis=1)                           # [S]
     for t in range(IT):
         s = jnp.clip(pods.ia_sig[:, t], 0, None)
         valid_t = pods.ia_valid[:, t]
-        adj, active = _self_adj(snap, sig_match, dom_s, s,
-                                exclude_self_node, pod_idx)
+        adj, _, active_tot = _self_adj(snap, sig_match, dom_s, s,
+                                       exclude_self_node, pod_idx)
         nc = node_count_sig[s] - adj
         hk = has_key_sig[s]
         node_has = hk & (nc > 0)
         anti = pods.ia_anti[:, t]
         req = pods.ia_required[:, t]
         # Upstream special case for required positive affinity: if no
-        # pod anywhere matches the selector but the incoming pod matches
-        # its own selector, any node with the topology key satisfies.
+        # pod anywhere matches the selector (including on nodes lacking
+        # the topology key — hence match_tot, not domain counts) but the
+        # incoming pod matches its own selector, any node with the
+        # topology key satisfies.
         if sig_match is not None:
             self_match = sig_match[s, M + pod_idx]           # [P]
-            all_zero = (total_sig[s] - active) <= 0          # [P]
+            all_zero = (st.match_tot[s] - active_tot) <= 0   # [P]
             pos_ok = node_has | ((all_zero & self_match)[:, None] & hk)
         else:
             pos_ok = node_has
@@ -206,14 +325,21 @@ def pairwise_from_counts(snap: ClusterSnapshot, counts, aff_ok,
         ia_raw += jnp.where(
             (valid_t & ~req)[:, None] & node_has, w[:, None], 0.0
         )
+
+    # Symmetric required anti-affinity: other members' anti terms repel
+    # matching incoming pods — applies to every pod, even ones with no
+    # constraints of their own.
+    if sig_match is not None:
+        ia_ok &= ~symmetric_anti_block(snap, st, sig_match, exclude_self_node)
     return spread_ok, spread_pen, ia_ok, ia_raw
 
 
-def pairwise_row(snap: ClusterSnapshot, counts, sig_match, p, aff_ok_p):
+def pairwise_row(snap: ClusterSnapshot, st: PairState, sig_match, p, aff_ok_p):
     """Single-pod [N] variant for the sequential scan: same math as
     pairwise_from_counts restricted to traced pod index p (no
     self-exclusion needed: the scan checks before committing)."""
     nodes, pods = snap.nodes, snap.pods
+    counts = st.counts
     dom_s = sig_domains(snap)                                # [S, N]
     node_count_sig = jnp.take_along_axis(
         counts, jnp.clip(dom_s, 0, None), axis=1
@@ -254,12 +380,22 @@ def pairwise_row(snap: ClusterSnapshot, counts, sig_match, p, aff_ok_p):
         anti = pods.ia_anti[p, t]
         req = pods.ia_required[p, t]
         # Same required-positive-affinity self-match special case as
-        # pairwise_from_counts.
-        all_zero = counts[s].sum() <= 0
+        # pairwise_from_counts; match_tot counts members on key-less
+        # nodes too, matching the oracle's match.any().
+        all_zero = st.match_tot[s] <= 0
         self_match = sig_match[s, M + p]
         pos_ok = node_has | (all_zero & self_match & hk)
         ok_t = jnp.where(anti, ~node_has, pos_ok)
         ia_ok &= jnp.where(valid_t & req, ok_t, True)
         w = jnp.where(anti, -pods.ia_weight[p, t], pods.ia_weight[p, t])
         ia_raw += jnp.where(valid_t & ~req & node_has, w, 0.0)
+
+    # Symmetric anti: [S] match vector x [S, N] holder counts.
+    anti_at = jnp.take_along_axis(
+        st.anti, jnp.clip(dom_s, 0, None), axis=1
+    )
+    anti_at = jnp.where(dom_s >= 0, anti_at, 0.0)
+    match_vec = sig_match[:, M + p].astype(jnp.float32)      # [S]
+    sym_blocked = (match_vec[:, None] * anti_at).sum(axis=0) > 0.5
+    ia_ok &= ~sym_blocked
     return spread_ok, spread_pen, ia_ok, ia_raw

@@ -26,8 +26,10 @@ Semantics notes (each mirrors an upstream plugin, SURVEY.md C2-C7):
     topology key are infeasible for DoNotSchedule constraints.
   * InterPodAffinity: required (anti-)affinity -> filter against running
     AND previously-assigned pending pods; preferred terms -> +-weight,
-    upstream-normalized. (Symmetric anti-affinity of *running* pods is
-    modelled via RunningPodArrays in a later phase; see SURVEY.md C7.)
+    upstream-normalized. Symmetric required anti-affinity: an *existing*
+    member's (running pod's or earlier-assigned pending pod's) required
+    anti-affinity term repels an incoming pod matching its selector
+    (SURVEY.md C7).
   * Dynamic QoS priority (C10): effective = base + gain*pressure,
     pressure = clip(slo - observed_avail, 0, 1); pop order is stable
     descending.
@@ -339,6 +341,55 @@ class Oracle:
                 raw += np.where(node_has, -w if anti else w, 0.0)
         return ok, raw
 
+    def symmetric_anti_ok(
+        self, p: int, assigned_nodes: list[int], assigned_pods: list[int]
+    ) -> np.ndarray:
+        """[N] bool: no member (running pod or already-assigned pending
+        pod) holds a required anti-affinity term whose selector matches
+        pod p with node n inside the holder's topology domain (upstream
+        symmetric anti-affinity)."""
+        snap, nodes, pods = self.snap, self.nodes, self.pods
+        dom = _np(nodes.domain)
+        N = dom.shape[0]
+        ok = np.ones(N, bool)
+        sig_key = _np(snap.sigs.key)
+        sig_atoms = _np(snap.sigs.atoms)
+        if not _np(snap.sigs.valid).any():
+            return ok
+        plp = _np(pods.label_pairs)[p : p + 1]
+        plk = _np(pods.label_keys)[p : p + 1]
+        sat_p = self.atom_sat_over(plp, plk)[:, 0]           # [A]
+
+        holders: list[tuple[int, int]] = []                  # (sig, node)
+        run = self.snap.running
+        ranti, rnode, rvalid = map(_np, (run.anti_sig, run.node_idx, run.valid))
+        for m in range(ranti.shape[0]):
+            if not rvalid[m] or rnode[m] < 0:
+                continue
+            for s in ranti[m]:
+                if s >= 0:
+                    holders.append((int(s), int(rnode[m])))
+        ia_sig, ia_anti, ia_req, ia_valid = map(
+            _np, (pods.ia_sig, pods.ia_anti, pods.ia_required, pods.ia_valid)
+        )
+        for q, nq in zip(assigned_pods, assigned_nodes):
+            for t in range(ia_sig.shape[1]):
+                if ia_valid[q, t] and ia_anti[q, t] and ia_req[q, t]:
+                    holders.append((int(ia_sig[q, t]), int(nq)))
+        for s, hn in holders:
+            match = bool(_np(pods.valid)[p])
+            for a in sig_atoms[s]:
+                if a >= 0:
+                    match = match and bool(sat_p[a])
+            if not match:
+                continue
+            key = sig_key[s]
+            hd = dom[hn, key]
+            if hd < 0:
+                continue  # holder's node lacks the key: no domain to poison
+            ok &= dom[:, key] != hd
+        return ok
+
     # -- the per-pod cycle ---------------------------------------------------
 
     def feasible_and_score(
@@ -362,6 +413,7 @@ class Oracle:
             & self.node_affinity_ok(p)
             & spread_ok
             & ia_ok
+            & self.symmetric_anti_ok(p, assigned_nodes, assigned_pods)
         )
         w = effective_weights(
             self.cfg,
@@ -477,6 +529,10 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
         ia_ok, _ = ora.interpod_ok_and_raw(p, others_n, others_p)
         if not ia_ok[n]:
             out.append(f"pod {p}: node {n} violates required pod affinity")
+        if not ora.symmetric_anti_ok(p, others_n, others_p)[n]:
+            out.append(
+                f"pod {p}: node {n} violates a member's symmetric anti-affinity"
+            )
     return out
 
 

@@ -35,7 +35,7 @@ from tpusched.config import (
     TAINT_EFFECTS,
     DO_NOT_SCHEDULE,
     SCHEDULE_ANYWAY,
-    _next_pow2,
+    _next_bucket,
 )
 
 
@@ -194,6 +194,12 @@ class RunningPodArrays:
     slack: Any        # [M] f32 observed_avail - slo (positive = cheap victim)
     label_pairs: Any  # [M, LP] int32
     label_keys: Any   # [M, LP] int32
+    # Required ANTI-affinity terms this running pod holds, as signature
+    # ids (-1 pad). Upstream inter-pod anti-affinity is SYMMETRIC: an
+    # existing pod's required anti-affinity repels incoming pods that
+    # match its selector (SURVEY.md C7). Preferred / positive terms of
+    # running pods are not symmetric for filtering and are not stored.
+    anti_sig: Any     # [M, IT] int32
     valid: Any        # [M] bool
 
 
@@ -307,13 +313,18 @@ class SnapshotBuilder:
         slack: float = 0.0,
         labels: Mapping[str, str] | None = None,
         count_into_used: bool = True,
+        pod_affinity: Sequence[PodAffinityTerm] = (),
     ) -> None:
+        """pod_affinity: only required ANTI terms affect scheduling (the
+        upstream symmetric anti-affinity rule); other terms are accepted
+        and ignored."""
         req = dict(requests)
         req.setdefault(RESOURCE_PODS, 1.0)
         self._running.append(
             dict(node=node, requests=req, priority=float(priority),
                  slack=float(slack), labels=dict(labels or {}),
-                 count_into_used=count_into_used)
+                 count_into_used=count_into_used,
+                 pod_affinity=list(pod_affinity))
         )
 
     # -- build --------------------------------------------------------------
@@ -419,6 +430,20 @@ class SnapshotBuilder:
                 t["sig"] = sid(t["key"], t["atoms"])
             pod_compiled.append(dict(req_terms=req_terms, pref_terms=pref_terms, ts=ts, ia=ia))
 
+        # Running pods' required anti-affinity terms (symmetric rule):
+        # interned into the same signature table as pending terms.
+        run_anti: list[list[int]] = []
+        run_anti_atom_max = 0
+        for rrec in self._running:
+            sigs_of_pod = []
+            for t in rrec["pod_affinity"]:
+                if not (t.anti and t.required):
+                    continue
+                alist = [aid(e) for e in t.selector]
+                run_anti_atom_max = max(run_anti_atom_max, len(alist))
+                sigs_of_pod.append(sid(topo_idx(t.topology_key), alist))
+            run_anti.append(sigs_of_pod)
+
         # Intern node labels/taints.
         for nrec in self._nodes:
             for k, v in nrec["labels"].items():
@@ -453,15 +478,19 @@ class SnapshotBuilder:
             atom_values=max((len(a[2]) for a in atoms), default=0),
             terms=max((len(pc["req_terms"]) for pc in pod_compiled), default=0),
             term_atoms=max(
-                [len(t) for pc in pod_compiled for t in pc["req_terms"]]
+                [run_anti_atom_max]
+                + [len(t) for pc in pod_compiled for t in pc["req_terms"]]
                 + [len(t[0]) for pc in pod_compiled for t in pc["pref_terms"]]
                 + [len(c["atoms"]) for pc in pod_compiled for c in pc["ts"]]
-                + [len(t["atoms"]) for pc in pod_compiled for t in pc["ia"]] or [0]
+                + [len(t["atoms"]) for pc in pod_compiled for t in pc["ia"]]
             ),
             pref_terms=max((len(pc["pref_terms"]) for pc in pod_compiled), default=0),
             topo_keys=len(topo_keys),
             spread_constraints=max((len(pc["ts"]) for pc in pod_compiled), default=0),
-            affinity_terms=max((len(pc["ia"]) for pc in pod_compiled), default=0),
+            affinity_terms=max(
+                [len(pc["ia"]) for pc in pod_compiled]
+                + [len(a) for a in run_anti] or [0]
+            ),
             pod_groups=len(self._groups),
             taint_vocab=len(taint_ids),
             signatures=len(sigs),
@@ -592,6 +621,7 @@ class SnapshotBuilder:
         run_slack = np.zeros(M, np.float32)
         run_lp = np.full((M, bk.pod_labels), -1, np.int32)
         run_lk = np.full((M, bk.pod_labels), -1, np.int32)
+        run_anti_sig = np.full((M, bk.affinity_terms), -1, np.int32)
         run_valid = np.zeros(M, bool)
         for i, rrec in enumerate(self._running):
             ni = node_index[rrec["node"]]
@@ -606,6 +636,8 @@ class SnapshotBuilder:
             for j, (k, v) in enumerate(sorted(rrec["labels"].items())):
                 run_lk[i, j] = key_ids[k]
                 run_lp[i, j] = pair_ids[(k, v)]
+            for j, s in enumerate(run_anti[i]):
+                run_anti_sig[i, j] = s
 
         snap = ClusterSnapshot(
             nodes=NodeArrays(
@@ -632,7 +664,7 @@ class SnapshotBuilder:
             running=RunningPodArrays(
                 node_idx=run_node, requests=run_req, priority=run_prio,
                 slack=run_slack, label_pairs=run_lp, label_keys=run_lk,
-                valid=run_valid,
+                anti_sig=run_anti_sig, valid=run_valid,
             ),
             atoms=AtomTable(key=atom_key, op=atom_op, pairs=atom_pairs,
                             num=atom_num, valid=atom_valid),
@@ -686,7 +718,7 @@ class _PodArraysNP:
 
 
 def _ceil_bucket(x: int) -> int:
-    return _next_pow2(max(x, 1))
+    return _next_bucket(max(x, 1))
 
 
 def _tolerates(tol: Toleration, tk: str, tv: str, te: str) -> bool:

@@ -47,8 +47,10 @@ def make_cluster(
     affinity_frac: float = 0.0,
     spread_frac: float = 0.0,
     interpod_frac: float = 0.0,
+    run_anti_frac: float = 0.0,
     gang_frac: float = 0.0,
     gang_size: int = 4,
+    keyless_node_frac: float = 0.0,
 ):
     """General-purpose random cluster. Fractions control what share of
     pods/nodes carry each constraint type, so the same generator covers
@@ -65,6 +67,11 @@ def make_cluster(
             "disktype": "ssd" if rng.random() < 0.5 else "hdd",
             "tier": str(rng.integers(0, 4)),
         }
+        if rng.random() < keyless_node_frac:
+            # Node missing the topology key: exercises the upstream
+            # "member on a key-less node" corner (spread DoNotSchedule
+            # filters such nodes; affinity match-anywhere still counts).
+            del labels["topology.kubernetes.io/zone"]
         taints = []
         if rng.random() < taint_frac:
             taints.append(("dedicated", "batch", "NoSchedule"))
@@ -106,12 +113,25 @@ def make_cluster(
                 continue
             rem[0] -= cpu_req
             rem[1] -= mem_req
+            run_kwargs: dict = {}
+            if rng.random() < run_anti_frac:
+                # A running pod whose required anti-affinity repels a
+                # whole app from its zone (symmetric anti-affinity).
+                run_kwargs["pod_affinity"] = [PodAffinityTerm(
+                    topology_key="topology.kubernetes.io/zone",
+                    selector=(MatchExpression(
+                        "app", "In", (apps[int(rng.integers(len(apps)))],)
+                    ),),
+                    anti=True,
+                    required=True,
+                )]
             b.add_running_pod(
                 node=name,
                 requests={"cpu": cpu_req, "memory": mem_req},
                 priority=float(rng.integers(0, 100)),
                 slack=float(rng.uniform(-0.2, 0.3)),
                 labels={"app": apps[int(rng.integers(len(apps)))]},
+                **run_kwargs,
             )
 
     for i in range(n_pods):
