@@ -161,7 +161,7 @@ def bench_preemption(args):
     log(f"[preemption] solve@1000x200 @90% util mode={args.mode}")
     rng = np.random.default_rng(45)
     snap, _ = _build(config5_preemption, rng, n_pods=1000, n_nodes=200)
-    engine = Engine(EngineConfig(mode=args.mode))
+    engine = Engine(EngineConfig(mode=args.mode, preemption=True))
     fn = _prep(engine, snap, "solve")
     stats = bench_fn(fn, max(20, args.iters // 3), label="preemption")
     emit("preemption_solve_p99_latency_1000x200", stats)

@@ -87,8 +87,8 @@ def test_fast_places_as_many_as_oracle(rng):
     assert (res.assignment >= 0).sum() >= (ora.assignment >= 0).sum() - 2
 
 
-def test_fast_gang_fields_ignored_until_phase5(rng):
-    # gangs present should not break fast mode (enforcement later)
+def test_fast_gang_workload_valid(rng):
+    # gangs enforced all-or-nothing; fast mode stays valid
     snap, _ = make_cluster(rng, 32, 8, gang_frac=0.5, gang_size=4)
     cfg = fast_cfg()
     res = Engine(cfg).solve(snap)

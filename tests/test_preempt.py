@@ -1,0 +1,220 @@
+"""Preemption (PostFilter) tests (SURVEY.md C9, BASELINE configs[4]):
+pods with no feasible node evict the cheapest eligible victim set by
+QoS-slack cost, identically in oracle, parity, and fast modes."""
+
+import numpy as np
+import pytest
+
+from tpusched import Engine, EngineConfig
+from tpusched.oracle import Oracle, validate_assignment
+from tpusched.snapshot import SnapshotBuilder
+from tpusched.synth import make_cluster
+
+
+def _cfg(mode="parity"):
+    return EngineConfig(mode=mode, preemption=True)
+
+
+def _full_node(b, name, victims, cpu=4000):
+    """Node filled to capacity by `victims` = [(prio, slack, cpu)]."""
+    b.add_node(name, {"cpu": cpu, "memory": 64 << 30, "pods": 110})
+    for i, (prio, slack, vcpu) in enumerate(victims):
+        b.add_running_pod(name, {"cpu": vcpu, "memory": 1 << 30},
+                          priority=prio, slack=slack)
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_preempts_cheapest_victim(mode):
+    """Two full nodes; the victim with the most QoS slack (equal
+    priority) is the cheapest eviction."""
+    cfg = _cfg(mode)
+    b = SnapshotBuilder(cfg)
+    _full_node(b, "n0", [(10, 0.05, 4000)])   # victim barely above SLO
+    _full_node(b, "n1", [(10, 0.30, 4000)])   # victim with slack to spare
+    b.add_pod("p", {"cpu": 2000, "memory": 1 << 30}, priority=500)
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert res.assignment[0] == 1, "should pick the high-slack victim's node"
+    assert res.evicted[:2].tolist() == [False, True]
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+    np.testing.assert_array_equal(res.evicted, ora.evicted)
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_no_eligible_victims_no_preemption(mode):
+    """Victims with higher effective priority than the preemptor are
+    untouchable."""
+    cfg = _cfg(mode)
+    b = SnapshotBuilder(cfg)
+    _full_node(b, "n0", [(1000, 0.3, 4000)])
+    b.add_pod("p", {"cpu": 2000, "memory": 1 << 30}, priority=5)
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert res.assignment[0] == -1
+    assert not res.evicted.any()
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_minimal_victim_prefix(mode):
+    """Node with several small victims: evict only as many (cheapest
+    first) as needed."""
+    cfg = _cfg(mode)
+    b = SnapshotBuilder(cfg)
+    _full_node(b, "n0", [(10, 0.3, 1000), (10, 0.2, 1000),
+                         (10, 0.1, 1000), (10, 0.0, 1000)])
+    b.add_pod("p", {"cpu": 1500, "memory": 1 << 30}, priority=500)
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert res.assignment[0] == 0
+    # needs 1500 free -> evict the two cheapest (slack 0.3 and 0.2)
+    assert res.evicted[:4].tolist() == [True, True, False, False]
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.evicted, ora.evicted)
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_below_slo_victim_is_protected(mode):
+    """A victim BELOW its SLO gets the qos_gain boost: a moderate
+    preemptor cannot evict it, a desperate one can."""
+    cfg = _cfg(mode)
+    b = SnapshotBuilder(cfg)
+    _full_node(b, "n0", [(10, -0.5, 4000)])   # 0.5 below SLO -> boosted
+    b.add_pod("meek", {"cpu": 2000, "memory": 1 << 30}, priority=50)
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert res.assignment[0] == -1, "boosted victim must be protected"
+
+    b2 = SnapshotBuilder(cfg)
+    _full_node(b2, "n0", [(10, -0.5, 4000)])
+    # desperate: SLO 0.99, observed 0.0 -> pressure 0.99 -> +990
+    b2.add_pod("desperate", {"cpu": 2000, "memory": 1 << 30}, priority=50,
+               slo_target=0.99, observed_avail=0.0)
+    snap2, _ = b2.build()
+    res2 = Engine(cfg).solve(snap2)
+    assert res2.assignment[0] == 0, "desperate pod should out-rank victim"
+    assert res2.evicted[:1].tolist() == [True]
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_preemptor_respects_taints(mode):
+    """Preemption cannot repair a taint: the tainted full node is not a
+    candidate even with cheap victims."""
+    cfg = _cfg(mode)
+    b = SnapshotBuilder(cfg)
+    b.add_node("n0", {"cpu": 4000, "memory": 64 << 30},
+               taints=[("dedicated", "batch", "NoSchedule")])
+    b.add_running_pod("n0", {"cpu": 4000, "memory": 1 << 30},
+                      priority=1, slack=0.5)
+    b.add_pod("p", {"cpu": 2000, "memory": 1 << 30}, priority=500)
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert res.assignment[0] == -1
+    assert not res.evicted.any()
+
+
+def test_later_pod_sees_eviction():
+    """Parity mode: after pod A preempts on n0, pod B's cycle sees the
+    updated state (victim gone, A's requests in place)."""
+    cfg = _cfg("parity")
+    b = SnapshotBuilder(cfg)
+    _full_node(b, "n0", [(10, 0.3, 3000), (10, 0.0, 1000)])
+    b.add_pod("a", {"cpu": 2500, "memory": 1 << 30}, priority=500)
+    b.add_pod("b", {"cpu": 400, "memory": 1 << 30}, priority=100)
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+    np.testing.assert_array_equal(res.evicted, ora.evicted)
+    # a preempted the 3000-cpu victim; remaining 500 free fits b's 400
+    assert res.assignment[0] == 0 and res.assignment[1] == 0
+    assert res.evicted[:2].tolist() == [True, False]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_preemption_parity_fuzz(seed):
+    rng = np.random.default_rng(11000 + seed)
+    snap, _ = make_cluster(
+        rng,
+        n_pods=int(rng.integers(10, 40)),
+        n_nodes=int(rng.integers(3, 10)),
+        initial_utilization=0.9,
+        n_running_per_node=int(rng.integers(2, 6)),
+        interpod_frac=float(rng.uniform(0, 0.3)),
+        spread_frac=float(rng.uniform(0, 0.3)),
+    )
+    cfg = _cfg("parity")
+    res = Engine(cfg).solve(snap)
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+    np.testing.assert_array_equal(res.evicted, ora.evicted)
+    assert (res.evicted.sum() > 0) or (res.assignment >= 0).all() or (
+        res.assignment == -1
+    ).any()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_preemption_fast_valid(seed):
+    rng = np.random.default_rng(12000 + seed)
+    snap, _ = make_cluster(
+        rng,
+        n_pods=int(rng.integers(10, 40)),
+        n_nodes=int(rng.integers(3, 10)),
+        initial_utilization=0.9,
+        n_running_per_node=4,
+    )
+    cfg = _cfg("fast")
+    res = Engine(cfg).solve(snap)
+    violations = validate_assignment(
+        snap, cfg, res.assignment, commit_key=res.commit_key,
+        evicted=res.evicted,
+    )
+    assert violations == [], violations
+
+
+def test_fast_postpass_prefers_fit_over_eviction():
+    """Regression: after pod a's eviction frees room, pod b (also left
+    over from the rounds) must simply fit — NOT evict the second victim."""
+    cfg = _cfg("fast")
+    b = SnapshotBuilder(cfg)
+    _full_node(b, "n0", [(10, 0.3, 3000), (10, 0.0, 1000)])
+    b.add_pod("a", {"cpu": 2500, "memory": 1 << 30}, priority=500)
+    b.add_pod("b", {"cpu": 400, "memory": 1 << 30}, priority=100)
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert res.assignment[0] == 0 and res.assignment[1] == 0
+    assert res.evicted[:2].tolist() == [True, False], (
+        "b fits in the freed 500 cpu; evicting the second victim is a bug"
+    )
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_gang_members_do_not_preempt(mode):
+    """A sub-quorum-capable gang must not evict running pods: its
+    placement is provisional until quorum."""
+    cfg = _cfg(mode)
+    b = SnapshotBuilder(cfg)
+    _full_node(b, "n0", [(1, 0.5, 4000)])  # very cheap victim
+    for i in range(2):
+        b.add_pod(f"g-{i}", {"cpu": 1500, "memory": 1 << 30}, priority=500,
+                  pod_group="g", pod_group_min_member=2)
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert (res.assignment[:2] == -1).all()
+    assert not res.evicted.any(), "gang member evicted a running pod"
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+    np.testing.assert_array_equal(res.evicted, ora.evicted)
+
+
+def test_preemption_off_by_default():
+    cfg = EngineConfig()
+    b = SnapshotBuilder(cfg)
+    _full_node(b, "n0", [(1, 0.5, 4000)])
+    b.add_pod("p", {"cpu": 2000, "memory": 1 << 30}, priority=500)
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert res.assignment[0] == -1
+    assert not res.evicted.any()

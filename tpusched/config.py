@@ -172,8 +172,17 @@ class QoSConfig:
     # configured ("balanced") profile and a pure least-requested
     # ("place me fast") profile: effective_w = (1-p)*w + p*w_urgent.
     urgency_reweight: bool = True
-    # A preemptor must exceed a victim's slack by this margin.
+    # A preemptor's effective priority must exceed a victim's effective
+    # priority (victim: priority + qos_gain * clip(-slack, 0, 1), i.e. a
+    # victim below its SLO is boosted) by this margin to evict it.
     preemption_margin: float = 0.0
+    # Eviction cost (SURVEY.md C9: "eviction cost = victim's QoS slack"):
+    #   cost(victim) = eff_priority(victim) - evict_slack_weight
+    #                  * clip(slack, 0, 1)
+    # so among equal-priority victims, the one furthest ABOVE its SLO is
+    # cheapest. Costs are shifted positive per snapshot (+1 per victim),
+    # which also encodes the upstream "fewer victims" preference.
+    evict_slack_weight: float = 100.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -193,6 +202,11 @@ class EngineConfig:
     # trades completeness for bounded latency: pods still pending at the
     # cap stay unassigned for the batch.
     max_rounds: int = 0
+    # PostFilter preemption (SURVEY.md C9): pods with no feasible node
+    # evict the cheapest eligible victim set (QoS-slack cost) on the
+    # best node. Off by default: enabling it changes SolveResult
+    # semantics (evicted victims) and the host must issue deletes.
+    preemption: bool = False
     # Deterministic tie-break: lowest node index among score maxima.
     # (Upstream uses seeded roulette; both our paths and the oracle share
     # this rule so parity is well-defined. SURVEY.md §7 hard part 2.)
@@ -220,14 +234,14 @@ class EngineConfig:
             kw["weights"] = PluginWeights(**d["weights"])
         if "qos" in d:
             kw["qos"] = QoSConfig(**d["qos"])
-        for k in ("mode", "max_rounds", "tie_break"):
+        for k in ("mode", "max_rounds", "tie_break", "preemption"):
             if k in d:
                 kw[k] = d[k]
         if "mesh_shape" in d:
             kw["mesh_shape"] = tuple(d["mesh_shape"])
         extra = set(d) - {
             "resources", "score_resource_weights", "weights", "qos",
-            "mode", "max_rounds", "tie_break", "mesh_shape",
+            "mode", "max_rounds", "tie_break", "mesh_shape", "preemption",
         }
         if extra:
             raise ValueError(f"unknown EngineConfig keys: {sorted(extra)}")

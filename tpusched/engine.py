@@ -32,6 +32,9 @@ class SolveResult:
     # (parity: pop-order position; fast: round index). -1 = unplaced.
     commit_key: np.ndarray | None = None
     rounds: int = 0            # commit rounds (fast mode; P for parity)
+    # [M] bool: running pods evicted by preemption (cfg.preemption);
+    # the host must delete these before binding their preemptors.
+    evicted: np.ndarray | None = None
     solve_seconds: float = 0.0
 
 
@@ -68,13 +71,15 @@ class Engine:
             node_sat_t, member_sat_t = _sat_tables(snap)
             if cfg.mode == "fast":
                 return solve_rounds(cfg, snap, node_sat_t, member_sat_t)
-            a, c, u, o = solve_sequential(cfg, snap, node_sat_t, member_sat_t)
+            a, c, u, o, ev = solve_sequential(
+                cfg, snap, node_sat_t, member_sat_t
+            )
             # parity commit key = position in pop order (strictly serial)
             P = a.shape[0]
             rank = jnp.zeros(P, jnp.int32).at[o].set(
                 jnp.arange(P, dtype=jnp.int32)
             )
-            return a, c, u, o, rank, jnp.int32(P)
+            return a, c, u, o, rank, jnp.int32(P), ev
 
         def _solve_packed(snap: ClusterSnapshot):
             # One flat f32 output = ONE device->host fetch. The transport
@@ -82,11 +87,12 @@ class Engine:
             # trip per fetched buffer, which dwarfs the payload cost —
             # same lesson as SURVEY.md §7 hard part 6. Indices are exact
             # in f32 (< 2^24).
-            assigned, chosen, used, order, commit_key, rounds = _solve(snap)
+            assigned, chosen, used, order, commit_key, rounds, ev = _solve(snap)
             return jnp.concatenate([
                 assigned.astype(jnp.float32), chosen,
                 order.astype(jnp.float32), commit_key.astype(jnp.float32),
-                used.reshape(-1), rounds.astype(jnp.float32)[None],
+                used.reshape(-1), ev.astype(jnp.float32),
+                rounds.astype(jnp.float32)[None],
             ])
 
         def _score(snap: ClusterSnapshot):
@@ -122,12 +128,15 @@ class Engine:
         buf = np.asarray(self._solve_packed_jit(snap))
         P = snap.pods.valid.shape[0]
         N, R = snap.nodes.used.shape
+        M = snap.running.valid.shape[0]
+        base = 4 * P + N * R
         out = SolveResult(
             assignment=buf[:P].astype(np.int32),
             chosen_score=buf[P : 2 * P],
             order=buf[2 * P : 3 * P].astype(np.int32),
             commit_key=buf[3 * P : 4 * P].astype(np.int32),
-            final_used=buf[4 * P : 4 * P + N * R].reshape(N, R),
+            final_used=buf[4 * P : base].reshape(N, R),
+            evicted=buf[base : base + M] > 0,
             rounds=int(buf[-1]),
         )
         out.solve_seconds = time.perf_counter() - t0

@@ -42,6 +42,7 @@ from flax import struct
 from tpusched.config import EngineConfig
 from tpusched.kernels import filter as kfilter
 from tpusched.kernels import pairwise as kpair
+from tpusched.kernels import preempt as kpreempt
 from tpusched.kernels import score as kscore
 from tpusched.qos import effective_priority, effective_weights, pressure_of
 from tpusched.snapshot import ClusterSnapshot
@@ -136,19 +137,17 @@ def batched_cycle(cfg: EngineConfig, snap: ClusterSnapshot,
 
 def pod_cycle(cfg: EngineConfig, snap: ClusterSnapshot, static: StaticCtx,
               p, used, pair_st):
-    """Single-pod [N] Filter + Score (sequential scan body)."""
+    """Single-pod [N] Filter + Score (sequential scan body). Also
+    returns the non-resource feasibility (static & pairwise) so the
+    preemption branch can reuse it without recomputing pairwise_row."""
     nodes = snap.nodes
     nvalid = nodes.valid
     req = snap.pods.requests[p]
     spread_ok, spread_pen, ia_ok, ia_raw = kpair.pairwise_row(
         snap, pair_st, static.sig_match, p, static.aff_ok[p]
     )
-    feasible = (
-        static.mask[p]
-        & kfilter.resource_fit(nodes.allocatable, used, req)
-        & spread_ok
-        & ia_ok
-    )
+    allowed = static.mask[p] & spread_ok & ia_ok
+    feasible = allowed & kfilter.resource_fit(nodes.allocatable, used, req)
     score = (
         static.w_lr[p] * kscore.least_requested(nodes.allocatable, used, req, static.rw)
         + static.w_ba[p] * kscore.balanced_allocation(nodes.allocatable, used, req, static.rw)
@@ -156,7 +155,38 @@ def pod_cycle(cfg: EngineConfig, snap: ClusterSnapshot, static: StaticCtx,
         + static.w_ts[p] * kscore.inverse_normalize(spread_pen, nvalid)
         + static.w_ia[p] * kscore.minmax_normalize(ia_raw, nvalid)
     ).astype(jnp.float32)
-    return feasible, score
+    return feasible, score, allowed
+
+
+def gang_rollback(snap: ClusterSnapshot, used, assigned, chosen, pair_st,
+                  sig_match):
+    """All-or-nothing Permit gate (SURVEY.md C8, coscheduling): a pod
+    group with fewer than group_min_member placed members rolls back
+    entirely — capacity, pair state, and assignments. minMember is a
+    floor, not a cap: extra members above quorum stay placed. Quorum is
+    batch-local (running members are not tracked against minMember).
+    Returns (used, assigned, chosen, pair_st, rolled_mask)."""
+    pods = snap.pods
+    P = assigned.shape[0]
+    G = snap.group_min_member.shape[0]
+    if G == 0:
+        return used, assigned, chosen, pair_st, jnp.zeros(P, bool)
+    g = pods.group
+    placed = (assigned >= 0) & pods.valid & (g >= 0)
+    gclip = jnp.clip(g, 0, None)
+    cnt = jnp.zeros(G, jnp.float32).at[gclip].add(placed.astype(jnp.float32))
+    quorum = cnt >= snap.group_min_member.astype(jnp.float32)
+    roll = placed & ~quorum[gclip]
+    used = used.at[jnp.clip(assigned, 0, None)].add(
+        -jnp.where(roll[:, None], pods.requests, 0.0)
+    )
+    if snap.sigs.key.shape[0]:
+        pair_st = kpair.pair_state_commit(
+            snap, pair_st, sig_match, assigned, roll, sign=-1.0
+        )
+    assigned = jnp.where(roll, -1, assigned)
+    chosen = jnp.where(roll, NEG_INF, chosen)
+    return used, assigned, chosen, pair_st, roll
 
 
 def pop_order(cfg: EngineConfig, snap: ClusterSnapshot):
@@ -170,29 +200,82 @@ def pop_order(cfg: EngineConfig, snap: ClusterSnapshot):
     return jnp.argsort(-key, stable=True)
 
 
+def _preempt_branch(cfg: EngineConfig, snap: ClusterSnapshot, static,
+                    pctx, prio_p, p, allowed, used, st, evicted):
+    """PostFilter for one pod: victim search + state updates. `allowed`
+    is the pod's non-resource feasibility row from pod_cycle. Returns
+    (used, st, evicted, node-or-minus-1)."""
+    best_n, can, evict_m, freed = kpreempt.preempt_step(
+        cfg, snap, pctx, prio_p, snap.pods.requests[p], allowed, used, evicted
+    )
+    used = used - freed
+    used = used.at[best_n].add(
+        jnp.where(can, snap.pods.requests[p], 0.0)
+    )
+    st = kpair.pair_state_evict(snap, st, static.sig_match, evict_m)
+    st = kpair.pair_state_add_pod(snap, st, static.sig_match, p, best_n, can)
+    evicted = evicted | evict_m
+    return used, st, evicted, jnp.where(can, best_n, -1).astype(jnp.int32)
+
+
 def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
                      node_sat_t, member_sat_t):
-    """Exact sequential commit: stock scheduleOne semantics on device."""
+    """Exact sequential commit: stock scheduleOne semantics on device,
+    including inline PostFilter preemption (cfg.preemption) at the exact
+    point upstream runs it — immediately after a pod fails Filter.
+    Returns (assigned, chosen, used, order, evicted)."""
     static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
     P = snap.pods.valid.shape[0]
+    M = snap.running.valid.shape[0]
     order = pop_order(cfg, snap)
     st0 = kpair.pair_state_init(snap, static.sig_match)
+    do_preempt = cfg.preemption and M > 0
+    if do_preempt:
+        pctx = kpreempt.precompute(cfg, snap)
+        prio = effective_priority(
+            cfg, snap.pods.base_priority, snap.pods.slo_target,
+            snap.pods.observed_avail,
+        )
 
     def body(carry, p):
-        used, assigned, st = carry
-        feasible, score = pod_cycle(cfg, snap, static, p, used, st)
+        used, assigned, st, evicted = carry
+        feasible, score, allowed = pod_cycle(cfg, snap, static, p, used, st)
         masked = jnp.where(feasible, score, NEG_INF)
         n = jnp.argmax(masked)  # tie-break: first max (EngineConfig.tie_break)
         commit = jnp.any(feasible)
         used = used.at[n].add(jnp.where(commit, snap.pods.requests[p], 0.0))
         st = kpair.pair_state_add_pod(snap, st, static.sig_match, p, n, commit)
-        assigned = assigned.at[p].set(jnp.where(commit, n, -1).astype(jnp.int32))
-        return (used, assigned, st), jnp.where(commit, masked[n], NEG_INF)
+        a_p = jnp.where(commit, n, -1).astype(jnp.int32)
+        if do_preempt:
+            # Gang members never preempt: their placement is provisional
+            # until quorum (gang_rollback), and evicting real workloads
+            # for a provisional placement would strand the victims.
+            used, st, evicted, pn = jax.lax.cond(
+                ~commit & snap.pods.valid[p] & (snap.pods.group[p] < 0),
+                lambda ops: _preempt_branch(
+                    cfg, snap, static, pctx, prio[p], p, allowed, *ops
+                ),
+                lambda ops: (*ops, jnp.int32(-1)),
+                (used, st, evicted),
+            )
+            a_p = jnp.where(commit, a_p, pn)
+        assigned = assigned.at[p].set(a_p)
+        # Preempted placements carry no score (upstream nominates without
+        # rescoring); chosen stays -inf for them, as in the oracle.
+        return (used, assigned, st, evicted), jnp.where(commit, masked[n], NEG_INF)
 
-    init = (snap.nodes.used, jnp.full(P, -1, jnp.int32), st0)
-    (used, assigned, _), chosen_in_order = jax.lax.scan(body, init, order)
+    init = (
+        snap.nodes.used, jnp.full(P, -1, jnp.int32), st0,
+        jnp.zeros(M, bool),
+    )
+    (used, assigned, st, evicted), chosen_in_order = jax.lax.scan(
+        body, init, order
+    )
     chosen = jnp.full(P, NEG_INF, jnp.float32).at[order].set(chosen_in_order)
-    return assigned, chosen, used, order
+    used, assigned, chosen, _, _ = gang_rollback(
+        snap, used, assigned, chosen, st, static.sig_match
+    )
+    return assigned, chosen, used, order, evicted
 
 
 def score_batch(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
@@ -450,10 +533,82 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         jnp.zeros(P, bool), jnp.full(P, NEG_INF, jnp.float32),
         jnp.full(P, -1, jnp.int32), jnp.array(True), jnp.int32(0),
     )
-    used, assigned, _, _, chosen, round_of, _, rounds = jax.lax.while_loop(
+    used, assigned, st_f, _, chosen, round_of, _, rounds = jax.lax.while_loop(
         cond, body, init
     )
+    M = snap.running.valid.shape[0]
+    evicted = jnp.zeros(M, bool)
+    if cfg.preemption and M > 0:
+        # PostFilter pass over still-unplaced pods in priority order
+        # (fast mode runs it after the commit rounds; parity mode runs
+        # it inline like upstream scheduleOne). Each leftover pod first
+        # re-checks PLAIN feasibility against the now-current state — an
+        # earlier preemptor's eviction (or a max_rounds cap) may have
+        # left room, in which case it commits without evicting anyone.
+        pctx = kpreempt.precompute(cfg, snap)
+        prio = effective_priority(
+            cfg, pods.base_priority, pods.slo_target, pods.observed_avail
+        )
+
+        def pbody(carry, p):
+            used, assigned, st, evicted, round_of, chosen = carry
+            active = (assigned[p] < 0) & pods.valid[p]
+
+            def act(ops):
+                used, st, evicted = ops
+                feasible, score, allowed = pod_cycle(
+                    cfg, snap, static, p, used, st
+                )
+                masked = jnp.where(feasible, score, NEG_INF)
+                n = jnp.argmax(masked)
+                commit = jnp.any(feasible)
+                used2 = used.at[n].add(
+                    jnp.where(commit, pods.requests[p], 0.0)
+                )
+                st2 = kpair.pair_state_add_pod(
+                    snap, st, static.sig_match, p, n, commit
+                )
+                # Gang members never preempt (see solve_sequential).
+                used3, st3, evicted3, pn = jax.lax.cond(
+                    ~commit & (pods.group[p] < 0),
+                    lambda ops2: _preempt_branch(
+                        cfg, snap, static, pctx, prio[p], p, allowed, *ops2
+                    ),
+                    lambda ops2: (*ops2, jnp.int32(-1)),
+                    (used2, st2, evicted),
+                )
+                a_p = jnp.where(commit, n.astype(jnp.int32), pn)
+                ch = jnp.where(commit, masked[n], NEG_INF)
+                return used3, st3, evicted3, a_p, ch
+
+            used, st, evicted, a_p, ch = jax.lax.cond(
+                active, act,
+                lambda ops: (
+                    *ops, jnp.int32(-1), jnp.float32(NEG_INF)
+                ),
+                (used, st, evicted),
+            )
+            assigned = assigned.at[p].set(
+                jnp.where(a_p >= 0, a_p, assigned[p])
+            )
+            chosen = chosen.at[p].set(
+                jnp.where(a_p >= 0, ch, chosen[p])
+            )
+            # Post-pass commits land strictly after all rounds, in pop
+            # order (commit_key = rounds + rank).
+            round_of = round_of.at[p].set(
+                jnp.where(a_p >= 0, rounds + rank[p], round_of[p])
+            )
+            return (used, assigned, st, evicted, round_of, chosen), a_p
+
+        (used, assigned, st_f, evicted, round_of, chosen), _ = jax.lax.scan(
+            pbody, (used, assigned, st_f, evicted, round_of, chosen), order
+        )
+    used, assigned, chosen, st_f, rolled = gang_rollback(
+        snap, used, assigned, chosen, st_f, static.sig_match
+    )
+    round_of = jnp.where(rolled, -1, round_of)
     # Commit key for external validity audits: pods committed in earlier
     # rounds precede later ones; within a round all commits share a key
     # (the engine validated them against end-of-round state).
-    return assigned, chosen, used, order, round_of, rounds
+    return assigned, chosen, used, order, round_of, rounds, evicted

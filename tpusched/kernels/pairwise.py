@@ -185,6 +185,42 @@ def pair_state_add_pod(snap: ClusterSnapshot, st: PairState, sig_match,
     return PairState(counts=counts, anti=anti, match_tot=match_tot)
 
 
+def pair_state_evict(snap: ClusterSnapshot, st: PairState, sig_match,
+                     evict_m) -> PairState:
+    """Remove evicted RUNNING members' contributions (preemption,
+    SURVEY.md C9): their selector matches leave counts/match_tot and
+    their required anti terms stop poisoning domains."""
+    dom_s = sig_domains(snap)                                # [S, N]
+    S = dom_s.shape[0]
+    node = snap.running.node_idx                             # [M]
+    M = node.shape[0]
+    mdom = dom_s[:, jnp.clip(node, 0, None)]                 # [S, M]
+    ok = (
+        sig_match[:, :M] & evict_m[None, :]
+        & (mdom >= 0) & (node >= 0)[None, :]
+    )
+    rows = jnp.broadcast_to(jnp.arange(S)[:, None], mdom.shape)
+    counts = st.counts.at[rows, jnp.clip(mdom, 0, None)].add(
+        -ok.astype(jnp.float32)
+    )
+    match_tot = st.match_tot - jnp.sum(
+        (sig_match[:, :M] & evict_m[None, :]).astype(jnp.float32), axis=1
+    )
+    anti = st.anti
+    asig = snap.running.anti_sig                             # [M, J]
+    if asig.shape[1]:
+        sclip = jnp.clip(asig, 0, None)
+        dom_mj = dom_s[sclip, jnp.clip(node, 0, None)[:, None]]  # [M, J]
+        okj = (
+            (asig >= 0) & evict_m[:, None]
+            & (node >= 0)[:, None] & (dom_mj >= 0)
+        )
+        anti = anti.at[sclip, jnp.clip(dom_mj, 0, None)].add(
+            -okj.astype(jnp.float32)
+        )
+    return PairState(counts=counts, anti=anti, match_tot=match_tot)
+
+
 # ---------------------------------------------------------------------------
 # Constraint evaluation from the state.
 # ---------------------------------------------------------------------------

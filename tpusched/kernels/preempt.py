@@ -1,0 +1,138 @@
+"""Preemption (PostFilter) kernel (SURVEY.md C9, §3.4).
+
+The reference scheduler's signature behavior: a pod with no feasible
+node searches for nodes where evicting lower-priority victims makes it
+fit, choosing the minimum-cost victim set, with eviction cost driven by
+the victims' QoS slack (pods running above their SLO are cheap to evict;
+see qos.evict_cost_raw and QoSConfig).
+
+TPU formulation: victims are sorted ONCE per snapshot by (node, cost)
+(PreemptCtx). A preemptor's step is then a masked segment-prefix scan —
+eligible victims' cumulative requests within each node's segment — and
+the cheapest feasible prefix per node falls out of the FIRST position
+where the preemptor fits (costs ascend within a segment, so the first
+feasible prefix is the min-cost one). A scatter-min over segments yields
+per-node best costs; argmin picks the node. Everything is fixed-shape
+[M]/[N] arithmetic — no Hungarian augmenting paths, no data-dependent
+loops (the auction-style "bid per node, pick globally best" recommended
+over classical Hungarian by SURVEY.md §7 hard part 4).
+
+Scope notes (mirrored exactly by the oracle so parity is testable):
+  * Only RESOURCE infeasibility is repaired: the preemptor's static
+    predicates (taints/affinity) and pairwise constraints must already
+    hold on the target node, evaluated against pre-eviction state.
+  * No PodDisruptionBudget concept (the snapshot has none).
+  * The preemptor is assigned immediately (the host shim issues deletes
+    then binds; upstream nominates and re-queues instead).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from tpusched.config import EngineConfig
+from tpusched.kernels import pairwise as kpair
+from tpusched.qos import evict_cost_raw, victim_effective_priority
+from tpusched.snapshot import ClusterSnapshot
+
+
+@struct.dataclass
+class PreemptCtx:
+    """Snapshot-static victim ordering and costs."""
+
+    perm: Any        # [M] int32: running pods sorted by (node, cost)
+    node_s: Any      # [M] int32 node of sorted victim (N = invalid sentinel)
+    seg_start: Any   # [M] int32 index where this node's segment begins
+    cost_s: Any      # [M] f32 shifted-positive eviction cost, sorted
+    vprio_s: Any     # [M] f32 victim effective priority, sorted
+    req_s: Any       # [M, R] f32 victim requests, sorted
+
+
+def precompute(cfg: EngineConfig, snap: ClusterSnapshot) -> PreemptCtx:
+    run = snap.running
+    M = run.valid.shape[0]
+    N = snap.nodes.valid.shape[0]
+    vprio = victim_effective_priority(cfg, run.priority, run.slack)
+    raw = evict_cost_raw(cfg, run.priority, run.slack).astype(jnp.float32)
+    # Shift costs positive (+1 per victim): prefix sums then strictly
+    # increase, making "first feasible prefix = cheapest" hold and
+    # encoding the fewer-victims preference (upstream tie-break).
+    mn = jnp.min(jnp.where(run.valid, raw, jnp.inf))
+    mn = jnp.where(jnp.isfinite(mn), mn, 0.0)
+    cost = raw - mn + 1.0
+    node_m = jnp.where(run.valid & (run.node_idx >= 0), run.node_idx, N)
+    perm = jnp.lexsort((cost, node_m))
+    node_s = node_m[perm]
+    idx = jnp.arange(M, dtype=jnp.int32)
+    if M:
+        boundary = jnp.concatenate(
+            [jnp.ones(1, bool), node_s[1:] != node_s[:-1]]
+        )
+        seg_start = jax.lax.cummax(jnp.where(boundary, idx, 0))
+    else:
+        seg_start = idx
+    return PreemptCtx(
+        perm=perm, node_s=node_s, seg_start=seg_start,
+        cost_s=cost[perm], vprio_s=vprio[perm].astype(jnp.float32),
+        req_s=run.requests[perm],
+    )
+
+
+def preempt_step(cfg: EngineConfig, snap: ClusterSnapshot, ctx: PreemptCtx,
+                 p_prio, p_req, allowed_row, used, evicted):
+    """One preemptor's victim search. Returns
+    (best_n, can, evict_m, freed) — chosen node (int32), whether
+    preemption succeeds (bool), the [M] eviction mask, and the [N, R]
+    capacity freed on the chosen node (zeros elsewhere)."""
+    nodes = snap.nodes
+    M = ctx.perm.shape[0]
+    N = nodes.valid.shape[0]
+    idx = jnp.arange(M, dtype=jnp.int32)
+
+    elig = (
+        (ctx.node_s < N)
+        & ~evicted[ctx.perm]
+        & (ctx.vprio_s + cfg.qos.preemption_margin < p_prio)
+    )
+    req_m = jnp.where(elig[:, None], ctx.req_s, 0.0)
+    cum_req = jnp.cumsum(req_m, axis=0)                      # [M, R] inclusive
+    cum_cost = jnp.cumsum(jnp.where(elig, ctx.cost_s, 0.0))  # [M]
+    off_req = jnp.where(
+        (ctx.seg_start > 0)[:, None],
+        cum_req[jnp.clip(ctx.seg_start - 1, 0, None)], 0.0,
+    )
+    off_cost = jnp.where(
+        ctx.seg_start > 0, cum_cost[jnp.clip(ctx.seg_start - 1, 0, None)], 0.0
+    )
+    within_req = cum_req - off_req                           # [M, R]
+    within_cost = cum_cost - off_cost                        # [M]
+    cap_node = jnp.clip(ctx.node_s, 0, N - 1)
+    fits = elig & jnp.all(
+        used[cap_node] - within_req + p_req[None, :]
+        <= nodes.allocatable[cap_node],
+        axis=-1,
+    )
+    # Per node: cost of the FIRST feasible prefix (costs ascend within a
+    # segment, so first feasible = cheapest); N index = sentinel bucket.
+    node_cost = jnp.full(N + 1, jnp.inf).at[ctx.node_s].min(
+        jnp.where(fits, within_cost, jnp.inf)
+    )[:N]
+    total = jnp.where(allowed_row & nodes.valid, node_cost, jnp.inf)
+    best_n = jnp.argmin(total).astype(jnp.int32)
+    can = jnp.isfinite(total[best_n])
+    first_pos = jnp.full(N + 1, M, jnp.int32).at[ctx.node_s].min(
+        jnp.where(fits, idx, M)
+    )[jnp.clip(best_n, 0, N - 1)]
+    sel_s = can & (ctx.node_s == best_n) & elig & (idx <= first_pos)
+    evict_m = jnp.zeros(M, bool).at[ctx.perm].set(sel_s)
+    freed_on_best = jnp.sum(
+        jnp.where(sel_s[:, None], ctx.req_s, 0.0), axis=0
+    )                                                        # [R]
+    freed = jnp.zeros_like(used).at[best_n].add(
+        jnp.where(can, freed_on_best, 0.0)
+    )
+    return best_n, can, evict_m, freed

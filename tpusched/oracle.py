@@ -60,7 +60,13 @@ from tpusched.config import (
     OP_NOT_IN,
     SCHEDULE_ANYWAY,
 )
-from tpusched.qos import effective_priority, pressure_of, effective_weights
+from tpusched.qos import (
+    effective_priority,
+    effective_weights,
+    evict_cost_raw,
+    pressure_of,
+    victim_effective_priority,
+)
 from tpusched.snapshot import ClusterSnapshot
 
 
@@ -70,6 +76,7 @@ class OracleResult:
     order: np.ndarray            # [P] int32 pop order (indices into pods)
     chosen_score: np.ndarray     # [P] f32 score of the chosen node (-inf if none)
     final_used: np.ndarray       # [N, R] f32 node used after all commits
+    evicted: np.ndarray | None = None  # [M] bool preemption victims
 
 
 def _np(x) -> np.ndarray:
@@ -83,6 +90,9 @@ class Oracle:
         self.nodes = snap.nodes
         self.pods = snap.pods
         self._atom_sat_nodes = None
+        # Preemption state: evicted running pods stop counting as
+        # members everywhere (capacity, pairwise counts, anti holders).
+        self._evicted = np.zeros(_np(snap.running.valid).shape[0], bool)
 
     # -- atoms over node labels --------------------------------------------
 
@@ -225,7 +235,8 @@ class Oracle:
         lp = np.concatenate([_np(run.label_pairs)] + extra_lp, axis=0)
         lk = np.concatenate([_np(run.label_keys)] + extra_lk, axis=0)
         valid = np.concatenate(
-            [_np(run.valid)] + [np.ones(len(x), bool) for x in extra_lp]
+            [_np(run.valid) & ~self._evicted]
+            + [np.ones(len(x), bool) for x in extra_lp]
         )
         sat = self.atom_sat_over(lp, lk)
         match = valid.copy()
@@ -364,7 +375,7 @@ class Oracle:
         run = self.snap.running
         ranti, rnode, rvalid = map(_np, (run.anti_sig, run.node_idx, run.valid))
         for m in range(ranti.shape[0]):
-            if not rvalid[m] or rnode[m] < 0:
+            if not rvalid[m] or rnode[m] < 0 or self._evicted[m]:
                 continue
             for s in ranti[m]:
                 if s >= 0:
@@ -434,6 +445,99 @@ class Oracle:
         ).astype(np.float32)
         return feasible, score
 
+    def try_preempt(
+        self, p: int, p_prio: float, used: np.ndarray,
+        assigned_nodes: list[int], assigned_pods: list[int],
+    ) -> tuple[int, list[int]]:
+        """PostFilter (SURVEY.md C9): find the minimum-cost eligible
+        victim prefix per allowed node, pick the cheapest node. Mirrors
+        kernels/preempt.py exactly (same cost shift, same stable
+        cost-sort, same first-feasible-prefix rule). Returns
+        (node or -1, victim running-pod indices)."""
+        cfg = self.cfg
+        run = self.snap.running
+        rvalid, rnode = _np(run.valid), _np(run.node_idx)
+        M = rvalid.shape[0]
+        if not cfg.preemption or M == 0:
+            return -1, []
+        if _np(self.pods.group)[p] >= 0:
+            # Gang members never preempt: their placement is provisional
+            # until quorum, and evicting for a provisional placement
+            # would strand the victims (mirrors kernels/assign.py).
+            return -1, []
+        spread_ok, _ = self.spread_ok_and_penalty(p, assigned_nodes, assigned_pods)
+        ia_ok, _ = self.interpod_ok_and_raw(p, assigned_nodes, assigned_pods)
+        allowed = (
+            _np(self.nodes.valid)
+            & self.taints_ok(p)
+            & self.node_affinity_ok(p)
+            & spread_ok
+            & ia_ok
+            & self.symmetric_anti_ok(p, assigned_nodes, assigned_pods)
+        )
+        N = allowed.shape[0]
+        vprio = np.asarray(
+            victim_effective_priority(cfg, _np(run.priority), _np(run.slack)),
+            np.float32,
+        )
+        raw = np.asarray(
+            evict_cost_raw(cfg, _np(run.priority), _np(run.slack)), np.float32
+        )
+        mn = raw[rvalid].min() if rvalid.any() else np.float32(0.0)
+        cost = (raw - mn + 1.0).astype(np.float32)
+        elig = (
+            rvalid & ~self._evicted & (rnode >= 0)
+            & (vprio + cfg.qos.preemption_margin < p_prio)
+        )
+        alloc = _np(self.nodes.allocatable)
+        req_p = _np(self.pods.requests)[p]
+        rreq = _np(run.requests)
+        # Victim-prefix search, arithmetic mirroring kernels/preempt.py
+        # step for step (global f32 cumsum minus segment offset over the
+        # same (node, cost) sort) so fit/cost decisions agree with the
+        # device to the last ULP the scan association allows.
+        node_m = np.where(rvalid & (rnode >= 0), rnode, N)
+        perm = np.lexsort((cost, node_m))
+        node_s = node_m[perm]
+        idx = np.arange(M)
+        boundary = np.concatenate([[True], node_s[1:] != node_s[:-1]]) if M else np.zeros(0, bool)
+        seg_start = np.maximum.accumulate(np.where(boundary, idx, 0))
+        elig_s = elig[perm]
+        req_s = np.where(elig_s[:, None], rreq[perm], 0.0).astype(np.float32)
+        cum_req = np.cumsum(req_s, axis=0, dtype=np.float32)
+        cum_cost = np.cumsum(
+            np.where(elig_s, cost[perm], 0.0), dtype=np.float32
+        )
+        off_req = np.where(
+            (seg_start > 0)[:, None], cum_req[np.maximum(seg_start - 1, 0)], 0.0
+        )
+        off_cost = np.where(
+            seg_start > 0, cum_cost[np.maximum(seg_start - 1, 0)], 0.0
+        )
+        within_req = cum_req - off_req
+        within_cost = cum_cost - off_cost
+        cap_node = np.minimum(node_s, N - 1)
+        fits = elig_s & np.all(
+            used[cap_node] - within_req + req_p[None, :] <= alloc[cap_node],
+            axis=-1,
+        )
+        node_cost = np.full(N + 1, np.inf, np.float32)
+        first_pos = np.full(N + 1, M, np.int64)
+        for i in range(M):
+            if fits[i]:
+                n_i = node_s[i]
+                if within_cost[i] < node_cost[n_i]:
+                    node_cost[n_i] = within_cost[i]
+                if i < first_pos[n_i]:
+                    first_pos[n_i] = i
+        total = np.where(allowed & _np(self.nodes.valid), node_cost[:N], np.inf)
+        best_n = int(np.argmin(total))
+        if not np.isfinite(total[best_n]):
+            return -1, []
+        fp = first_pos[best_n]
+        sel_s = (node_s == best_n) & elig_s & (idx <= fp)
+        return best_n, [int(perm[i]) for i in range(M) if sel_s[i]]
+
     def solve(self) -> OracleResult:
         pods, nodes = self.pods, self.nodes
         pvalid = _np(pods.valid)
@@ -452,11 +556,24 @@ class Oracle:
         chosen_score = np.full(P, -np.inf, np.float32)
         assigned_nodes: list[int] = []
         assigned_pods: list[int] = []
+        self._evicted[:] = False
+        rreq = _np(self.snap.running.requests)
         for p in order:
             feasible, score = self.feasible_and_score(
                 int(p), used, assigned_nodes, assigned_pods
             )
             if not feasible.any():
+                n, victims = self.try_preempt(
+                    int(p), float(prio[p]), used, assigned_nodes, assigned_pods
+                )
+                if n >= 0:
+                    for m in victims:
+                        used[n] -= rreq[m]
+                        self._evicted[m] = True
+                    assignment[p] = n  # chosen_score stays -inf (no rescore)
+                    used[n] += _np(pods.requests)[p]
+                    assigned_nodes.append(n)
+                    assigned_pods.append(int(p))
                 continue
             masked = np.where(feasible, score, -np.inf)
             n = int(np.argmax(masked))  # first max = tie_break "first"
@@ -465,17 +582,34 @@ class Oracle:
             used[n] += _np(pods.requests)[p]
             assigned_nodes.append(n)
             assigned_pods.append(int(p))
+        # Gang all-or-nothing Permit gate (SURVEY.md C8): groups below
+        # their minMember quorum unwind entirely (assignments, capacity).
+        group = _np(pods.group)
+        gmin = _np(self.snap.group_min_member)
+        if gmin.shape[0]:
+            cnt = np.zeros(gmin.shape[0], np.int64)
+            for p in range(P):
+                if assignment[p] >= 0 and group[p] >= 0:
+                    cnt[group[p]] += 1
+            for p in range(P):
+                gp = group[p]
+                if assignment[p] >= 0 and gp >= 0 and cnt[gp] < gmin[gp]:
+                    used[assignment[p]] -= _np(pods.requests)[p]
+                    assignment[p] = -1
+                    chosen_score[p] = -np.inf
         return OracleResult(
             assignment=assignment,
             order=order.astype(np.int32),
             chosen_score=chosen_score,
             final_used=used,
+            evicted=self._evicted.copy(),
         )
 
 
 def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
                         assignment: np.ndarray,
-                        commit_key: np.ndarray | None = None) -> list[str]:
+                        commit_key: np.ndarray | None = None,
+                        evicted: np.ndarray | None = None) -> list[str]:
     """Independent validity audit of any assignment (used to check the
     fast mode's guarantees): capacity respected, static predicates hold,
     and every placed pod's DoNotSchedule-spread / required inter-pod
@@ -500,6 +634,14 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
     ]
     out = []
     used = _np(nodes.used).copy()
+    if evicted is not None:
+        evicted = np.asarray(evicted)
+        ora._evicted[:] = evicted  # members stop counting in pairwise checks
+        run = snap.running
+        rnode, rreq = _np(run.node_idx), _np(run.requests)
+        for m in np.argwhere(evicted).ravel():
+            if rnode[m] >= 0:
+                used[rnode[m]] -= rreq[m]
     for p, n in placed:
         used[n] += _np(pods.requests)[p]
     over = used > _np(nodes.allocatable) + 1e-3
@@ -533,6 +675,21 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
             out.append(
                 f"pod {p}: node {n} violates a member's symmetric anti-affinity"
             )
+    # Gang all-or-nothing: a group with ANY placed member must have at
+    # least minMember placed (SURVEY.md C8).
+    group = _np(pods.group)
+    gmin = _np(snap.group_min_member)
+    if gmin.shape[0]:
+        cnt: dict[int, int] = {}
+        for p, n in placed:
+            if group[p] >= 0:
+                cnt[int(group[p])] = cnt.get(int(group[p]), 0) + 1
+        for g, c in sorted(cnt.items()):
+            if c < gmin[g]:
+                out.append(
+                    f"group {g}: {c} placed < minMember {gmin[g]} "
+                    "(partial gang placement)"
+                )
     return out
 
 

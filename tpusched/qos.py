@@ -38,6 +38,25 @@ def slack_of(slo_target, observed_avail):
     return observed_avail - slo_target
 
 
+def victim_effective_priority(cfg: EngineConfig, priority, slack):
+    """Running pods store slack directly; a victim below its SLO
+    (negative slack) gets the same qos_gain boost a pending pod would:
+    pressure = clip(-slack, 0, 1)."""
+    pressure = (-slack).clip(0.0, 1.0)
+    return priority + cfg.qos.qos_gain * pressure
+
+
+def evict_cost_raw(cfg: EngineConfig, priority, slack):
+    """Eviction cost before the per-snapshot positive shift (see
+    QoSConfig.evict_slack_weight): effective priority, discounted by how
+    far ABOVE its SLO the victim runs (cheap victims have QoS to spare).
+    Works on numpy and jax arrays (pure ufunc arithmetic)."""
+    return (
+        victim_effective_priority(cfg, priority, slack)
+        - cfg.qos.evict_slack_weight * slack.clip(0.0, 1.0)
+    )
+
+
 _PLUGINS = (
     "least_requested",
     "balanced_allocation",

@@ -217,11 +217,8 @@ def config3_pairwise(rng: np.random.Generator, n_pods: int = 2_000, n_nodes: int
 def config4_gangs(rng: np.random.Generator, n_groups: int = 1_000, gang_size: int = 4,
                   n_nodes: int = 1_000, **kw):
     """Gang/coscheduling bin-pack: 1k pod-groups all-or-nothing
-    (BASELINE.json:"configs"[3]).
-
-    NOTE: generates the gang *data* (pods.group / group_min_member);
-    all-or-nothing enforcement in the engine lands with SURVEY.md §7
-    phase 5 — until then the solver places members independently."""
+    (BASELINE.json:"configs"[3]). Enforcement: gang_rollback in
+    kernels/assign.py (both modes) and the oracle's Permit-gate unwind."""
     return make_cluster(
         rng, n_groups * gang_size, n_nodes, gang_frac=1.0, gang_size=gang_size, **kw
     )
@@ -229,11 +226,7 @@ def config4_gangs(rng: np.random.Generator, n_groups: int = 1_000, gang_size: in
 
 def config5_preemption(rng: np.random.Generator, n_pods: int = 1_000, n_nodes: int = 200, **kw):
     """Multi-tenant preemption pressure: cluster near-full so most pending
-    pods need victims (BASELINE.json:"configs"[4]).
-
-    NOTE: generates the pressure workload (running pods with QoS slack);
-    the preemption solver itself lands with SURVEY.md §7 phase 5 — until
-    then infeasible pods simply stay unscheduled."""
+    pods need victims (BASELINE.json:"configs"[4])."""
     kw.setdefault("initial_utilization", 0.9)
     kw.setdefault("n_running_per_node", 8)
     return make_cluster(rng, n_pods, n_nodes, **kw)
