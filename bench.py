@@ -111,15 +111,37 @@ def bench_headline(args):
     from tpusched.synth import config2_scale
 
     log(f"[headline] {args.what}@{args.pods}x{args.nodes} mode={args.mode}")
-    rng = np.random.default_rng(42)
-    snap, _ = _build(config2_scale, rng, args.pods, args.nodes, with_qos=True)
+    n_pods, n_nodes = args.pods, args.nodes
+    if args.replay:
+        from tpusched.dump import load_snapshot
+
+        snap, rmeta = load_snapshot(args.replay)
+        if rmeta is not None:  # label by the replayed snapshot's true size
+            n_pods, n_nodes = rmeta.n_pods, rmeta.n_nodes
+        log(f"  replayed snapshot from {args.replay}: {n_pods}x{n_nodes}")
+    else:
+        rng = np.random.default_rng(42)
+        snap, meta = _build(config2_scale, rng, args.pods, args.nodes,
+                            with_qos=True)
+        if args.dump:
+            from tpusched.dump import save_snapshot
+
+            save_snapshot(args.dump, snap, meta)
+            log(f"  dumped snapshot to {args.dump}")
     engine = Engine(EngineConfig(mode=args.mode))
     fn = _prep(engine, snap, args.what)
-    stats = bench_fn(fn, args.iters, label="headline")
-    log(f"  throughput ~{args.pods / stats['p50']:,.0f} placements/sec")
+    if args.profile:
+        import jax
+
+        with jax.profiler.trace(args.profile):
+            stats = bench_fn(fn, min(args.iters, 10), label="headline")
+        log(f"  profiler trace written to {args.profile}")
+    else:
+        stats = bench_fn(fn, args.iters, label="headline")
+    log(f"  throughput ~{n_pods / stats['p50']:,.0f} placements/sec")
     emit(
-        f"{args.what}_p99_latency_{args.pods}x{args.nodes}", stats,
-        {"placements_per_sec": round(args.pods / stats["p50"], 1)},
+        f"{args.what}_p99_latency_{n_pods}x{n_nodes}", stats,
+        {"placements_per_sec": round(n_pods / stats["p50"], 1)},
     )
     return stats
 
@@ -206,6 +228,12 @@ def main():
     ap.add_argument("--mode", choices=["fast", "parity"], default="fast")
     ap.add_argument("--only", choices=sorted(BENCHES), default=None,
                     help="run a single bench instead of all")
+    ap.add_argument("--dump", default=None,
+                    help="save the headline snapshot to this .npz")
+    ap.add_argument("--replay", default=None,
+                    help="load the headline snapshot from this .npz")
+    ap.add_argument("--profile", default=None,
+                    help="write a jax.profiler trace to this directory")
     args = ap.parse_args()
 
     import jax

@@ -18,6 +18,19 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Sanitizer modes (SURVEY.md §5 "Race detection / sanitizers"): CI can
+# run the whole suite with NaN checking / de-optimized XLA:
+#   TPUSCHED_DEBUG_NANS=1 pytest tests/
+#   TPUSCHED_DEBUG_CHECKS=1 pytest tests/  (disables most XLA opts)
+def _env_on(name: str) -> bool:
+    return os.environ.get(name, "").lower() not in ("", "0", "false", "no")
+
+
+if _env_on("TPUSCHED_DEBUG_NANS"):
+    jax.config.update("jax_debug_nans", True)
+if _env_on("TPUSCHED_DEBUG_CHECKS"):
+    jax.config.update("jax_disable_most_optimizations", True)
+
 import numpy as np
 import pytest
 

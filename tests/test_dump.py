@@ -1,0 +1,27 @@
+"""Snapshot dump/replay round-trip (SURVEY.md §5 checkpoint/resume)."""
+
+import numpy as np
+
+from tpusched import Engine, EngineConfig
+from tpusched.dump import load_snapshot, save_snapshot
+from tpusched.synth import make_cluster
+
+
+def test_dump_replay_roundtrip(tmp_path, rng):
+    snap, meta = make_cluster(rng, 20, 8, taint_frac=0.3, spread_frac=0.3,
+                              interpod_frac=0.3, run_anti_frac=0.2)
+    path = str(tmp_path / "snap.npz")
+    save_snapshot(path, snap, meta)
+    snap2, meta2 = load_snapshot(path)
+    # identical pytrees
+    import jax
+
+    for a, b in zip(jax.tree.leaves(snap), jax.tree.leaves(snap2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert meta2.pod_names == meta.pod_names
+    assert meta2.buckets == meta.buckets
+    # identical solve
+    cfg = EngineConfig()
+    r1 = Engine(cfg).solve(snap)
+    r2 = Engine(cfg).solve(snap2)
+    np.testing.assert_array_equal(r1.assignment, r2.assignment)

@@ -1,0 +1,146 @@
+"""Host shim E2E tests (SURVEY.md C13, §3.3; BASELINE configs[0]):
+watch -> batch -> solve -> bind against the fake API server, through
+both the in-process engine and the gRPC sidecar; fault injection and
+idempotent-bind semantics."""
+
+import numpy as np
+import pytest
+
+from tpusched import EngineConfig
+from tpusched.host import (
+    Conflict,
+    FakeApiServer,
+    HostScheduler,
+    build_synthetic_cluster,
+)
+from tpusched.oracle import Oracle
+from tpusched.rpc.codec import snapshot_from_proto
+
+
+def _cluster(n_pods=100, n_nodes=10, seed=0):
+    api = FakeApiServer()
+    rng = np.random.default_rng(seed)
+    build_synthetic_cluster(api, rng, n_pods, n_nodes)
+    return api
+
+
+def test_e2e_100x10_single_batch_matches_oracle():
+    """configs[0]: one batched cycle schedules all 100 pods exactly as
+    the sequential oracle would."""
+    api = _cluster()
+    cfg = EngineConfig()  # parity mode
+    host = HostScheduler(api, cfg)
+    # capture the wire snapshot the host will solve, for the oracle
+    msg = host._wire_snapshot(api.pending_pods())
+    snap, meta = snapshot_from_proto(msg, cfg)
+    ora = Oracle(snap, cfg).solve()
+
+    stats = host.cycle()
+    assert stats.batch_size == 100
+    bound = {p["name"]: p["node"] for p in api.bound_pods()}
+    for i, name in enumerate(meta.pod_names):
+        if ora.assignment[i] >= 0:
+            assert bound[name] == meta.node_names[ora.assignment[i]]
+        else:
+            assert name not in bound
+    assert stats.placed == int((ora.assignment >= 0).sum())
+    assert not api.pending_pods() or stats.placed < 100
+
+
+def test_e2e_multi_batch_drains_queue():
+    api = _cluster(n_pods=60, n_nodes=8, seed=3)
+    host = HostScheduler(api, EngineConfig(mode="fast"), batch_size=16)
+    cycles = host.run_until_idle()
+    assert cycles >= 4  # 60 pods / 16 per batch
+    assert api.pending_pods() == []
+    # later batches saw earlier binds as running pods (capacity respected)
+    per_node: dict[str, float] = {}
+    for p in api.bound_pods():
+        per_node.setdefault(p["node"], 0.0)
+        per_node[p["node"]] += p["requests"]["cpu"]
+    for n in api.list_nodes():
+        assert per_node.get(n["name"], 0.0) <= n["allocatable"]["cpu"] + 1e-6
+
+
+def test_e2e_through_grpc_sidecar():
+    from tpusched.rpc.client import SchedulerClient
+    from tpusched.rpc.server import make_server
+
+    cfg = EngineConfig(mode="fast")
+    server, port, _ = make_server("127.0.0.1:0", config=cfg)
+    server.start()
+    try:
+        with SchedulerClient(f"127.0.0.1:{port}") as client:
+            api = _cluster(n_pods=40, n_nodes=6, seed=5)
+            host = HostScheduler(api, cfg, client=client)
+            host.run_until_idle()
+            assert api.pending_pods() == []
+            assert api.bind_count == 40
+    finally:
+        server.stop(0)
+
+
+def test_bind_is_once_only():
+    api = FakeApiServer()
+    api.add_node("n0", allocatable={"cpu": 1000.0, "memory": 1e9})
+    api.add_pod("p0", requests={"cpu": 100.0, "memory": 1e6})
+    api.bind("p0", "n0")
+    with pytest.raises(Conflict):
+        api.bind("p0", "n0")  # double bind must be rejected
+
+
+def test_crash_replay_no_duplicate_binds():
+    """SURVEY.md §5 failure recovery: the engine is stateless, so a
+    'crashed' host simply re-reads the API server; already-bound pods
+    are not re-bound, leftovers get scheduled."""
+    api = _cluster(n_pods=30, n_nodes=6, seed=7)
+    cfg = EngineConfig(mode="fast")
+    host1 = HostScheduler(api, cfg, batch_size=30)
+    # First host "crashes" after solving but before binding everything:
+    pending = api.pending_pods()
+    msg = host1._wire_snapshot(pending)
+    snap, meta = snapshot_from_proto(msg, cfg)
+    res = host1._engine.solve(snap)
+    # bind only the first 10 assignments, then "crash"
+    done = 0
+    for i, n in enumerate(res.assignment[: meta.n_pods]):
+        if n >= 0 and done < 10:
+            api.bind(meta.pod_names[i], meta.node_names[int(n)])
+            done += 1
+    binds_before = api.bind_count
+    # Fresh host replays from cluster truth:
+    host2 = HostScheduler(api, cfg, batch_size=30)
+    host2.run_until_idle()
+    assert api.pending_pods() == []
+    # every pod bound exactly once overall
+    assert api.bind_count == 30
+    assert api.bind_count - binds_before == 20
+
+
+def test_preemption_deletes_then_binds():
+    api = FakeApiServer()
+    api.add_node("n0", allocatable={"cpu": 4000.0, "memory": 64e9})
+    api.add_bound_pod("victim", "n0", requests={"cpu": 4000.0, "memory": 1e9},
+                      priority=1.0, slack=0.5)
+    api.add_pod("urgent", requests={"cpu": 2000.0, "memory": 1e9},
+                priority=500.0, observed_avail=1.0)
+    cfg = EngineConfig(preemption=True)
+    host = HostScheduler(api, cfg)
+    stats = host.cycle()
+    assert stats.evicted == 1 and stats.placed == 1
+    assert api.delete_count == 1
+    bound = {p["name"]: p["node"] for p in api.bound_pods()}
+    assert bound == {"urgent": "n0"}  # victim gone, preemptor in place
+
+
+def test_gang_pods_all_or_nothing_e2e():
+    api = FakeApiServer()
+    api.add_node("n0", allocatable={"cpu": 2000.0, "memory": 64e9})
+    for i in range(4):
+        api.add_pod(f"g-{i}", requests={"cpu": 1000.0, "memory": 1e9},
+                    pod_group="g", pod_group_min_member=4,
+                    observed_avail=1.0)
+    host = HostScheduler(api, EngineConfig())
+    host.run_until_idle(max_cycles=3)
+    assert api.bound_pods() == []  # quorum impossible: nothing binds
+    assert len(api.pending_pods()) == 4

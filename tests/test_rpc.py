@@ -1,0 +1,180 @@
+"""gRPC boundary contract tests (SURVEY.md C12, §4 item 4): a second
+process-style client gets ScoreBatch/Assign answers over the wire that
+match the in-process engine and oracle; golden proto round-trips."""
+
+import numpy as np
+import pytest
+
+from tpusched import Engine, EngineConfig
+from tpusched.config import Buckets
+from tpusched.oracle import Oracle
+from tpusched.rpc import (
+    SchedulerClient,
+    make_server,
+    pb,
+    snapshot_from_proto,
+    snapshot_to_proto,
+)
+
+
+def _wire_snapshot():
+    nodes = [
+        dict(name="n0", allocatable={"cpu": 4000, "memory": 16 << 30},
+             labels={"zone": "a", "disktype": "ssd"}),
+        dict(name="n1", allocatable={"cpu": 8000, "memory": 32 << 30},
+             labels={"zone": "b", "disktype": "hdd"},
+             taints=[("dedicated", "batch", "NoSchedule")]),
+    ]
+    pods = [
+        dict(name="p0", requests={"cpu": 1000, "memory": 2 << 30},
+             priority=10, labels={"app": "web"}),
+        dict(name="p1", requests={"cpu": 500, "memory": 1 << 30},
+             node_selector={"disktype": "ssd"}, labels={"app": "db"}),
+    ]
+    running = [
+        dict(name="r0", node="n0", requests={"cpu": 500, "memory": 1 << 30},
+             priority=5, slack=0.2, labels={"app": "cache"}),
+    ]
+    return snapshot_to_proto(nodes, pods, running)
+
+
+@pytest.fixture(scope="module")
+def server_and_client():
+    server, port, svc = make_server("127.0.0.1:0")
+    server.start()
+    client = SchedulerClient(f"127.0.0.1:{port}")
+    yield client, svc
+    client.close()
+    server.stop(0)
+
+
+def test_proto_golden_roundtrip():
+    msg = _wire_snapshot()
+    data = msg.SerializeToString()
+    back = pb.ClusterSnapshot.FromString(data)
+    assert back == msg
+    assert back.SerializeToString() == data  # stable re-serialization
+    assert [n.name for n in back.nodes] == ["n0", "n1"]
+    assert back.nodes[1].taints[0].effect == "NoSchedule"
+
+
+def test_decoder_matches_builder():
+    """Decoding the wire snapshot must produce the same solve as
+    building directly."""
+    msg = _wire_snapshot()
+    cfg = EngineConfig()
+    snap, meta = snapshot_from_proto(msg, cfg)
+    assert meta.pod_names == ["p0", "p1"]
+    res = Engine(cfg).solve(snap)
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+    # p1 requires ssd -> n0; p0 cannot tolerate n1's taint -> n0
+    assert meta.node_names[res.assignment[0]] == "n0"
+    assert meta.node_names[res.assignment[1]] == "n0"
+
+
+def test_health_over_wire(server_and_client):
+    client, _ = server_and_client
+    h = client.health()
+    assert h.ok and h.devices >= 1
+
+
+def test_assign_over_wire_matches_oracle(server_and_client):
+    client, _ = server_and_client
+    msg = _wire_snapshot()
+    resp = client.assign(msg)
+    by_pod = {a.pod: a.node for a in resp.assignments}
+    snap, meta = snapshot_from_proto(msg, EngineConfig())
+    ora = Oracle(snap, EngineConfig()).solve()
+    for i, name in enumerate(meta.pod_names):
+        expect = meta.node_names[ora.assignment[i]] if ora.assignment[i] >= 0 else ""
+        assert by_pod[name] == expect
+    assert resp.solve_seconds > 0
+
+
+def test_score_batch_over_wire(server_and_client):
+    client, _ = server_and_client
+    msg = _wire_snapshot()
+    resp = client.score_batch(msg)
+    assert list(resp.pod_names) == ["p0", "p1"]
+    assert list(resp.node_names) == ["n0", "n1"]
+    snap, _ = snapshot_from_proto(msg, EngineConfig())
+    local = Engine(EngineConfig()).score(snap)
+    for i, row in enumerate(resp.rows):
+        np.testing.assert_array_equal(
+            np.asarray(row.feasible), local.feasible[i, :2]
+        )
+        np.testing.assert_allclose(
+            np.asarray(row.scores), local.scores[i, :2], rtol=1e-6
+        )
+    # p1's ssd selector: n1 infeasible over the wire too
+    assert list(resp.rows[1].feasible) == [True, False]
+
+
+def test_preemption_eviction_names_over_wire():
+    cfg = EngineConfig(preemption=True)
+    server, port, svc = make_server("127.0.0.1:0", config=cfg)
+    server.start()
+    try:
+        with SchedulerClient(f"127.0.0.1:{port}") as client:
+            nodes = [dict(name="n0", allocatable={"cpu": 4000, "memory": 64 << 30})]
+            pods = [dict(name="urgent", requests={"cpu": 2000, "memory": 1 << 30},
+                         priority=500)]
+            running = [dict(name="victim", node="n0",
+                            requests={"cpu": 4000, "memory": 1 << 30},
+                            priority=1, slack=0.5)]
+            resp = client.assign(snapshot_to_proto(nodes, pods, running))
+            assert resp.assignments[0].node == "n0"
+            assert list(resp.evicted) == ["victim"]
+    finally:
+        server.stop(0)
+
+
+def test_metrics_after_traffic(server_and_client):
+    client, svc = server_and_client
+    client.assign(_wire_snapshot())
+    text = client.metrics_text()
+    assert "scheduler_schedule_attempts_total" in text
+    assert "scheduler_e2e_scheduling_duration_seconds_bucket" in text
+    attempts = [l for l in text.splitlines()
+                if l.startswith("scheduler_schedule_attempts_total")]
+    assert int(attempts[0].split()[-1]) >= 2
+
+
+def test_request_flood(server_and_client):
+    """SURVEY.md §5 race-detection stand-in: concurrent clients hammer
+    the sidecar; every response must be internally consistent."""
+    import threading
+
+    client, _ = server_and_client
+    msg = _wire_snapshot()
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(5):
+                resp = client.assign(msg)
+                nodes = {a.pod: a.node for a in resp.assignments}
+                assert nodes["p1"] == "n0"
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == [], errors
+
+
+def test_floor_buckets_pin_shapes():
+    """A server with floor buckets must not change compile shapes when a
+    smaller snapshot arrives."""
+    bk = Buckets.fit(64, 64, 64)
+    server, port, svc = make_server("127.0.0.1:0", buckets=bk)
+    server.start()
+    try:
+        with SchedulerClient(f"127.0.0.1:{port}") as client:
+            client.assign(_wire_snapshot())
+    finally:
+        server.stop(0)

@@ -1,0 +1,82 @@
+"""Snapshot dump/replay (SURVEY.md §5 "Checkpoint / resume").
+
+Scheduler state is soft — the cluster is the source of truth — so the
+engine checkpoints nothing. What IS worth persisting: the exact padded
+ClusterSnapshot of a batch, for bench reproducibility and offline
+debugging of a production decision ("replay the batch that made this
+placement"). One .npz per snapshot: leaves in deterministic pytree
+order + a JSON meta record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+
+from tpusched.config import Buckets
+from tpusched.snapshot import ClusterSnapshot, SnapshotMeta
+
+
+def _norm(path: str) -> str:
+    # np.savez appends .npz to bare paths but np.load does not; keep the
+    # two symmetric so dump/replay accept the same string.
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def save_snapshot(path: str, snap: ClusterSnapshot,
+                  meta: SnapshotMeta | None = None) -> None:
+    path = _norm(path)
+    leaves = jax.tree.leaves(snap)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    if meta is not None:
+        md = dataclasses.asdict(meta)
+        md["buckets"] = dataclasses.asdict(meta.buckets)
+        arrays["meta_json"] = np.frombuffer(
+            json.dumps(md).encode(), dtype=np.uint8
+        )
+    np.savez_compressed(path, **arrays)
+
+
+def load_snapshot(path: str) -> tuple[ClusterSnapshot, SnapshotMeta | None]:
+    data = np.load(_norm(path))
+    treedef = jax.tree.structure(snap_skeleton())
+    n = treedef.num_leaves
+    snap = jax.tree.unflatten(
+        treedef, [data[f"leaf_{i}"] for i in range(n)]
+    )
+    meta = None
+    if "meta_json" in data:
+        md = json.loads(bytes(data["meta_json"]).decode())
+        md["buckets"] = Buckets(**md["buckets"])
+        meta = SnapshotMeta(**md)
+    return snap, meta
+
+
+def snap_skeleton() -> ClusterSnapshot:
+    """A ClusterSnapshot whose every field is a (distinct) scalar leaf:
+    defines the canonical leaf order for save/load. Structure is fixed
+    by the dataclass definitions, so any snapshot flattens to the same
+    treedef."""
+    from tpusched.snapshot import (
+        AtomTable,
+        NodeArrays,
+        PodArrays,
+        RunningPodArrays,
+        SigTable,
+    )
+
+    def fill(cls):
+        return cls(**{f.name: 0 for f in dataclasses.fields(cls)})
+
+    return ClusterSnapshot(
+        nodes=fill(NodeArrays),
+        pods=fill(PodArrays),
+        running=fill(RunningPodArrays),
+        atoms=fill(AtomTable),
+        sigs=fill(SigTable),
+        taint_effect=0,
+        group_min_member=0,
+    )

@@ -1,0 +1,339 @@
+"""Host scheduler shim + fake API server (SURVEY.md C13, §3.3).
+
+Plays the kube-scheduler role for E2E runs (BASELINE.json:"configs"[0]:
+100 pods x 10 nodes): watch pending pods, accumulate a batch, call the
+engine (in-process or through the gRPC sidecar, C12), issue Binds and
+eviction Deletes against the API server, repeat until the queue drains.
+
+The FakeApiServer stands in for kind/a real API server (neither exists
+in this image): it holds spec-level node/pod records, enforces
+bind-once-while-pending semantics (the idempotency the reference relies
+on for safe retries after a scheduler crash, SURVEY.md §5 "Failure
+detection"), and is thread-safe.
+
+Cluster state is the source of truth: the shim keeps no cache between
+cycles — each batch re-reads the API server (recovery = replay,
+SURVEY.md §5 "Checkpoint / resume").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from tpusched.config import Buckets, EngineConfig
+from tpusched.engine import Engine
+from tpusched.rpc.codec import snapshot_from_proto, snapshot_to_proto
+
+
+class Conflict(Exception):
+    """Bind of a pod that is no longer pending (double-bind guard)."""
+
+
+class FakeApiServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._nodes: dict[str, dict] = {}
+        self._pods: dict[str, dict] = {}      # pending + bound
+        self.bind_count = 0
+        self.delete_count = 0
+
+    # -- cluster setup ------------------------------------------------------
+
+    def add_node(self, name: str, **spec) -> None:
+        with self._lock:
+            self._nodes[name] = dict(spec, name=name)
+
+    def add_pod(self, name: str, **spec) -> None:
+        with self._lock:
+            self._pods[name] = dict(
+                spec, name=name, phase="Pending", node=None,
+                submitted=time.time(),
+            )
+
+    def add_bound_pod(self, name: str, node: str, **spec) -> None:
+        """A pod already running on a node (pre-existing workload)."""
+        with self._lock:
+            self._pods[name] = dict(
+                spec, name=name, phase="Bound", node=node,
+                submitted=time.time(),
+            )
+
+    # -- watch/list side ----------------------------------------------------
+
+    def list_nodes(self) -> list[dict]:
+        with self._lock:
+            return [dict(n) for n in self._nodes.values()]
+
+    def pending_pods(self) -> list[dict]:
+        with self._lock:
+            return [dict(p) for p in self._pods.values() if p["phase"] == "Pending"]
+
+    def bound_pods(self) -> list[dict]:
+        with self._lock:
+            return [dict(p) for p in self._pods.values() if p["phase"] == "Bound"]
+
+    # -- write side ---------------------------------------------------------
+
+    def bind(self, pod_name: str, node_name: str) -> None:
+        with self._lock:
+            pod = self._pods.get(pod_name)
+            if pod is None:
+                raise Conflict(f"bind: pod {pod_name} does not exist")
+            if pod["phase"] != "Pending":
+                raise Conflict(
+                    f"bind: pod {pod_name} is {pod['phase']} on {pod['node']}"
+                )
+            if node_name not in self._nodes:
+                raise Conflict(f"bind: node {node_name} does not exist")
+            pod["phase"] = "Bound"
+            pod["node"] = node_name
+            self.bind_count += 1
+
+    def delete_pod(self, pod_name: str) -> bool:
+        """Eviction; returns False if already gone (idempotent)."""
+        with self._lock:
+            if pod_name not in self._pods:
+                return False
+            del self._pods[pod_name]
+            self.delete_count += 1
+            return True
+
+
+# ---------------------------------------------------------------------------
+# The scheduler host.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CycleStats:
+    batch_size: int
+    placed: int
+    evicted: int
+    build_seconds: float
+    solve_seconds: float
+    bind_seconds: float
+
+    @property
+    def total_seconds(self) -> float:
+        return self.build_seconds + self.solve_seconds + self.bind_seconds
+
+
+class HostScheduler:
+    """One scheduling host: batches pending pods, solves, binds.
+
+    backend: an Engine (in-process) or a SchedulerClient (gRPC sidecar)
+    — both consume the same wire snapshot via the C12 codec, so the
+    in-process path exercises exactly what the sidecar decodes.
+    """
+
+    def __init__(
+        self,
+        api: FakeApiServer,
+        config: EngineConfig | None = None,
+        client=None,
+        batch_size: int = 1024,
+        buckets: Buckets | None = None,
+        engine: Engine | None = None,
+    ):
+        self.api = api
+        self.config = config or EngineConfig()
+        self.client = client
+        self.batch_size = batch_size
+        self.buckets = buckets
+        # Engine jit caches live per instance: callers running many hosts
+        # (benchmarks, replays) should pass a shared engine so compiles
+        # amortize the way the long-lived sidecar's do.
+        if client is not None:
+            self._engine = None
+        else:
+            self._engine = engine if engine is not None else Engine(self.config)
+        self.cycles: list[CycleStats] = []
+
+    # -- snapshot assembly --------------------------------------------------
+
+    @staticmethod
+    def _node_record(n: dict) -> dict:
+        return dict(
+            name=n["name"], allocatable=n.get("allocatable", {}),
+            labels=n.get("labels", {}), taints=n.get("taints", []),
+            used=n.get("used", {}),
+        )
+
+    @staticmethod
+    def _pending_record(p: dict) -> dict:
+        keep = (
+            "name", "requests", "priority", "slo_target", "observed_avail",
+            "labels", "node_selector", "required_terms", "preferred_terms",
+            "tolerations", "topology_spread", "pod_affinity", "pod_group",
+            "pod_group_min_member",
+        )
+        return {k: p[k] for k in keep if k in p}
+
+    @staticmethod
+    def _running_record(p: dict) -> dict:
+        rec = dict(
+            name=p["name"], node=p["node"], requests=p.get("requests", {}),
+            priority=p.get("priority", 0.0), labels=p.get("labels", {}),
+            pod_affinity=p.get("pod_affinity", []),
+        )
+        # QoS slack of a running pod: observed availability minus SLO
+        # (SURVEY.md C10); specs carry both or a precomputed slack.
+        if "slack" in p:
+            rec["slack"] = p["slack"]
+        else:
+            rec["slack"] = p.get("observed_avail", 1.0) - p.get("slo_target", 0.0)
+        return rec
+
+    def _wire_snapshot(self, pending: list[dict]):
+        nodes = [self._node_record(n) for n in self.api.list_nodes()]
+        running = [self._running_record(p) for p in self.api.bound_pods()]
+        pods = [self._pending_record(p) for p in pending]
+        return snapshot_to_proto(nodes, pods, running)
+
+    # -- one cycle ----------------------------------------------------------
+
+    def cycle(self) -> CycleStats | None:
+        """One batched scheduling cycle; None when nothing is pending."""
+        pending = self.api.pending_pods()
+        if not pending:
+            return None
+        pending = pending[: self.batch_size]
+        t0 = time.perf_counter()
+        msg = self._wire_snapshot(pending)
+        build_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        if self.client is not None:
+            resp = self.client.assign(msg)
+            assignments = [(a.pod, a.node) for a in resp.assignments if a.node]
+            evicted = list(resp.evicted)
+            solve_s = time.perf_counter() - t0
+        else:
+            snap, meta = snapshot_from_proto(msg, self.config, self.buckets)
+            res = self._engine.solve(snap)
+            assignments = [
+                (meta.pod_names[i], meta.node_names[int(n)])
+                for i, n in enumerate(res.assignment[: meta.n_pods])
+                if n >= 0
+            ]
+            evicted = []
+            if res.evicted is not None and res.evicted.any():
+                names = meta.running_names or []
+                evicted = [
+                    names[m] for m in np.argwhere(res.evicted).ravel()
+                    if m < len(names)
+                ]
+            solve_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        # Deletes before binds: a preemptor's room must exist before its
+        # bind (upstream issues evictions first, then re-queues).
+        for name in evicted:
+            self.api.delete_pod(name)
+        placed = 0
+        for pod_name, node_name in assignments:
+            try:
+                self.api.bind(pod_name, node_name)
+                placed += 1
+            except Conflict:
+                # Another actor bound/removed it; safe to skip — the
+                # next cycle re-reads truth (idempotent-bind story).
+                continue
+        bind_s = time.perf_counter() - t0
+        stats = CycleStats(
+            batch_size=len(pending), placed=placed, evicted=len(evicted),
+            build_seconds=build_s, solve_seconds=solve_s, bind_seconds=bind_s,
+        )
+        self.cycles.append(stats)
+        return stats
+
+    def run_until_idle(self, max_cycles: int = 100) -> int:
+        """Cycle until no pending pods remain or no progress is made.
+        Returns the number of cycles executed."""
+        n = 0
+        while n < max_cycles:
+            stats = self.cycle()
+            n += 1 if stats else 0
+            if stats is None:
+                break
+            if stats.placed == 0 and stats.evicted == 0:
+                break  # unschedulable leftovers; a real host would back off
+        return n
+
+
+# ---------------------------------------------------------------------------
+# E2E benchmark entry (BASELINE.json:"configs"[0]; used by bench.py).
+# ---------------------------------------------------------------------------
+
+
+def build_synthetic_cluster(api: FakeApiServer, rng, n_pods: int, n_nodes: int):
+    """configs[0]-shaped cluster: QoS-weighted LeastRequested workload."""
+    for i in range(n_nodes):
+        api.add_node(
+            f"node-{i}",
+            allocatable={"cpu": 8000.0, "memory": float(32 << 30)},
+            labels={"kubernetes.io/hostname": f"node-{i}",
+                    "topology.kubernetes.io/zone": f"zone-{i % 3}"},
+        )
+    for i in range(n_pods):
+        slo = float(rng.choice([0.0, 0.9, 0.99]))
+        api.add_pod(
+            f"pod-{i}",
+            requests={"cpu": float(rng.integers(100, 500)),
+                      "memory": float(rng.integers(1 << 28, 1 << 30))},
+            priority=float(rng.integers(0, 100)),
+            slo_target=slo,
+            observed_avail=float(rng.uniform(0.5, 1.0)),
+            labels={"app": ["web", "db", "cache"][int(rng.integers(3))]},
+        )
+
+
+def run_e2e_benchmark(n_pods: int = 100, n_nodes: int = 10, iters: int = 10,
+                      use_grpc: bool = True):
+    """Full-boundary E2E: fake API server -> host shim -> gRPC sidecar
+    -> engine -> binds. Returns bench.py-style percentile stats of the
+    complete cycle latency plus placements/sec."""
+    from tpusched.rpc.client import SchedulerClient
+    from tpusched.rpc.server import make_server
+
+    cfg = EngineConfig(mode="fast")
+    server = client = shared_engine = None
+    if use_grpc:
+        server, port, _ = make_server("127.0.0.1:0", config=cfg)
+        server.start()
+        client = SchedulerClient(f"127.0.0.1:{port}")
+    else:
+        shared_engine = Engine(cfg)  # one jit cache across iterations
+    times, placed_total = [], 0
+    try:
+        for it in range(iters + 1):  # +1 warmup (compile)
+            api = FakeApiServer()
+            rng = np.random.default_rng(1000 + it)
+            build_synthetic_cluster(api, rng, n_pods, n_nodes)
+            host = HostScheduler(api, cfg, client=client, engine=shared_engine)
+            t0 = time.perf_counter()
+            host.run_until_idle()
+            dt = time.perf_counter() - t0
+            placed = sum(c.placed for c in host.cycles)
+            if it > 0:  # skip compile iteration
+                times.append(dt)
+                placed_total += placed
+    finally:
+        if client is not None:
+            client.close()
+        if server is not None:
+            server.stop(0)
+    times = np.asarray(times)
+    return dict(
+        p50=float(np.percentile(times, 50)),
+        p90=float(np.percentile(times, 90)),
+        p99=float(np.percentile(times, 99)),
+        max=float(times.max()),
+        mean=float(times.mean()),
+        iters=len(times),
+        placements_per_sec=round(placed_total / times.sum(), 1),
+    )

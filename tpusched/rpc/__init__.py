@@ -1,0 +1,19 @@
+"""gRPC boundary (SURVEY.md C12): proto codec, sidecar server, client.
+
+Regenerate the pb2 module after editing protos/tpusched.proto:
+    protoc -Iprotos --python_out=tpusched/rpc protos/tpusched.proto
+"""
+
+from tpusched.rpc import tpusched_pb2 as pb
+from tpusched.rpc.codec import snapshot_from_proto, snapshot_to_proto
+from tpusched.rpc.server import SchedulerService, make_server
+from tpusched.rpc.client import SchedulerClient
+
+__all__ = [
+    "pb",
+    "snapshot_from_proto",
+    "snapshot_to_proto",
+    "SchedulerService",
+    "make_server",
+    "SchedulerClient",
+]

@@ -1,0 +1,64 @@
+"""Python client for the tpusched sidecar (SURVEY.md C12).
+
+Mirrors what the Go `--score-backend=tpu` plugin would do: serialize the
+cluster snapshot, call ScoreBatch (the Score-plugin path) or Assign (the
+full batched solve), read back scores/assignments by name.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from tpusched.rpc import tpusched_pb2 as pb
+from tpusched.rpc.server import SERVICE
+
+
+class SchedulerClient:
+    def __init__(self, address: str, timeout: float = 120.0):
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.max_receive_message_length", -1),
+                ("grpc.max_send_message_length", -1),
+            ],
+        )
+
+        def method(name, req_cls, resp_cls):
+            return self._channel.unary_unary(
+                f"/{SERVICE}/{name}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString,
+            )
+
+        self._score = method("ScoreBatch", pb.ScoreRequest, pb.ScoreResponse)
+        self._assign = method("Assign", pb.AssignRequest, pb.AssignResponse)
+        self._health = method("Health", pb.HealthRequest, pb.HealthResponse)
+        self._metrics = method("Metrics", pb.MetricsRequest, pb.MetricsResponse)
+
+    def health(self) -> pb.HealthResponse:
+        return self._health(pb.HealthRequest(), timeout=self.timeout)
+
+    def score_batch(self, snapshot: pb.ClusterSnapshot) -> pb.ScoreResponse:
+        return self._score(
+            pb.ScoreRequest(snapshot=snapshot), timeout=self.timeout
+        )
+
+    def assign(self, snapshot: pb.ClusterSnapshot) -> pb.AssignResponse:
+        return self._assign(
+            pb.AssignRequest(snapshot=snapshot), timeout=self.timeout
+        )
+
+    def metrics_text(self) -> str:
+        return self._metrics(
+            pb.MetricsRequest(), timeout=self.timeout
+        ).prometheus_text
+
+    def close(self):
+        self._channel.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,209 @@
+"""Proto <-> SnapshotBuilder codec (SURVEY.md C12).
+
+The wire model is spec-level records; this module is the single place
+where they meet the engine's host-side interning (SnapshotBuilder).
+snapshot_to_proto exists for clients/tests that already hold builder
+-style records (the host shim uses it); a Go scheduler would emit the
+proto directly from its cache.
+"""
+
+from __future__ import annotations
+
+from tpusched.config import Buckets, EngineConfig
+from tpusched.rpc import tpusched_pb2 as pb
+from tpusched.snapshot import (
+    MatchExpression,
+    NodeSelectorTerm,
+    PodAffinityTerm,
+    PreferredTerm,
+    SnapshotBuilder,
+    Toleration,
+    TopologySpreadConstraint,
+)
+
+
+def _res_map(resources) -> dict[str, float]:
+    return {r.name: r.quantity for r in resources}
+
+
+def _labels(labels) -> dict[str, str]:
+    return {l.key: l.value for l in labels}
+
+
+def _exprs(msgs) -> tuple[MatchExpression, ...]:
+    return tuple(
+        MatchExpression(m.key, m.op, tuple(m.values)) for m in msgs
+    )
+
+
+def _affinity(msgs) -> list[PodAffinityTerm]:
+    return [
+        PodAffinityTerm(
+            topology_key=t.topology_key,
+            selector=_exprs(t.selector),
+            anti=t.anti,
+            required=t.required,
+            weight=t.weight or 1.0,
+        )
+        for t in msgs
+    ]
+
+
+def snapshot_from_proto(
+    msg: pb.ClusterSnapshot,
+    config: EngineConfig | None = None,
+    buckets: Buckets | None = None,
+):
+    """Decode a wire snapshot into a built (ClusterSnapshot, SnapshotMeta)."""
+    config = config or EngineConfig()
+    b = SnapshotBuilder(config, buckets)
+    for n in msg.nodes:
+        b.add_node(
+            n.name,
+            allocatable=_res_map(n.allocatable),
+            labels=_labels(n.labels),
+            taints=[(t.key, t.value, t.effect) for t in n.taints],
+            used=_res_map(n.used),
+        )
+    for p in msg.pods:
+        b.add_pod(
+            p.name,
+            requests=_res_map(p.requests),
+            priority=p.priority,
+            slo_target=p.slo_target,
+            # proto3 cannot distinguish unset from 0.0: clients must set
+            # observed_availability explicitly (0.0 means 0.0; a pod with
+            # no SLO is unaffected either way since pressure clips at 0).
+            observed_avail=p.observed_availability,
+            labels=_labels(p.labels),
+            node_selector=_labels(p.node_selector),
+            required_terms=[
+                NodeSelectorTerm(_exprs(t.expressions))
+                for t in p.required_terms
+            ],
+            preferred_terms=[
+                PreferredTerm(t.weight, NodeSelectorTerm(_exprs(t.term.expressions)))
+                for t in p.preferred_terms
+            ],
+            tolerations=[
+                Toleration(t.key, t.operator or "Equal", t.value, t.effect)
+                for t in p.tolerations
+            ],
+            topology_spread=[
+                TopologySpreadConstraint(
+                    topology_key=c.topology_key,
+                    max_skew=c.max_skew,
+                    when_unsatisfiable=c.when_unsatisfiable,
+                    selector=_exprs(c.selector),
+                )
+                for c in p.topology_spread
+            ],
+            pod_affinity=_affinity(p.pod_affinity),
+            pod_group=p.pod_group or None,
+            pod_group_min_member=p.pod_group_min_member,
+        )
+    for r in msg.running:
+        b.add_running_pod(
+            node=r.node,
+            requests=_res_map(r.requests),
+            priority=r.priority,
+            slack=r.slack,
+            labels=_labels(r.labels),
+            count_into_used=not r.exclude_from_used,
+            pod_affinity=_affinity(r.pod_affinity),
+        )
+    snap, meta = b.build()
+    # Running-pod names travel with meta for eviction responses.
+    meta.running_names = [r.name or f"running-{i}" for i, r in enumerate(msg.running)]
+    return snap, meta
+
+
+# ---------------------------------------------------------------------------
+# Encoder (host shim / tests).
+# ---------------------------------------------------------------------------
+
+
+def _set_resources(field, mapping):
+    for name, q in mapping.items():
+        r = field.add()
+        r.name, r.quantity = name, float(q)
+
+
+def _set_labels(field, mapping):
+    for k, v in sorted(mapping.items()):
+        l = field.add()
+        l.key, l.value = k, v
+
+
+def _set_exprs(field, exprs):
+    for e in exprs:
+        m = field.add()
+        m.key, m.op = e.key, e.op
+        m.values.extend(e.values)
+
+
+def _set_affinity(field, terms):
+    for t in terms:
+        m = field.add()
+        m.topology_key = t.topology_key
+        _set_exprs(m.selector, t.selector)
+        m.anti, m.required, m.weight = t.anti, t.required, float(t.weight)
+
+
+def snapshot_to_proto(
+    nodes: list[dict], pods: list[dict], running: list[dict] | None = None
+) -> pb.ClusterSnapshot:
+    """Encode builder-style records (the kwargs SnapshotBuilder.add_*
+    take, plus 'name'/'node') into a wire snapshot."""
+    msg = pb.ClusterSnapshot()
+    for n in nodes:
+        nm = msg.nodes.add()
+        nm.name = n["name"]
+        _set_resources(nm.allocatable, n.get("allocatable", {}))
+        _set_labels(nm.labels, n.get("labels", {}))
+        _set_resources(nm.used, n.get("used", {}))
+        for (k, v, e) in n.get("taints", []):
+            t = nm.taints.add()
+            t.key, t.value, t.effect = k, v, e
+    for p in pods:
+        pm = msg.pods.add()
+        pm.name = p["name"]
+        _set_resources(pm.requests, p.get("requests", {}))
+        pm.priority = float(p.get("priority", 0.0))
+        pm.slo_target = float(p.get("slo_target", 0.0))
+        pm.observed_availability = float(p.get("observed_avail", 1.0))
+        _set_labels(pm.labels, p.get("labels", {}))
+        _set_labels(pm.node_selector, p.get("node_selector", {}))
+        for term in p.get("required_terms", []):
+            tm = pm.required_terms.add()
+            _set_exprs(tm.expressions, term.expressions)
+        for pt in p.get("preferred_terms", []):
+            tm = pm.preferred_terms.add()
+            tm.weight = float(pt.weight)
+            _set_exprs(tm.term.expressions, pt.term.expressions)
+        for tol in p.get("tolerations", []):
+            t = pm.tolerations.add()
+            t.key, t.operator, t.value, t.effect = (
+                tol.key, tol.operator, tol.value, tol.effect
+            )
+        for c in p.get("topology_spread", []):
+            cm = pm.topology_spread.add()
+            cm.topology_key = c.topology_key
+            cm.max_skew = int(c.max_skew)
+            cm.when_unsatisfiable = c.when_unsatisfiable
+            _set_exprs(cm.selector, c.selector)
+        _set_affinity(pm.pod_affinity, p.get("pod_affinity", []))
+        if p.get("pod_group"):
+            pm.pod_group = p["pod_group"]
+            pm.pod_group_min_member = int(p.get("pod_group_min_member", 0))
+    for r in running or []:
+        rm = msg.running.add()
+        rm.name = r.get("name", "")
+        rm.node = r["node"]
+        _set_resources(rm.requests, r.get("requests", {}))
+        rm.priority = float(r.get("priority", 0.0))
+        rm.slack = float(r.get("slack", 0.0))
+        _set_labels(rm.labels, r.get("labels", {}))
+        _set_affinity(rm.pod_affinity, r.get("pod_affinity", []))
+        rm.exclude_from_used = not r.get("count_into_used", True)
+    return msg

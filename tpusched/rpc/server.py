@@ -1,0 +1,251 @@
+"""The TPU scheduling sidecar (SURVEY.md C12): a gRPC server wrapping
+Engine. This is the process a `--score-backend=tpu` scheduler talks to
+(BASELINE.json:"north_star").
+
+Service stubs are hand-wired with grpc generic handlers (the image has
+protoc + grpcio but no grpc_tools codegen); the method table mirrors
+protos/tpusched.proto's service block.
+
+Observability (SURVEY.md §5): every batch emits one structured JSON log
+line (sizes, rounds, per-phase seconds, placements/sec) on stderr, and
+the Metrics rpc serves Prometheus text with upstream-compatible metric
+names (scheduler_e2e_scheduling_duration_seconds etc.).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from concurrent import futures
+
+import numpy as np
+
+import grpc
+
+from tpusched.config import Buckets, EngineConfig
+from tpusched.engine import Engine
+from tpusched.rpc import tpusched_pb2 as pb
+from tpusched.rpc.codec import snapshot_from_proto
+
+SERVICE = "tpusched.TpuScheduler"
+
+
+class _Metrics:
+    """Tiny Prometheus registry: counters + a duration histogram with
+    upstream scheduler metric names."""
+
+    BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()  # handlers run on a thread pool
+        self.attempts = 0
+        self.placements = 0
+        self.evictions = 0
+        self.batches = 0
+        self.hist = [0] * (len(self.BUCKETS) + 1)
+        self.dur_sum = 0.0
+
+    def observe(self, n_pods: int, n_placed: int, n_evicted: int, dur: float):
+        with self._lock:
+            self.attempts += n_pods
+            self.placements += n_placed
+            self.evictions += n_evicted
+            self.batches += 1
+            self.dur_sum += dur
+            for i, b in enumerate(self.BUCKETS):
+                if dur <= b:
+                    self.hist[i] += 1
+                    break
+            else:
+                self.hist[-1] += 1
+
+    def render(self) -> str:
+        with self._lock:
+            return self._render_locked()
+
+    def _render_locked(self) -> str:
+        lines = [
+            "# TYPE scheduler_schedule_attempts_total counter",
+            f"scheduler_schedule_attempts_total {self.attempts}",
+            "# TYPE scheduler_pod_placements_total counter",
+            f"scheduler_pod_placements_total {self.placements}",
+            "# TYPE scheduler_preemption_victims_total counter",
+            f"scheduler_preemption_victims_total {self.evictions}",
+            "# TYPE scheduler_batches_total counter",
+            f"scheduler_batches_total {self.batches}",
+            "# TYPE scheduler_e2e_scheduling_duration_seconds histogram",
+        ]
+        cum = 0
+        for b, c in zip(self.BUCKETS, self.hist):
+            cum += c
+            lines.append(
+                f'scheduler_e2e_scheduling_duration_seconds_bucket{{le="{b}"}} {cum}'
+            )
+        cum += self.hist[-1]
+        lines.append(
+            f'scheduler_e2e_scheduling_duration_seconds_bucket{{le="+Inf"}} {cum}'
+        )
+        lines.append(
+            f"scheduler_e2e_scheduling_duration_seconds_sum {self.dur_sum:.6f}"
+        )
+        lines.append(
+            f"scheduler_e2e_scheduling_duration_seconds_count {self.batches}"
+        )
+        return "\n".join(lines) + "\n"
+
+
+class SchedulerService:
+    def __init__(
+        self,
+        config: EngineConfig | None = None,
+        buckets: Buckets | None = None,
+        log_stream=None,
+    ):
+        self.config = config or EngineConfig()
+        # Floor buckets pin compile shapes across requests (a feature
+        # first appearing mid-serving would otherwise trigger a full
+        # recompile stall; SnapshotBuilder docstring caveat).
+        self.buckets = buckets
+        self.metrics = _Metrics()
+        self._engine = Engine(self.config)
+        self._log = log_stream if log_stream is not None else sys.stderr
+
+    def _decode(self, snapshot_msg):
+        t0 = time.perf_counter()
+        snap, meta = snapshot_from_proto(
+            snapshot_msg, self.config, self.buckets
+        )
+        return snap, meta, time.perf_counter() - t0
+
+    def _log_batch(self, rpc: str, meta, decode_s: float, solve_s: float,
+                   placed: int, evicted: int, rounds: int):
+        rec = dict(
+            ts=time.time(), rpc=rpc, pods=meta.n_pods, nodes=meta.n_nodes,
+            running=meta.n_running, buckets=[meta.buckets.pods, meta.buckets.nodes],
+            decode_s=round(decode_s, 6), solve_s=round(solve_s, 6),
+            placed=placed, evicted=evicted, rounds=rounds,
+            placements_per_sec=round(placed / solve_s, 1) if solve_s > 0 else 0,
+        )
+        print(json.dumps(rec), file=self._log, flush=True)
+
+    # -- rpc methods --------------------------------------------------------
+
+    def ScoreBatch(self, request: pb.ScoreRequest, context) -> pb.ScoreResponse:
+        snap, meta, decode_s = self._decode(request.snapshot)
+        res = self._engine.score(snap)
+        resp = pb.ScoreResponse()
+        resp.pod_names.extend(meta.pod_names)
+        resp.node_names.extend(meta.node_names)
+        P, N = meta.n_pods, meta.n_nodes
+        for i in range(P):
+            row = resp.rows.add()
+            row.feasible.extend(res.feasible[i, :N].tolist())
+            row.scores.extend(res.scores[i, :N].tolist())
+        self._log_batch("ScoreBatch", meta, decode_s, res.solve_seconds,
+                        0, 0, 0)
+        self.metrics.observe(P, 0, 0, decode_s + res.solve_seconds)
+        return resp
+
+    def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
+        snap, meta, decode_s = self._decode(request.snapshot)
+        res = self._engine.solve(snap)
+        resp = pb.AssignResponse()
+        placed = 0
+        for i, name in enumerate(meta.pod_names):
+            a = resp.assignments.add()
+            a.pod = name
+            n = int(res.assignment[i])
+            if n >= 0:
+                a.node = meta.node_names[n]
+                placed += 1
+                s = float(res.chosen_score[i])
+                a.score = s if np.isfinite(s) else 0.0
+            a.commit_key = int(res.commit_key[i])
+        n_evicted = 0
+        if res.evicted is not None and res.evicted.any():
+            running_names = getattr(meta, "running_names", None) or []
+            for m in np.argwhere(res.evicted).ravel():
+                if m < len(running_names):
+                    resp.evicted.append(running_names[m])
+                    n_evicted += 1
+        resp.rounds = res.rounds
+        resp.solve_seconds = res.solve_seconds
+        self._log_batch("Assign", meta, decode_s, res.solve_seconds,
+                        placed, n_evicted, res.rounds)
+        self.metrics.observe(meta.n_pods, placed, n_evicted,
+                             decode_s + res.solve_seconds)
+        return resp
+
+    def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
+        import jax
+
+        return pb.HealthResponse(
+            ok=True, backend=jax.default_backend(), devices=len(jax.devices())
+        )
+
+    def Metrics(self, request: pb.MetricsRequest, context) -> pb.MetricsResponse:
+        return pb.MetricsResponse(prometheus_text=self.metrics.render())
+
+
+def make_server(
+    address: str = "127.0.0.1:0",
+    config: EngineConfig | None = None,
+    buckets: Buckets | None = None,
+    max_workers: int = 4,
+    log_stream=None,
+):
+    """Build (grpc.Server, bound_port, service). Unlimited message size:
+    a 10k-pod snapshot exceeds the 4 MB default."""
+    svc = SchedulerService(config, buckets, log_stream=log_stream)
+
+    def handler(fn, req_cls):
+        return grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
+
+    table = {
+        "ScoreBatch": handler(svc.ScoreBatch, pb.ScoreRequest),
+        "Assign": handler(svc.Assign, pb.AssignRequest),
+        "Health": handler(svc.Health, pb.HealthRequest),
+        "Metrics": handler(svc.Metrics, pb.MetricsRequest),
+    }
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            ("grpc.max_receive_message_length", -1),
+            ("grpc.max_send_message_length", -1),
+        ],
+    )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, table),)
+    )
+    port = server.add_insecure_port(address)
+    return server, port, svc
+
+
+def serve(address: str = "127.0.0.1:50051", config: EngineConfig | None = None):
+    """Blocking entry point: python -m tpusched.rpc.server"""
+    server, port, _ = make_server(address, config)
+    server.start()
+    print(f"tpusched sidecar listening on port {port}", file=sys.stderr)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--address", default="127.0.0.1:50051")
+    ap.add_argument("--config", default=None, help="EngineConfig YAML path")
+    args = ap.parse_args()
+    cfg = None
+    if args.config:
+        from tpusched.config import load_config
+
+        cfg = load_config(args.config)
+    serve(args.address, cfg)

@@ -225,6 +225,9 @@ class SnapshotMeta:
     n_running: int
     buckets: Buckets
     group_names: list[str]
+    # Running-pod names (eviction responses); populated by callers that
+    # track them (the gRPC codec and host shim).
+    running_names: list[str] | None = None
 
 
 # ---------------------------------------------------------------------------
