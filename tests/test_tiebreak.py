@@ -1,0 +1,115 @@
+"""Seeded tie-break (SURVEY.md §7 hard part 2) and fast-mode divergence
+quantification (the north star's parity claim needs numbers, not just
+"matches when non-contended")."""
+
+import numpy as np
+import pytest
+
+from tpusched import Engine, EngineConfig
+from tpusched.oracle import Oracle, validate_assignment
+from tpusched.qos import tie_hash
+from tpusched.snapshot import SnapshotBuilder
+from tpusched.synth import make_cluster
+
+
+def test_tie_hash_host_device_agree():
+    import jax.numpy as jnp
+
+    idx = jnp.arange(64)
+    dev = np.asarray(tie_hash(1234, idx))
+    host = np.array([tie_hash(1234, int(i)) for i in range(64)], np.uint32)
+    np.testing.assert_array_equal(dev, host)
+
+
+def _identical_cluster(cfg, n_nodes=8, n_pods=4):
+    b = SnapshotBuilder(cfg)
+    for i in range(n_nodes):
+        b.add_node(f"n{i}", {"cpu": 8000, "memory": 32 << 30})
+    for i in range(n_pods):
+        b.add_pod(f"p{i}", {"cpu": 100, "memory": 1 << 28})
+    return b.build()
+
+
+def test_seeded_tiebreak_parity_with_oracle():
+    """Identical nodes -> every node ties; device and oracle must pick
+    the SAME winner for any seed."""
+    for seed in (0, 1, 7, 123456):
+        cfg = EngineConfig(tie_break="seeded", tie_seed=seed)
+        snap, _ = _identical_cluster(cfg)
+        res = Engine(cfg).solve(snap)
+        ora = Oracle(snap, cfg).solve()
+        np.testing.assert_array_equal(res.assignment, ora.assignment)
+
+
+def test_seeded_tiebreak_spreads_choices():
+    """Unlike 'first', the seeded pick should not pile every first pod
+    onto node 0 across seeds."""
+    firsts = set()
+    for seed in range(8):
+        cfg = EngineConfig(tie_break="seeded", tie_seed=seed)
+        snap, _ = _identical_cluster(cfg)
+        res = Engine(cfg).solve(snap)
+        firsts.add(int(res.assignment[0]))
+    assert len(firsts) > 2, f"seeded tie-break is not spreading: {firsts}"
+
+
+def test_seeded_requires_parity_mode():
+    with pytest.raises(NotImplementedError):
+        Engine(EngineConfig(mode="fast", tie_break="seeded"))
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_seeded_fuzz_parity(seed):
+    cfg = EngineConfig(tie_break="seeded", tie_seed=42 + seed)
+    rng = np.random.default_rng(31000 + seed)
+    snap, _ = make_cluster(
+        rng, int(rng.integers(10, 40)), int(rng.integers(4, 12)),
+        taint_frac=0.3, toleration_frac=0.3, spread_frac=0.3,
+        interpod_frac=0.3,
+    )
+    res = Engine(cfg).solve(snap)
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+
+
+# ---------------------------------------------------------------------------
+# Fast-mode divergence quantification (VERDICT weak #7).
+# ---------------------------------------------------------------------------
+
+
+def test_fast_divergence_quantified():
+    """Across contended random snapshots, quantify fast-vs-sequential
+    divergence. The fast mode's contract (assign.py docstring): always
+    VALID, and the dealing commit may ORDER contended pods onto
+    different nodes than the sequential scan — but it must not LOSE
+    placements. Measured baseline (2026-07, round 2, seeds 50000-50029):
+    mean placed-ratio 0.9996, min 0.932; exact-set agreement on
+    contended snapshots is ~0 by design (the dealer load-balances where
+    per-pod argmax piles up) — exactness on non-interacting snapshots is
+    covered by test_fast_matches_sequential_when_pinned."""
+    seeds = range(30)
+    placed_ratio = []
+    for s in seeds:
+        rng = np.random.default_rng(50000 + s)
+        snap, _ = make_cluster(
+            rng,
+            n_pods=int(rng.integers(20, 60)),
+            n_nodes=int(rng.integers(4, 12)),
+            initial_utilization=float(rng.uniform(0.3, 0.7)),
+            spread_frac=float(rng.uniform(0, 0.4)),
+            interpod_frac=float(rng.uniform(0, 0.4)),
+        )
+        fcfg = EngineConfig(mode="fast")
+        res = Engine(fcfg).solve(snap)
+        ora = Oracle(snap, EngineConfig()).solve()
+        violations = validate_assignment(
+            snap, fcfg, res.assignment, commit_key=res.commit_key
+        )
+        assert violations == [], f"seed {s}: {violations}"
+        n_fast = int((res.assignment >= 0).sum())
+        n_seq = int((ora.assignment >= 0).sum())
+        placed_ratio.append(n_fast / max(n_seq, 1))
+    mean_ratio = float(np.mean(placed_ratio))
+    min_ratio = float(np.min(placed_ratio))
+    assert mean_ratio >= 0.97, f"fast mode lost placements: {mean_ratio:.3f}"
+    assert min_ratio >= 0.90, f"worst-case placement loss: {min_ratio:.3f}"

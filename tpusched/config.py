@@ -207,10 +207,15 @@ class EngineConfig:
     # best node. Off by default: enabling it changes SolveResult
     # semantics (evicted victims) and the host must issue deletes.
     preemption: bool = False
-    # Deterministic tie-break: lowest node index among score maxima.
-    # (Upstream uses seeded roulette; both our paths and the oracle share
-    # this rule so parity is well-defined. SURVEY.md §7 hard part 2.)
+    # Tie-break among equal-score maxima (SURVEY.md §7 hard part 2):
+    #   "first"  — lowest node index (deterministic default);
+    #   "seeded" — uniform pick via a per-pod hash of tie_seed, the
+    #              deterministic analogue of upstream's rand-among-max
+    #              (identical in oracle and device, so parity holds for
+    #              any seed). Parity mode + oracle only; fast mode's
+    #              dealing commit always uses "first".
     tie_break: str = "first"
+    tie_seed: int = 0
     # Mesh shape for multi-device runs: (pods-axis, nodes-axis). (1,1)
     # means single device.
     mesh_shape: tuple[int, int] = (1, 1)
@@ -234,14 +239,15 @@ class EngineConfig:
             kw["weights"] = PluginWeights(**d["weights"])
         if "qos" in d:
             kw["qos"] = QoSConfig(**d["qos"])
-        for k in ("mode", "max_rounds", "tie_break", "preemption"):
+        for k in ("mode", "max_rounds", "tie_break", "tie_seed", "preemption"):
             if k in d:
                 kw[k] = d[k]
         if "mesh_shape" in d:
             kw["mesh_shape"] = tuple(d["mesh_shape"])
         extra = set(d) - {
             "resources", "score_resource_weights", "weights", "qos",
-            "mode", "max_rounds", "tie_break", "mesh_shape", "preemption",
+            "mode", "max_rounds", "tie_break", "tie_seed", "mesh_shape",
+            "preemption",
         }
         if extra:
             raise ValueError(f"unknown EngineConfig keys: {sorted(extra)}")

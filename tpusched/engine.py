@@ -62,9 +62,14 @@ class Engine:
         cfg = self.config
         if cfg.mode not in ("parity", "fast"):
             raise ValueError(f"mode={cfg.mode!r}: want 'parity' or 'fast'")
-        if cfg.tie_break != "first":
+        if cfg.tie_break not in ("first", "seeded"):
             raise NotImplementedError(
-                f"tie_break={cfg.tie_break!r}: only 'first' is implemented"
+                f"tie_break={cfg.tie_break!r}: want 'first' or 'seeded'"
+            )
+        if cfg.tie_break == "seeded" and cfg.mode != "parity":
+            raise NotImplementedError(
+                "tie_break='seeded' requires mode='parity' (the fast "
+                "dealing commit always breaks ties by lowest index)"
             )
 
         def _solve(snap: ClusterSnapshot):

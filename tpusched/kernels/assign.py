@@ -44,7 +44,12 @@ from tpusched.kernels import filter as kfilter
 from tpusched.kernels import pairwise as kpair
 from tpusched.kernels import preempt as kpreempt
 from tpusched.kernels import score as kscore
-from tpusched.qos import effective_priority, effective_weights, pressure_of
+from tpusched.qos import (
+    effective_priority,
+    effective_weights,
+    pressure_of,
+    tie_hash,
+)
 from tpusched.snapshot import ClusterSnapshot
 
 NEG_INF = -jnp.inf
@@ -189,6 +194,20 @@ def gang_rollback(snap: ClusterSnapshot, used, assigned, chosen, pair_st,
     return used, assigned, chosen, pair_st, roll
 
 
+def pick_node(cfg: EngineConfig, masked, p):
+    """Select among score maxima (C5 'max-score node wins'): lowest
+    index ("first") or a seeded uniform pick ("seeded", the upstream
+    rand-among-max analogue; oracle mirrors bit-for-bit)."""
+    if cfg.tie_break == "first":
+        return jnp.argmax(masked)
+    mx = jnp.max(masked)
+    ties = masked == mx
+    cnt = jnp.maximum(jnp.sum(ties), 1).astype(jnp.uint32)
+    h = (tie_hash(cfg.tie_seed, p) % cnt).astype(jnp.int32)
+    rank = jnp.cumsum(ties) - 1
+    return jnp.argmax(ties & (rank == h))
+
+
 def pop_order(cfg: EngineConfig, snap: ClusterSnapshot):
     """Queue order (SURVEY.md C10): stable descending sort by dynamic
     QoS priority; invalid pods sink to the end."""
@@ -241,7 +260,7 @@ def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
         used, assigned, st, evicted = carry
         feasible, score, allowed = pod_cycle(cfg, snap, static, p, used, st)
         masked = jnp.where(feasible, score, NEG_INF)
-        n = jnp.argmax(masked)  # tie-break: first max (EngineConfig.tie_break)
+        n = pick_node(cfg, masked, p)
         commit = jnp.any(feasible)
         used = used.at[n].add(jnp.where(commit, snap.pods.requests[p], 0.0))
         st = kpair.pair_state_add_pod(snap, st, static.sig_match, p, n, commit)
@@ -305,6 +324,7 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
     # don't count — or pending holders, whose node is unknown yet) has a
     # selector matching it.
     S = snap.sigs.key.shape[0]
+    invol = None
     if S:
         M = snap.running.valid.shape[0]
         anti_possible = st0.anti.sum(axis=1) > 0
@@ -316,6 +336,21 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             static.sig_match[:, M:] & anti_possible[:, None], axis=0
         )
         has_pair = has_pair | sym_target
+        # Signature-involvement [P, S]: the sigs whose counts a pod's
+        # checks read (its own constraint sigs) or whose counts its
+        # commit writes (selectors matching it). Pods with DISJOINT
+        # involvement cannot affect each other's pairwise validation, so
+        # conservative pods may commit concurrently one-per-sig-cluster
+        # instead of one-per-round globally — the difference between
+        # O(#conservative) and O(#sig-clusters) rounds on spread-heavy
+        # workloads.
+        invol = static.sig_match[:, M:].T & pods.valid[:, None]  # [P, S]
+        for c in range(pods.ts_key.shape[1]):
+            s_c = jnp.clip(pods.ts_sig[:, c], 0, None)
+            invol = invol.at[jnp.arange(P), s_c].max(pods.ts_valid[:, c])
+        for t in range(pods.ia_key.shape[1]):
+            s_t = jnp.clip(pods.ia_sig[:, t], 0, None)
+            invol = invol.at[jnp.arange(P), s_t].max(pods.ia_valid[:, t])
     BIG = jnp.int32(2**31 - 1)
     # Round bound: worst case is one conservative pod committing per
     # round, so the auto bound is O(P); cfg.max_rounds > 0 caps it lower
@@ -337,10 +372,25 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         masked = jnp.where(feasible, score, NEG_INF)
         want = jnp.any(feasible, axis=1)
 
-        # Conservative pods commit only when globally first among wanting
-        # pending pods (their check state is then exactly sequential).
-        first_rank = jnp.min(jnp.where(want, rank, BIG))
-        allowed = want & (~conservative | (rank == first_rank))
+        # Conservative pods commit only when first among wanting pods
+        # they could INTERACT with: minimal rank within every signature
+        # cluster they touch (pods with disjoint involvement are
+        # independent). Pods with no involvement at all can never
+        # re-violate; let them retry freely.
+        if invol is None:
+            first_rank = jnp.min(jnp.where(want, rank, BIG))
+            ok_cons = rank == first_rank
+        else:
+            cons_want = want & conservative
+            rank_or_big = jnp.where(cons_want, rank, BIG)       # [P]
+            min_rank_sig = jnp.min(
+                jnp.where(invol, rank_or_big[:, None], BIG), axis=0
+            )                                                   # [S]
+            ok_cons = jnp.all(
+                jnp.where(invol, rank[:, None] == min_rank_sig[None, :], True),
+                axis=1,
+            )
+        allowed = want & (~conservative | ok_cons)
 
         # Load-balancing scores give every pod nearly the SAME global
         # node ranking, so per-pod argmax/top-K concentrates all commits

@@ -65,6 +65,7 @@ from tpusched.qos import (
     effective_weights,
     evict_cost_raw,
     pressure_of,
+    tie_hash,
     victim_effective_priority,
 )
 from tpusched.snapshot import ClusterSnapshot
@@ -576,7 +577,12 @@ class Oracle:
                     assigned_pods.append(int(p))
                 continue
             masked = np.where(feasible, score, -np.inf)
-            n = int(np.argmax(masked))  # first max = tie_break "first"
+            if self.cfg.tie_break == "seeded":
+                mx = masked.max()
+                ties = np.where(masked == mx)[0]
+                n = int(ties[tie_hash(self.cfg.tie_seed, int(p)) % len(ties)])
+            else:
+                n = int(np.argmax(masked))  # first max = tie_break "first"
             assignment[p] = n
             chosen_score[p] = masked[n]
             used[n] += _np(pods.requests)[p]

@@ -89,3 +89,26 @@ def effective_weights(cfg: EngineConfig, pressure) -> dict:
 
 def _is_array(x) -> bool:
     return hasattr(x, "shape") and getattr(x, "shape", ()) != ()
+
+
+def tie_hash(seed: int, pod_index):
+    """Deterministic per-pod 32-bit mix for the "seeded" tie-break.
+    Pure uint32 arithmetic so host ints (oracle) and jax uint32 (device)
+    agree bit-for-bit; xxhash-style avalanche constants."""
+    import numpy as _np
+
+    if isinstance(pod_index, (int, _np.integer)):
+        x = (seed * 2654435761 + int(pod_index) * 2246822519) & 0xFFFFFFFF
+        x ^= x >> 16
+        x = (x * 2246822519) & 0xFFFFFFFF
+        x ^= x >> 13
+        return x
+    import jax.numpy as jnp
+
+    x = jnp.uint32(seed & 0xFFFFFFFF) * jnp.uint32(2654435761) + (
+        pod_index.astype(jnp.uint32) * jnp.uint32(2246822519)
+    )
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(2246822519)
+    x = x ^ (x >> 13)
+    return x
