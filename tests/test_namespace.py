@@ -1,0 +1,246 @@
+"""Namespace scoping of pairwise constraints (SURVEY.md C6/C7 depth).
+
+Upstream semantics reproduced here:
+  * An inter-pod (anti-)affinity term matches only member pods in the
+    term's namespace scope — by default the incoming pod's OWN
+    namespace; an explicit `namespaces` list widens it; "*" (the
+    namespaceSelector:{} escape hatch) matches all namespaces.
+  * PodTopologySpread counts only pods in the incoming pod's own
+    namespace.
+  * Symmetric required anti-affinity repels only pods inside the
+    holder's term scope.
+"""
+
+import numpy as np
+import pytest
+
+from tpusched import Engine, EngineConfig
+from tpusched.oracle import Oracle, validate_assignment
+from tpusched.rpc.codec import snapshot_from_proto, snapshot_to_proto
+from tpusched.snapshot import (
+    MatchExpression,
+    PodAffinityTerm,
+    SnapshotBuilder,
+    TopologySpreadConstraint,
+)
+from tpusched.synth import make_cluster
+
+ZONE = "topology.kubernetes.io/zone"
+WEB = (MatchExpression("app", "In", ("web",)),)
+
+
+def _nodes(b, n=4, zones=("a", "b")):
+    for i in range(n):
+        b.add_node(f"n{i}", {"cpu": 4000, "memory": 16 << 30},
+                   labels={ZONE: zones[i % len(zones)]})
+
+
+def _solve_both(snap, cfg):
+    res = Engine(cfg).solve(snap)
+    ora = Oracle(snap, cfg).solve()
+    if cfg.mode == "parity":
+        np.testing.assert_array_equal(res.assignment, ora.assignment)
+    return res, ora
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_required_affinity_scoped_to_own_namespace(mode):
+    """A required affinity toward app=web must ignore a web pod running
+    in a DIFFERENT namespace: with no in-scope match anywhere, the
+    self-match special case applies only if the pod matches its own
+    selector — here it doesn't (app=api), so it stays unscheduled."""
+    cfg = EngineConfig(mode=mode)
+    b = SnapshotBuilder(cfg)
+    _nodes(b)
+    b.add_running_pod("n0", {"cpu": 100, "memory": 1 << 28},
+                      labels={"app": "web"}, namespace="other")
+    b.add_pod(
+        "api", {"cpu": 100, "memory": 1 << 28}, labels={"app": "api"},
+        namespace="mine",
+        pod_affinity=[PodAffinityTerm(ZONE, WEB, required=True)],
+    )
+    snap, _ = b.build()
+    res, ora = _solve_both(snap, cfg)
+    assert res.assignment[0] == -1, "cross-namespace match must not satisfy"
+    assert ora.assignment[0] == -1
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_explicit_namespaces_allow_cross_namespace_match(mode):
+    """The same term with namespaces=("other",) must see the web pod and
+    co-locate with its zone."""
+    cfg = EngineConfig(mode=mode)
+    b = SnapshotBuilder(cfg)
+    _nodes(b)
+    b.add_running_pod("n0", {"cpu": 100, "memory": 1 << 28},
+                      labels={"app": "web"}, namespace="other")
+    b.add_pod(
+        "api", {"cpu": 100, "memory": 1 << 28}, labels={"app": "api"},
+        namespace="mine",
+        pod_affinity=[PodAffinityTerm(ZONE, WEB, required=True,
+                                      namespaces=("other",))],
+    )
+    snap, _ = b.build()
+    res, _ = _solve_both(snap, cfg)
+    zones = np.asarray(snap.nodes.domain)[:, 0]
+    assert res.assignment[0] >= 0
+    assert zones[res.assignment[0]] == zones[0], "must land in web's zone"
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_star_matches_all_namespaces(mode):
+    cfg = EngineConfig(mode=mode)
+    b = SnapshotBuilder(cfg)
+    _nodes(b)
+    b.add_running_pod("n1", {"cpu": 100, "memory": 1 << 28},
+                      labels={"app": "web"}, namespace="whatever")
+    b.add_pod(
+        "api", {"cpu": 100, "memory": 1 << 28}, labels={"app": "api"},
+        namespace="mine",
+        pod_affinity=[PodAffinityTerm(ZONE, WEB, required=True,
+                                      namespaces=("*",))],
+    )
+    snap, _ = b.build()
+    res, _ = _solve_both(snap, cfg)
+    zones = np.asarray(snap.nodes.domain)[:, 0]
+    assert res.assignment[0] >= 0
+    assert zones[res.assignment[0]] == zones[1]
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_anti_affinity_ignores_other_namespace(mode):
+    """Anti-affinity against app=web scoped to own namespace: a web pod
+    in another namespace must NOT block the zone."""
+    cfg = EngineConfig(mode=mode)
+    b = SnapshotBuilder(cfg, None)
+    # Single zone: if the anti term saw the foreign pod, nothing fits.
+    b.add_node("n0", {"cpu": 4000, "memory": 16 << 30}, labels={ZONE: "a"})
+    b.add_running_pod("n0", {"cpu": 100, "memory": 1 << 28},
+                      labels={"app": "web"}, namespace="other")
+    b.add_pod(
+        "lonely", {"cpu": 100, "memory": 1 << 28}, labels={"app": "api"},
+        namespace="mine",
+        pod_affinity=[PodAffinityTerm(ZONE, WEB, anti=True, required=True)],
+    )
+    snap, _ = b.build()
+    res, _ = _solve_both(snap, cfg)
+    assert res.assignment[0] == 0, "foreign-namespace web must not repel"
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_spread_counts_only_own_namespace(mode):
+    """maxSkew=1 DoNotSchedule over zones: two same-selector pods already
+    in zone a but in ANOTHER namespace must not count, so the incoming
+    pod may still pick zone a (higher LeastRequested headroom there)."""
+    cfg = EngineConfig(mode=mode)
+    b = SnapshotBuilder(cfg)
+    # zone a node is much emptier -> wins scoring if feasible.
+    b.add_node("big-a", {"cpu": 16000, "memory": 64 << 30}, labels={ZONE: "a"})
+    b.add_node("small-b", {"cpu": 4000, "memory": 16 << 30}, labels={ZONE: "b"})
+    for i in range(2):
+        b.add_running_pod("big-a", {"cpu": 100, "memory": 1 << 28},
+                          labels={"app": "web"}, namespace="other")
+    b.add_pod(
+        "w", {"cpu": 100, "memory": 1 << 28}, labels={"app": "web"},
+        namespace="mine",
+        topology_spread=[TopologySpreadConstraint(
+            ZONE, max_skew=1, when_unsatisfiable="DoNotSchedule",
+            selector=WEB,
+        )],
+    )
+    snap, _ = b.build()
+    res, _ = _solve_both(snap, cfg)
+    assert res.assignment[0] == 0, (
+        "other-namespace members must not inflate the skew count"
+    )
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_spread_same_namespace_still_enforced(mode):
+    """Control for the test above: same members in the SAME namespace
+    must push the pod to zone b (skew filter)."""
+    cfg = EngineConfig(mode=mode)
+    b = SnapshotBuilder(cfg)
+    b.add_node("big-a", {"cpu": 16000, "memory": 64 << 30}, labels={ZONE: "a"})
+    b.add_node("small-b", {"cpu": 4000, "memory": 16 << 30}, labels={ZONE: "b"})
+    for i in range(2):
+        b.add_running_pod("big-a", {"cpu": 100, "memory": 1 << 28},
+                          labels={"app": "web"}, namespace="mine")
+    b.add_pod(
+        "w", {"cpu": 100, "memory": 1 << 28}, labels={"app": "web"},
+        namespace="mine",
+        topology_spread=[TopologySpreadConstraint(
+            ZONE, max_skew=1, when_unsatisfiable="DoNotSchedule",
+            selector=WEB,
+        )],
+    )
+    snap, _ = b.build()
+    res, _ = _solve_both(snap, cfg)
+    assert res.assignment[0] == 1
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_symmetric_anti_respects_holder_scope(mode):
+    """A running holder's anti term scoped to ITS own namespace repels
+    only pods in that namespace; a same-labels pod elsewhere is free."""
+    cfg = EngineConfig(mode=mode)
+    b = SnapshotBuilder(cfg)
+    b.add_node("n0", {"cpu": 4000, "memory": 16 << 30}, labels={ZONE: "a"})
+    b.add_running_pod(
+        "n0", {"cpu": 100, "memory": 1 << 28}, labels={"app": "db"},
+        namespace="team-a",
+        pod_affinity=[PodAffinityTerm(ZONE, WEB, anti=True, required=True)],
+    )
+    b.add_pod("w-a", {"cpu": 100, "memory": 1 << 28}, labels={"app": "web"},
+              namespace="team-a")
+    b.add_pod("w-b", {"cpu": 100, "memory": 1 << 28}, labels={"app": "web"},
+              namespace="team-b")
+    snap, _ = b.build()
+    res, _ = _solve_both(snap, cfg)
+    assert res.assignment[0] == -1, "in-scope pod must be repelled"
+    assert res.assignment[1] == 0, "out-of-scope pod must place"
+
+
+def test_wire_round_trip_preserves_namespaces():
+    """Codec: namespace fields survive proto encode/decode and produce
+    the same placements as the direct builder path."""
+    cfg = EngineConfig()
+    nodes = [dict(name=f"n{i}", allocatable={"cpu": 4000.0, "memory": float(16 << 30)},
+                  labels={ZONE: "ab"[i % 2]}) for i in range(4)]
+    running = [dict(name="r0", node="n0", requests={"cpu": 100.0},
+                    labels={"app": "web"}, namespace="other")]
+    pods = [dict(name="api", requests={"cpu": 100.0}, labels={"app": "api"},
+                 namespace="mine", observed_avail=1.0,
+                 pod_affinity=[PodAffinityTerm(ZONE, WEB, required=True,
+                                               namespaces=("other", "mine"))])]
+    msg = snapshot_to_proto(nodes, pods, running)
+    assert list(msg.pods[0].pod_affinity[0].namespaces) == ["other", "mine"]
+    assert msg.pods[0].namespace == "mine"
+    assert msg.running[0].namespace == "other"
+    snap, meta = snapshot_from_proto(msg, cfg)
+    res = Engine(cfg).solve(snap)
+    zones = np.asarray(snap.nodes.domain)[:, 0]
+    assert res.assignment[0] >= 0
+    assert zones[res.assignment[0]] == zones[0]
+
+
+def test_parity_fuzz_with_namespaces():
+    """Random multi-namespace snapshots: device parity mode must match
+    the oracle exactly, and fast mode must stay valid."""
+    for seed in range(4):
+        r = np.random.default_rng(900 + seed)
+        snap, _ = make_cluster(
+            r, 40, 12, spread_frac=0.4, interpod_frac=0.4,
+            run_anti_frac=0.2, namespace_count=3,
+        )
+        cfg = EngineConfig(mode="parity")
+        res = Engine(cfg).solve(snap)
+        ora = Oracle(snap, cfg).solve()
+        np.testing.assert_array_equal(res.assignment, ora.assignment)
+
+        fcfg = EngineConfig(mode="fast")
+        fres = Engine(fcfg).solve(snap)
+        violations = validate_assignment(
+            snap, fcfg, fres.assignment, commit_key=fres.commit_key
+        )
+        assert violations == [], violations

@@ -103,7 +103,8 @@ class Buckets:
     affinity_terms: int = 2    # IT: inter-pod (anti)affinity terms per pod
     pod_groups: int = 64       # G: distinct gangs (pod groups)
     taint_vocab: int = 16      # VT: distinct taints across the cluster
-    signatures: int = 8        # S: distinct (topo key, selector) signatures
+    signatures: int = 8        # S: distinct (topo key, ns, selector) signatures
+    sig_namespaces: int = 2    # NSV: explicit namespace ids per signature
 
     @staticmethod
     def fit(
@@ -136,7 +137,7 @@ class Buckets:
             node_labels=0, pod_labels=0, node_taints=0, atoms=0,
             atom_values=0, terms=0, term_atoms=0, pref_terms=0,
             topo_keys=0, spread_constraints=0, affinity_terms=0,
-            pod_groups=0, taint_vocab=0, signatures=0,
+            pod_groups=0, taint_vocab=0, signatures=0, sig_namespaces=0,
         )
 
 

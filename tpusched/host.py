@@ -169,7 +169,7 @@ class HostScheduler:
             "name", "requests", "priority", "slo_target", "observed_avail",
             "labels", "node_selector", "required_terms", "preferred_terms",
             "tolerations", "topology_spread", "pod_affinity", "pod_group",
-            "pod_group_min_member",
+            "pod_group_min_member", "namespace",
         )
         return {k: p[k] for k in keep if k in p}
 
@@ -179,6 +179,7 @@ class HostScheduler:
             name=p["name"], node=p["node"], requests=p.get("requests", {}),
             priority=p.get("priority", 0.0), labels=p.get("labels", {}),
             pod_affinity=p.get("pod_affinity", []),
+            namespace=p.get("namespace", "default"),
         )
         # QoS slack of a running pod: observed availability minus SLO
         # (SURVEY.md C10); specs carry both or a precomputed slack.

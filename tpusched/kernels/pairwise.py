@@ -59,11 +59,23 @@ def member_label_sat_t(snap: ClusterSnapshot, sat_fn):
 
 
 def sig_member_match(snap: ClusterSnapshot, member_sat_t):
-    """[S, M+P] bool: does member x's label set match signature s's
-    selector. Label-only (validity applied at count time). A signature
-    with zero atoms matches everything (upstream empty label selector)."""
+    """[S, M+P] bool: does member x match signature s — label selector
+    satisfied AND member namespace in the sig's scope (upstream
+    podAffinityTerm.namespaces / same-namespace spread counting). A
+    signature with zero atoms matches every namespace-eligible member
+    (upstream empty label selector)."""
     match = gather_term_sat(member_sat_t, snap.sigs.atoms)   # [S, M+P]
-    return match & snap.sigs.valid[:, None]
+    member_ns = jnp.concatenate(
+        [snap.running.namespace, snap.pods.namespace]
+    )                                                        # [M+P]
+    if snap.sigs.ns.shape[1]:
+        ns_ok = jnp.any(
+            snap.sigs.ns[:, :, None] == member_ns[None, None, :], axis=1
+        )                                                    # [S, M+P]
+        ns_ok |= snap.sigs.ns_all[:, None]
+    else:
+        ns_ok = jnp.broadcast_to(snap.sigs.ns_all[:, None], match.shape)
+    return match & ns_ok & snap.sigs.valid[:, None]
 
 
 def sig_domains(snap: ClusterSnapshot):

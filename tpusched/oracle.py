@@ -228,19 +228,33 @@ class Oracle:
 
     # -- pairwise: topology spread + inter-pod affinity ---------------------
 
-    def _match_counts(self, sel_atoms: np.ndarray, extra_lp, extra_lk) -> np.ndarray:
-        """[X] bool: which of running+assigned pods match the selector.
-        A selector with zero atoms matches everything (upstream empty
-        label selector)."""
+    def _ns_ok(self, sig: int, member_ns: np.ndarray) -> np.ndarray:
+        """[X] bool: member namespaces within signature sig's scope
+        (upstream podAffinityTerm.namespaces / same-namespace spread)."""
+        sigs = self.snap.sigs
+        if bool(_np(sigs.ns_all)[sig]):
+            return np.ones(member_ns.shape[0], bool)
+        allowed = _np(sigs.ns)[sig]
+        allowed = allowed[allowed >= 0]
+        return np.isin(member_ns, allowed)
+
+    def _match_counts(self, sel_atoms: np.ndarray, sig: int,
+                      assigned_pods: list[int]) -> np.ndarray:
+        """[X] bool: which of running+assigned pods match the selector
+        within the signature's namespace scope. A selector with zero
+        atoms matches everything (upstream empty label selector)."""
         run = self.snap.running
-        lp = np.concatenate([_np(run.label_pairs)] + extra_lp, axis=0)
-        lk = np.concatenate([_np(run.label_keys)] + extra_lk, axis=0)
+        ap = list(assigned_pods)
+        plp, plk = _np(self.pods.label_pairs), _np(self.pods.label_keys)
+        pns = _np(self.pods.namespace)
+        lp = np.concatenate([_np(run.label_pairs), plp[ap]], axis=0)
+        lk = np.concatenate([_np(run.label_keys), plk[ap]], axis=0)
+        mns = np.concatenate([_np(run.namespace), pns[ap]])
         valid = np.concatenate(
-            [_np(run.valid) & ~self._evicted]
-            + [np.ones(len(x), bool) for x in extra_lp]
+            [_np(run.valid) & ~self._evicted, np.ones(len(ap), bool)]
         )
         sat = self.atom_sat_over(lp, lk)
-        match = valid.copy()
+        match = valid & self._ns_ok(sig, mns)
         for a in sel_atoms:
             if a >= 0:
                 match &= sat[a]
@@ -261,9 +275,6 @@ class Oracle:
         tsv = _np(pods.ts_valid)[p]
         if not tsv.any():
             return ok, penalty
-        plp, plk = _np(pods.label_pairs), _np(pods.label_keys)
-        extra_lp = [plp[assigned_pods]] if assigned_pods else []
-        extra_lk = [plk[assigned_pods]] if assigned_pods else []
         run_nodes = _np(self.snap.running.node_idx)
         member_nodes = np.concatenate(
             [run_nodes, np.asarray(assigned_nodes, np.int32)]
@@ -276,7 +287,10 @@ class Oracle:
                 continue
             key = tsk[c]
             has_key = dom[:, key] >= 0
-            match = self._match_counts(_np(pods.ts_sel_atoms)[p, c], extra_lp, extra_lk)
+            match = self._match_counts(
+                _np(pods.ts_sel_atoms)[p, c], int(_np(pods.ts_sig)[p, c]),
+                assigned_pods,
+            )
             # count matching member pods per domain of this topo key
             member_dom = np.where(member_nodes >= 0, dom[member_nodes, key], -1)
             n_dom = int(dom[:, key].max()) + 1 if has_key.any() else 0
@@ -307,8 +321,6 @@ class Oracle:
         if not iav.any():
             return ok, raw
         plp, plk = _np(pods.label_pairs), _np(pods.label_keys)
-        extra_lp = [plp[assigned_pods]] if assigned_pods else []
-        extra_lk = [plk[assigned_pods]] if assigned_pods else []
         run_nodes = _np(self.snap.running.node_idx)
         member_nodes = np.concatenate(
             [run_nodes, np.asarray(assigned_nodes, np.int32)]
@@ -317,7 +329,10 @@ class Oracle:
             if not iav[t]:
                 continue
             key = _np(pods.ia_key)[p, t]
-            match = self._match_counts(_np(pods.ia_sel_atoms)[p, t], extra_lp, extra_lk)
+            match = self._match_counts(
+                _np(pods.ia_sel_atoms)[p, t], int(_np(pods.ia_sig)[p, t]),
+                assigned_pods,
+            )
             member_dom = np.where(member_nodes >= 0, dom[member_nodes, key], -1)
             # domain -> has matching pod?
             has_key = dom[:, key] >= 0
@@ -342,7 +357,12 @@ class Oracle:
                     self_sat = self.atom_sat_over(
                         plp[p : p + 1], plk[p : p + 1]
                     )[:, 0]
-                    self_match = bool(_np(pods.valid)[p])
+                    self_match = bool(_np(pods.valid)[p]) and bool(
+                        self._ns_ok(
+                            int(_np(pods.ia_sig)[p, t]),
+                            _np(pods.namespace)[p : p + 1],
+                        )[0]
+                    )
                     for a in _np(pods.ia_sel_atoms)[p, t]:
                         if a >= 0:
                             self_match = self_match and bool(self_sat[a])
@@ -389,7 +409,9 @@ class Oracle:
                 if ia_valid[q, t] and ia_anti[q, t] and ia_req[q, t]:
                     holders.append((int(ia_sig[q, t]), int(nq)))
         for s, hn in holders:
-            match = bool(_np(pods.valid)[p])
+            match = bool(_np(pods.valid)[p]) and bool(
+                self._ns_ok(int(s), _np(pods.namespace)[p : p + 1])[0]
+            )
             for a in sig_atoms[s]:
                 if a >= 0:
                     match = match and bool(sat_p[a])

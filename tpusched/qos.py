@@ -95,9 +95,7 @@ def tie_hash(seed: int, pod_index):
     """Deterministic per-pod 32-bit mix for the "seeded" tie-break.
     Pure uint32 arithmetic so host ints (oracle) and jax uint32 (device)
     agree bit-for-bit; xxhash-style avalanche constants."""
-    import numpy as _np
-
-    if isinstance(pod_index, (int, _np.integer)):
+    if isinstance(pod_index, (int, np.integer)):
         x = (seed * 2654435761 + int(pod_index) * 2246822519) & 0xFFFFFFFF
         x ^= x >> 16
         x = (x * 2246822519) & 0xFFFFFFFF

@@ -44,6 +44,7 @@ def _affinity(msgs) -> list[PodAffinityTerm]:
             anti=t.anti,
             required=t.required,
             weight=t.weight or 1.0,
+            namespaces=tuple(t.namespaces),
         )
         for t in msgs
     ]
@@ -101,6 +102,7 @@ def snapshot_from_proto(
             pod_affinity=_affinity(p.pod_affinity),
             pod_group=p.pod_group or None,
             pod_group_min_member=p.pod_group_min_member,
+            namespace=p.namespace or "default",
         )
     for r in msg.running:
         b.add_running_pod(
@@ -111,6 +113,7 @@ def snapshot_from_proto(
             labels=_labels(r.labels),
             count_into_used=not r.exclude_from_used,
             pod_affinity=_affinity(r.pod_affinity),
+            namespace=r.namespace or "default",
         )
     snap, meta = b.build()
     # Running-pod names travel with meta for eviction responses.
@@ -148,6 +151,7 @@ def _set_affinity(field, terms):
         m.topology_key = t.topology_key
         _set_exprs(m.selector, t.selector)
         m.anti, m.required, m.weight = t.anti, t.required, float(t.weight)
+        m.namespaces.extend(t.namespaces)
 
 
 def snapshot_to_proto(
@@ -196,6 +200,8 @@ def snapshot_to_proto(
         if p.get("pod_group"):
             pm.pod_group = p["pod_group"]
             pm.pod_group_min_member = int(p.get("pod_group_min_member", 0))
+        if p.get("namespace"):
+            pm.namespace = p["namespace"]
     for r in running or []:
         rm = msg.running.add()
         rm.name = r.get("name", "")
@@ -206,4 +212,6 @@ def snapshot_to_proto(
         _set_labels(rm.labels, r.get("labels", {}))
         _set_affinity(rm.pod_affinity, r.get("pod_affinity", []))
         rm.exclude_from_used = not r.get("count_into_used", True)
+        if r.get("namespace"):
+            rm.namespace = r["namespace"]
     return msg

@@ -96,6 +96,11 @@ class PodAffinityTerm:
     anti: bool = False
     required: bool = True
     weight: float = 1.0      # only used when required=False
+    # Namespace scope (upstream podAffinityTerm.namespaces): the term
+    # matches only member pods in these namespaces. Empty = the incoming
+    # pod's own namespace (upstream default); ("*",) = all namespaces
+    # (the namespaceSelector:{} escape hatch).
+    namespaces: tuple[str, ...] = ()
 
 
 def selector_from_labels(labels: Mapping[str, str]) -> tuple[MatchExpression, ...]:
@@ -125,16 +130,25 @@ class AtomTable:
 
 @struct.dataclass
 class SigTable:
-    """Distinct (topology key, pod-label selector) signatures across all
-    topology-spread and inter-pod-affinity constraints (SURVEY.md C6/C7).
+    """Distinct (topology key, namespace scope, pod-label selector)
+    signatures across all topology-spread and inter-pod-affinity
+    constraints (SURVEY.md C6/C7).
 
     Domain counting is done once per signature — counts[s, d] = matching
     member pods in domain d of sig s's topology key — instead of once per
     pod, which is what makes pairwise constraints scale: pods reference
-    signatures by id (pods.ts_sig / pods.ia_sig) and just gather."""
+    signatures by id (pods.ts_sig / pods.ia_sig) and just gather.
+
+    A member matches sig s iff its labels satisfy the selector atoms AND
+    its namespace is in the sig's scope (ns list, or ns_all). Spread
+    constraints are always scoped to the incoming pod's own namespace
+    (upstream counts same-namespace pods only); affinity terms resolve
+    their `namespaces` field at build time."""
 
     key: Any     # [S] int32 topology-key index
     atoms: Any   # [S, AT] int32 selector atom ids (-1 pad; none = match all)
+    ns: Any      # [S, NSV] int32 allowed namespace ids (-1 pad)
+    ns_all: Any  # [S] bool: matches every namespace
     valid: Any   # [S] bool
 
 
@@ -183,6 +197,7 @@ class PodArrays:
     ia_valid: Any        # [P, IT] bool
     # Gang scheduling.
     group: Any           # [P] int32 pod-group id (-1 = none)
+    namespace: Any       # [P] int32 namespace id
     valid: Any           # [P] bool
 
 
@@ -200,6 +215,7 @@ class RunningPodArrays:
     # match its selector (SURVEY.md C7). Preferred / positive terms of
     # running pods are not symmetric for filtering and are not stored.
     anti_sig: Any     # [M, IT] int32
+    namespace: Any    # [M] int32 namespace id
     valid: Any        # [M] bool
 
 
@@ -289,6 +305,7 @@ class SnapshotBuilder:
         pod_affinity: Sequence[PodAffinityTerm] = (),
         pod_group: str | None = None,
         pod_group_min_member: int = 0,
+        namespace: str = "default",
     ) -> None:
         req = dict(requests)
         req.setdefault(RESOURCE_PODS, 1.0)
@@ -305,7 +322,8 @@ class SnapshotBuilder:
                  tolerations=list(tolerations),
                  topology_spread=list(topology_spread),
                  pod_affinity=list(pod_affinity),
-                 pod_group=pod_group)
+                 pod_group=pod_group,
+                 namespace=str(namespace) or "default")
         )
 
     def add_running_pod(
@@ -317,6 +335,7 @@ class SnapshotBuilder:
         labels: Mapping[str, str] | None = None,
         count_into_used: bool = True,
         pod_affinity: Sequence[PodAffinityTerm] = (),
+        namespace: str = "default",
     ) -> None:
         """pod_affinity: only required ANTI terms affect scheduling (the
         upstream symmetric anti-affinity rule); other terms are accepted
@@ -327,7 +346,8 @@ class SnapshotBuilder:
             dict(node=node, requests=req, priority=float(priority),
                  slack=float(slack), labels=dict(labels or {}),
                  count_into_used=count_into_used,
-                 pod_affinity=list(pod_affinity))
+                 pod_affinity=list(pod_affinity),
+                 namespace=str(namespace) or "default")
         )
 
     # -- build --------------------------------------------------------------
@@ -375,24 +395,43 @@ class SnapshotBuilder:
             else:
                 pids = ()
                 num = float("nan")
-            sig = (k, op, pids, num)
+            # Dedup key must not contain NaN (nan != nan would make every
+            # non-numeric atom "distinct", exploding the atom/signature
+            # tables ~Px): key numeric ops by the number, others by None.
+            sig = (k, op, pids, num if num == num else None)
             if sig not in atom_ids:
                 atom_ids[sig] = len(atoms)
-                atoms.append(sig)
+                atoms.append((k, op, pids, num))
             return atom_ids[sig]
 
-        # Pairwise-constraint signatures: one (topo key, selector) entry
-        # per distinct combination, so domain counting happens per
-        # signature, not per pod (see SigTable).
-        sig_ids: dict[tuple, int] = {}
-        sigs: list[tuple[int, tuple[int, ...]]] = []
+        # Pairwise-constraint signatures: one (topo key, namespace scope,
+        # selector) entry per distinct combination, so domain counting
+        # happens per signature, not per pod (see SigTable).
+        ns_ids: dict[str, int] = {}
 
-        def sid(key_idx: int, atoms_list: list[int]) -> int:
-            sig = (key_idx, tuple(sorted(atoms_list)))
+        def nsid(ns: str) -> int:
+            return ns_ids.setdefault(ns, len(ns_ids))
+
+        sig_ids: dict[tuple, int] = {}
+        # each entry: (key_idx, ns_scope, atoms) where ns_scope is "*"
+        # (all namespaces) or a sorted tuple of namespace ids.
+        sigs: list[tuple[int, Any, tuple[int, ...]]] = []
+
+        def sid(key_idx: int, atoms_list: list[int], ns_scope) -> int:
+            sig = (key_idx, ns_scope, tuple(sorted(atoms_list)))
             if sig not in sig_ids:
                 sig_ids[sig] = len(sigs)
                 sigs.append(sig)
             return sig_ids[sig]
+
+        def ns_scope_of(namespaces: Sequence[str], own_ns: str):
+            """Resolve an affinity term's namespace list against the
+            owning pod's namespace (upstream: empty = own namespace)."""
+            if not namespaces:
+                return (nsid(own_ns),)
+            if "*" in namespaces:
+                return "*"
+            return tuple(sorted(nsid(x) for x in set(namespaces)))
 
         # First pass: intern everything referenced by pods so vocab sizes
         # are known before arrays are allocated.
@@ -416,6 +455,7 @@ class SnapshotBuilder:
                 ([aid(e) for e in pt.term.expressions], float(pt.weight))
                 for pt in p["preferred_terms"] if pt.term.expressions
             ]
+            own_ns = p["namespace"]
             ts = [
                 dict(key=topo_idx(c.topology_key), max_skew=float(c.max_skew),
                      when=DO_NOT_SCHEDULE if c.when_unsatisfiable == "DoNotSchedule" else SCHEDULE_ANYWAY,
@@ -423,14 +463,17 @@ class SnapshotBuilder:
                 for c in p["topology_spread"]
             ]
             for c in ts:
-                c["sig"] = sid(c["key"], c["atoms"])
+                # Spread counting is always scoped to the incoming pod's
+                # own namespace (upstream PodTopologySpread semantics).
+                c["sig"] = sid(c["key"], c["atoms"], (nsid(own_ns),))
             ia = [
                 dict(key=topo_idx(t.topology_key), atoms=[aid(e) for e in t.selector],
-                     anti=t.anti, required=t.required, weight=float(t.weight))
+                     anti=t.anti, required=t.required, weight=float(t.weight),
+                     ns=ns_scope_of(t.namespaces, own_ns))
                 for t in p["pod_affinity"]
             ]
             for t in ia:
-                t["sig"] = sid(t["key"], t["atoms"])
+                t["sig"] = sid(t["key"], t["atoms"], t["ns"])
             pod_compiled.append(dict(req_terms=req_terms, pref_terms=pref_terms, ts=ts, ia=ia))
 
         # Running pods' required anti-affinity terms (symmetric rule):
@@ -444,7 +487,10 @@ class SnapshotBuilder:
                     continue
                 alist = [aid(e) for e in t.selector]
                 run_anti_atom_max = max(run_anti_atom_max, len(alist))
-                sigs_of_pod.append(sid(topo_idx(t.topology_key), alist))
+                sigs_of_pod.append(sid(
+                    topo_idx(t.topology_key), alist,
+                    ns_scope_of(t.namespaces, rrec["namespace"]),
+                ))
             run_anti.append(sigs_of_pod)
 
         # Intern node labels/taints.
@@ -456,9 +502,11 @@ class SnapshotBuilder:
         for rrec in self._running:
             for k, v in rrec["labels"].items():
                 kid(k); pid(k, v)
+            nsid(rrec["namespace"])
         for p in self._pods:
             for k, v in p["labels"].items():
                 kid(k); pid(k, v)
+            nsid(p["namespace"])
 
         # Buckets: start minimal (size-0 feature axes, whose kernels the
         # tracer drops entirely) and grow only to observed need, so
@@ -497,6 +545,9 @@ class SnapshotBuilder:
             pod_groups=len(self._groups),
             taint_vocab=len(taint_ids),
             signatures=len(sigs),
+            sig_namespaces=max(
+                (len(ns) for _, ns, _ in sigs if ns != "*"), default=0
+            ),
         )
         grow = {
             f: max(getattr(bk, f), _ceil_bucket(v))
@@ -563,10 +614,16 @@ class SnapshotBuilder:
         # Signature table.
         sig_key = np.full(bk.signatures, -1, np.int32)
         sig_atoms_arr = np.full((bk.signatures, bk.term_atoms), -1, np.int32)
+        sig_ns = np.full((bk.signatures, bk.sig_namespaces), -1, np.int32)
+        sig_ns_all = np.zeros(bk.signatures, bool)
         sig_valid = np.zeros(bk.signatures, bool)
-        for s, (k, alist) in enumerate(sigs):
+        for s, (k, ns_scope, alist) in enumerate(sigs):
             sig_key[s] = k
             sig_atoms_arr[s, : len(alist)] = alist
+            if ns_scope == "*":
+                sig_ns_all[s] = True
+            else:
+                sig_ns[s, : len(ns_scope)] = ns_scope
             sig_valid[s] = True
 
         # Pod arrays.
@@ -612,6 +669,7 @@ class SnapshotBuilder:
                 pods.ia_weight[i, t] = term["weight"]
             if p["pod_group"] is not None:
                 pods.group[i] = group_idx[p["pod_group"]]
+            pods.namespace[i] = ns_ids[p["namespace"]]
 
         group_min = np.zeros(bk.pod_groups, np.int32)
         for g, name in enumerate(group_list):
@@ -625,6 +683,7 @@ class SnapshotBuilder:
         run_lp = np.full((M, bk.pod_labels), -1, np.int32)
         run_lk = np.full((M, bk.pod_labels), -1, np.int32)
         run_anti_sig = np.full((M, bk.affinity_terms), -1, np.int32)
+        run_ns = np.full(M, -1, np.int32)
         run_valid = np.zeros(M, bool)
         for i, rrec in enumerate(self._running):
             ni = node_index[rrec["node"]]
@@ -641,6 +700,7 @@ class SnapshotBuilder:
                 run_lp[i, j] = pair_ids[(k, v)]
             for j, s in enumerate(run_anti[i]):
                 run_anti_sig[i, j] = s
+            run_ns[i] = ns_ids[rrec["namespace"]]
 
         snap = ClusterSnapshot(
             nodes=NodeArrays(
@@ -662,16 +722,18 @@ class SnapshotBuilder:
                 ia_key=pods.ia_key, ia_sel_atoms=pods.ia_sel_atoms,
                 ia_sig=pods.ia_sig, ia_anti=pods.ia_anti,
                 ia_required=pods.ia_required, ia_weight=pods.ia_weight,
-                ia_valid=pods.ia_valid, group=pods.group, valid=pods.valid,
+                ia_valid=pods.ia_valid, group=pods.group,
+                namespace=pods.namespace, valid=pods.valid,
             ),
             running=RunningPodArrays(
                 node_idx=run_node, requests=run_req, priority=run_prio,
                 slack=run_slack, label_pairs=run_lp, label_keys=run_lk,
-                anti_sig=run_anti_sig, valid=run_valid,
+                anti_sig=run_anti_sig, namespace=run_ns, valid=run_valid,
             ),
             atoms=AtomTable(key=atom_key, op=atom_op, pairs=atom_pairs,
                             num=atom_num, valid=atom_valid),
-            sigs=SigTable(key=sig_key, atoms=sig_atoms_arr, valid=sig_valid),
+            sigs=SigTable(key=sig_key, atoms=sig_atoms_arr, ns=sig_ns,
+                          ns_all=sig_ns_all, valid=sig_valid),
             taint_effect=taint_effect,
             group_min_member=group_min,
         )
@@ -717,6 +779,7 @@ class _PodArraysNP:
         self.ia_weight = np.zeros((P, bk.affinity_terms), np.float32)
         self.ia_valid = np.zeros((P, bk.affinity_terms), bool)
         self.group = np.full(P, -1, np.int32)
+        self.namespace = np.full(P, -1, np.int32)
         self.valid = np.zeros(P, bool)
 
 

@@ -51,6 +51,7 @@ def make_cluster(
     gang_frac: float = 0.0,
     gang_size: int = 4,
     keyless_node_frac: float = 0.0,
+    namespace_count: int = 1,
 ):
     """General-purpose random cluster. Fractions control what share of
     pods/nodes carry each constraint type, so the same generator covers
@@ -131,6 +132,7 @@ def make_cluster(
                 priority=float(rng.integers(0, 100)),
                 slack=float(rng.uniform(-0.2, 0.3)),
                 labels={"app": apps[int(rng.integers(len(apps)))]},
+                namespace=f"ns-{rng.integers(namespace_count)}",
                 **run_kwargs,
             )
 
@@ -164,6 +166,22 @@ def make_cluster(
             ]
         if rng.random() < interpod_frac:
             anti = rng.random() < 0.5
+            # Namespace scope variation (upstream podAffinityTerm
+            # .namespaces): mostly own-namespace (default), sometimes an
+            # explicit cross-namespace list or all-namespaces.
+            ns_roll = rng.random()
+            if namespace_count > 1 and ns_roll < 0.2:
+                term_ns = ("*",)
+            elif namespace_count > 1 and ns_roll < 0.5:
+                term_ns = tuple(
+                    f"ns-{k}" for k in rng.choice(
+                        namespace_count,
+                        size=int(rng.integers(1, min(namespace_count, 3) + 1)),
+                        replace=False,
+                    )
+                )
+            else:
+                term_ns = ()
             kwargs["pod_affinity"] = [
                 PodAffinityTerm(
                     topology_key="topology.kubernetes.io/zone",
@@ -171,6 +189,7 @@ def make_cluster(
                     anti=anti,
                     required=bool(rng.random() < 0.3),
                     weight=float(rng.integers(1, 100)),
+                    namespaces=term_ns,
                 )
             ]
         if gang_frac > 0 and rng.random() < gang_frac:
@@ -187,6 +206,7 @@ def make_cluster(
             slo_target=slo,
             observed_avail=float(rng.uniform(0.5, 1.0)),
             labels={"app": app},
+            namespace=f"ns-{rng.integers(namespace_count)}",
             **kwargs,
         )
     return b.build()
