@@ -12,7 +12,7 @@ budget (>1.0 means under budget).
 
 Usage: python bench.py [--pods N] [--nodes N] [--iters N] [--only NAME]
        [--what score|score_top1|solve] [--mode fast|parity]
-NAME in {headline, pairwise, gangs, preemption, e2e}.
+NAME in {headline, pairwise, gangs, preemption, pipeline, e2e}.
 """
 
 from __future__ import annotations
@@ -189,6 +189,35 @@ def bench_preemption(args):
     emit("preemption_solve_p99_latency_1000x200", stats)
 
 
+def bench_pipeline(args):
+    """SURVEY.md §2.3 PP analogue: decode of batch k+1 overlapped with
+    device solve of batch k over a stream of independent snapshots."""
+    from tpusched import Engine, EngineConfig
+    from tpusched.pipeline import bench_overlap
+    from tpusched.synth import config2_scale
+
+    pods, nodes = 5000, 2000
+    log(f"[pipeline] stream of 8 batches @{pods}x{nodes} mode={args.mode}")
+    eng = Engine(EngineConfig(mode=args.mode))
+
+    def decode(seed):
+        return config2_scale(np.random.default_rng(seed), pods, nodes,
+                             with_qos=True)
+
+    stats = bench_overlap(eng, list(range(8)), decode)
+    log(f"  sequential {stats['sequential_s']:.2f}s "
+        f"pipelined {stats['pipelined_s']:.2f}s "
+        f"speedup {stats['speedup']:.2f}x")
+    print(json.dumps({
+        "metric": f"pipeline_overlap_speedup_{pods}x{nodes}",
+        "value": round(stats["speedup"], 3),
+        "unit": "x",
+        "vs_baseline": round(stats["speedup"], 3),
+        "sequential_s": round(stats["sequential_s"], 3),
+        "pipelined_s": round(stats["pipelined_s"], 3),
+    }), flush=True)
+
+
 def bench_e2e(args):
     """configs[0]: 100 pods x 10 nodes through the host shim."""
     try:
@@ -205,6 +234,7 @@ BENCHES = {
     "pairwise": bench_pairwise,
     "gangs": bench_gangs,
     "preemption": bench_preemption,
+    "pipeline": bench_pipeline,
     "e2e": bench_e2e,
     # headline runs last so the final stdout line is the headline metric
     "headline": bench_headline,

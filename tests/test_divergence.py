@@ -1,0 +1,31 @@
+"""Fast-vs-parity divergence numbers (VERDICT weak #7): constraint-free
+snapshots must agree EXACTLY; contended ones must stay valid and close."""
+
+from tpusched.divergence import measure
+
+
+def test_plain_preset_same_throughput():
+    """No constraints: both modes must place (nearly) the same NUMBER of
+    pods. Node choices — and at full-cluster margins even which pods
+    land — legitimately differ: load-balancing scores couple every
+    pod's choice to all earlier commits, so the two orders reach
+    different but equally valid packings (tests/test_fast.py pins the
+    uncoupled case where agreement is exact)."""
+    stats = measure("plain", seeds=4, n_pods=40, n_nodes=12)
+    assert stats.fast_violations == 0
+    assert abs(stats.placed_delta) <= stats.seeds, stats.row()
+
+
+def test_mixed_preset_valid_and_close():
+    stats = measure("mixed", seeds=4, n_pods=40, n_nodes=12)
+    assert stats.fast_violations == 0, stats.row()
+    # Under heavy pairwise contention the two orders reach different
+    # valid fixpoints; measured gap stays within a few percent of pods
+    # (those pods retry next batch in a live cluster). Parity mode is
+    # the way out when exact stock placements are required.
+    assert stats.placed_delta >= -0.08 * stats.pods, stats.row()
+
+
+def test_pairwise_preset_valid():
+    stats = measure("pairwise", seeds=3, n_pods=40, n_nodes=12)
+    assert stats.fast_violations == 0, stats.row()

@@ -122,20 +122,17 @@ class Engine:
 
     # -- public API ---------------------------------------------------------
 
-    def solve(self, snap: ClusterSnapshot) -> SolveResult:
-        """Full batched scheduling: assign every pending pod (or -1).
-
-        Timing includes the device->host readback: on some backends
-        (axon tunnel) block_until_ready does not actually block, and the
-        host shim needs the assignments anyway — the D2H copy is part of
-        the schedule cycle."""
-        t0 = time.perf_counter()
-        buf = np.asarray(self._solve_packed_jit(snap))
+    @staticmethod
+    def unpack(snap: ClusterSnapshot, buf) -> SolveResult:
+        """Decode _solve_packed's flat buffer (the single authority on
+        its layout — solve() and pipeline.solve_stream both go through
+        here, so the packing can't drift between them)."""
+        buf = np.asarray(buf)
         P = snap.pods.valid.shape[0]
         N, R = snap.nodes.used.shape
         M = snap.running.valid.shape[0]
         base = 4 * P + N * R
-        out = SolveResult(
+        return SolveResult(
             assignment=buf[:P].astype(np.int32),
             chosen_score=buf[P : 2 * P],
             order=buf[2 * P : 3 * P].astype(np.int32),
@@ -144,6 +141,16 @@ class Engine:
             evicted=buf[base : base + M] > 0,
             rounds=int(buf[-1]),
         )
+
+    def solve(self, snap: ClusterSnapshot) -> SolveResult:
+        """Full batched scheduling: assign every pending pod (or -1).
+
+        Timing includes the device->host readback: on some backends
+        (axon tunnel) block_until_ready does not actually block, and the
+        host shim needs the assignments anyway — the D2H copy is part of
+        the schedule cycle."""
+        t0 = time.perf_counter()
+        out = self.unpack(snap, self._solve_packed_jit(snap))
         out.solve_seconds = time.perf_counter() - t0
         return out
 

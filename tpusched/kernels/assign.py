@@ -517,6 +517,27 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             (used, jnp.full(P, -1, jnp.int32), jnp.zeros(P, jnp.int32)),
         )
         commit = choice >= 0
+        # Rescue: if the dealing pass committed NOTHING while some
+        # allowed pod still has a feasible node (its dealt + top-K
+        # candidates were all prefix-blocked, but a node further down
+        # its row has room), commit the first such pod (by rank) at its
+        # best feasible node. Feasibility was computed against
+        # round-start state and no other commit landed this round, so
+        # the placement is valid; this guarantees every round places at
+        # least one pod until nothing pending is placeable — the same
+        # drain point as the sequential semantics.
+        can_rescue = ~jnp.any(commit) & jnp.any(allowed & want)
+        rk = jnp.where(allowed & want, rank, BIG)
+        p_star = jnp.argmin(rk)
+        n_star = jnp.argmax(masked[p_star]).astype(jnp.int32)
+        do_rescue = can_rescue
+        used2 = used2.at[n_star].add(
+            jnp.where(do_rescue, pods.requests[p_star], 0.0)
+        )
+        choice = choice.at[p_star].set(
+            jnp.where(do_rescue, n_star, choice[p_star])
+        )
+        commit = choice >= 0
         chosen_val = jnp.take_along_axis(
             masked, jnp.clip(choice, 0, N - 1)[:, None], axis=1
         )[:, 0]

@@ -58,6 +58,20 @@ def member_label_sat_t(snap: ClusterSnapshot, sat_fn):
     return sat_fn(lp, lk).T
 
 
+def ns_scope_ok(sigs_ns, sigs_ns_all, member_ns):
+    """[S, X] bool: member namespace within each signature's scope
+    (explicit ns-id list, or ns_all). Shared by sig_member_match and the
+    ring/blockwise path (tpusched.ring) so scope semantics live once."""
+    if sigs_ns.shape[1]:
+        ok = jnp.any(
+            sigs_ns[:, :, None] == member_ns[None, None, :], axis=1
+        )
+        return ok | sigs_ns_all[:, None]
+    return jnp.broadcast_to(
+        sigs_ns_all[:, None], (sigs_ns.shape[0], member_ns.shape[0])
+    )
+
+
 def sig_member_match(snap: ClusterSnapshot, member_sat_t):
     """[S, M+P] bool: does member x match signature s — label selector
     satisfied AND member namespace in the sig's scope (upstream
@@ -68,13 +82,7 @@ def sig_member_match(snap: ClusterSnapshot, member_sat_t):
     member_ns = jnp.concatenate(
         [snap.running.namespace, snap.pods.namespace]
     )                                                        # [M+P]
-    if snap.sigs.ns.shape[1]:
-        ns_ok = jnp.any(
-            snap.sigs.ns[:, :, None] == member_ns[None, None, :], axis=1
-        )                                                    # [S, M+P]
-        ns_ok |= snap.sigs.ns_all[:, None]
-    else:
-        ns_ok = jnp.broadcast_to(snap.sigs.ns_all[:, None], match.shape)
+    ns_ok = ns_scope_ok(snap.sigs.ns, snap.sigs.ns_all, member_ns)
     return match & ns_ok & snap.sigs.valid[:, None]
 
 

@@ -1,0 +1,124 @@
+"""Double-buffered batch pipeline (SURVEY.md §2.3 "Pipeline parallel").
+
+The schedule cycle has three phases with different engines:
+  decode  — proto -> SnapshotBuilder -> padded arrays   (host CPU)
+  H2D + solve — device compute                          (TPU)
+  fetch   — packed result device->host                  (transport)
+
+A sequential loop pays decode_k+1 strictly after fetch_k. Two transports
+need two overlap mechanisms, and this module uses both:
+
+  * Standard runtimes: jax dispatch is asynchronous, so dispatching
+    batch k and then decoding batch k+1 on the same thread overlaps
+    host decode with device compute.
+  * The axon tunnel (this image): execution is DRIVEN BY THE FETCH —
+    dispatch returns in <1 ms but the program only runs while a
+    device->host read is in flight (measured: a 0.5 s sleep after
+    dispatch does not shorten the subsequent fetch). The overlap
+    therefore comes from fetching batch k on a background thread
+    (np.asarray releases the GIL inside the transport wait) while the
+    main thread does batch k+1's GIL-bound decode.
+
+Wall-clock per batch approaches max(decode, solve + fetch) instead of
+their sum — the "double-buffered" overlap SURVEY.md §7 hard part 6 asks
+for.
+
+This is for streams of INDEPENDENT snapshots (a sidecar serving many
+schedulers, replay/bench pipelines). A single cluster's consecutive
+cycles feed back (cycle k's binds change cycle k+1's snapshot), so they
+cannot be pipelined — same limit as the reference's one-at-a-time
+scheduleOne loop.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+
+from tpusched.engine import Engine, SolveResult
+from tpusched.snapshot import ClusterSnapshot
+
+
+def _unpack(engine: Engine, snap: ClusterSnapshot, buf) -> SolveResult:
+    """Packed-buffer decode — single layout authority is Engine.unpack."""
+    return engine.unpack(snap, buf)
+
+
+def solve_stream(
+    engine: Engine,
+    batches: Iterable[Any],
+    decode: Callable[[Any], tuple[ClusterSnapshot, Any]] | None = None,
+) -> Iterator[tuple[Any, SolveResult]]:
+    """Pipeline a stream of batches through the engine.
+
+    batches: an iterable of raw batch items. decode(item) must return
+    (ClusterSnapshot, meta); None means items already ARE
+    (snapshot, meta) pairs. Yields (meta, SolveResult) in order.
+
+    The generator keeps exactly one batch in flight on the device while
+    the host decodes the next (double buffering): dispatch(k) ->
+    decode(k+1) -> fetch(k) -> dispatch(k+1) -> ...
+    """
+    decode = decode or (lambda item: item)
+
+    def fetch(buf):
+        # Completion time measured INSIDE the worker so solve_seconds
+        # covers dispatch->fetch-done (same meaning as Engine.solve's
+        # field), not the main thread's decode of the next batch.
+        out = np.asarray(buf)
+        return out, time.perf_counter()
+
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        in_flight = None  # (Future[(np buffer, done_t)], snap, meta, t0)
+        for item in batches:
+            snap, meta = decode(item)  # overlaps the in-flight fetch
+            if in_flight is not None:
+                fut, psnap, pmeta, t0 = in_flight
+                raw, done_t = fut.result()
+                res = _unpack(engine, psnap, raw)
+                res.solve_seconds = done_t - t0
+                yield pmeta, res
+            t0 = time.perf_counter()
+            snap = engine.put(snap)
+            buf = engine._solve_packed_jit(snap)  # async dispatch
+            # The background np.asarray drives execution on fetch-driven
+            # transports and releases the GIL during the wait either way.
+            in_flight = (pool.submit(fetch, buf), snap, meta, t0)
+        if in_flight is not None:
+            fut, psnap, pmeta, t0 = in_flight
+            raw, done_t = fut.result()
+            res = _unpack(engine, psnap, raw)
+            res.solve_seconds = done_t - t0
+            yield pmeta, res
+
+
+def bench_overlap(
+    engine: Engine,
+    batches: list[Any],
+    decode: Callable[[Any], tuple[ClusterSnapshot, Any]],
+) -> dict:
+    """Measure sequential vs pipelined wall-clock over the same batch
+    list (first batch compiles and is excluded via a warmup pass).
+    Returns {sequential_s, pipelined_s, speedup}."""
+    # Warmup/compile on the first batch.
+    snap, _ = decode(batches[0])
+    np.asarray(engine._solve_packed_jit(engine.put(snap)))
+
+    t0 = time.perf_counter()
+    for item in batches:
+        snap, _ = decode(item)
+        np.asarray(engine._solve_packed_jit(engine.put(snap)))
+    sequential = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in solve_stream(engine, batches, decode):
+        pass
+    pipelined = time.perf_counter() - t0
+    return dict(
+        sequential_s=sequential,
+        pipelined_s=pipelined,
+        speedup=sequential / pipelined if pipelined > 0 else float("inf"),
+    )

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import grpc
 
+from tpusched.rpc import codec
 from tpusched.rpc import tpusched_pb2 as pb
 from tpusched.rpc.server import SERVICE
 
@@ -49,6 +50,12 @@ class SchedulerClient:
             pb.AssignRequest(snapshot=snapshot), timeout=self.timeout
         )
 
+    def score_batch_delta(self, delta: pb.SnapshotDelta) -> pb.ScoreResponse:
+        return self._score(pb.ScoreRequest(delta=delta), timeout=self.timeout)
+
+    def assign_delta(self, delta: pb.SnapshotDelta) -> pb.AssignResponse:
+        return self._assign(pb.AssignRequest(delta=delta), timeout=self.timeout)
+
     def metrics_text(self) -> str:
         return self._metrics(
             pb.MetricsRequest(), timeout=self.timeout
@@ -62,3 +69,99 @@ class SchedulerClient:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class DeltaSession:
+    """Transparent delta transport over a SchedulerClient (SURVEY.md §7
+    hard part 6): callers always pass the FULL wire snapshot; the
+    session diffs it against what the sidecar last acknowledged and
+    ships only changed records. Falls back to a full send when the
+    sidecar no longer holds the base (FAILED_PRECONDITION — e.g. after
+    a sidecar restart or LRU eviction), which also makes crash recovery
+    automatic: state lives only as an optimization."""
+
+    def __init__(self, client: SchedulerClient):
+        self.client = client
+        self._base: codec.SnapshotStore | None = None
+        self._base_id: str | None = None
+        # After a fallback (sidecar restart / base evicted from its LRU),
+        # skip the delta attempt for exponentially more sends: a client
+        # whose base is always evicted (many interleaved sessions) must
+        # not pay a failed delta RPC + full resend on every cycle.
+        self._skip_delta = 0
+        self._consec_fallbacks = 0
+        # Wire accounting for benchmarks/observability.
+        self.full_sends = 0
+        self.delta_sends = 0
+        self.fallbacks = 0
+        self.bytes_sent = 0
+        self.bytes_full_equiv = 0
+
+    def _call(self, snapshot: pb.ClusterSnapshot, send_full, send_delta):
+        full_bytes = snapshot.ByteSize()
+        self.bytes_full_equiv += full_bytes
+        if (
+            self._base is not None
+            and self._base_id is not None
+            and self._skip_delta == 0
+        ):
+            new_bytes = codec.SnapshotStore()
+            delta = codec.delta_between(
+                self._base, snapshot, self._base_id, new_bytes=new_bytes
+            )
+            self.bytes_sent += delta.ByteSize()  # transmitted even on reject
+            try:
+                resp = send_delta(delta)
+                self.delta_sends += 1
+                self._consec_fallbacks = 0
+                self._remember(snapshot, resp.snapshot_id, new_bytes)
+                return resp
+            except grpc.RpcError as e:
+                if e.code() != grpc.StatusCode.FAILED_PRECONDITION:
+                    raise
+                self.fallbacks += 1
+                self._consec_fallbacks += 1
+                if self._consec_fallbacks >= 2:
+                    self._skip_delta = min(
+                        2 ** (self._consec_fallbacks - 1), 64
+                    )
+                self._base = self._base_id = None
+        elif self._skip_delta > 0:
+            self._skip_delta -= 1
+        resp = send_full(snapshot)
+        self.full_sends += 1
+        self.bytes_sent += full_bytes
+        self._remember(snapshot, resp.snapshot_id)
+        return resp
+
+    def _remember(self, snapshot: pb.ClusterSnapshot, sid: str,
+                  prebuilt: "codec.SnapshotStore | None" = None) -> None:
+        """Record what was sent, as per-record BYTES: immune to the
+        caller mutating its message in place afterwards, and usable only
+        when the snapshot is delta-safe (unique non-empty names — the
+        stores key by name). `prebuilt` reuses the bytes delta_between
+        already serialized for the diff (no second serialization pass)."""
+        if not sid or not codec.delta_safe(snapshot):
+            self._base = self._base_id = None
+            return
+        if prebuilt is not None:
+            st = prebuilt
+        else:
+            st = codec.SnapshotStore()
+            st.nodes = {n.name: n.SerializeToString() for n in snapshot.nodes}
+            st.pods = {p.name: p.SerializeToString() for p in snapshot.pods}
+            st.running = {
+                r.name: r.SerializeToString() for r in snapshot.running
+            }
+        self._base = st
+        self._base_id = sid
+
+    def assign(self, snapshot: pb.ClusterSnapshot) -> pb.AssignResponse:
+        return self._call(
+            snapshot, self.client.assign, self.client.assign_delta
+        )
+
+    def score_batch(self, snapshot: pb.ClusterSnapshot) -> pb.ScoreResponse:
+        return self._call(
+            snapshot, self.client.score_batch, self.client.score_batch_delta
+        )

@@ -55,10 +55,16 @@ def snapshot_from_proto(
     config: EngineConfig | None = None,
     buckets: Buckets | None = None,
 ):
-    """Decode a wire snapshot into a built (ClusterSnapshot, SnapshotMeta)."""
+    """Decode a wire snapshot into a built (ClusterSnapshot, SnapshotMeta).
+
+    Records are processed in NAME order, not wire order: index-based
+    tie-breaks (lowest node index among score maxima, submission order
+    among equal priorities) are then deterministic for a given cluster
+    STATE regardless of how the records were transported — a full send
+    and a delta-path recompose of the same state schedule identically."""
     config = config or EngineConfig()
     b = SnapshotBuilder(config, buckets)
-    for n in msg.nodes:
+    for n in _by_name(msg.nodes):
         b.add_node(
             n.name,
             allocatable=_res_map(n.allocatable),
@@ -66,7 +72,7 @@ def snapshot_from_proto(
             taints=[(t.key, t.value, t.effect) for t in n.taints],
             used=_res_map(n.used),
         )
-    for p in msg.pods:
+    for p in _by_name(msg.pods):
         b.add_pod(
             p.name,
             requests=_res_map(p.requests),
@@ -104,7 +110,7 @@ def snapshot_from_proto(
             pod_group_min_member=p.pod_group_min_member,
             namespace=p.namespace or "default",
         )
-    for r in msg.running:
+    for r in _by_name(msg.running):
         b.add_running_pod(
             node=r.node,
             requests=_res_map(r.requests),
@@ -119,6 +125,120 @@ def snapshot_from_proto(
     # Running-pod names travel with meta for eviction responses.
     meta.running_names = [r.name or f"running-{i}" for i, r in enumerate(msg.running)]
     return snap, meta
+
+
+# ---------------------------------------------------------------------------
+# Delta snapshots (SURVEY.md §7 hard part 6).
+# ---------------------------------------------------------------------------
+
+
+class UnknownBase(KeyError):
+    """Delta referenced a base_id the store no longer holds."""
+
+
+def _by_name(coll):
+    """Canonical record order (see snapshot_from_proto): sort by name
+    WITHOUT copying messages (decode is the hot path). Running pods may
+    be unnamed; Python's stable sort keeps their relative wire order."""
+    return sorted(coll, key=lambda r: r.name)
+
+
+def delta_safe(msg: pb.ClusterSnapshot) -> bool:
+    """A snapshot is usable as a delta base only if every record carries
+    a unique non-empty name: the stores key by name, so unnamed or
+    duplicate records would silently collapse on the delta path."""
+    for coll in (msg.nodes, msg.pods, msg.running):
+        names = [r.name for r in coll]
+        if any(not n for n in names) or len(set(names)) != len(names):
+            return False
+    return True
+
+
+class SnapshotStore:
+    """Name-keyed record store of one snapshot's proto sub-messages, so a
+    SnapshotDelta can be applied and the full ClusterSnapshot recomposed
+    server-side. Wire savings: the client ships only changed records;
+    the recompose + re-intern cost stays on the sidecar host."""
+
+    def __init__(self, msg: pb.ClusterSnapshot | None = None):
+        self.nodes: dict[str, pb.Node] = {}
+        self.pods: dict[str, pb.PendingPod] = {}
+        self.running: dict[str, pb.RunningPod] = {}
+        if msg is not None:
+            self.set_full(msg)
+
+    def set_full(self, msg: pb.ClusterSnapshot) -> None:
+        self.nodes = {n.name: n for n in msg.nodes}
+        self.pods = {p.name: p for p in msg.pods}
+        self.running = {r.name: r for r in msg.running}
+
+    def copy(self) -> "SnapshotStore":
+        st = SnapshotStore()
+        st.nodes, st.pods, st.running = (
+            dict(self.nodes), dict(self.pods), dict(self.running)
+        )
+        return st
+
+    def apply_delta(self, delta: pb.SnapshotDelta) -> None:
+        for n in delta.upsert_nodes:
+            self.nodes[n.name] = n
+        for name in delta.remove_nodes:
+            self.nodes.pop(name, None)
+        for p in delta.upsert_pods:
+            self.pods[p.name] = p
+        for name in delta.remove_pods:
+            self.pods.pop(name, None)
+        for r in delta.upsert_running:
+            self.running[r.name] = r
+        for name in delta.remove_running:
+            self.running.pop(name, None)
+
+    def compose(self) -> pb.ClusterSnapshot:
+        msg = pb.ClusterSnapshot()
+        msg.nodes.extend(self.nodes.values())
+        msg.pods.extend(self.pods.values())
+        msg.running.extend(self.running.values())
+        return msg
+
+
+def _ser(rec) -> bytes:
+    return rec if isinstance(rec, bytes) else rec.SerializeToString()
+
+
+def delta_between(prev: SnapshotStore, msg: pb.ClusterSnapshot,
+                  base_id: str,
+                  new_bytes: SnapshotStore | None = None) -> pb.SnapshotDelta:
+    """Client-side diff: the SnapshotDelta turning `prev` into `msg`.
+    Record equality by serialized bytes. `prev` values may be messages
+    or pre-serialized bytes (DeltaSession stores bytes so that a caller
+    mutating its snapshot message in place between calls — the records
+    would then alias — still diffs against what was actually sent).
+
+    new_bytes: optional empty SnapshotStore; when given, filled with
+    msg's per-record serialized bytes so the caller can remember them
+    as the next base without serializing everything a second time."""
+    delta = pb.SnapshotDelta(base_id=base_id)
+
+    def diff(prev_d, coll, upserts, removes, out_d):
+        new_names = set()
+        for rec in coll:
+            new_names.add(rec.name)
+            raw = rec.SerializeToString()
+            if out_d is not None:
+                out_d[rec.name] = raw
+            old = prev_d.get(rec.name)
+            if old is None or _ser(old) != raw:
+                upserts.append(rec)
+        removes.extend(k for k in prev_d if k not in new_names)
+
+    nb = new_bytes
+    diff(prev.nodes, msg.nodes, delta.upsert_nodes, delta.remove_nodes,
+         nb.nodes if nb else None)
+    diff(prev.pods, msg.pods, delta.upsert_pods, delta.remove_pods,
+         nb.pods if nb else None)
+    diff(prev.running, msg.running, delta.upsert_running,
+         delta.remove_running, nb.running if nb else None)
+    return delta
 
 
 # ---------------------------------------------------------------------------

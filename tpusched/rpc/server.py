@@ -26,9 +26,15 @@ import grpc
 from tpusched.config import Buckets, EngineConfig
 from tpusched.engine import Engine
 from tpusched.rpc import tpusched_pb2 as pb
-from tpusched.rpc.codec import snapshot_from_proto
+from tpusched.rpc.codec import SnapshotStore, delta_safe, snapshot_from_proto
 
 SERVICE = "tpusched.TpuScheduler"
+
+# Recent snapshot stores kept for delta resolution. Each store holds
+# references into decoded request protos (cheap); the cap bounds memory
+# and defines how stale a client's base_id may be before it must resend
+# a full snapshot.
+STORE_CAP = 8
 
 
 class _Metrics:
@@ -112,6 +118,48 @@ class SchedulerService:
         self.metrics = _Metrics()
         self._engine = Engine(self.config)
         self._log = log_stream if log_stream is not None else sys.stderr
+        import threading
+
+        self._store_lock = threading.Lock()
+        self._stores: dict[str, SnapshotStore] = {}  # LRU by insertion
+        self._next_store = 0
+
+    def _register_store(self, store: SnapshotStore) -> str:
+        with self._store_lock:
+            sid = f"snap-{self._next_store}"
+            self._next_store += 1
+            self._stores[sid] = store
+            while len(self._stores) > STORE_CAP:
+                self._stores.pop(next(iter(self._stores)))
+        return sid
+
+    def _resolve(self, request, context):
+        """Full-or-delta request -> (ClusterSnapshot msg, snapshot_id).
+        Unknown/expired base_id aborts FAILED_PRECONDITION so the client
+        falls back to a full snapshot (DeltaSession does). Snapshots
+        whose records lack unique non-empty names are served but not
+        registered (empty snapshot_id): name-keyed stores would collapse
+        them (DeltaSession refuses to delta against those too)."""
+        if request.HasField("delta") and request.delta.base_id:
+            with self._store_lock:
+                base = self._stores.get(request.delta.base_id)
+                if base is not None:
+                    # True-LRU refresh: a hit keeps the base alive while
+                    # unrelated sessions churn the cap.
+                    self._stores.pop(request.delta.base_id)
+                    self._stores[request.delta.base_id] = base
+            if base is None:
+                context.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"unknown snapshot base_id {request.delta.base_id!r}",
+                )
+            store = base.copy()
+            store.apply_delta(request.delta)
+            return store.compose(), self._register_store(store)
+        msg = request.snapshot
+        if not delta_safe(msg):
+            return msg, ""
+        return msg, self._register_store(SnapshotStore(msg))
 
     def _decode(self, snapshot_msg):
         t0 = time.perf_counter()
@@ -134,9 +182,10 @@ class SchedulerService:
     # -- rpc methods --------------------------------------------------------
 
     def ScoreBatch(self, request: pb.ScoreRequest, context) -> pb.ScoreResponse:
-        snap, meta, decode_s = self._decode(request.snapshot)
+        msg, sid = self._resolve(request, context)
+        snap, meta, decode_s = self._decode(msg)
         res = self._engine.score(snap)
-        resp = pb.ScoreResponse()
+        resp = pb.ScoreResponse(snapshot_id=sid)
         resp.pod_names.extend(meta.pod_names)
         resp.node_names.extend(meta.node_names)
         P, N = meta.n_pods, meta.n_nodes
@@ -150,9 +199,10 @@ class SchedulerService:
         return resp
 
     def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
-        snap, meta, decode_s = self._decode(request.snapshot)
+        msg, sid = self._resolve(request, context)
+        snap, meta, decode_s = self._decode(msg)
         res = self._engine.solve(snap)
-        resp = pb.AssignResponse()
+        resp = pb.AssignResponse(snapshot_id=sid)
         placed = 0
         for i, name in enumerate(meta.pod_names):
             a = resp.assignments.add()
