@@ -1,0 +1,184 @@
+"""PodDisruptionBudget-aware preemption (SURVEY.md C9 "fewest PDB
+violations, lowest priorities"): victims whose eviction would exceed
+their budget's remaining disruptions are avoided whenever any
+non-violating victim set exists, and evicted only as a last resort —
+identically in oracle, parity, and fast modes."""
+
+import numpy as np
+import pytest
+
+from tpusched import Engine, EngineConfig
+from tpusched.oracle import Oracle
+from tpusched.snapshot import SnapshotBuilder
+from tpusched.synth import make_cluster
+
+
+def _cfg(mode="parity"):
+    return EngineConfig(mode=mode, preemption=True)
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_protected_victim_avoided_when_alternative_exists(mode):
+    """n0's victim is cheap by slack but PDB-exhausted; n1's victim is
+    pricier but unprotected — preemption must pick n1."""
+    cfg = _cfg(mode)
+    b = SnapshotBuilder(cfg)
+    b.add_node("n0", {"cpu": 4000, "memory": 64 << 30})
+    b.add_running_pod("n0", {"cpu": 4000, "memory": 1 << 30},
+                      priority=10, slack=0.3,
+                      pdb_group="db", pdb_disruptions_allowed=0)
+    b.add_node("n1", {"cpu": 4000, "memory": 64 << 30})
+    b.add_running_pod("n1", {"cpu": 4000, "memory": 1 << 30},
+                      priority=10, slack=0.05)
+    b.add_pod("p", {"cpu": 2000, "memory": 1 << 30}, priority=500)
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert res.assignment[0] == 1, "must avoid the PDB-protected victim"
+    assert res.evicted[:2].tolist() == [False, True]
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+    np.testing.assert_array_equal(res.evicted, ora.evicted)
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_pdb_violated_as_last_resort(mode):
+    """Only PDB-exhausted victims exist: upstream still evicts (budgets
+    are best-effort in preemption), so the pod must place."""
+    cfg = _cfg(mode)
+    b = SnapshotBuilder(cfg)
+    b.add_node("n0", {"cpu": 4000, "memory": 64 << 30})
+    b.add_running_pod("n0", {"cpu": 4000, "memory": 1 << 30},
+                      priority=10, slack=0.3,
+                      pdb_group="db", pdb_disruptions_allowed=0)
+    b.add_pod("p", {"cpu": 2000, "memory": 1 << 30}, priority=500)
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert res.assignment[0] == 0
+    assert res.evicted[0]
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+    np.testing.assert_array_equal(res.evicted, ora.evicted)
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_budget_allows_limited_evictions(mode):
+    """allowed=1 on a two-member budget: evicting ONE member is clean,
+    the second in the same victim set is a violation — so a preemptor
+    needing both picks an unprotected pair elsewhere."""
+    cfg = _cfg(mode)
+    b = SnapshotBuilder(cfg)
+    b.add_node("n0", {"cpu": 4000, "memory": 64 << 30})
+    for i in range(2):
+        b.add_running_pod("n0", {"cpu": 2000, "memory": 1 << 30},
+                          priority=10, slack=0.3,
+                          pdb_group="db", pdb_disruptions_allowed=1)
+    b.add_node("n1", {"cpu": 4000, "memory": 64 << 30})
+    for i in range(2):
+        b.add_running_pod("n1", {"cpu": 2000, "memory": 1 << 30},
+                          priority=10, slack=0.05)
+    b.add_pod("p", {"cpu": 3000, "memory": 1 << 30}, priority=500)
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert res.assignment[0] == 1, "needs 2 victims; budget allows only 1"
+    assert res.evicted[:4].tolist() == [False, False, True, True]
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.evicted, ora.evicted)
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_budget_shared_across_preemptors(mode):
+    """allowed=1 across two nodes' victims: the first preemptor may
+    consume the budget; the second must then prefer the unprotected
+    victim even though the protected one is cheaper by slack."""
+    cfg = _cfg(mode)
+    b = SnapshotBuilder(cfg)
+    # Two single-victim nodes under one budget with allowed=1, plus one
+    # unprotected node. Preemptors (cpu=4000) each need a full node.
+    b.add_node("n0", {"cpu": 4000, "memory": 64 << 30})
+    b.add_running_pod("n0", {"cpu": 4000, "memory": 1 << 30},
+                      priority=10, slack=0.4,
+                      pdb_group="db", pdb_disruptions_allowed=1)
+    b.add_node("n1", {"cpu": 4000, "memory": 64 << 30})
+    b.add_running_pod("n1", {"cpu": 4000, "memory": 1 << 30},
+                      priority=10, slack=0.35,
+                      pdb_group="db", pdb_disruptions_allowed=1)
+    b.add_node("n2", {"cpu": 4000, "memory": 64 << 30})
+    b.add_running_pod("n2", {"cpu": 4000, "memory": 1 << 30},
+                      priority=10, slack=0.05)
+    b.add_pod("p1", {"cpu": 4000, "memory": 1 << 30}, priority=500)
+    b.add_pod("p2", {"cpu": 4000, "memory": 1 << 30}, priority=400)
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    # p1 (higher priority) pops first, takes the cheapest (slack 0.4,
+    # budget has 1 left -> clean). p2 must NOT take the other db victim
+    # (budget now exhausted) -> takes the unprotected n2 victim.
+    assert res.assignment[0] == 0
+    assert res.assignment[1] == 2
+    assert res.evicted[:3].tolist() == [True, False, True]
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+    np.testing.assert_array_equal(res.evicted, ora.evicted)
+
+
+def test_pdb_fields_survive_the_wire():
+    """Codec round-trip: pdb_group/pdb_disruptions_allowed reach the
+    built snapshot through the proto path."""
+    import numpy as _np
+
+    from tpusched.rpc.codec import snapshot_from_proto, snapshot_to_proto
+
+    nodes = [dict(name="n0", allocatable={"cpu": 4000.0})]
+    running = [
+        dict(name="r0", node="n0", requests={"cpu": 1000.0},
+             pdb_group="db", pdb_disruptions_allowed=2),
+        dict(name="r1", node="n0", requests={"cpu": 1000.0}),
+    ]
+    msg = snapshot_to_proto(nodes, [], running)
+    assert msg.running[0].pdb_group == "db"
+    assert msg.running[0].pdb_disruptions_allowed == 2
+    snap, _ = snapshot_from_proto(msg, EngineConfig())
+    assert _np.asarray(snap.pdb_allowed)[0] == 2.0
+    groups = _np.asarray(snap.running.pdb_group)
+    assert groups[0] == 0 and groups[1] == -1
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_same_named_pdbs_in_different_namespaces_are_separate(mode):
+    """PDBs are namespaced: an exhausted budget 'db' in ns A must not
+    inherit allowance from an ample budget 'db' in ns B."""
+    cfg = _cfg(mode)
+    b = SnapshotBuilder(cfg)
+    b.add_node("n0", {"cpu": 4000, "memory": 64 << 30})
+    b.add_running_pod("n0", {"cpu": 4000, "memory": 1 << 30},
+                      priority=10, slack=0.3, namespace="a",
+                      pdb_group="db", pdb_disruptions_allowed=0)
+    b.add_node("n1", {"cpu": 4000, "memory": 64 << 30})
+    b.add_running_pod("n1", {"cpu": 4000, "memory": 1 << 30},
+                      priority=10, slack=0.05, namespace="b",
+                      pdb_group="db", pdb_disruptions_allowed=2)
+    b.add_pod("p", {"cpu": 2000, "memory": 1 << 30}, priority=500)
+    snap, _ = b.build()
+    assert np.asarray(snap.pdb_allowed)[:2].tolist() == [0.0, 2.0]
+    res = Engine(cfg).solve(snap)
+    # ns-a's budget is exhausted (violation); ns-b's has room -> n1.
+    assert res.assignment[0] == 1
+    assert res.evicted[:2].tolist() == [False, True]
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+    np.testing.assert_array_equal(res.evicted, ora.evicted)
+
+
+def test_parity_fuzz_with_pdbs():
+    """Random near-full clusters with PDBs: parity mode must match the
+    oracle exactly (assignments AND victim sets)."""
+    for seed in range(4):
+        rng = np.random.default_rng(4200 + seed)
+        snap, _ = make_cluster(
+            rng, 30, 8, initial_utilization=0.9, n_running_per_node=6,
+            pdb_frac=0.5,
+        )
+        cfg = _cfg("parity")
+        res = Engine(cfg).solve(snap)
+        ora = Oracle(snap, cfg).solve()
+        np.testing.assert_array_equal(res.assignment, ora.assignment)
+        np.testing.assert_array_equal(res.evicted, ora.evicted)

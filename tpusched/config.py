@@ -105,6 +105,7 @@ class Buckets:
     taint_vocab: int = 16      # VT: distinct taints across the cluster
     signatures: int = 8        # S: distinct (topo key, ns, selector) signatures
     sig_namespaces: int = 2    # NSV: explicit namespace ids per signature
+    pdb_groups: int = 8        # GP: distinct PodDisruptionBudgets
 
     @staticmethod
     def fit(
@@ -138,6 +139,7 @@ class Buckets:
             atom_values=0, terms=0, term_atoms=0, pref_terms=0,
             topo_keys=0, spread_constraints=0, affinity_terms=0,
             pod_groups=0, taint_vocab=0, signatures=0, sig_namespaces=0,
+            pdb_groups=0,
         )
 
 

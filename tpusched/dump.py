@@ -79,4 +79,5 @@ def snap_skeleton() -> ClusterSnapshot:
         sigs=fill(SigTable),
         taint_effect=0,
         group_min_member=0,
+        pdb_allowed=0,
     )

@@ -181,6 +181,9 @@ class HostScheduler:
             pod_affinity=p.get("pod_affinity", []),
             namespace=p.get("namespace", "default"),
         )
+        if p.get("pdb_group"):
+            rec["pdb_group"] = p["pdb_group"]
+            rec["pdb_disruptions_allowed"] = p.get("pdb_disruptions_allowed", 0)
         # QoS slack of a running pod: observed availability minus SLO
         # (SURVEY.md C10); specs carry both or a precomputed slack.
         if "slack" in p:

@@ -17,11 +17,26 @@ per-node best costs; argmin picks the node. Everything is fixed-shape
 loops (the auction-style "bid per node, pick globally best" recommended
 over classical Hungarian by SURVEY.md §7 hard part 4).
 
+PodDisruptionBudgets (SURVEY.md C9 "fewest PDB violations"): each
+running pod may belong to a budget (running.pdb_group) with a remaining
+disruptions_allowed (snapshot.pdb_allowed). A victim whose eviction
+would exceed its budget's remaining allowance — counting earlier
+preemptors' evictions AND same-prefix co-victims — is a VIOLATION.
+Candidate prefixes are ranked lexicographically by (violation count,
+cost), exactly upstream's ordering: any non-violating set beats any
+violating one, and violation stays available as the last resort
+(upstream evicts PDB-protected pods when nothing else fits). Violation
+counts are small integers (exact in f32 under any summation order), so
+oracle/device parity survives; a cost PENALTY of ~1e8 would instead
+poison the f32 prefix sums, whose rounding depends on the backend's
+scan association. With violations in play, costs within a segment no
+longer rank prefixes, so the chosen prefix is the lexicographic MIN
+over all feasible prefix positions, not the first feasible.
+
 Scope notes (mirrored exactly by the oracle so parity is testable):
   * Only RESOURCE infeasibility is repaired: the preemptor's static
     predicates (taints/affinity) and pairwise constraints must already
     hold on the target node, evaluated against pre-eviction state.
-  * No PodDisruptionBudget concept (the snapshot has none).
   * The preemptor is assigned immediately (the host shim issues deletes
     then binds; upstream nominates and re-queues instead).
 """
@@ -50,6 +65,7 @@ class PreemptCtx:
     cost_s: Any      # [M] f32 shifted-positive eviction cost, sorted
     vprio_s: Any     # [M] f32 victim effective priority, sorted
     req_s: Any       # [M, R] f32 victim requests, sorted
+    pdb_s: Any       # [M] int32 PDB id of sorted victim (-1 none)
 
 
 def precompute(cfg: EngineConfig, snap: ClusterSnapshot) -> PreemptCtx:
@@ -79,6 +95,7 @@ def precompute(cfg: EngineConfig, snap: ClusterSnapshot) -> PreemptCtx:
         perm=perm, node_s=node_s, seg_start=seg_start,
         cost_s=cost[perm], vprio_s=vprio[perm].astype(jnp.float32),
         req_s=run.requests[perm],
+        pdb_s=run.pdb_group[perm],
     )
 
 
@@ -98,9 +115,40 @@ def preempt_step(cfg: EngineConfig, snap: ClusterSnapshot, ctx: PreemptCtx,
         & ~evicted[ctx.perm]
         & (ctx.vprio_s + cfg.qos.preemption_margin < p_prio)
     )
+    # PDB violations (see module docstring): a victim violates if the
+    # same-budget count within its node-segment prefix (including
+    # itself) plus earlier preemptors' evictions exceeds the budget.
+    GP = snap.pdb_allowed.shape[0]
+    if GP:
+        pdb_clip = jnp.clip(ctx.pdb_s, 0, None)
+        has_pdb = ctx.pdb_s >= 0
+        run_pdb = snap.running.pdb_group
+        consumed = jnp.zeros(GP, jnp.float32).at[
+            jnp.clip(run_pdb, 0, None)
+        ].add((evicted & (run_pdb >= 0) & snap.running.valid).astype(
+            jnp.float32
+        ))
+        remaining = snap.pdb_allowed - consumed              # [GP]
+        gsel = (
+            (jnp.arange(GP)[:, None] == pdb_clip[None, :])
+            & (elig & has_pdb)[None, :]
+        )                                                    # [GP, M]
+        cum_g = jnp.cumsum(gsel.astype(jnp.float32), axis=1)
+        my_cum = cum_g[pdb_clip, idx]                        # [M] incl. self
+        off_g = jnp.where(
+            ctx.seg_start > 0,
+            cum_g[pdb_clip, jnp.clip(ctx.seg_start - 1, 0, None)], 0.0,
+        )
+        within_cnt = my_cum - off_g
+        viol = elig & has_pdb & (within_cnt > remaining[pdb_clip])
+    else:
+        viol = jnp.zeros(M, bool)
     req_m = jnp.where(elig[:, None], ctx.req_s, 0.0)
     cum_req = jnp.cumsum(req_m, axis=0)                      # [M, R] inclusive
     cum_cost = jnp.cumsum(jnp.where(elig, ctx.cost_s, 0.0))  # [M]
+    # Violation count per prefix: 0/1 sums are exact in f32 under any
+    # summation order (<= M < 2^24), unlike penalty-inflated cost sums.
+    cum_viol = jnp.cumsum(viol.astype(jnp.float32))          # [M]
     off_req = jnp.where(
         (ctx.seg_start > 0)[:, None],
         cum_req[jnp.clip(ctx.seg_start - 1, 0, None)], 0.0,
@@ -108,26 +156,46 @@ def preempt_step(cfg: EngineConfig, snap: ClusterSnapshot, ctx: PreemptCtx,
     off_cost = jnp.where(
         ctx.seg_start > 0, cum_cost[jnp.clip(ctx.seg_start - 1, 0, None)], 0.0
     )
+    off_viol = jnp.where(
+        ctx.seg_start > 0, cum_viol[jnp.clip(ctx.seg_start - 1, 0, None)], 0.0
+    )
     within_req = cum_req - off_req                           # [M, R]
     within_cost = cum_cost - off_cost                        # [M]
+    within_viol = cum_viol - off_viol                        # [M]
     cap_node = jnp.clip(ctx.node_s, 0, N - 1)
     fits = elig & jnp.all(
         used[cap_node] - within_req + p_req[None, :]
         <= nodes.allocatable[cap_node],
         axis=-1,
     )
-    # Per node: cost of the FIRST feasible prefix (costs ascend within a
-    # segment, so first feasible = cheapest); N index = sentinel bucket.
-    node_cost = jnp.full(N + 1, jnp.inf).at[ctx.node_s].min(
-        jnp.where(fits, within_cost, jnp.inf)
+    # Lexicographic (violations, cost) MIN over feasible prefixes, in
+    # exact two-stage comparisons (never summing the two channels):
+    # per node, fewest violations first; among those prefixes, min cost.
+    # N index = sentinel bucket.
+    node_viol = jnp.full(N + 1, jnp.inf).at[ctx.node_s].min(
+        jnp.where(fits, within_viol, jnp.inf)
     )[:N]
-    total = jnp.where(allowed_row & nodes.valid, node_cost, jnp.inf)
+    fits_v = fits & (within_viol == node_viol[cap_node])
+    node_cost = jnp.full(N + 1, jnp.inf).at[ctx.node_s].min(
+        jnp.where(fits_v, within_cost, jnp.inf)
+    )[:N]
+    # Across nodes: global fewest violations, then cheapest. (inf ==
+    # inf is True, so the allowed mask must gate `total` as well —
+    # otherwise a disallowed node's finite prefix wins when NO allowed
+    # node is feasible.)
+    ok_node = allowed_row & nodes.valid
+    viol_total = jnp.where(ok_node, node_viol, jnp.inf)
+    min_viol = jnp.min(viol_total)
+    total = jnp.where(ok_node & (viol_total == min_viol), node_cost, jnp.inf)
     best_n = jnp.argmin(total).astype(jnp.int32)
     can = jnp.isfinite(total[best_n])
-    first_pos = jnp.full(N + 1, M, jnp.int32).at[ctx.node_s].min(
-        jnp.where(fits, idx, M)
-    )[jnp.clip(best_n, 0, N - 1)]
-    sel_s = can & (ctx.node_s == best_n) & elig & (idx <= first_pos)
+    best_pos = jnp.argmin(
+        jnp.where(
+            fits & (ctx.node_s == best_n) & (within_viol == min_viol),
+            within_cost, jnp.inf,
+        )
+    ).astype(jnp.int32)
+    sel_s = can & (ctx.node_s == best_n) & elig & (idx <= best_pos)
     evict_m = jnp.zeros(M, bool).at[ctx.perm].set(sel_s)
     freed_on_best = jnp.sum(
         jnp.where(sel_s[:, None], ctx.req_s, 0.0), axis=0

@@ -59,6 +59,7 @@ def snapshot_shardings(mesh: Mesh, snap: ClusterSnapshot) -> ClusterSnapshot:
         sigs=build(snap.sigs, "rep"),
         taint_effect=_spec_for("rep", mesh),
         group_min_member=_spec_for("rep", mesh),
+        pdb_allowed=_spec_for("rep", mesh),
     )
 
 

@@ -526,38 +526,88 @@ class Oracle:
         boundary = np.concatenate([[True], node_s[1:] != node_s[:-1]]) if M else np.zeros(0, bool)
         seg_start = np.maximum.accumulate(np.where(boundary, idx, 0))
         elig_s = elig[perm]
+        # PDB violation flags, mirroring kernels/preempt.py: a victim
+        # violates its budget when the same-budget count within its
+        # node-segment prefix (incl. itself) plus earlier preemptors'
+        # evictions exceeds the remaining allowance. Prefixes are ranked
+        # lexicographically by (violations, cost) — never summed into
+        # one penalty channel, so f32 parity with the device holds.
+        pdb_allowed = _np(self.snap.pdb_allowed)
+        GP = pdb_allowed.shape[0]
+        if GP:
+            run_pdb = _np(run.pdb_group)
+            pdb_s = run_pdb[perm]
+            consumed = np.zeros(GP, np.float32)
+            for m in range(M):
+                if self._evicted[m] and run_pdb[m] >= 0 and rvalid[m]:
+                    consumed[run_pdb[m]] += 1.0
+            remaining = pdb_allowed - consumed
+            pdb_clip = np.clip(pdb_s, 0, None)
+            gsel = (
+                (np.arange(GP)[:, None] == pdb_clip[None, :])
+                & (elig_s & (pdb_s >= 0))[None, :]
+            )
+            cum_g = np.cumsum(gsel.astype(np.float32), axis=1)
+            my_cum = cum_g[pdb_clip, idx]
+            off_g = np.where(
+                seg_start > 0,
+                cum_g[pdb_clip, np.maximum(seg_start - 1, 0)], 0.0,
+            )
+            within_cnt = my_cum - off_g
+            viol = elig_s & (pdb_s >= 0) & (within_cnt > remaining[pdb_clip])
+        else:
+            viol = np.zeros(M, bool)
         req_s = np.where(elig_s[:, None], rreq[perm], 0.0).astype(np.float32)
         cum_req = np.cumsum(req_s, axis=0, dtype=np.float32)
         cum_cost = np.cumsum(
             np.where(elig_s, cost[perm], 0.0), dtype=np.float32
         )
+        cum_viol = np.cumsum(viol.astype(np.float32), dtype=np.float32)
         off_req = np.where(
             (seg_start > 0)[:, None], cum_req[np.maximum(seg_start - 1, 0)], 0.0
         )
         off_cost = np.where(
             seg_start > 0, cum_cost[np.maximum(seg_start - 1, 0)], 0.0
         )
+        off_viol = np.where(
+            seg_start > 0, cum_viol[np.maximum(seg_start - 1, 0)], 0.0
+        )
         within_req = cum_req - off_req
         within_cost = cum_cost - off_cost
+        within_viol = cum_viol - off_viol
         cap_node = np.minimum(node_s, N - 1)
         fits = elig_s & np.all(
             used[cap_node] - within_req + req_p[None, :] <= alloc[cap_node],
             axis=-1,
         )
+        # Lexicographic (violations, cost) MIN feasible prefix per node
+        # (ties -> first position), mirroring the kernel's two-stage
+        # scatter-min + argmin selection exactly.
+        node_viol = np.full(N + 1, np.inf, np.float32)
         node_cost = np.full(N + 1, np.inf, np.float32)
-        first_pos = np.full(N + 1, M, np.int64)
         for i in range(M):
             if fits[i]:
                 n_i = node_s[i]
+                if within_viol[i] < node_viol[n_i]:
+                    node_viol[n_i] = within_viol[i]
+        for i in range(M):
+            if fits[i] and within_viol[i] == node_viol[node_s[i]]:
+                n_i = node_s[i]
                 if within_cost[i] < node_cost[n_i]:
                     node_cost[n_i] = within_cost[i]
-                if i < first_pos[n_i]:
-                    first_pos[n_i] = i
-        total = np.where(allowed & _np(self.nodes.valid), node_cost[:N], np.inf)
+        nvalid = _np(self.nodes.valid)
+        ok_node = allowed & nvalid
+        viol_total = np.where(ok_node, node_viol[:N], np.inf)
+        min_viol = viol_total.min() if N else np.inf
+        total = np.where(
+            ok_node & (viol_total == min_viol), node_cost[:N], np.inf
+        )
         best_n = int(np.argmin(total))
         if not np.isfinite(total[best_n]):
             return -1, []
-        fp = first_pos[best_n]
+        cand = fits & (node_s == best_n) & (within_viol == min_viol)
+        masked = np.where(cand, within_cost, np.inf)
+        fp = int(np.argmin(masked))
         sel_s = (node_s == best_n) & elig_s & (idx <= fp)
         return best_n, [int(perm[i]) for i in range(M) if sel_s[i]]
 

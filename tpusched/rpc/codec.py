@@ -120,6 +120,8 @@ def snapshot_from_proto(
             count_into_used=not r.exclude_from_used,
             pod_affinity=_affinity(r.pod_affinity),
             namespace=r.namespace or "default",
+            pdb_group=r.pdb_group or None,
+            pdb_disruptions_allowed=r.pdb_disruptions_allowed,
         )
     snap, meta = b.build()
     # Running-pod names travel with meta for eviction responses.
@@ -334,4 +336,9 @@ def snapshot_to_proto(
         rm.exclude_from_used = not r.get("count_into_used", True)
         if r.get("namespace"):
             rm.namespace = r["namespace"]
+        if r.get("pdb_group"):
+            rm.pdb_group = r["pdb_group"]
+            rm.pdb_disruptions_allowed = int(
+                r.get("pdb_disruptions_allowed", 0)
+            )
     return msg

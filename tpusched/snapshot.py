@@ -216,6 +216,7 @@ class RunningPodArrays:
     # running pods are not symmetric for filtering and are not stored.
     anti_sig: Any     # [M, IT] int32
     namespace: Any    # [M] int32 namespace id
+    pdb_group: Any    # [M] int32 PodDisruptionBudget id (-1 = none)
     valid: Any        # [M] bool
 
 
@@ -228,6 +229,11 @@ class ClusterSnapshot:
     sigs: SigTable
     taint_effect: Any     # [VT] int8
     group_min_member: Any  # [G] int32 (0 for unused slots)
+    # [GP] f32 remaining disruptions allowed per PodDisruptionBudget
+    # (SURVEY.md C9 "fewest PDB violations"): evicting more than this
+    # many members of a budget is a violation, avoided unless no
+    # non-violating victim set exists (upstream last-resort semantics).
+    pdb_allowed: Any
 
 
 @dataclasses.dataclass
@@ -271,6 +277,7 @@ class SnapshotBuilder:
         self._pods: list[dict] = []
         self._running: list[dict] = []
         self._groups: dict[str, int] = {}  # name -> min_member
+        self._pdbs: dict[str, int] = {}    # name -> disruptions allowed
 
     # -- record intake ------------------------------------------------------
 
@@ -336,18 +343,31 @@ class SnapshotBuilder:
         count_into_used: bool = True,
         pod_affinity: Sequence[PodAffinityTerm] = (),
         namespace: str = "default",
+        pdb_group: str | None = None,
+        pdb_disruptions_allowed: int = 0,
     ) -> None:
         """pod_affinity: only required ANTI terms affect scheduling (the
         upstream symmetric anti-affinity rule); other terms are accepted
-        and ignored."""
+        and ignored. pdb_group names the PodDisruptionBudget covering
+        this pod; pdb_disruptions_allowed is that budget's remaining
+        allowed disruptions (the max across members wins, mirroring how
+        a PDB is one object its members share). PDBs are NAMESPACED
+        objects upstream, so the budget identity is (namespace, name) —
+        same-named PDBs in different namespaces stay separate budgets."""
         req = dict(requests)
         req.setdefault(RESOURCE_PODS, 1.0)
+        ns = str(namespace) or "default"
+        if pdb_group is not None:
+            key = (ns, pdb_group)
+            prev = self._pdbs.get(key, 0)
+            self._pdbs[key] = max(prev, int(pdb_disruptions_allowed))
         self._running.append(
             dict(node=node, requests=req, priority=float(priority),
                  slack=float(slack), labels=dict(labels or {}),
                  count_into_used=count_into_used,
                  pod_affinity=list(pod_affinity),
-                 namespace=str(namespace) or "default")
+                 namespace=ns,
+                 pdb_group=(ns, pdb_group) if pdb_group is not None else None)
         )
 
     # -- build --------------------------------------------------------------
@@ -548,6 +568,7 @@ class SnapshotBuilder:
             sig_namespaces=max(
                 (len(ns) for _, ns, _ in sigs if ns != "*"), default=0
             ),
+            pdb_groups=len(self._pdbs),
         )
         grow = {
             f: max(getattr(bk, f), _ceil_bucket(v))
@@ -684,7 +705,13 @@ class SnapshotBuilder:
         run_lk = np.full((M, bk.pod_labels), -1, np.int32)
         run_anti_sig = np.full((M, bk.affinity_terms), -1, np.int32)
         run_ns = np.full(M, -1, np.int32)
+        run_pdb = np.full(M, -1, np.int32)
         run_valid = np.zeros(M, bool)
+        pdb_list = sorted(self._pdbs)
+        pdb_idx = {g: i for i, g in enumerate(pdb_list)}
+        pdb_allowed = np.zeros(bk.pdb_groups, np.float32)
+        for g, name in enumerate(pdb_list):
+            pdb_allowed[g] = float(self._pdbs[name])
         for i, rrec in enumerate(self._running):
             ni = node_index[rrec["node"]]
             run_node[i] = ni
@@ -701,6 +728,8 @@ class SnapshotBuilder:
             for j, s in enumerate(run_anti[i]):
                 run_anti_sig[i, j] = s
             run_ns[i] = ns_ids[rrec["namespace"]]
+            if rrec["pdb_group"] is not None:
+                run_pdb[i] = pdb_idx[rrec["pdb_group"]]
 
         snap = ClusterSnapshot(
             nodes=NodeArrays(
@@ -728,7 +757,8 @@ class SnapshotBuilder:
             running=RunningPodArrays(
                 node_idx=run_node, requests=run_req, priority=run_prio,
                 slack=run_slack, label_pairs=run_lp, label_keys=run_lk,
-                anti_sig=run_anti_sig, namespace=run_ns, valid=run_valid,
+                anti_sig=run_anti_sig, namespace=run_ns,
+                pdb_group=run_pdb, valid=run_valid,
             ),
             atoms=AtomTable(key=atom_key, op=atom_op, pairs=atom_pairs,
                             num=atom_num, valid=atom_valid),
@@ -736,6 +766,7 @@ class SnapshotBuilder:
                           ns_all=sig_ns_all, valid=sig_valid),
             taint_effect=taint_effect,
             group_min_member=group_min,
+            pdb_allowed=pdb_allowed,
         )
         meta = SnapshotMeta(
             node_names=[n["name"] for n in self._nodes],

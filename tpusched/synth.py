@@ -52,6 +52,7 @@ def make_cluster(
     gang_size: int = 4,
     keyless_node_frac: float = 0.0,
     namespace_count: int = 1,
+    pdb_frac: float = 0.0,
 ):
     """General-purpose random cluster. Fractions control what share of
     pods/nodes carry each constraint type, so the same generator covers
@@ -126,6 +127,12 @@ def make_cluster(
                     anti=True,
                     required=True,
                 )]
+            if rng.random() < pdb_frac:
+                # PDB per (app-ish) group of running pods: a shared
+                # budget of 0-2 remaining disruptions.
+                g = int(rng.integers(8))
+                run_kwargs["pdb_group"] = f"pdb-{g}"
+                run_kwargs["pdb_disruptions_allowed"] = int(rng.integers(0, 3))
             b.add_running_pod(
                 node=name,
                 requests={"cpu": cpu_req, "memory": mem_req},
@@ -246,7 +253,9 @@ def config4_gangs(rng: np.random.Generator, n_groups: int = 1_000, gang_size: in
 
 def config5_preemption(rng: np.random.Generator, n_pods: int = 1_000, n_nodes: int = 200, **kw):
     """Multi-tenant preemption pressure: cluster near-full so most pending
-    pods need victims (BASELINE.json:"configs"[4])."""
+    pods need victims; a third of them PDB-covered so the victim search
+    exercises the fewest-violations ranking (BASELINE.json:"configs"[4])."""
     kw.setdefault("initial_utilization", 0.9)
     kw.setdefault("n_running_per_node", 8)
+    kw.setdefault("pdb_frac", 0.3)
     return make_cluster(rng, n_pods, n_nodes, **kw)
