@@ -168,6 +168,36 @@ def test_same_named_pdbs_in_different_namespaces_are_separate(mode):
     np.testing.assert_array_equal(res.evicted, ora.evicted)
 
 
+def test_eviction_names_correct_for_unsorted_wire_order():
+    """Running records arriving in NON-name-sorted wire order must still
+    produce eviction names matching the right pods (codec builds arrays
+    in name order; running_names must follow the same order)."""
+    from tpusched.rpc.codec import snapshot_from_proto, snapshot_to_proto
+
+    nodes = [dict(name="n0", allocatable={"cpu": 4000.0, "memory": float(64 << 30)}),
+             dict(name="n1", allocatable={"cpu": 4000.0, "memory": float(64 << 30)})]
+    # Wire order z-then-a; name order a-then-z. Only "z-victim" (on n1,
+    # huge slack) is the cheap eviction target.
+    running = [
+        dict(name="z-victim", node="n1",
+             requests={"cpu": 4000.0, "memory": float(1 << 30)},
+             priority=10, slack=0.5),
+        dict(name="a-protected", node="n0",
+             requests={"cpu": 4000.0, "memory": float(1 << 30)},
+             priority=10, slack=0.0),
+    ]
+    pods = [dict(name="p", requests={"cpu": 2000.0, "memory": float(1 << 30)},
+                 priority=500.0, observed_avail=1.0)]
+    msg = snapshot_to_proto(nodes, pods, running)
+    cfg = _cfg("parity")
+    snap, meta = snapshot_from_proto(msg, cfg)
+    res = Engine(cfg).solve(snap)
+    evicted_names = [
+        meta.running_names[m] for m in np.argwhere(res.evicted).ravel()
+    ]
+    assert evicted_names == ["z-victim"], evicted_names
+
+
 def test_parity_fuzz_with_pdbs():
     """Random near-full clusters with PDBs: parity mode must match the
     oracle exactly (assignments AND victim sets)."""

@@ -124,8 +124,12 @@ def snapshot_from_proto(
             pdb_disruptions_allowed=r.pdb_disruptions_allowed,
         )
     snap, meta = b.build()
-    # Running-pod names travel with meta for eviction responses.
-    meta.running_names = [r.name or f"running-{i}" for i, r in enumerate(msg.running)]
+    # Running-pod names travel with meta for eviction responses — in the
+    # same name-sorted order the arrays were built in, so evicted[m]
+    # resolves to the right pod whatever the wire order was.
+    meta.running_names = [
+        r.name or f"running-{i}" for i, r in enumerate(_by_name(msg.running))
+    ]
     return snap, meta
 
 
