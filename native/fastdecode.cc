@@ -1,0 +1,1493 @@
+// Native wire decoder for the tpusched sidecar (SURVEY.md C12, §3.2).
+//
+// The serving-path bottleneck at 10k pods x 5k nodes is NOT the solve
+// (~0.3 s on one TPU chip) but the host-side decode: pb2 object churn +
+// the Python SnapshotBuilder loops cost ~1.6 s per request. This module
+// parses the protobuf WIRE BYTES of a tpusched.ClusterSnapshot directly
+// (hand-rolled varint/length-delimited reader — no libprotobuf
+// dependency) and replicates SnapshotBuilder.build() in C++: interning,
+// bucketing, padding, every array. The contract is EXACT equality with
+// the Python path (fuzz-tested in tests/test_native.py); any divergence
+// is a bug in this file.
+//
+// The reference ecosystem's scheduler runtime is compiled (Go); this is
+// the analogous native runtime component wrapping the JAX/TPU compute
+// path — Python stays at the orchestration boundary only.
+//
+// Semantics replicated from tpusched/snapshot.py (build()) and
+// tpusched/rpc/codec.py (snapshot_from_proto): name-sorted record
+// order, insertion-ordered interning tables, namespace-scoped
+// signatures, gang/PDB tables, toleration precompilation, bucket
+// fitting (pow2 <= 2048, then multiples of 1024).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <map>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Errors: set a Python exception and unwind via C++ exception.
+// ---------------------------------------------------------------------------
+
+struct DecodeError {
+  std::string msg;
+};
+
+[[noreturn]] void fail(const std::string& m) { throw DecodeError{m}; }
+
+// ---------------------------------------------------------------------------
+// Protobuf wire reader.
+// ---------------------------------------------------------------------------
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+
+  bool done() const { return p >= end; }
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end) {
+      uint8_t b = *p++;
+      v |= uint64_t(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+      if (shift >= 64) fail("varint too long");
+    }
+    fail("truncated varint");
+  }
+
+  double f64() {
+    if (end - p < 8) fail("truncated double");
+    double v;
+    std::memcpy(&v, p, 8);
+    p += 8;
+    return v;
+  }
+
+  Reader sub() {
+    uint64_t n = varint();
+    if (uint64_t(end - p) < n) fail("truncated length-delimited field");
+    Reader r{p, p + n};
+    p += n;
+    return r;
+  }
+
+  std::string str() {
+    Reader r = sub();
+    return std::string(reinterpret_cast<const char*>(r.p), r.end - r.p);
+  }
+
+  void skip(uint32_t wire_type) {
+    switch (wire_type) {
+      case 0: varint(); break;
+      case 1:
+        if (end - p < 8) fail("truncated fixed64");
+        p += 8;
+        break;
+      case 2: sub(); break;
+      case 5:
+        if (end - p < 4) fail("truncated fixed32");
+        p += 4;
+        break;
+      default: fail("unsupported wire type " + std::to_string(wire_type));
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Record structs (mirror of the proto schema).
+// ---------------------------------------------------------------------------
+
+struct Res {
+  std::string name;
+  double q = 0;
+};
+struct Lab {
+  std::string k, v;
+};
+struct TaintR {
+  std::string k, v, e;
+};
+struct Expr {
+  std::string key, op;
+  std::vector<std::string> values;
+};
+struct Term {
+  std::vector<Expr> exprs;
+};
+struct PrefTerm {
+  double weight = 0;
+  Term term;
+};
+struct Tol {
+  std::string key, op = "Equal", value, effect;
+};
+struct SpreadC {
+  std::string topo;
+  int32_t max_skew = 0;
+  std::string when;
+  std::vector<Expr> sel;
+};
+struct AffT {
+  std::string topo;
+  std::vector<Expr> sel;
+  bool anti = false, required = false;
+  double weight = 1.0;
+  std::vector<std::string> namespaces;
+};
+struct NodeRec {
+  std::string name;
+  std::vector<Res> alloc, used;
+  std::vector<Lab> labels;
+  std::vector<TaintR> taints;
+};
+struct PodRec {
+  std::string name;
+  std::vector<Res> requests;
+  double priority = 0, slo = 0, observed = 0;
+  std::vector<Lab> labels, node_selector;
+  std::vector<Term> required_terms;
+  std::vector<PrefTerm> preferred_terms;
+  std::vector<Tol> tolerations;
+  std::vector<SpreadC> spread;
+  std::vector<AffT> affinity;
+  std::string pod_group;
+  int32_t pod_group_min = 0;
+  std::string ns = "default";
+};
+struct RunRec {
+  std::string name, node;
+  std::vector<Res> requests;
+  double priority = 0, slack = 0;
+  std::vector<Lab> labels;
+  std::vector<AffT> affinity;
+  bool exclude_from_used = false;
+  std::string ns = "default";
+  std::string pdb_group;
+  int32_t pdb_allowed = 0;
+};
+
+Res parse_res(Reader r) {
+  Res out;
+  while (!r.done()) {
+    uint64_t tag = r.varint();
+    switch (tag) {
+      case (1 << 3) | 2: out.name = r.str(); break;
+      case (2 << 3) | 1: out.q = r.f64(); break;
+      default: r.skip(tag & 7);
+    }
+  }
+  return out;
+}
+
+Lab parse_lab(Reader r) {
+  Lab out;
+  while (!r.done()) {
+    uint64_t tag = r.varint();
+    switch (tag) {
+      case (1 << 3) | 2: out.k = r.str(); break;
+      case (2 << 3) | 2: out.v = r.str(); break;
+      default: r.skip(tag & 7);
+    }
+  }
+  return out;
+}
+
+TaintR parse_taint(Reader r) {
+  TaintR out;
+  while (!r.done()) {
+    uint64_t tag = r.varint();
+    switch (tag) {
+      case (1 << 3) | 2: out.k = r.str(); break;
+      case (2 << 3) | 2: out.v = r.str(); break;
+      case (3 << 3) | 2: out.e = r.str(); break;
+      default: r.skip(tag & 7);
+    }
+  }
+  return out;
+}
+
+Expr parse_expr(Reader r) {
+  Expr out;
+  while (!r.done()) {
+    uint64_t tag = r.varint();
+    switch (tag) {
+      case (1 << 3) | 2: out.key = r.str(); break;
+      case (2 << 3) | 2: out.op = r.str(); break;
+      case (3 << 3) | 2: out.values.push_back(r.str()); break;
+      default: r.skip(tag & 7);
+    }
+  }
+  return out;
+}
+
+Term parse_term(Reader r) {
+  Term out;
+  while (!r.done()) {
+    uint64_t tag = r.varint();
+    if (tag == ((1 << 3) | 2))
+      out.exprs.push_back(parse_expr(r.sub()));
+    else
+      r.skip(tag & 7);
+  }
+  return out;
+}
+
+PrefTerm parse_pref(Reader r) {
+  PrefTerm out;
+  while (!r.done()) {
+    uint64_t tag = r.varint();
+    switch (tag) {
+      case (1 << 3) | 1: out.weight = r.f64(); break;
+      case (2 << 3) | 2: out.term = parse_term(r.sub()); break;
+      default: r.skip(tag & 7);
+    }
+  }
+  return out;
+}
+
+Tol parse_tol(Reader r) {
+  Tol out;
+  out.op.clear();
+  while (!r.done()) {
+    uint64_t tag = r.varint();
+    switch (tag) {
+      case (1 << 3) | 2: out.key = r.str(); break;
+      case (2 << 3) | 2: out.op = r.str(); break;
+      case (3 << 3) | 2: out.value = r.str(); break;
+      case (4 << 3) | 2: out.effect = r.str(); break;
+      default: r.skip(tag & 7);
+    }
+  }
+  if (out.op.empty()) out.op = "Equal";  // codec: t.operator or "Equal"
+  return out;
+}
+
+SpreadC parse_spread(Reader r) {
+  SpreadC out;
+  while (!r.done()) {
+    uint64_t tag = r.varint();
+    switch (tag) {
+      case (1 << 3) | 2: out.topo = r.str(); break;
+      case (2 << 3) | 0: out.max_skew = int32_t(r.varint()); break;
+      case (3 << 3) | 2: out.when = r.str(); break;
+      case (4 << 3) | 2: out.sel.push_back(parse_expr(r.sub())); break;
+      default: r.skip(tag & 7);
+    }
+  }
+  return out;
+}
+
+AffT parse_aff(Reader r) {
+  AffT out;
+  bool have_weight = false;
+  while (!r.done()) {
+    uint64_t tag = r.varint();
+    switch (tag) {
+      case (1 << 3) | 2: out.topo = r.str(); break;
+      case (2 << 3) | 2: out.sel.push_back(parse_expr(r.sub())); break;
+      case (3 << 3) | 0: out.anti = r.varint() != 0; break;
+      case (4 << 3) | 0: out.required = r.varint() != 0; break;
+      case (5 << 3) | 1: {
+        double w = r.f64();
+        // codec: weight=t.weight or 1.0 (0.0 -> 1.0)
+        out.weight = (w == 0.0) ? 1.0 : w;
+        have_weight = true;
+        break;
+      }
+      case (6 << 3) | 2: out.namespaces.push_back(r.str()); break;
+      default: r.skip(tag & 7);
+    }
+  }
+  if (!have_weight) out.weight = 1.0;
+  return out;
+}
+
+NodeRec parse_node(Reader r) {
+  NodeRec out;
+  while (!r.done()) {
+    uint64_t tag = r.varint();
+    switch (tag) {
+      case (1 << 3) | 2: out.name = r.str(); break;
+      case (2 << 3) | 2: out.alloc.push_back(parse_res(r.sub())); break;
+      case (3 << 3) | 2: out.labels.push_back(parse_lab(r.sub())); break;
+      case (4 << 3) | 2: out.taints.push_back(parse_taint(r.sub())); break;
+      case (5 << 3) | 2: out.used.push_back(parse_res(r.sub())); break;
+      default: r.skip(tag & 7);
+    }
+  }
+  return out;
+}
+
+PodRec parse_pod(Reader r) {
+  PodRec out;
+  while (!r.done()) {
+    uint64_t tag = r.varint();
+    switch (tag) {
+      case (1 << 3) | 2: out.name = r.str(); break;
+      case (2 << 3) | 2: out.requests.push_back(parse_res(r.sub())); break;
+      case (3 << 3) | 1: out.priority = r.f64(); break;
+      case (4 << 3) | 1: out.slo = r.f64(); break;
+      case (5 << 3) | 1: out.observed = r.f64(); break;
+      case (6 << 3) | 2: out.labels.push_back(parse_lab(r.sub())); break;
+      case (7 << 3) | 2: out.node_selector.push_back(parse_lab(r.sub())); break;
+      case (8 << 3) | 2: out.required_terms.push_back(parse_term(r.sub())); break;
+      case (9 << 3) | 2: out.preferred_terms.push_back(parse_pref(r.sub())); break;
+      case (10 << 3) | 2: out.tolerations.push_back(parse_tol(r.sub())); break;
+      case (11 << 3) | 2: out.spread.push_back(parse_spread(r.sub())); break;
+      case (12 << 3) | 2: out.affinity.push_back(parse_aff(r.sub())); break;
+      case (13 << 3) | 2: out.pod_group = r.str(); break;
+      case (14 << 3) | 0: out.pod_group_min = int32_t(r.varint()); break;
+      case (15 << 3) | 2: {
+        std::string ns = r.str();
+        if (!ns.empty()) out.ns = ns;
+        break;
+      }
+      default: r.skip(tag & 7);
+    }
+  }
+  return out;
+}
+
+RunRec parse_run(Reader r) {
+  RunRec out;
+  while (!r.done()) {
+    uint64_t tag = r.varint();
+    switch (tag) {
+      case (1 << 3) | 2: out.name = r.str(); break;
+      case (2 << 3) | 2: out.node = r.str(); break;
+      case (3 << 3) | 2: out.requests.push_back(parse_res(r.sub())); break;
+      case (4 << 3) | 1: out.priority = r.f64(); break;
+      case (5 << 3) | 1: out.slack = r.f64(); break;
+      case (6 << 3) | 2: out.labels.push_back(parse_lab(r.sub())); break;
+      case (7 << 3) | 2: out.affinity.push_back(parse_aff(r.sub())); break;
+      case (8 << 3) | 0: out.exclude_from_used = r.varint() != 0; break;
+      case (9 << 3) | 2: {
+        std::string ns = r.str();
+        if (!ns.empty()) out.ns = ns;
+        break;
+      }
+      case (10 << 3) | 2: out.pdb_group = r.str(); break;
+      case (11 << 3) | 0: out.pdb_allowed = int32_t(r.varint()); break;
+      default: r.skip(tag & 7);
+    }
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Builder-semantics helpers.
+// ---------------------------------------------------------------------------
+
+// Python dict(list-of-pairs) semantics: first-occurrence position, last
+// value wins. Returns insertion-ordered unique pairs.
+std::vector<Lab> dict_labels(const std::vector<Lab>& in) {
+  std::vector<Lab> out;
+  std::unordered_map<std::string, size_t> pos;
+  for (const auto& l : in) {
+    auto it = pos.find(l.k);
+    if (it == pos.end()) {
+      pos.emplace(l.k, out.size());
+      out.push_back(l);
+    } else {
+      out[it->second].v = l.v;
+    }
+  }
+  return out;
+}
+
+std::vector<Res> dict_res(const std::vector<Res>& in) {
+  std::vector<Res> out;
+  std::unordered_map<std::string, size_t> pos;
+  for (const auto& r : in) {
+    auto it = pos.find(r.name);
+    if (it == pos.end()) {
+      pos.emplace(r.name, out.size());
+      out.push_back(r);
+    } else {
+      out[it->second].q = r.q;
+    }
+  }
+  return out;
+}
+
+double res_get(const std::vector<Res>& m, const std::string& name,
+               double dflt) {
+  for (const auto& r : m)
+    if (r.name == name) return r.q;
+  return dflt;
+}
+
+bool res_has(const std::vector<Res>& m, const std::string& name) {
+  for (const auto& r : m)
+    if (r.name == name) return true;
+  return false;
+}
+
+// Mirror of snapshot._try_float: Python float(str) semantics for the
+// common cases; returns NaN on failure. Handles whitespace, inf/nan,
+// sign, scientific notation, and digit-group underscores; rejects hex.
+double try_float(const std::string& s) {
+  std::string t;
+  size_t a = s.find_first_not_of(" \t\r\n\f\v");
+  if (a == std::string::npos) return std::numeric_limits<double>::quiet_NaN();
+  size_t b = s.find_last_not_of(" \t\r\n\f\v");
+  t = s.substr(a, b - a + 1);
+  if (t.find('x') != std::string::npos || t.find('X') != std::string::npos)
+    return std::numeric_limits<double>::quiet_NaN();
+  if (t.find('_') != std::string::npos) {
+    // Python allows single underscores BETWEEN digits.
+    std::string u;
+    for (size_t i = 0; i < t.size(); ++i) {
+      if (t[i] == '_') {
+        bool ok = i > 0 && i + 1 < t.size() && std::isdigit((unsigned char)t[i - 1]) &&
+                  std::isdigit((unsigned char)t[i + 1]);
+        if (!ok) return std::numeric_limits<double>::quiet_NaN();
+      } else {
+        u.push_back(t[i]);
+      }
+    }
+    t = u;
+  }
+  const char* c = t.c_str();
+  char* endp = nullptr;
+  double v = std::strtod(c, &endp);
+  if (endp == c || *endp != '\0')
+    return std::numeric_limits<double>::quiet_NaN();
+  return v;
+}
+
+// float(expr.values[0]) for Gt/Lt atoms: raises on failure (mirror of
+// the Python builder, where float() raises ValueError). Genuine NaN
+// literals are case-insensitive in Python float() ("nAn" is legal).
+double strict_float(const std::string& s) {
+  double v = try_float(s);
+  if (std::isnan(v)) {
+    std::string low;
+    for (char c : s)
+      if (!std::isspace((unsigned char)c))
+        low.push_back(char(std::tolower((unsigned char)c)));
+    if (!(low == "nan" || low == "+nan" || low == "-nan"))
+      fail("could not convert string to float: '" + s + "'");
+  }
+  return v;
+}
+
+// Insertion-ordered interner over string keys.
+struct Interner {
+  std::unordered_map<std::string, int32_t> m;
+  std::vector<std::string> order;
+  int32_t id(const std::string& k) {
+    auto it = m.find(k);
+    if (it != m.end()) return it->second;
+    int32_t v = int32_t(order.size());
+    m.emplace(k, v);
+    order.push_back(k);
+    return v;
+  }
+  int32_t get(const std::string& k) const {
+    auto it = m.find(k);
+    return it == m.end() ? -1 : it->second;
+  }
+  size_t size() const { return order.size(); }
+};
+
+// Operators / effects (mirror config.py tables).
+int op_code(const std::string& op) {
+  if (op == "In") return 0;
+  if (op == "NotIn") return 1;
+  if (op == "Exists") return 2;
+  if (op == "DoesNotExist") return 3;
+  if (op == "Gt") return 4;
+  if (op == "Lt") return 5;
+  fail("bad operator '" + op + "'");
+}
+
+int effect_code(const std::string& e) {
+  if (e == "NoSchedule") return 0;
+  if (e == "PreferNoSchedule") return 1;
+  if (e == "NoExecute") return 2;
+  fail("bad taint effect '" + e + "'");
+}
+
+// Bucket policy (config._next_bucket / _ceil_bucket).
+int64_t next_pow2(int64_t x) {
+  if (x <= 1) return 1;
+  int64_t v = 1;
+  while (v < x) v <<= 1;
+  return v;
+}
+int64_t next_bucket(int64_t x) {
+  if (x <= 2048) return next_pow2(x);
+  return (x + 1023) / 1024 * 1024;
+}
+int64_t ceil_bucket(int64_t x) { return next_bucket(std::max<int64_t>(x, 1)); }
+
+struct Atom {
+  int32_t key;
+  int8_t op;
+  std::vector<int32_t> pids;  // sorted
+  double num;                 // NaN unless Gt/Lt
+};
+
+struct Sig {
+  int32_t key;                 // topo-key index
+  bool ns_all;
+  std::vector<int32_t> ns;     // sorted ns ids (empty when ns_all)
+  std::vector<int32_t> atoms;  // sorted atom ids
+};
+
+// ---------------------------------------------------------------------------
+// Numpy helpers.
+// ---------------------------------------------------------------------------
+
+PyObject* np_zeros(int nd, npy_intp* dims, int type) {
+  return PyArray_ZEROS(nd, dims, type, 0);
+}
+
+PyObject* np_full_i32(int nd, npy_intp* dims, int32_t fill) {
+  PyObject* a = PyArray_EMPTY(nd, dims, NPY_INT32, 0);
+  if (!a) fail("alloc failed");
+  int32_t* p = (int32_t*)PyArray_DATA((PyArrayObject*)a);
+  npy_intp n = PyArray_SIZE((PyArrayObject*)a);
+  for (npy_intp i = 0; i < n; ++i) p[i] = fill;
+  return a;
+}
+
+PyObject* np_full_f32(int nd, npy_intp* dims, float fill) {
+  PyObject* a = PyArray_EMPTY(nd, dims, NPY_FLOAT32, 0);
+  if (!a) fail("alloc failed");
+  float* p = (float*)PyArray_DATA((PyArrayObject*)a);
+  npy_intp n = PyArray_SIZE((PyArrayObject*)a);
+  for (npy_intp i = 0; i < n; ++i) p[i] = fill;
+  return a;
+}
+
+float* f32p(PyObject* a) { return (float*)PyArray_DATA((PyArrayObject*)a); }
+int32_t* i32p(PyObject* a) { return (int32_t*)PyArray_DATA((PyArrayObject*)a); }
+int8_t* i8p(PyObject* a) { return (int8_t*)PyArray_DATA((PyArrayObject*)a); }
+bool* b8p(PyObject* a) { return (bool*)PyArray_DATA((PyArrayObject*)a); }
+
+// dict-set helper that steals the value reference.
+void dset(PyObject* d, const char* k, PyObject* v) {
+  if (!v) fail("null value for dict");
+  PyDict_SetItemString(d, k, v);
+  Py_DECREF(v);
+}
+
+// ---------------------------------------------------------------------------
+// The decode.
+// ---------------------------------------------------------------------------
+
+struct Buckets {
+  int64_t pods = 128, nodes = 128, running_pods = 256;
+  int64_t node_labels = 16, pod_labels = 8, node_taints = 4;
+  int64_t atoms = 64, atom_values = 8, terms = 4, term_atoms = 4;
+  int64_t pref_terms = 4, topo_keys = 4, spread_constraints = 2;
+  int64_t affinity_terms = 2, pod_groups = 64, taint_vocab = 16;
+  int64_t signatures = 8, sig_namespaces = 2, pdb_groups = 8;
+};
+
+// Per-pod compiled constraint info (mirror of pod_compiled).
+struct PodCompiled {
+  std::vector<std::vector<int32_t>> req_terms;
+  std::vector<std::pair<std::vector<int32_t>, double>> pref_terms;
+  struct TS {
+    int32_t key;
+    double max_skew;
+    int8_t when;
+    std::vector<int32_t> atoms;
+    int32_t sig;
+  };
+  std::vector<TS> ts;
+  struct IA {
+    int32_t key;
+    std::vector<int32_t> atoms;
+    bool anti, required;
+    double weight;
+    int32_t sig;
+  };
+  std::vector<IA> ia;
+};
+
+PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
+                      PyObject* resources_seq, PyObject* buckets_dict) {
+  // Resource axis names.
+  std::vector<std::string> resources;
+  {
+    PyObject* fast = PySequence_Fast(resources_seq, "resources not a sequence");
+    if (!fast) fail("bad resources");
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* it = PySequence_Fast_GET_ITEM(fast, i);
+      Py_ssize_t sl = 0;
+      const char* sp = PyUnicode_AsUTF8AndSize(it, &sl);
+      if (!sp) {
+        Py_DECREF(fast);
+        fail("bad resource name");
+      }
+      resources.emplace_back(sp, sl);
+    }
+    Py_DECREF(fast);
+  }
+  const int64_t R = int64_t(resources.size());
+
+  // Explicit bucket floors (or defaults of Buckets.minimal()).
+  Buckets bk;
+  bool have_floor = buckets_dict && buckets_dict != Py_None;
+  auto bkget = [&](const char* name, int64_t dflt) -> int64_t {
+    if (!have_floor) return dflt;
+    PyObject* v = PyDict_GetItemString(buckets_dict, name);
+    if (!v) return dflt;
+    return PyLong_AsLongLong(v);
+  };
+  if (have_floor) {
+    bk.pods = bkget("pods", bk.pods);
+    bk.nodes = bkget("nodes", bk.nodes);
+    bk.running_pods = bkget("running_pods", bk.running_pods);
+    bk.node_labels = bkget("node_labels", bk.node_labels);
+    bk.pod_labels = bkget("pod_labels", bk.pod_labels);
+    bk.node_taints = bkget("node_taints", bk.node_taints);
+    bk.atoms = bkget("atoms", bk.atoms);
+    bk.atom_values = bkget("atom_values", bk.atom_values);
+    bk.terms = bkget("terms", bk.terms);
+    bk.term_atoms = bkget("term_atoms", bk.term_atoms);
+    bk.pref_terms = bkget("pref_terms", bk.pref_terms);
+    bk.topo_keys = bkget("topo_keys", bk.topo_keys);
+    bk.spread_constraints = bkget("spread_constraints", bk.spread_constraints);
+    bk.affinity_terms = bkget("affinity_terms", bk.affinity_terms);
+    bk.pod_groups = bkget("pod_groups", bk.pod_groups);
+    bk.taint_vocab = bkget("taint_vocab", bk.taint_vocab);
+    bk.signatures = bkget("signatures", bk.signatures);
+    bk.sig_namespaces = bkget("sig_namespaces", bk.sig_namespaces);
+    bk.pdb_groups = bkget("pdb_groups", bk.pdb_groups);
+  } else {
+    // Buckets.minimal(): feature axes start at ZERO; pods/nodes/running
+    // fitted below.
+    bk.node_labels = bk.pod_labels = bk.node_taints = 0;
+    bk.atoms = bk.atom_values = bk.terms = bk.term_atoms = 0;
+    bk.pref_terms = bk.topo_keys = bk.spread_constraints = 0;
+    bk.affinity_terms = bk.pod_groups = bk.taint_vocab = 0;
+    bk.signatures = bk.sig_namespaces = bk.pdb_groups = 0;
+  }
+
+  // Parse the ClusterSnapshot envelope.
+  std::vector<NodeRec> nodes;
+  std::vector<PodRec> pods;
+  std::vector<RunRec> running;
+  {
+    Reader r{data, data + len};
+    while (!r.done()) {
+      uint64_t tag = r.varint();
+      switch (tag) {
+        case (1 << 3) | 2: nodes.push_back(parse_node(r.sub())); break;
+        case (2 << 3) | 2: pods.push_back(parse_pod(r.sub())); break;
+        case (3 << 3) | 2: running.push_back(parse_run(r.sub())); break;
+        default: r.skip(tag & 7);
+      }
+    }
+  }
+
+  // codec._by_name: stable sort by record name.
+  std::stable_sort(nodes.begin(), nodes.end(),
+                   [](const NodeRec& a, const NodeRec& b) { return a.name < b.name; });
+  std::stable_sort(pods.begin(), pods.end(),
+                   [](const PodRec& a, const PodRec& b) { return a.name < b.name; });
+  std::stable_sort(running.begin(), running.end(),
+                   [](const RunRec& a, const RunRec& b) { return a.name < b.name; });
+
+  // Normalize label/resource lists to dict semantics once.
+  for (auto& n : nodes) {
+    n.labels = dict_labels(n.labels);
+    n.alloc = dict_res(n.alloc);
+    n.used = dict_res(n.used);
+  }
+  for (auto& p : pods) {
+    p.labels = dict_labels(p.labels);
+    p.node_selector = dict_labels(p.node_selector);
+    p.requests = dict_res(p.requests);
+  }
+  for (auto& rr : running) {
+    rr.labels = dict_labels(rr.labels);
+    rr.requests = dict_res(rr.requests);
+  }
+
+  const int64_t n_nodes = int64_t(nodes.size());
+  const int64_t n_pods = int64_t(pods.size());
+  const int64_t n_running = int64_t(running.size());
+
+  // ---- Interning tables (insertion-ordered, matching build()). ----
+  Interner keys, ns_ids;
+  Interner pairs;   // key: k + '\x1f' + v
+  Interner taints;  // key: k + '\x1f' + v + '\x1f' + e
+  std::vector<std::string> taint_effects_by_id;  // effect per taint id
+  Interner atoms_tab;  // serialized atom -> id
+  std::vector<Atom> atoms;
+  Interner sigs_tab;  // serialized sig -> id
+  std::vector<Sig> sigs;
+  std::vector<std::string> topo_keys;
+  std::vector<std::unordered_map<std::string, int32_t>> domain_ids;
+
+  auto kid = [&](const std::string& k) { return keys.id(k); };
+  auto pid = [&](const std::string& k, const std::string& v) {
+    return pairs.id(k + '\x1f' + v);
+  };
+  auto tid = [&](const TaintR& t) {
+    std::string key = t.k + '\x1f' + t.v + '\x1f' + t.e;
+    int before = int(taints.size());
+    int32_t id = taints.id(key);
+    if (int(taints.size()) > before) {
+      effect_code(t.e);  // validate
+      taint_effects_by_id.push_back(t.e);
+    }
+    return id;
+  };
+  auto topo_idx = [&](const std::string& k) -> int32_t {
+    for (size_t i = 0; i < topo_keys.size(); ++i)
+      if (topo_keys[i] == k) return int32_t(i);
+    topo_keys.push_back(k);
+    domain_ids.emplace_back();
+    return int32_t(topo_keys.size() - 1);
+  };
+  auto aid = [&](const Expr& e) -> int32_t {
+    int op = op_code(e.op);
+    if ((op == 4 || op == 5) && e.values.size() != 1)
+      fail(e.op + " needs exactly one value");
+    int32_t k = kid(e.key);
+    std::vector<int32_t> pids;
+    double num = std::numeric_limits<double>::quiet_NaN();
+    if (op == 0 || op == 1) {
+      for (const auto& v : e.values) pids.push_back(pid(e.key, v));
+      std::sort(pids.begin(), pids.end());
+    } else if (op == 4 || op == 5) {
+      num = strict_float(e.values[0]);
+    }
+    // Dedup key: NaN -> sentinel (mirror of the Python fix).
+    std::string ser;
+    ser.reserve(16 + pids.size() * 4);
+    ser.append(reinterpret_cast<const char*>(&k), 4);
+    char opc = char(op);
+    ser.push_back(opc);
+    for (int32_t p : pids) ser.append(reinterpret_cast<const char*>(&p), 4);
+    ser.push_back('|');
+    if (std::isnan(num)) {
+      ser.append("none");
+    } else {
+      ser.append(reinterpret_cast<const char*>(&num), 8);
+    }
+    int before = int(atoms_tab.size());
+    int32_t id = atoms_tab.id(ser);
+    if (int(atoms_tab.size()) > before)
+      atoms.push_back(Atom{k, int8_t(op), std::move(pids), num});
+    return id;
+  };
+  auto sid = [&](int32_t key_idx, std::vector<int32_t> alist, bool ns_all,
+                 std::vector<int32_t> ns_list) -> int32_t {
+    std::sort(alist.begin(), alist.end());
+    std::string ser;
+    ser.append(reinterpret_cast<const char*>(&key_idx), 4);
+    ser.push_back(ns_all ? '*' : '.');
+    for (int32_t n : ns_list) ser.append(reinterpret_cast<const char*>(&n), 4);
+    ser.push_back('|');
+    for (int32_t a : alist) ser.append(reinterpret_cast<const char*>(&a), 4);
+    int before = int(sigs_tab.size());
+    int32_t id = sigs_tab.id(ser);
+    if (int(sigs_tab.size()) > before)
+      sigs.push_back(Sig{key_idx, ns_all, std::move(ns_list), std::move(alist)});
+    return id;
+  };
+  auto ns_scope_of = [&](const std::vector<std::string>& nss,
+                         const std::string& own)
+      -> std::pair<bool, std::vector<int32_t>> {
+    if (nss.empty()) return {false, {ns_ids.id(own)}};
+    for (const auto& s : nss)
+      if (s == "*") return {true, {}};
+    // sorted(set(names)) by NAME, then ids sorted (mirror ns_scope_of).
+    std::vector<std::string> uniq(nss);
+    std::sort(uniq.begin(), uniq.end());
+    uniq.erase(std::unique(uniq.begin(), uniq.end()), uniq.end());
+    std::vector<int32_t> ids;
+    for (const auto& s : uniq) ids.push_back(ns_ids.id(s));
+    std::sort(ids.begin(), ids.end());
+    return {false, ids};
+  };
+
+  // Gangs / PDBs.
+  std::map<std::string, int32_t> groups;  // ordered later via sorted names
+  std::map<std::pair<std::string, std::string>, int32_t> pdbs;
+
+  // ---- First pass: pod_compiled (exact order of build()). ----
+  std::vector<PodCompiled> pcs(n_pods);
+  for (int64_t i = 0; i < n_pods; ++i) {
+    const PodRec& p = pods[i];
+    PodCompiled& pc = pcs[i];
+    if (!p.pod_group.empty()) {
+      auto it = groups.find(p.pod_group);
+      int32_t prev = it == groups.end() ? 0 : it->second;
+      groups[p.pod_group] = std::max(prev, p.pod_group_min);
+    }
+    // nodeSelector -> sorted items -> In atoms.
+    std::vector<int32_t> sel_atoms;
+    {
+      std::vector<Lab> sel = p.node_selector;
+      std::sort(sel.begin(), sel.end(), [](const Lab& a, const Lab& b) {
+        return a.k < b.k || (a.k == b.k && a.v < b.v);
+      });
+      for (const auto& l : sel)
+        sel_atoms.push_back(aid(Expr{l.k, "In", {l.v}}));
+    }
+    for (const auto& t : p.required_terms) {
+      if (t.exprs.empty()) continue;  // empty term matches no objects
+      std::vector<int32_t> alist;
+      for (const auto& e : t.exprs) alist.push_back(aid(e));
+      for (int32_t a : sel_atoms) alist.push_back(a);
+      pc.req_terms.push_back(std::move(alist));
+    }
+    if (pc.req_terms.empty() && !sel_atoms.empty())
+      pc.req_terms.push_back(sel_atoms);
+    for (const auto& pt : p.preferred_terms) {
+      if (pt.term.exprs.empty()) continue;
+      std::vector<int32_t> alist;
+      for (const auto& e : pt.term.exprs) alist.push_back(aid(e));
+      pc.pref_terms.emplace_back(std::move(alist), pt.weight);
+    }
+    for (const auto& c : p.spread) {
+      PodCompiled::TS ts;
+      ts.key = topo_idx(c.topo);
+      ts.max_skew = double(c.max_skew);
+      ts.when = (c.when == "DoNotSchedule") ? 0 : 1;
+      for (const auto& e : c.sel) ts.atoms.push_back(aid(e));
+      ts.sig = -1;
+      pc.ts.push_back(std::move(ts));
+    }
+    for (auto& ts : pc.ts)
+      ts.sig = sid(ts.key, ts.atoms, false, {ns_ids.id(p.ns)});
+    for (const auto& t : p.affinity) {
+      PodCompiled::IA ia;
+      ia.key = topo_idx(t.topo);
+      for (const auto& e : t.sel) ia.atoms.push_back(aid(e));
+      ia.anti = t.anti;
+      ia.required = t.required;
+      ia.weight = t.weight;
+      auto scope = ns_scope_of(t.namespaces, p.ns);
+      ia.sig = sid(ia.key, ia.atoms, scope.first, scope.second);
+      pc.ia.push_back(std::move(ia));
+    }
+  }
+
+  // ---- Running pods' required anti terms. ----
+  std::vector<std::vector<int32_t>> run_anti(n_running);
+  int64_t run_anti_atom_max = 0;
+  for (int64_t i = 0; i < n_running; ++i) {
+    const RunRec& rr = running[i];
+    for (const auto& t : rr.affinity) {
+      if (!(t.anti && t.required)) continue;
+      std::vector<int32_t> alist;
+      for (const auto& e : t.sel) alist.push_back(aid(e));
+      run_anti_atom_max =
+          std::max(run_anti_atom_max, int64_t(alist.size()));
+      auto scope = ns_scope_of(t.namespaces, rr.ns);
+      run_anti[i].push_back(
+          sid(topo_idx(t.topo), alist, scope.first, scope.second));
+    }
+  }
+
+  // ---- Label/taint/ns interning passes (exact order). ----
+  for (const auto& n : nodes) {
+    for (const auto& l : n.labels) {
+      kid(l.k);
+      pid(l.k, l.v);
+    }
+    for (const auto& t : n.taints) tid(t);
+  }
+  for (const auto& rr : running) {
+    for (const auto& l : rr.labels) {
+      kid(l.k);
+      pid(l.k, l.v);
+    }
+    ns_ids.id(rr.ns);
+  }
+  for (const auto& p : pods) {
+    for (const auto& l : p.labels) {
+      kid(l.k);
+      pid(l.k, l.v);
+    }
+    ns_ids.id(p.ns);
+  }
+  // PDBs keyed by (namespace, name), max allowance wins.
+  for (const auto& rr : running) {
+    if (rr.pdb_group.empty()) continue;
+    auto key = std::make_pair(rr.ns, rr.pdb_group);
+    auto it = pdbs.find(key);
+    int32_t prev = it == pdbs.end() ? 0 : it->second;
+    pdbs[key] = std::max(prev, rr.pdb_allowed);
+  }
+
+  // ---- Bucket fitting (build()'s `need` + growth rules). ----
+  int64_t need_node_labels = 0, need_pod_labels = 0, need_node_taints = 0;
+  for (const auto& n : nodes) {
+    need_node_labels = std::max(need_node_labels, int64_t(n.labels.size()));
+    need_node_taints = std::max(need_node_taints, int64_t(n.taints.size()));
+  }
+  for (const auto& p : pods)
+    need_pod_labels = std::max(need_pod_labels, int64_t(p.labels.size()));
+  for (const auto& rr : running)
+    need_pod_labels = std::max(need_pod_labels, int64_t(rr.labels.size()));
+  int64_t need_atom_values = 0;
+  for (const auto& a : atoms)
+    need_atom_values = std::max(need_atom_values, int64_t(a.pids.size()));
+  int64_t need_terms = 0, need_term_atoms = run_anti_atom_max,
+          need_pref = 0, need_spread = 0, need_ia = 0;
+  for (int64_t i = 0; i < n_pods; ++i) {
+    const PodCompiled& pc = pcs[i];
+    need_terms = std::max(need_terms, int64_t(pc.req_terms.size()));
+    for (const auto& t : pc.req_terms)
+      need_term_atoms = std::max(need_term_atoms, int64_t(t.size()));
+    for (const auto& t : pc.pref_terms)
+      need_term_atoms = std::max(need_term_atoms, int64_t(t.first.size()));
+    for (const auto& c : pc.ts)
+      need_term_atoms = std::max(need_term_atoms, int64_t(c.atoms.size()));
+    for (const auto& t : pc.ia)
+      need_term_atoms = std::max(need_term_atoms, int64_t(t.atoms.size()));
+    need_pref = std::max(need_pref, int64_t(pc.pref_terms.size()));
+    need_spread = std::max(need_spread, int64_t(pc.ts.size()));
+    need_ia = std::max(need_ia, int64_t(pc.ia.size()));
+  }
+  for (const auto& ra : run_anti)
+    need_ia = std::max(need_ia, int64_t(ra.size()));
+  int64_t need_sig_ns = 0;
+  for (const auto& s : sigs)
+    if (!s.ns_all)
+      need_sig_ns = std::max(need_sig_ns, int64_t(s.ns.size()));
+
+  auto grow = [&](int64_t& slot, int64_t need) {
+    if (need > slot) slot = std::max(slot, ceil_bucket(need));
+  };
+  grow(bk.node_labels, need_node_labels);
+  grow(bk.pod_labels, need_pod_labels);
+  grow(bk.node_taints, need_node_taints);
+  grow(bk.atoms, int64_t(atoms.size()));
+  grow(bk.atom_values, need_atom_values);
+  grow(bk.terms, need_terms);
+  grow(bk.term_atoms, need_term_atoms);
+  grow(bk.pref_terms, need_pref);
+  grow(bk.topo_keys, int64_t(topo_keys.size()));
+  grow(bk.spread_constraints, need_spread);
+  grow(bk.affinity_terms, need_ia);
+  grow(bk.pod_groups, int64_t(groups.size()));
+  grow(bk.taint_vocab, int64_t(taints.size()));
+  grow(bk.signatures, int64_t(sigs.size()));
+  grow(bk.sig_namespaces, need_sig_ns);
+  grow(bk.pdb_groups, int64_t(pdbs.size()));
+  // pods/nodes/running: Buckets.fit semantics (min 8, pow2/1024 policy).
+  if (!have_floor) {
+    bk.pods = std::max<int64_t>(8, next_bucket(n_pods));
+    bk.nodes = std::max<int64_t>(8, next_bucket(n_nodes));
+    bk.running_pods = std::max<int64_t>(8, next_bucket(std::max<int64_t>(1, n_running)));
+  }
+  if (n_pods > bk.pods) bk.pods = std::max(bk.pods, ceil_bucket(n_pods));
+  if (n_nodes > bk.nodes) bk.nodes = std::max(bk.nodes, ceil_bucket(n_nodes));
+  if (n_running > bk.running_pods)
+    bk.running_pods = std::max(bk.running_pods, ceil_bucket(n_running));
+
+  const int64_t P = bk.pods, N = bk.nodes, M = bk.running_pods;
+
+  PyObject* out = PyDict_New();
+  if (!out) fail("dict alloc failed");
+
+  // ---- Atom table. ----
+  {
+    npy_intp dA[1] = {(npy_intp)bk.atoms};
+    npy_intp dAV[2] = {(npy_intp)bk.atoms, (npy_intp)bk.atom_values};
+    PyObject* a_key = np_full_i32(1, dA, -1);
+    PyObject* a_op = np_zeros(1, dA, NPY_INT8);
+    PyObject* a_pairs = np_full_i32(2, dAV, -1);
+    PyObject* a_num = np_full_f32(1, dA, std::numeric_limits<float>::quiet_NaN());
+    PyObject* a_valid = np_zeros(1, dA, NPY_BOOL);
+    for (size_t i = 0; i < atoms.size(); ++i) {
+      i32p(a_key)[i] = atoms[i].key;
+      i8p(a_op)[i] = atoms[i].op;
+      for (size_t j = 0; j < atoms[i].pids.size(); ++j)
+        i32p(a_pairs)[i * bk.atom_values + j] = atoms[i].pids[j];
+      f32p(a_num)[i] = float(atoms[i].num);
+      b8p(a_valid)[i] = true;
+    }
+    dset(out, "atom_key", a_key);
+    dset(out, "atom_op", a_op);
+    dset(out, "atom_pairs", a_pairs);
+    dset(out, "atom_num", a_num);
+    dset(out, "atom_valid", a_valid);
+  }
+
+  // ---- Node arrays. ----
+  std::unordered_map<std::string, int32_t> node_index;
+  npy_intp dNR[2] = {(npy_intp)N, (npy_intp)R};
+  npy_intp dNL[2] = {(npy_intp)N, (npy_intp)bk.node_labels};
+  npy_intp dNT[2] = {(npy_intp)N, (npy_intp)bk.node_taints};
+  npy_intp dNK[2] = {(npy_intp)N, (npy_intp)bk.topo_keys};
+  npy_intp dN[1] = {(npy_intp)N};
+  PyObject* node_alloc = np_zeros(2, dNR, NPY_FLOAT32);
+  PyObject* node_used = np_zeros(2, dNR, NPY_FLOAT32);
+  PyObject* node_lp = np_full_i32(2, dNL, -1);
+  PyObject* node_lk = np_full_i32(2, dNL, -1);
+  PyObject* node_ln = np_full_f32(2, dNL, std::numeric_limits<float>::quiet_NaN());
+  PyObject* node_t = np_full_i32(2, dNT, -1);
+  PyObject* node_dom = np_full_i32(2, dNK, -1);
+  PyObject* node_valid = np_zeros(1, dN, NPY_BOOL);
+  for (int64_t i = 0; i < n_nodes; ++i) {
+    NodeRec& n = nodes[i];
+    node_index[n.name] = int32_t(i);
+    b8p(node_valid)[i] = true;
+    for (int64_t r = 0; r < R; ++r) {
+      double dflt = (resources[r] == "pods") ? 110.0 : 0.0;
+      // add_node: alloc.setdefault("pods", 110.0)
+      double av = res_has(n.alloc, resources[r])
+                      ? res_get(n.alloc, resources[r], 0.0)
+                      : dflt;
+      f32p(node_alloc)[i * R + r] = float(av);
+      f32p(node_used)[i * R + r] = float(res_get(n.used, resources[r], 0.0));
+    }
+    std::vector<Lab> sl = n.labels;
+    std::sort(sl.begin(), sl.end(), [](const Lab& a, const Lab& b) {
+      return a.k < b.k || (a.k == b.k && a.v < b.v);
+    });
+    for (size_t j = 0; j < sl.size(); ++j) {
+      i32p(node_lk)[i * bk.node_labels + j] = keys.get(sl[j].k);
+      i32p(node_lp)[i * bk.node_labels + j] = pairs.get(sl[j].k + '\x1f' + sl[j].v);
+      f32p(node_ln)[i * bk.node_labels + j] = float(try_float(sl[j].v));
+    }
+    for (size_t j = 0; j < n.taints.size(); ++j) {
+      const TaintR& t = n.taints[j];
+      i32p(node_t)[i * bk.node_taints + j] =
+          taints.get(t.k + '\x1f' + t.v + '\x1f' + t.e);
+    }
+    for (size_t ti = 0; ti < topo_keys.size(); ++ti) {
+      // if topo key in node labels (dict semantics: last value).
+      const std::string* val = nullptr;
+      for (const auto& l : n.labels)
+        if (l.k == topo_keys[ti]) val = &l.v;
+      if (val) {
+        auto& dmap = domain_ids[ti];
+        auto it = dmap.find(*val);
+        int32_t d;
+        if (it == dmap.end()) {
+          d = int32_t(dmap.size());
+          dmap.emplace(*val, d);
+        } else {
+          d = it->second;
+        }
+        i32p(node_dom)[i * bk.topo_keys + ti] = d;
+      }
+    }
+  }
+
+  // ---- Taint effect table. ----
+  {
+    npy_intp dVT[1] = {(npy_intp)bk.taint_vocab};
+    PyObject* te = np_zeros(1, dVT, NPY_INT8);
+    for (size_t t = 0; t < taint_effects_by_id.size(); ++t)
+      i8p(te)[t] = int8_t(effect_code(taint_effects_by_id[t]));
+    dset(out, "taint_effect", te);
+  }
+
+  // ---- Signature table. ----
+  {
+    npy_intp dS[1] = {(npy_intp)bk.signatures};
+    npy_intp dSA[2] = {(npy_intp)bk.signatures, (npy_intp)bk.term_atoms};
+    npy_intp dSN[2] = {(npy_intp)bk.signatures, (npy_intp)bk.sig_namespaces};
+    PyObject* s_key = np_full_i32(1, dS, -1);
+    PyObject* s_atoms = np_full_i32(2, dSA, -1);
+    PyObject* s_ns = np_full_i32(2, dSN, -1);
+    PyObject* s_ns_all = np_zeros(1, dS, NPY_BOOL);
+    PyObject* s_valid = np_zeros(1, dS, NPY_BOOL);
+    for (size_t s = 0; s < sigs.size(); ++s) {
+      i32p(s_key)[s] = sigs[s].key;
+      for (size_t j = 0; j < sigs[s].atoms.size(); ++j)
+        i32p(s_atoms)[s * bk.term_atoms + j] = sigs[s].atoms[j];
+      if (sigs[s].ns_all) {
+        b8p(s_ns_all)[s] = true;
+      } else {
+        for (size_t j = 0; j < sigs[s].ns.size(); ++j)
+          i32p(s_ns)[s * bk.sig_namespaces + j] = sigs[s].ns[j];
+      }
+      b8p(s_valid)[s] = true;
+    }
+    dset(out, "sig_key", s_key);
+    dset(out, "sig_atoms", s_atoms);
+    dset(out, "sig_ns", s_ns);
+    dset(out, "sig_ns_all", s_ns_all);
+    dset(out, "sig_valid", s_valid);
+  }
+
+  // ---- Pod arrays. ----
+  std::vector<std::string> group_list;
+  for (const auto& g : groups) group_list.push_back(g.first);  // sorted (map)
+  std::unordered_map<std::string, int32_t> group_idx;
+  for (size_t i = 0; i < group_list.size(); ++i)
+    group_idx[group_list[i]] = int32_t(i);
+
+  npy_intp dPR[2] = {(npy_intp)P, (npy_intp)R};
+  npy_intp dP[1] = {(npy_intp)P};
+  npy_intp dPVT[2] = {(npy_intp)P, (npy_intp)bk.taint_vocab};
+  npy_intp dPL[2] = {(npy_intp)P, (npy_intp)bk.pod_labels};
+  npy_intp dPTA[3] = {(npy_intp)P, (npy_intp)bk.terms, (npy_intp)bk.term_atoms};
+  npy_intp dPT[2] = {(npy_intp)P, (npy_intp)bk.terms};
+  npy_intp dPPA[3] = {(npy_intp)P, (npy_intp)bk.pref_terms, (npy_intp)bk.term_atoms};
+  npy_intp dPP[2] = {(npy_intp)P, (npy_intp)bk.pref_terms};
+  npy_intp dPC[2] = {(npy_intp)P, (npy_intp)bk.spread_constraints};
+  npy_intp dPCA[3] = {(npy_intp)P, (npy_intp)bk.spread_constraints, (npy_intp)bk.term_atoms};
+  npy_intp dPI[2] = {(npy_intp)P, (npy_intp)bk.affinity_terms};
+  npy_intp dPIA[3] = {(npy_intp)P, (npy_intp)bk.affinity_terms, (npy_intp)bk.term_atoms};
+
+  PyObject* p_req = np_zeros(2, dPR, NPY_FLOAT32);
+  PyObject* p_prio = np_zeros(1, dP, NPY_FLOAT32);
+  PyObject* p_slo = np_zeros(1, dP, NPY_FLOAT32);
+  PyObject* p_obs = np_full_f32(1, dP, 1.0f);
+  PyObject* p_tol = np_zeros(2, dPVT, NPY_BOOL);
+  PyObject* p_lp = np_full_i32(2, dPL, -1);
+  PyObject* p_lk = np_full_i32(2, dPL, -1);
+  PyObject* p_rta = np_full_i32(3, dPTA, -1);
+  PyObject* p_rtv = np_zeros(2, dPT, NPY_BOOL);
+  PyObject* p_pta = np_full_i32(3, dPPA, -1);
+  PyObject* p_ptv = np_zeros(2, dPP, NPY_BOOL);
+  PyObject* p_pw = np_zeros(2, dPP, NPY_FLOAT32);
+  PyObject* p_tsk = np_full_i32(2, dPC, -1);
+  PyObject* p_tsm = np_zeros(2, dPC, NPY_FLOAT32);
+  PyObject* p_tsw = np_zeros(2, dPC, NPY_INT8);
+  PyObject* p_tsa = np_full_i32(3, dPCA, -1);
+  PyObject* p_tss = np_full_i32(2, dPC, -1);
+  PyObject* p_tsv = np_zeros(2, dPC, NPY_BOOL);
+  PyObject* p_iak = np_full_i32(2, dPI, -1);
+  PyObject* p_iaa = np_full_i32(3, dPIA, -1);
+  PyObject* p_ias = np_full_i32(2, dPI, -1);
+  PyObject* p_ian = np_zeros(2, dPI, NPY_BOOL);
+  PyObject* p_iar = np_zeros(2, dPI, NPY_BOOL);
+  PyObject* p_iaw = np_zeros(2, dPI, NPY_FLOAT32);
+  PyObject* p_iav = np_zeros(2, dPI, NPY_BOOL);
+  PyObject* p_group = np_full_i32(1, dP, -1);
+  PyObject* p_ns = np_full_i32(1, dP, -1);
+  PyObject* p_valid = np_zeros(1, dP, NPY_BOOL);
+
+  // Toleration matching (mirror of _tolerates).
+  auto tolerates = [&](const Tol& tol, const std::string& tk,
+                       const std::string& tv, const std::string& te) -> bool {
+    if (tol.op != "Exists" && tol.op != "Equal")
+      fail("bad toleration operator '" + tol.op + "'");
+    bool key_ok;
+    if (tol.key.empty()) {
+      if (tol.op != "Exists") return false;
+      key_ok = true;
+    } else {
+      key_ok = tol.key == tk;
+    }
+    if (!key_ok) return false;
+    if (tol.op == "Equal" && tol.value != tv) return false;
+    if (!tol.effect.empty() && tol.effect != te) return false;
+    return true;
+  };
+
+  for (int64_t i = 0; i < n_pods; ++i) {
+    const PodRec& p = pods[i];
+    const PodCompiled& pc = pcs[i];
+    b8p(p_valid)[i] = true;
+    for (int64_t r = 0; r < R; ++r) {
+      double dflt = (resources[r] == "pods") ? 1.0 : 0.0;
+      double rv = res_has(p.requests, resources[r])
+                      ? res_get(p.requests, resources[r], 0.0)
+                      : dflt;
+      f32p(p_req)[i * R + r] = float(rv);
+    }
+    f32p(p_prio)[i] = float(p.priority);
+    f32p(p_slo)[i] = float(p.slo);
+    f32p(p_obs)[i] = float(p.observed);
+    std::vector<Lab> sl = p.labels;
+    std::sort(sl.begin(), sl.end(), [](const Lab& a, const Lab& b) {
+      return a.k < b.k || (a.k == b.k && a.v < b.v);
+    });
+    for (size_t j = 0; j < sl.size(); ++j) {
+      i32p(p_lk)[i * bk.pod_labels + j] = keys.get(sl[j].k);
+      i32p(p_lp)[i * bk.pod_labels + j] = pairs.get(sl[j].k + '\x1f' + sl[j].v);
+    }
+    // Tolerations vs the whole taint vocab.
+    for (size_t t = 0; t < taints.order.size(); ++t) {
+      const std::string& ser = taints.order[t];
+      size_t c1 = ser.find('\x1f');
+      size_t c2 = ser.find('\x1f', c1 + 1);
+      std::string tk = ser.substr(0, c1);
+      std::string tv = ser.substr(c1 + 1, c2 - c1 - 1);
+      std::string te = ser.substr(c2 + 1);
+      bool any = false;
+      for (const auto& tol : p.tolerations)
+        if (tolerates(tol, tk, tv, te)) {
+          any = true;
+          break;
+        }
+      b8p(p_tol)[i * bk.taint_vocab + t] = any;
+    }
+    for (size_t t = 0; t < pc.req_terms.size(); ++t) {
+      b8p(p_rtv)[i * bk.terms + t] = true;
+      for (size_t j = 0; j < pc.req_terms[t].size(); ++j)
+        i32p(p_rta)[(i * bk.terms + t) * bk.term_atoms + j] = pc.req_terms[t][j];
+    }
+    for (size_t t = 0; t < pc.pref_terms.size(); ++t) {
+      b8p(p_ptv)[i * bk.pref_terms + t] = true;
+      for (size_t j = 0; j < pc.pref_terms[t].first.size(); ++j)
+        i32p(p_pta)[(i * bk.pref_terms + t) * bk.term_atoms + j] =
+            pc.pref_terms[t].first[j];
+      f32p(p_pw)[i * bk.pref_terms + t] = float(pc.pref_terms[t].second);
+    }
+    for (size_t c = 0; c < pc.ts.size(); ++c) {
+      const auto& ts = pc.ts[c];
+      b8p(p_tsv)[i * bk.spread_constraints + c] = true;
+      i32p(p_tsk)[i * bk.spread_constraints + c] = ts.key;
+      f32p(p_tsm)[i * bk.spread_constraints + c] = float(ts.max_skew);
+      i8p(p_tsw)[i * bk.spread_constraints + c] = ts.when;
+      for (size_t j = 0; j < ts.atoms.size(); ++j)
+        i32p(p_tsa)[(i * bk.spread_constraints + c) * bk.term_atoms + j] =
+            ts.atoms[j];
+      i32p(p_tss)[i * bk.spread_constraints + c] = ts.sig;
+    }
+    for (size_t t = 0; t < pc.ia.size(); ++t) {
+      const auto& ia = pc.ia[t];
+      b8p(p_iav)[i * bk.affinity_terms + t] = true;
+      i32p(p_iak)[i * bk.affinity_terms + t] = ia.key;
+      for (size_t j = 0; j < ia.atoms.size(); ++j)
+        i32p(p_iaa)[(i * bk.affinity_terms + t) * bk.term_atoms + j] =
+            ia.atoms[j];
+      i32p(p_ias)[i * bk.affinity_terms + t] = ia.sig;
+      b8p(p_ian)[i * bk.affinity_terms + t] = ia.anti;
+      b8p(p_iar)[i * bk.affinity_terms + t] = ia.required;
+      f32p(p_iaw)[i * bk.affinity_terms + t] = float(ia.weight);
+    }
+    if (!p.pod_group.empty())
+      i32p(p_group)[i] = group_idx[p.pod_group];
+    i32p(p_ns)[i] = ns_ids.get(p.ns);
+  }
+
+  // ---- Gang / PDB tables. ----
+  {
+    npy_intp dG[1] = {(npy_intp)bk.pod_groups};
+    PyObject* gm = np_zeros(1, dG, NPY_INT32);
+    for (size_t g = 0; g < group_list.size(); ++g)
+      i32p(gm)[g] = groups[group_list[g]];
+    dset(out, "group_min_member", gm);
+  }
+  std::vector<std::pair<std::string, std::string>> pdb_list;
+  for (const auto& kv : pdbs) pdb_list.push_back(kv.first);  // sorted (map)
+  std::map<std::pair<std::string, std::string>, int32_t> pdb_idx;
+  for (size_t i = 0; i < pdb_list.size(); ++i)
+    pdb_idx[pdb_list[i]] = int32_t(i);
+  {
+    npy_intp dGP[1] = {(npy_intp)bk.pdb_groups};
+    PyObject* pa = np_zeros(1, dGP, NPY_FLOAT32);
+    for (size_t g = 0; g < pdb_list.size(); ++g)
+      f32p(pa)[g] = float(pdbs[pdb_list[g]]);
+    dset(out, "pdb_allowed", pa);
+  }
+
+  // ---- Running pods. ----
+  npy_intp dM[1] = {(npy_intp)M};
+  npy_intp dMR[2] = {(npy_intp)M, (npy_intp)R};
+  npy_intp dML[2] = {(npy_intp)M, (npy_intp)bk.pod_labels};
+  npy_intp dMA[2] = {(npy_intp)M, (npy_intp)bk.affinity_terms};
+  PyObject* r_node = np_full_i32(1, dM, -1);
+  PyObject* r_req = np_zeros(2, dMR, NPY_FLOAT32);
+  PyObject* r_prio = np_zeros(1, dM, NPY_FLOAT32);
+  PyObject* r_slack = np_zeros(1, dM, NPY_FLOAT32);
+  PyObject* r_lp = np_full_i32(2, dML, -1);
+  PyObject* r_lk = np_full_i32(2, dML, -1);
+  PyObject* r_anti = np_full_i32(2, dMA, -1);
+  PyObject* r_ns = np_full_i32(1, dM, -1);
+  PyObject* r_pdb = np_full_i32(1, dM, -1);
+  PyObject* r_valid = np_zeros(1, dM, NPY_BOOL);
+  for (int64_t i = 0; i < n_running; ++i) {
+    const RunRec& rr = running[i];
+    auto nit = node_index.find(rr.node);
+    if (nit == node_index.end())
+      fail("running pod on unknown node '" + rr.node + "'");
+    int32_t ni = nit->second;
+    i32p(r_node)[i] = ni;
+    b8p(r_valid)[i] = true;
+    for (int64_t r = 0; r < R; ++r) {
+      double dflt = (resources[r] == "pods") ? 1.0 : 0.0;
+      double rv = res_has(rr.requests, resources[r])
+                      ? res_get(rr.requests, resources[r], 0.0)
+                      : dflt;
+      f32p(r_req)[i * R + r] = float(rv);
+      if (!rr.exclude_from_used)
+        f32p(node_used)[int64_t(ni) * R + r] += float(rv);
+    }
+    f32p(r_prio)[i] = float(rr.priority);
+    f32p(r_slack)[i] = float(rr.slack);
+    std::vector<Lab> sl = rr.labels;
+    std::sort(sl.begin(), sl.end(), [](const Lab& a, const Lab& b) {
+      return a.k < b.k || (a.k == b.k && a.v < b.v);
+    });
+    for (size_t j = 0; j < sl.size(); ++j) {
+      i32p(r_lk)[i * bk.pod_labels + j] = keys.get(sl[j].k);
+      i32p(r_lp)[i * bk.pod_labels + j] = pairs.get(sl[j].k + '\x1f' + sl[j].v);
+    }
+    for (size_t j = 0; j < run_anti[i].size(); ++j)
+      i32p(r_anti)[i * bk.affinity_terms + j] = run_anti[i][j];
+    i32p(r_ns)[i] = ns_ids.get(rr.ns);
+    if (!rr.pdb_group.empty())
+      i32p(r_pdb)[i] = pdb_idx[std::make_pair(rr.ns, rr.pdb_group)];
+  }
+
+  dset(out, "node_allocatable", node_alloc);
+  dset(out, "node_used", node_used);
+  dset(out, "node_label_pairs", node_lp);
+  dset(out, "node_label_keys", node_lk);
+  dset(out, "node_label_nums", node_ln);
+  dset(out, "node_taint_ids", node_t);
+  dset(out, "node_domain", node_dom);
+  dset(out, "node_valid", node_valid);
+
+  dset(out, "pod_requests", p_req);
+  dset(out, "pod_base_priority", p_prio);
+  dset(out, "pod_slo_target", p_slo);
+  dset(out, "pod_observed_avail", p_obs);
+  dset(out, "pod_tolerated", p_tol);
+  dset(out, "pod_label_pairs", p_lp);
+  dset(out, "pod_label_keys", p_lk);
+  dset(out, "pod_req_term_atoms", p_rta);
+  dset(out, "pod_req_term_valid", p_rtv);
+  dset(out, "pod_pref_term_atoms", p_pta);
+  dset(out, "pod_pref_term_valid", p_ptv);
+  dset(out, "pod_pref_weight", p_pw);
+  dset(out, "pod_ts_key", p_tsk);
+  dset(out, "pod_ts_max_skew", p_tsm);
+  dset(out, "pod_ts_when", p_tsw);
+  dset(out, "pod_ts_sel_atoms", p_tsa);
+  dset(out, "pod_ts_sig", p_tss);
+  dset(out, "pod_ts_valid", p_tsv);
+  dset(out, "pod_ia_key", p_iak);
+  dset(out, "pod_ia_sel_atoms", p_iaa);
+  dset(out, "pod_ia_sig", p_ias);
+  dset(out, "pod_ia_anti", p_ian);
+  dset(out, "pod_ia_required", p_iar);
+  dset(out, "pod_ia_weight", p_iaw);
+  dset(out, "pod_ia_valid", p_iav);
+  dset(out, "pod_group", p_group);
+  dset(out, "pod_namespace", p_ns);
+  dset(out, "pod_valid", p_valid);
+
+  dset(out, "run_node_idx", r_node);
+  dset(out, "run_requests", r_req);
+  dset(out, "run_priority", r_prio);
+  dset(out, "run_slack", r_slack);
+  dset(out, "run_label_pairs", r_lp);
+  dset(out, "run_label_keys", r_lk);
+  dset(out, "run_anti_sig", r_anti);
+  dset(out, "run_namespace", r_ns);
+  dset(out, "run_pdb_group", r_pdb);
+  dset(out, "run_valid", r_valid);
+
+  // ---- Meta. ----
+  auto set_names = [&](const char* key, auto&& get_name, int64_t count) {
+    PyObject* lst = PyList_New(count);
+    for (int64_t i = 0; i < count; ++i) {
+      std::string nm = get_name(i);
+      PyList_SET_ITEM(lst, i, PyUnicode_FromStringAndSize(nm.data(), nm.size()));
+    }
+    dset(out, key, lst);
+  };
+  set_names("node_names", [&](int64_t i) { return nodes[i].name; }, n_nodes);
+  set_names("pod_names", [&](int64_t i) { return pods[i].name; }, n_pods);
+  set_names("running_names",
+            [&](int64_t i) {
+              return running[i].name.empty()
+                         ? "running-" + std::to_string(i)
+                         : running[i].name;
+            },
+            n_running);
+  set_names("group_names", [&](int64_t i) { return group_list[i]; },
+            int64_t(group_list.size()));
+  dset(out, "n_nodes", PyLong_FromLongLong(n_nodes));
+  dset(out, "n_pods", PyLong_FromLongLong(n_pods));
+  dset(out, "n_running", PyLong_FromLongLong(n_running));
+
+  PyObject* bout = PyDict_New();
+  auto bset = [&](const char* k, int64_t v) {
+    PyObject* o = PyLong_FromLongLong(v);
+    PyDict_SetItemString(bout, k, o);
+    Py_DECREF(o);
+  };
+  bset("pods", bk.pods);
+  bset("nodes", bk.nodes);
+  bset("running_pods", bk.running_pods);
+  bset("node_labels", bk.node_labels);
+  bset("pod_labels", bk.pod_labels);
+  bset("node_taints", bk.node_taints);
+  bset("atoms", bk.atoms);
+  bset("atom_values", bk.atom_values);
+  bset("terms", bk.terms);
+  bset("term_atoms", bk.term_atoms);
+  bset("pref_terms", bk.pref_terms);
+  bset("topo_keys", bk.topo_keys);
+  bset("spread_constraints", bk.spread_constraints);
+  bset("affinity_terms", bk.affinity_terms);
+  bset("pod_groups", bk.pod_groups);
+  bset("taint_vocab", bk.taint_vocab);
+  bset("signatures", bk.signatures);
+  bset("sig_namespaces", bk.sig_namespaces);
+  bset("pdb_groups", bk.pdb_groups);
+  dset(out, "buckets", bout);
+
+  return out;
+}
+
+PyObject* py_decode(PyObject* self, PyObject* args) {
+  Py_buffer buf;
+  PyObject* resources;
+  PyObject* buckets;
+  if (!PyArg_ParseTuple(args, "y*OO", &buf, &resources, &buckets))
+    return nullptr;
+  PyObject* out = nullptr;
+  try {
+    out = decode_impl(static_cast<const uint8_t*>(buf.buf), buf.len,
+                      resources, buckets);
+  } catch (const DecodeError& e) {
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, e.msg.c_str());
+    return nullptr;
+  } catch (const std::exception& e) {
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_RuntimeError, e.what());
+    return nullptr;
+  }
+  PyBuffer_Release(&buf);
+  return out;
+}
+
+PyMethodDef methods[] = {
+    {"decode_snapshot", py_decode, METH_VARARGS,
+     "decode_snapshot(wire_bytes, resources, buckets_or_None) -> dict"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+struct PyModuleDef moddef = {
+    PyModuleDef_HEAD_INIT, "_fastdecode",
+    "Native wire decoder for tpusched ClusterSnapshot protos", -1, methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__fastdecode(void) {
+  import_array();
+  return PyModule_Create(&moddef);
+}

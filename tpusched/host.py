@@ -26,7 +26,7 @@ import numpy as np
 
 from tpusched.config import Buckets, EngineConfig
 from tpusched.engine import Engine
-from tpusched.rpc.codec import snapshot_from_proto, snapshot_to_proto
+from tpusched.rpc.codec import decode_snapshot, snapshot_to_proto
 
 
 class Conflict(Exception):
@@ -217,7 +217,7 @@ class HostScheduler:
             evicted = list(resp.evicted)
             solve_s = time.perf_counter() - t0
         else:
-            snap, meta = snapshot_from_proto(msg, self.config, self.buckets)
+            snap, meta = decode_snapshot(msg, self.config, self.buckets)
             res = self._engine.solve(snap)
             assignments = [
                 (meta.pod_names[i], meta.node_names[int(n)])

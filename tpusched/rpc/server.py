@@ -26,7 +26,7 @@ import grpc
 from tpusched.config import Buckets, EngineConfig
 from tpusched.engine import Engine
 from tpusched.rpc import tpusched_pb2 as pb
-from tpusched.rpc.codec import SnapshotStore, delta_safe, snapshot_from_proto
+from tpusched.rpc.codec import SnapshotStore, decode_snapshot, delta_safe
 
 SERVICE = "tpusched.TpuScheduler"
 
@@ -163,7 +163,7 @@ class SchedulerService:
 
     def _decode(self, snapshot_msg):
         t0 = time.perf_counter()
-        snap, meta = snapshot_from_proto(
+        snap, meta = decode_snapshot(
             snapshot_msg, self.config, self.buckets
         )
         return snap, meta, time.perf_counter() - t0

@@ -446,12 +446,14 @@ class SnapshotBuilder:
 
         def ns_scope_of(namespaces: Sequence[str], own_ns: str):
             """Resolve an affinity term's namespace list against the
-            owning pod's namespace (upstream: empty = own namespace)."""
+            owning pod's namespace (upstream: empty = own namespace).
+            Iterate names in sorted order so id ASSIGNMENT order is
+            deterministic (set iteration is hash-randomized)."""
             if not namespaces:
                 return (nsid(own_ns),)
             if "*" in namespaces:
                 return "*"
-            return tuple(sorted(nsid(x) for x in set(namespaces)))
+            return tuple(sorted(nsid(x) for x in sorted(set(namespaces))))
 
         # First pass: intern everything referenced by pods so vocab sizes
         # are known before arrays are allocated.
