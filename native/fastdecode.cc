@@ -477,10 +477,14 @@ double try_float(const std::string& s) {
 double strict_float(const std::string& s) {
   double v = try_float(s);
   if (std::isnan(v)) {
+    // Python float() allows SURROUNDING whitespace only; interior
+    // whitespace ("n an") must keep failing.
+    size_t a = s.find_first_not_of(" \t\r\n\f\v");
+    size_t b = s.find_last_not_of(" \t\r\n\f\v");
     std::string low;
-    for (char c : s)
-      if (!std::isspace((unsigned char)c))
-        low.push_back(char(std::tolower((unsigned char)c)));
+    if (a != std::string::npos)
+      for (size_t i = a; i <= b; ++i)
+        low.push_back(char(std::tolower((unsigned char)s[i])));
     if (!(low == "nan" || low == "+nan" || low == "-nan"))
       fail("could not convert string to float: '" + s + "'");
   }
@@ -732,9 +736,10 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
 
   // ---- Interning tables (insertion-ordered, matching build()). ----
   Interner keys, ns_ids;
-  Interner pairs;   // key: k + '\x1f' + v
-  Interner taints;  // key: k + '\x1f' + v + '\x1f' + e
+  Interner pairs;   // key: length-prefixed (k, v)
+  Interner taints;  // key: length-prefixed (k, v, e)
   std::vector<std::string> taint_effects_by_id;  // effect per taint id
+  std::vector<TaintR> taint_list;                // components per taint id
   Interner atoms_tab;  // serialized atom -> id
   std::vector<Atom> atoms;
   Interner sigs_tab;  // serialized sig -> id
@@ -742,17 +747,30 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
   std::vector<std::string> topo_keys;
   std::vector<std::unordered_map<std::string, int32_t>> domain_ids;
 
+  // Length-prefixed joining: component strings may contain ANY byte, so
+  // a plain separator would let ("a\x1fb","c") and ("a","b\x1fc")
+  // collide into one id (the Python path keys tuples, never joins).
+  auto join2 = [](const std::string& a, const std::string& b) {
+    uint32_t la = uint32_t(a.size());
+    std::string key;
+    key.reserve(4 + a.size() + b.size());
+    key.append(reinterpret_cast<const char*>(&la), 4);
+    key += a;
+    key += b;
+    return key;
+  };
   auto kid = [&](const std::string& k) { return keys.id(k); };
   auto pid = [&](const std::string& k, const std::string& v) {
-    return pairs.id(k + '\x1f' + v);
+    return pairs.id(join2(k, v));
   };
   auto tid = [&](const TaintR& t) {
-    std::string key = t.k + '\x1f' + t.v + '\x1f' + t.e;
+    std::string key = join2(t.k, join2(t.v, t.e));
     int before = int(taints.size());
     int32_t id = taints.id(key);
     if (int(taints.size()) > before) {
       effect_code(t.e);  // validate
       taint_effects_by_id.push_back(t.e);
+      taint_list.push_back(t);
     }
     return id;
   };
@@ -1006,6 +1024,23 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
 
   const int64_t P = bk.pods, N = bk.nodes, M = bk.running_pods;
 
+  // Pre-validate everything that could otherwise fail() AFTER numpy
+  // allocation starts (a throw between array creation and dict
+  // insertion would leak the allocated arrays): running-pod node names
+  // and toleration operators. All other validations (operators, taint
+  // effects, Gt/Lt literals) already ran during interning above.
+  {
+    std::unordered_map<std::string, int32_t> names;
+    for (int64_t i = 0; i < n_nodes; ++i) names.emplace(nodes[i].name, 1);
+    for (const auto& rr : running)
+      if (!names.count(rr.node))
+        fail("running pod on unknown node '" + rr.node + "'");
+    for (const auto& p : pods)
+      for (const auto& tol : p.tolerations)
+        if (tol.op != "Exists" && tol.op != "Equal")
+          fail("bad toleration operator '" + tol.op + "'");
+  }
+
   PyObject* out = PyDict_New();
   if (!out) fail("dict alloc failed");
 
@@ -1067,13 +1102,13 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
     });
     for (size_t j = 0; j < sl.size(); ++j) {
       i32p(node_lk)[i * bk.node_labels + j] = keys.get(sl[j].k);
-      i32p(node_lp)[i * bk.node_labels + j] = pairs.get(sl[j].k + '\x1f' + sl[j].v);
+      i32p(node_lp)[i * bk.node_labels + j] = pairs.get(join2(sl[j].k, sl[j].v));
       f32p(node_ln)[i * bk.node_labels + j] = float(try_float(sl[j].v));
     }
     for (size_t j = 0; j < n.taints.size(); ++j) {
       const TaintR& t = n.taints[j];
       i32p(node_t)[i * bk.node_taints + j] =
-          taints.get(t.k + '\x1f' + t.v + '\x1f' + t.e);
+          taints.get(join2(t.k, join2(t.v, t.e)));
     }
     for (size_t ti = 0; ti < topo_keys.size(); ++ti) {
       // if topo key in node labels (dict semantics: last value).
@@ -1220,19 +1255,14 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
     });
     for (size_t j = 0; j < sl.size(); ++j) {
       i32p(p_lk)[i * bk.pod_labels + j] = keys.get(sl[j].k);
-      i32p(p_lp)[i * bk.pod_labels + j] = pairs.get(sl[j].k + '\x1f' + sl[j].v);
+      i32p(p_lp)[i * bk.pod_labels + j] = pairs.get(join2(sl[j].k, sl[j].v));
     }
     // Tolerations vs the whole taint vocab.
-    for (size_t t = 0; t < taints.order.size(); ++t) {
-      const std::string& ser = taints.order[t];
-      size_t c1 = ser.find('\x1f');
-      size_t c2 = ser.find('\x1f', c1 + 1);
-      std::string tk = ser.substr(0, c1);
-      std::string tv = ser.substr(c1 + 1, c2 - c1 - 1);
-      std::string te = ser.substr(c2 + 1);
+    for (size_t t = 0; t < taint_list.size(); ++t) {
+      const TaintR& tt = taint_list[t];
       bool any = false;
       for (const auto& tol : p.tolerations)
-        if (tolerates(tol, tk, tv, te)) {
+        if (tolerates(tol, tt.k, tt.v, tt.e)) {
           any = true;
           break;
         }
@@ -1339,7 +1369,7 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
     });
     for (size_t j = 0; j < sl.size(); ++j) {
       i32p(r_lk)[i * bk.pod_labels + j] = keys.get(sl[j].k);
-      i32p(r_lp)[i * bk.pod_labels + j] = pairs.get(sl[j].k + '\x1f' + sl[j].v);
+      i32p(r_lp)[i * bk.pod_labels + j] = pairs.get(join2(sl[j].k, sl[j].v));
     }
     for (size_t j = 0; j < run_anti[i].size(); ++j)
       i32p(r_anti)[i * bk.affinity_terms + j] = run_anti[i][j];

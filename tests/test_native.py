@@ -228,6 +228,40 @@ def test_unnamed_running_pods():
     _roundtrip(msg)
 
 
+def test_separator_bytes_in_labels_do_not_collide():
+    """Interner keys are length-prefixed: label components containing
+    exotic bytes (e.g. 0x1f) must stay distinct pairs, exactly as the
+    Python path's tuple-keyed dicts keep them."""
+    nodes = [dict(name="n0", allocatable={"cpu": 4000.0},
+                  labels={"a\x1fb": "c", "a": "b\x1fc"})]
+    pods = [dict(name="p", requests={"cpu": 100.0}, observed_avail=1.0,
+                 labels={"x\x1f": "y", "x": "\x1fy"})]
+    msg = snapshot_to_proto(nodes, pods, [])
+    _roundtrip(msg)
+
+
+def test_gtlt_whitespace_nan_literals_match_python():
+    """float() parity corners: surrounding whitespace and any-case nan
+    are legal Gt/Lt literals; interior whitespace is not (both paths
+    must reject it)."""
+    from tpusched.snapshot import MatchExpression, NodeSelectorTerm
+
+    def pod_with(value):
+        return [dict(name="p", requests={"cpu": 100.0}, observed_avail=1.0,
+                     required_terms=[NodeSelectorTerm(
+                         (MatchExpression("tier", "Gt", (value,)),))])]
+
+    nodes = [dict(name="n0", allocatable={"cpu": 4000.0},
+                  labels={"tier": "5"})]
+    for ok_value in (" 10 ", "nAn", "1_0"):
+        _roundtrip(snapshot_to_proto(nodes, pod_with(ok_value), []))
+    bad = snapshot_to_proto(nodes, pod_with("n an"), [])
+    with pytest.raises(Exception):
+        snapshot_from_proto(bad, EngineConfig())
+    with pytest.raises(Exception):
+        native.decode_snapshot_bytes(bad.SerializeToString(), EngineConfig())
+
+
 def test_unknown_node_raises():
     nodes = [dict(name="n0", allocatable={"cpu": 4000.0})]
     running = [dict(name="r", node="ghost", requests={"cpu": 100.0})]

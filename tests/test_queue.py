@@ -1,0 +1,153 @@
+"""Host queue semantics (SURVEY.md §1.2 L5: activeQ/backoffQ) and the
+sidecar's per-pod placement audit records (SURVEY.md §5)."""
+
+import io
+import json
+
+import numpy as np
+
+from tpusched import EngineConfig
+from tpusched.host import FakeApiServer, HostScheduler
+from tpusched.rpc import tpusched_pb2 as pb
+from tpusched.rpc.codec import snapshot_to_proto
+from tpusched.rpc.server import SchedulerService
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _small_cluster(api, unschedulable=True):
+    api.add_node("n0", allocatable={"cpu": 1000.0, "memory": float(4 << 30)})
+    api.add_pod("fits", requests={"cpu": 500.0, "memory": float(1 << 30)})
+    if unschedulable:
+        api.add_pod("huge", requests={"cpu": 99999.0, "memory": float(1 << 30)})
+
+
+def test_unschedulable_pod_backs_off_and_retries():
+    api = FakeApiServer()
+    _small_cluster(api)
+    clock = FakeClock()
+    host = HostScheduler(api, EngineConfig(mode="fast"), clock=clock,
+                         backoff_initial=1.0, backoff_max=10.0)
+    stats = host.cycle()
+    assert stats.placed == 1            # "fits" binds, "huge" does not
+    assert host.backlogged() == 1
+    # Within the backoff window the active queue is empty.
+    clock.t = 0.5
+    assert host.cycle() is None
+    # Window expires -> the pod is retried (still unschedulable, so its
+    # backoff doubles: attempts 1 -> 2).
+    clock.t = 1.5
+    stats = host.cycle()
+    assert stats is not None and stats.batch_size == 1 and stats.placed == 0
+    retry_at, attempts = host._backoff["pod\x00huge"]
+    assert attempts == 2
+    assert retry_at == clock.t + 2.0    # 1.0 * 2^1
+
+
+def test_backoff_caps():
+    api = FakeApiServer()
+    _small_cluster(api)
+    clock = FakeClock()
+    host = HostScheduler(api, EngineConfig(mode="fast"), clock=clock,
+                         backoff_initial=1.0, backoff_max=4.0)
+    for _ in range(6):
+        host.cycle()
+        clock.t = host._backoff["pod\x00huge"][0]  # jump to retry time
+    retry_at, attempts = host._backoff["pod\x00huge"]
+    assert retry_at - clock.t <= 4.0 + 1e-9, "delay must cap at backoff_max"
+
+
+def test_success_clears_backoff():
+    api = FakeApiServer()
+    api.add_node("n0", allocatable={"cpu": 1000.0, "memory": float(4 << 30)})
+    api.add_pod("p", requests={"cpu": 2000.0, "memory": float(1 << 30)})
+    clock = FakeClock()
+    host = HostScheduler(api, EngineConfig(mode="fast"), clock=clock)
+    host.cycle()
+    assert "pod\x00p" in host._backoff
+    # Capacity appears (new node); after the window the pod places and
+    # leaves the backoff book.
+    api.add_node("n1", allocatable={"cpu": 4000.0, "memory": float(4 << 30)})
+    clock.t = 10.0
+    stats = host.cycle()
+    assert stats.placed == 1
+    assert "pod\x00p" not in host._backoff
+
+
+def test_run_until_idle_stops_with_backlog():
+    api = FakeApiServer()
+    _small_cluster(api)
+    clock = FakeClock()
+    host = HostScheduler(api, EngineConfig(mode="fast"), clock=clock)
+    n = host.run_until_idle()
+    assert n <= 3
+    assert host.backlogged() == 1
+    assert api.bind_count == 1
+
+
+def test_gang_members_share_one_backoff_window():
+    """Per-pod backoff would desynchronize gang members' retry windows
+    and starve the all-or-nothing gate; the whole gang must back off
+    and retry as ONE unit."""
+    api = FakeApiServer()
+    api.add_node("n0", allocatable={"cpu": 1000.0, "memory": float(64 << 30)})
+    for i in range(3):
+        api.add_pod(f"g{i}", requests={"cpu": 800.0, "memory": float(1 << 28)},
+                    pod_group="gang", pod_group_min_member=3)
+    clock = FakeClock()
+    host = HostScheduler(api, EngineConfig(mode="fast"), clock=clock,
+                         backoff_initial=1.0)
+    host.cycle()
+    assert api.bind_count == 0
+    assert list(host._backoff) == ["gang\x00gang"]
+    # Capacity appears; the whole gang returns together and places.
+    for i in range(2):
+        api.add_node(f"extra-{i}",
+                     allocatable={"cpu": 1000.0, "memory": float(64 << 30)})
+    clock.t = 2.0
+    stats = host.cycle()
+    assert stats.batch_size == 3 and stats.placed == 3
+    assert host._backoff == {}
+
+
+def test_backoff_pruned_for_vanished_pods():
+    api = FakeApiServer()
+    _small_cluster(api)
+    clock = FakeClock()
+    host = HostScheduler(api, EngineConfig(mode="fast"), clock=clock)
+    host.cycle()
+    assert host._backoff
+    api.delete_pod("huge")
+    clock.t = 100.0
+    host.cycle()
+    assert host._backoff == {}, "entries for deleted pods must be pruned"
+
+
+def test_audit_records():
+    """audit_stream gets one placement record per pod and one per
+    eviction, matching the response."""
+    svc = SchedulerService(
+        EngineConfig(mode="fast", preemption=True),
+        log_stream=io.StringIO(), audit_stream=io.StringIO(),
+    )
+    nodes = [dict(name="n0", allocatable={"cpu": 4000.0, "memory": float(64 << 30)})]
+    running = [dict(name="victim", node="n0",
+                    requests={"cpu": 4000.0, "memory": float(1 << 30)},
+                    priority=1.0, slack=0.4)]
+    pods = [dict(name="p", requests={"cpu": 2000.0, "memory": float(1 << 30)},
+                 priority=500.0, observed_avail=1.0)]
+    req = pb.AssignRequest(snapshot=snapshot_to_proto(nodes, pods, running))
+    resp = svc.Assign(req, None)
+    records = [json.loads(l) for l in svc._audit.getvalue().splitlines()]
+    placements = [r for r in records if r["kind"] == "placement"]
+    evictions = [r for r in records if r["kind"] == "eviction"]
+    assert len(placements) == 1
+    assert placements[0]["pod"] == "p" and placements[0]["node"] == "n0"
+    assert placements[0]["snapshot_id"] == resp.snapshot_id
+    assert [e["pod"] for e in evictions] == ["victim"]

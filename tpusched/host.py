@@ -138,6 +138,9 @@ class HostScheduler:
         batch_size: int = 1024,
         buckets: Buckets | None = None,
         engine: Engine | None = None,
+        backoff_initial: float = 1.0,
+        backoff_max: float = 10.0,
+        clock=None,
     ):
         self.api = api
         self.config = config or EngineConfig()
@@ -152,6 +155,24 @@ class HostScheduler:
         else:
             self._engine = engine if engine is not None else Engine(self.config)
         self.cycles: list[CycleStats] = []
+        # Queue semantics (SURVEY.md §1.2 L5: activeQ/backoffQ): a pod
+        # that fails to place enters backoff with exponentially growing
+        # delay (upstream kube-scheduler: initial 1s, cap 10s) and is
+        # excluded from batches until its retry time — so one
+        # unschedulable pod cannot spin the cycle loop. Success clears
+        # its backoff state. `clock` is injectable for tests.
+        # GANG members share ONE backoff entry (keyed by the group):
+        # per-pod windows would desynchronize and the all-or-nothing
+        # gate could then never see the whole group in one batch.
+        self.backoff_initial = backoff_initial
+        self.backoff_max = backoff_max
+        self._clock = clock if clock is not None else time.monotonic
+        self._backoff: dict[str, tuple[float, int]] = {}  # key -> (retry_at, attempts)
+
+    @staticmethod
+    def _backoff_key(p: dict) -> str:
+        g = p.get("pod_group")
+        return f"gang\x00{g}" if g else f"pod\x00{p['name']}"
 
     # -- snapshot assembly --------------------------------------------------
 
@@ -200,9 +221,26 @@ class HostScheduler:
 
     # -- one cycle ----------------------------------------------------------
 
+    def backlogged(self) -> int:
+        """Pods currently waiting out a backoff window."""
+        now = self._clock()
+        return sum(1 for t, _ in self._backoff.values() if t > now)
+
     def cycle(self) -> CycleStats | None:
-        """One batched scheduling cycle; None when nothing is pending."""
-        pending = self.api.pending_pods()
+        """One batched scheduling cycle; None when nothing is ACTIVE
+        (pods in their backoff window don't count — they re-enter the
+        active queue when it expires)."""
+        now = self._clock()
+        all_pending = self.api.pending_pods()
+        # Prune backoff state for pods that vanished (deleted, or bound
+        # by another actor) so the book can't grow without bound.
+        live_keys = {self._backoff_key(p) for p in all_pending}
+        for k in [k for k in self._backoff if k not in live_keys]:
+            del self._backoff[k]
+        pending = [
+            p for p in all_pending
+            if self._backoff.get(self._backoff_key(p), (0.0, 0))[0] <= now
+        ]
         if not pending:
             return None
         pending = pending[: self.batch_size]
@@ -239,14 +277,36 @@ class HostScheduler:
         for name in evicted:
             self.api.delete_pod(name)
         placed = 0
+        bound_names = set()
         for pod_name, node_name in assignments:
             try:
                 self.api.bind(pod_name, node_name)
                 placed += 1
+                bound_names.add(pod_name)
             except Conflict:
                 # Another actor bound/removed it; safe to skip — the
                 # next cycle re-reads truth (idempotent-bind story).
                 continue
+        # Queue maintenance: placed pods (or gangs with any member
+        # placed) leave the backoff book; unplaced ones back off
+        # exponentially — one shared entry per gang.
+        now = self._clock()
+        failed_keys: dict[str, bool] = {}
+        for p in pending:
+            key = self._backoff_key(p)
+            if p["name"] in bound_names:
+                failed_keys[key] = False
+            else:
+                failed_keys.setdefault(key, True)
+        for key, fail in failed_keys.items():
+            if not fail:
+                self._backoff.pop(key, None)
+                continue
+            _, attempts = self._backoff.get(key, (0.0, 0))
+            delay = min(
+                self.backoff_initial * (2 ** attempts), self.backoff_max
+            )
+            self._backoff[key] = (now + delay, attempts + 1)
         bind_s = time.perf_counter() - t0
         stats = CycleStats(
             batch_size=len(pending), placed=placed, evicted=len(evicted),
@@ -256,16 +316,18 @@ class HostScheduler:
         return stats
 
     def run_until_idle(self, max_cycles: int = 100) -> int:
-        """Cycle until no pending pods remain or no progress is made.
-        Returns the number of cycles executed."""
+        """Cycle until the ACTIVE queue drains (unschedulable pods land
+        in backoff and stop participating — a live host would keep
+        polling and retry them as windows expire). Returns the number of
+        cycles executed."""
         n = 0
         while n < max_cycles:
             stats = self.cycle()
             n += 1 if stats else 0
             if stats is None:
                 break
-            if stats.placed == 0 and stats.evicted == 0:
-                break  # unschedulable leftovers; a real host would back off
+            if stats.placed == 0 and stats.evicted == 0 and self.backlogged():
+                break  # everything still pending is in backoff
         return n
 
 

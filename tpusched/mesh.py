@@ -24,6 +24,34 @@ POD_AXIS = "p"
 NODE_AXIS = "n"
 
 
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host entry (SURVEY.md §5 'Distributed communication
+    backend'): initialize jax.distributed so jax.devices() spans every
+    host's chips — ICI within a slice, DCN across slices — then build
+    meshes as usual; the same solve code runs SPMD with XLA inserting
+    the cross-host collectives. With no arguments, relies on the TPU
+    environment's auto-detection (GKE/Borg metadata); arguments mirror
+    jax.distributed.initialize for manual clusters.
+
+    The reference's analogue is client-go's watch/bind HTTP plumbing —
+    its only 'backend' — while compute scaling here rides XLA
+    collectives; gRPC stays at the host boundary (SURVEY.md §2.3)."""
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
 def make_mesh(shape: tuple[int, int] | None = None, devices=None) -> Mesh:
     """Mesh of shape (p, n). Default: all devices on the 'p' axis (pod
     sharding scales first; node-axis sharding pays collective cost on

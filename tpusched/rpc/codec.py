@@ -54,7 +54,17 @@ def decode_snapshot(
                     msg.SerializeToString(), config, buckets
                 )
             except Exception:
-                pass
+                # The fallback must be LOUD: a native decode failure is
+                # either a contract bug (native.py calls it "a bug in
+                # this file") or a permanent ~8x decode slowdown.
+                import logging
+                import traceback
+
+                logging.getLogger("tpusched.native").warning(
+                    "native decode failed; falling back to the Python "
+                    "decoder for this request:\n%s",
+                    traceback.format_exc(limit=3),
+                )
     return snapshot_from_proto(msg, config, buckets)
 
 

@@ -109,7 +109,13 @@ class SchedulerService:
         config: EngineConfig | None = None,
         buckets: Buckets | None = None,
         log_stream=None,
+        audit_stream=None,
     ):
+        """audit_stream: optional file-like; when set, every Assign
+        emits one JSON record PER POD (pod, node, score, commit_key —
+        the upstream per-pod placement-decision audit, SURVEY.md §5
+        'Metrics/observability') plus one per eviction. Off by default:
+        at 10k pods a full audit is ~1 MB per batch."""
         self.config = config or EngineConfig()
         # Floor buckets pin compile shapes across requests (a feature
         # first appearing mid-serving would otherwise trigger a full
@@ -118,8 +124,10 @@ class SchedulerService:
         self.metrics = _Metrics()
         self._engine = Engine(self.config)
         self._log = log_stream if log_stream is not None else sys.stderr
+        self._audit = audit_stream
         import threading
 
+        self._audit_lock = threading.Lock()  # handlers run on a pool
         self._store_lock = threading.Lock()
         self._stores: dict[str, SnapshotStore] = {}  # LRU by insertion
         self._next_store = 0
@@ -221,6 +229,25 @@ class SchedulerService:
                 if m < len(running_names):
                     resp.evicted.append(running_names[m])
                     n_evicted += 1
+        if self._audit is not None:
+            ts = time.time()
+            lines = []
+            for a in resp.assignments:
+                lines.append(json.dumps(dict(
+                    ts=ts, kind="placement", pod=a.pod,
+                    node=a.node or None,
+                    score=round(float(a.score), 4),
+                    commit_key=a.commit_key, snapshot_id=sid,
+                )))
+            for name in resp.evicted:
+                lines.append(json.dumps(dict(
+                    ts=ts, kind="eviction", pod=name, snapshot_id=sid,
+                )))
+            # One write per batch under a lock: concurrent handlers must
+            # not interleave partial lines into the audit log.
+            with self._audit_lock:
+                self._audit.write("\n".join(lines) + "\n")
+                self._audit.flush()
         resp.rounds = res.rounds
         resp.solve_seconds = res.solve_seconds
         self._log_batch("Assign", meta, decode_s, res.solve_seconds,
@@ -246,10 +273,12 @@ def make_server(
     buckets: Buckets | None = None,
     max_workers: int = 4,
     log_stream=None,
+    audit_stream=None,
 ):
     """Build (grpc.Server, bound_port, service). Unlimited message size:
     a 10k-pod snapshot exceeds the 4 MB default."""
-    svc = SchedulerService(config, buckets, log_stream=log_stream)
+    svc = SchedulerService(config, buckets, log_stream=log_stream,
+                           audit_stream=audit_stream)
 
     def handler(fn, req_cls):
         return grpc.unary_unary_rpc_method_handler(
@@ -278,9 +307,11 @@ def make_server(
     return server, port, svc
 
 
-def serve(address: str = "127.0.0.1:50051", config: EngineConfig | None = None):
+def serve(address: str = "127.0.0.1:50051", config: EngineConfig | None = None,
+          audit_path: str | None = None):
     """Blocking entry point: python -m tpusched.rpc.server"""
-    server, port, _ = make_server(address, config)
+    audit = open(audit_path, "a") if audit_path else None
+    server, port, _ = make_server(address, config, audit_stream=audit)
     server.start()
     print(f"tpusched sidecar listening on port {port}", file=sys.stderr)
     server.wait_for_termination()
@@ -292,10 +323,12 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--address", default="127.0.0.1:50051")
     ap.add_argument("--config", default=None, help="EngineConfig YAML path")
+    ap.add_argument("--audit", default=None,
+                    help="append per-pod placement audit JSONL to this file")
     args = ap.parse_args()
     cfg = None
     if args.config:
         from tpusched.config import load_config
 
         cfg = load_config(args.config)
-    serve(args.address, cfg)
+    serve(args.address, cfg, audit_path=args.audit)
