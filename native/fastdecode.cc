@@ -738,8 +738,7 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
   Interner keys, ns_ids;
   Interner pairs;   // key: length-prefixed (k, v)
   Interner taints;  // key: length-prefixed (k, v, e)
-  std::vector<std::string> taint_effects_by_id;  // effect per taint id
-  std::vector<TaintR> taint_list;                // components per taint id
+  std::vector<TaintR> taint_list;  // components per taint id
   Interner atoms_tab;  // serialized atom -> id
   std::vector<Atom> atoms;
   Interner sigs_tab;  // serialized sig -> id
@@ -769,7 +768,6 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
     int32_t id = taints.id(key);
     if (int(taints.size()) > before) {
       effect_code(t.e);  // validate
-      taint_effects_by_id.push_back(t.e);
       taint_list.push_back(t);
     }
     return id;
@@ -1035,10 +1033,13 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
     for (const auto& rr : running)
       if (!names.count(rr.node))
         fail("running pod on unknown node '" + rr.node + "'");
-    for (const auto& p : pods)
-      for (const auto& tol : p.tolerations)
-        if (tol.op != "Exists" && tol.op != "Equal")
-          fail("bad toleration operator '" + tol.op + "'");
+    // Mirror Python: _tolerates (and its operator validation) only runs
+    // per taint-vocab entry, so an empty vocab never validates ops.
+    if (!taint_list.empty())
+      for (const auto& p : pods)
+        for (const auto& tol : p.tolerations)
+          if (tol.op != "Exists" && tol.op != "Equal")
+            fail("bad toleration operator '" + tol.op + "'");
   }
 
   PyObject* out = PyDict_New();
@@ -1134,8 +1135,8 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
   {
     npy_intp dVT[1] = {(npy_intp)bk.taint_vocab};
     PyObject* te = np_zeros(1, dVT, NPY_INT8);
-    for (size_t t = 0; t < taint_effects_by_id.size(); ++t)
-      i8p(te)[t] = int8_t(effect_code(taint_effects_by_id[t]));
+    for (size_t t = 0; t < taint_list.size(); ++t)
+      i8p(te)[t] = int8_t(effect_code(taint_list[t].e));
     dset(out, "taint_effect", te);
   }
 

@@ -304,9 +304,14 @@ class HostScheduler:
                 continue
             _, attempts = self._backoff.get(key, (0.0, 0))
             delay = min(
-                self.backoff_initial * (2 ** attempts), self.backoff_max
+                self.backoff_initial * (2 ** min(attempts, 30)),
+                self.backoff_max,
             )
-            self._backoff[key] = (now + delay, attempts + 1)
+            # Stop counting once the delay is capped: 2**attempts would
+            # overflow float for a pod that stays unschedulable for long.
+            if delay < self.backoff_max:
+                attempts += 1
+            self._backoff[key] = (now + delay, attempts)
         bind_s = time.perf_counter() - t0
         stats = CycleStats(
             batch_size=len(pending), placed=placed, evicted=len(evicted),

@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from tpusched.config import EngineConfig
+from tpusched.config import DO_NOT_SCHEDULE, EngineConfig
 from tpusched.kernels import filter as kfilter
 from tpusched.kernels import pairwise as kpair
 from tpusched.kernels import preempt as kpreempt
@@ -555,44 +555,139 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             snap, pair_st, static.sig_match, choice, commit
         )
 
-        # Validate committed pairwise pods against end-of-round counts
-        # (self-excluded); roll back violators and mark conservative.
-        # Iterated to a fixpoint: a revert can strip the match that
-        # satisfied another same-round pod's positive affinity, so each
-        # pass re-checks the still-kept pods until no new violations
-        # (each pass reverts >= 1 pod, so it terminates).
+        # Validate committed pairwise pods against end-of-round counts;
+        # roll back violators. Iterated to a fixpoint: a revert can
+        # strip the match that satisfied another same-round pod's
+        # positive affinity, so each pass re-checks the still-kept pods
+        # until no new violations (each pass reverts >= 1 pod, so it
+        # terminates). Two violation classes with different policies:
+        #   * AFFINITY (required inter-pod / symmetric anti): revert and
+        #     mark conservative — these interactions are adversarial and
+        #     need the ordered one-per-cluster retry.
+        #   * DoNotSchedule SPREAD: revert only the EXCESS members per
+        #     (sig, domain) — keep the highest-priority prefix whose
+        #     size respects every kept member's skew bound. Reverted
+        #     pods retry WITHOUT the conservative gate: next round's
+        #     start-state counts mask the full domains, so the dealer
+        #     redirects them. Reverting ALL violators and serializing
+        #     them (the old policy) cost O(pods-with-spread) rounds on
+        #     spread-heavy workloads (~141 rounds on BASELINE config 3);
+        #     excess-only reverts converge in a handful.
+        dom_s_v = kpair.sig_domains(snap)                    # [S, N]
+        S_sigs = dom_s_v.shape[0]
+        dns_any = pods.ts_valid & (pods.ts_when == DO_NOT_SCHEDULE)  # [P, C]
+
+        def spread_excess(st_v, kept_v):
+            """[P] bool: members to revert so every kept DNS-spread
+            constraint holds against the resulting counts."""
+            counts_v = st_v.counts                           # [S, N]
+            node_cnt = jnp.take_along_axis(
+                counts_v, jnp.clip(dom_s_v, 0, None), axis=1
+            )                                                # [S, N]
+            node_cnt = jnp.where(dom_s_v >= 0, node_cnt, jnp.inf)
+            bad = jnp.zeros(P, bool)
+            idx = jnp.arange(P, dtype=jnp.int32)
+            for c in range(pods.ts_key.shape[1]):
+                s_c = jnp.clip(pods.ts_sig[:, c], 0, None)   # [P]
+                d_c = dom_s_v[s_c, jnp.clip(choice, 0, N - 1)]
+                member = (
+                    kept_v & dns_any[:, c] & (choice >= 0) & (d_c >= 0)
+                )
+                # Per-pod allowance T = min over eligible domains of the
+                # END-state count, plus the pod's own maxSkew.
+                nc_p = node_cnt[s_c]                         # [P, N]
+                eligible = nodes.valid[None, :] & static.aff_ok & (
+                    dom_s_v[s_c] >= 0
+                )
+                min_end = jnp.min(
+                    jnp.where(eligible, nc_p, jnp.inf), axis=1
+                )
+                min_end = jnp.where(jnp.isfinite(min_end), min_end, 0.0)
+                T = min_end + pods.ts_max_skew[:, c]         # [P]
+                cnt_total = counts_v[s_c, jnp.clip(d_c, 0, None)]
+                # Rank-ordered position within each (sig, domain) group
+                # of revert-eligible members, and the group's size.
+                gid = jnp.where(
+                    member, s_c * N + jnp.clip(d_c, 0, None), S_sigs * N
+                )
+                g_tab = jnp.zeros(S_sigs * N + 1, jnp.float32).at[gid].add(
+                    member.astype(jnp.float32)
+                )
+                g_elig = g_tab[gid]                          # [P]
+                b_fixed = cnt_total - g_elig  # non-revertable contribution
+                perm2 = jnp.lexsort((rank, gid))
+                gid_s = gid[perm2]
+                mem_s = member[perm2]
+                boundary = jnp.concatenate(
+                    [jnp.ones(1, bool), gid_s[1:] != gid_s[:-1]]
+                )
+                q_cum = jnp.cumsum(mem_s.astype(jnp.float32))
+                seg_start2 = jax.lax.cummax(jnp.where(boundary, idx, 0))
+                q_off = jnp.where(
+                    seg_start2 > 0,
+                    q_cum[jnp.clip(seg_start2 - 1, 0, None)], 0.0,
+                )
+                q_incl = q_cum - q_off                       # 1-based position
+                # Segmented prefix-min of T in rank order: the k-member
+                # prefix is admissible iff b + k <= min over its
+                # members' allowances.
+                T_s = jnp.where(mem_s, T[perm2], jnp.inf)
+
+                def comb(a, bpair):
+                    av, ab = a
+                    bv, bb = bpair
+                    return (jnp.where(bb, bv, jnp.minimum(av, bv)), ab | bb)
+
+                pm_s, _ = jax.lax.associative_scan(comb, (T_s, boundary))
+                survive_s = mem_s & (b_fixed[perm2] + q_incl <= pm_s)
+                bad_c = jnp.zeros(P, bool).at[perm2].set(mem_s & ~survive_s)
+                bad |= bad_c
+            return bad
+
         def vcond(vs):
-            _, _, _, again = vs
+            _, _, _, _, again = vs
             return again
 
         def vbody(vs):
-            st_v, used_v, kept_v, _ = vs
-            spread_ok2, _, ia_ok2, _ = kpair.pairwise_from_counts(
+            st_v, used_v, kept_v, ia_mark, _ = vs
+            _, _, ia_ok2, _ = kpair.pairwise_from_counts(
                 snap, st_v, static.aff_ok, static.sig_match,
                 exclude_self_node=jnp.where(kept_v, choice, -1),
             )
-            ok_at_choice = jnp.take_along_axis(
-                spread_ok2 & ia_ok2,
-                jnp.clip(choice, 0, N - 1)[:, None], axis=1,
+            ia_ok_at = jnp.take_along_axis(
+                ia_ok2, jnp.clip(choice, 0, N - 1)[:, None], axis=1
             )[:, 0]
-            new_viol = kept_v & has_pair & ~ok_at_choice
+            ia_bad = kept_v & has_pair & ~ia_ok_at
+            sp_bad = spread_excess(st_v, kept_v) & ~ia_bad
+            new_viol = ia_bad | sp_bad
             used_v = used_v.at[jnp.clip(choice, 0, N - 1)].add(
                 -jnp.where(new_viol[:, None], pods.requests, 0.0)
             )
             st_v = kpair.pair_state_commit(
                 snap, st_v, static.sig_match, choice, new_viol, sign=-1.0
             )
-            return st_v, used_v, kept_v & ~new_viol, jnp.any(new_viol)
+            return (st_v, used_v, kept_v & ~new_viol, ia_mark | ia_bad,
+                    jnp.any(new_viol))
 
         any_pair_committed = jnp.any(commit & has_pair)
-        st3, used3, kept, _ = jax.lax.while_loop(
-            vcond, vbody, (st2, used2, commit, any_pair_committed)
+        st3, used3, kept, ia_mark, _ = jax.lax.while_loop(
+            vcond, vbody,
+            (st2, used2, commit, jnp.zeros(P, bool), any_pair_committed),
         )
         viol = commit & ~kept
         assigned2 = jnp.where(kept, choice, assigned)
         chosen2 = jnp.where(kept, chosen_val, chosen)
-        new_conservative = viol & ~conservative
-        conservative2 = conservative | viol
+        # Progress backstop: if EVERY commit was reverted as spread
+        # excess (possible when non-revertable members crowded the
+        # domains) and nothing else moved, mark the first reverted pod
+        # conservative so the round loop keeps the old one-at-a-time
+        # guarantee instead of exiting with placeable pods stranded.
+        sp_rev = viol & ~ia_mark
+        need_fb = ~jnp.any(kept) & jnp.any(sp_rev)
+        fb_first = rank == jnp.min(jnp.where(sp_rev, rank, BIG))
+        fb_mask = sp_rev & fb_first & need_fb
+        new_conservative = (ia_mark | fb_mask) & ~conservative
+        conservative2 = conservative | ia_mark | fb_mask
         round_of2 = jnp.where(kept, r, round_of)
         all_done = jnp.all((assigned2 >= 0) | ~pods.valid)
         progress = (jnp.any(kept) | jnp.any(new_conservative)) & ~all_done

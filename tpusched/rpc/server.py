@@ -245,9 +245,10 @@ class SchedulerService:
                 )))
             # One write per batch under a lock: concurrent handlers must
             # not interleave partial lines into the audit log.
-            with self._audit_lock:
-                self._audit.write("\n".join(lines) + "\n")
-                self._audit.flush()
+            if lines:
+                with self._audit_lock:
+                    self._audit.write("\n".join(lines) + "\n")
+                    self._audit.flush()
         resp.rounds = res.rounds
         resp.solve_seconds = res.solve_seconds
         self._log_batch("Assign", meta, decode_s, res.solve_seconds,
