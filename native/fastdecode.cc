@@ -1022,24 +1022,48 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
 
   const int64_t P = bk.pods, N = bk.nodes, M = bk.running_pods;
 
-  // Pre-validate everything that could otherwise fail() AFTER numpy
-  // allocation starts (a throw between array creation and dict
+  // Pre-compute/validate everything that could otherwise fail() AFTER
+  // numpy allocation starts (a throw between array creation and dict
   // insertion would leak the allocated arrays): running-pod node names
-  // and toleration operators. All other validations (operators, taint
+  // and the toleration matrix. All other validations (operators, taint
   // effects, Gt/Lt literals) already ran during interning above.
+  //
+  // Toleration semantics mirror Python's any(_tolerates(...)) EXACTLY,
+  // including its short-circuit: _tolerates validates the operator only
+  // when a toleration is REACHED for some taint — a bad operator hiding
+  // behind an always-matching toleration is never seen, and an empty
+  // taint vocab validates nothing.
+  std::vector<std::vector<bool>> pod_tolerated(n_pods);
   {
     std::unordered_map<std::string, int32_t> names;
     for (int64_t i = 0; i < n_nodes; ++i) names.emplace(nodes[i].name, 1);
     for (const auto& rr : running)
       if (!names.count(rr.node))
         fail("running pod on unknown node '" + rr.node + "'");
-    // Mirror Python: _tolerates (and its operator validation) only runs
-    // per taint-vocab entry, so an empty vocab never validates ops.
-    if (!taint_list.empty())
-      for (const auto& p : pods)
-        for (const auto& tol : p.tolerations)
-          if (tol.op != "Exists" && tol.op != "Equal")
-            fail("bad toleration operator '" + tol.op + "'");
+    auto tolerates = [&](const Tol& tol, const TaintR& t) -> bool {
+      if (tol.op != "Exists" && tol.op != "Equal")
+        fail("bad toleration operator '" + tol.op + "'");
+      bool key_ok;
+      if (tol.key.empty()) {
+        if (tol.op != "Exists") return false;
+        key_ok = true;
+      } else {
+        key_ok = tol.key == t.k;
+      }
+      if (!key_ok) return false;
+      if (tol.op == "Equal" && tol.value != t.v) return false;
+      if (!tol.effect.empty() && tol.effect != t.e) return false;
+      return true;
+    };
+    for (int64_t i = 0; i < n_pods; ++i) {
+      pod_tolerated[i].assign(taint_list.size(), false);
+      for (size_t t = 0; t < taint_list.size(); ++t)
+        for (const auto& tol : pods[i].tolerations)
+          if (tolerates(tol, taint_list[t])) {
+            pod_tolerated[i][t] = true;
+            break;  // any() short-circuit
+          }
+    }
   }
 
   PyObject* out = PyDict_New();
@@ -1218,24 +1242,6 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
   PyObject* p_ns = np_full_i32(1, dP, -1);
   PyObject* p_valid = np_zeros(1, dP, NPY_BOOL);
 
-  // Toleration matching (mirror of _tolerates).
-  auto tolerates = [&](const Tol& tol, const std::string& tk,
-                       const std::string& tv, const std::string& te) -> bool {
-    if (tol.op != "Exists" && tol.op != "Equal")
-      fail("bad toleration operator '" + tol.op + "'");
-    bool key_ok;
-    if (tol.key.empty()) {
-      if (tol.op != "Exists") return false;
-      key_ok = true;
-    } else {
-      key_ok = tol.key == tk;
-    }
-    if (!key_ok) return false;
-    if (tol.op == "Equal" && tol.value != tv) return false;
-    if (!tol.effect.empty() && tol.effect != te) return false;
-    return true;
-  };
-
   for (int64_t i = 0; i < n_pods; ++i) {
     const PodRec& p = pods[i];
     const PodCompiled& pc = pcs[i];
@@ -1258,17 +1264,10 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
       i32p(p_lk)[i * bk.pod_labels + j] = keys.get(sl[j].k);
       i32p(p_lp)[i * bk.pod_labels + j] = pairs.get(join2(sl[j].k, sl[j].v));
     }
-    // Tolerations vs the whole taint vocab.
-    for (size_t t = 0; t < taint_list.size(); ++t) {
-      const TaintR& tt = taint_list[t];
-      bool any = false;
-      for (const auto& tol : p.tolerations)
-        if (tolerates(tol, tt.k, tt.v, tt.e)) {
-          any = true;
-          break;
-        }
-      b8p(p_tol)[i * bk.taint_vocab + t] = any;
-    }
+    // Tolerations: precomputed (with exact short-circuit validation
+    // semantics) in the leak-safe pre-pass above.
+    for (size_t t = 0; t < pod_tolerated[i].size(); ++t)
+      b8p(p_tol)[i * bk.taint_vocab + t] = pod_tolerated[i][t];
     for (size_t t = 0; t < pc.req_terms.size(); ++t) {
       b8p(p_rtv)[i * bk.terms + t] = true;
       for (size_t j = 0; j < pc.req_terms[t].size(); ++j)

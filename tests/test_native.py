@@ -262,6 +262,36 @@ def test_gtlt_whitespace_nan_literals_match_python():
         native.decode_snapshot_bytes(bad.SerializeToString(), EngineConfig())
 
 
+def test_bad_toleration_behind_match_short_circuits():
+    """Python's any(_tolerates(...)) never reaches a bad-operator
+    toleration hiding behind an always-matching one — native must
+    accept the same input; a bad op in FIRST position must fail on
+    both paths."""
+    from tpusched.snapshot import Toleration
+
+    nodes = [dict(name="n0", allocatable={"cpu": 4000.0},
+                  taints=[("k", "v", "NoSchedule")])]
+
+    def pod(tols):
+        return [dict(name="p", requests={"cpu": 100.0}, observed_avail=1.0,
+                     tolerations=tols)]
+
+    ok = snapshot_to_proto(
+        nodes,
+        pod([Toleration("", "Exists", "", ""),
+             Toleration("x", "Bogus", "", "")]),
+        [],
+    )
+    _roundtrip(ok)  # both paths accept; arrays equal
+    bad = snapshot_to_proto(
+        nodes, pod([Toleration("x", "Bogus", "", "")]), []
+    )
+    with pytest.raises(Exception):
+        snapshot_from_proto(bad, EngineConfig())
+    with pytest.raises(Exception):
+        native.decode_snapshot_bytes(bad.SerializeToString(), EngineConfig())
+
+
 def test_unknown_node_raises():
     nodes = [dict(name="n0", allocatable={"cpu": 4000.0})]
     running = [dict(name="r", node="ghost", requests={"cpu": 100.0})]

@@ -167,6 +167,63 @@ def test_request_flood(server_and_client):
     assert errors == [], errors
 
 
+def test_concurrent_delta_sessions_with_audit():
+    """Soak of the round-2 sidecar features under thread contention:
+    several DeltaSessions interleave (churning the snapshot-store LRU
+    past its cap, forcing fallbacks) while the audit stream is enabled —
+    every response must stay correct, and the audit JSONL must stay
+    line-parseable (no interleaved partial lines)."""
+    import io
+    import json
+    import threading
+
+    from tpusched.rpc.client import DeltaSession
+    from tpusched.rpc.server import STORE_CAP
+
+    audit = io.StringIO()
+    server, port, svc = make_server(
+        "127.0.0.1:0", config=EngineConfig(mode="fast"), audit_stream=audit
+    )
+    server.start()
+    errors = []
+    try:
+        def worker(wid):
+            try:
+                with SchedulerClient(f"127.0.0.1:{port}") as client:
+                    sess = DeltaSession(client)
+                    nodes = [dict(name=f"w{wid}-n0",
+                                  allocatable={"cpu": 4000.0,
+                                               "memory": float(64 << 30)})]
+                    for it in range(6):
+                        pods = [dict(
+                            name=f"w{wid}-p{j}",
+                            requests={"cpu": 100.0, "memory": float(1 << 28)},
+                            observed_avail=1.0,
+                        ) for j in range(it + 1)]
+                        resp = sess.assign(snapshot_to_proto(nodes, pods, []))
+                        got = {a.pod: a.node for a in resp.assignments}
+                        assert all(n == f"w{wid}-n0" for n in got.values()), got
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(STORE_CAP + 3)  # more sessions than store slots
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        server.stop(0)
+    assert errors == [], errors
+    for line in audit.getvalue().splitlines():
+        rec = json.loads(line)  # every line parses
+        assert rec["kind"] in ("placement", "eviction")
+    with svc._store_lock:
+        assert len(svc._stores) <= STORE_CAP
+
+
 def test_floor_buckets_pin_shapes():
     """A server with floor buckets must not change compile shapes when a
     smaller snapshot arrives."""

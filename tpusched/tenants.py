@@ -1,0 +1,85 @@
+"""Multi-tenant batched solving (SURVEY.md §2.3 "EP" row).
+
+The expert-parallel analogue in this domain is routing INDEPENDENT
+scheduling problems to solver shards. A sidecar serving many clusters
+(or many isolated tenants of one control plane) holds B snapshots with
+no cross-tenant interaction — exactly a batch dimension:
+
+  * stack_snapshots: B bucket-aligned ClusterSnapshots -> one pytree
+    with a leading tenant axis;
+  * solve_many: jax.vmap of the SAME solve kernels over that axis —
+    one compiled program schedules every tenant simultaneously,
+    saturating a chip that a single small cluster would leave idle;
+  * the tenant axis shards over the mesh's 'p' axis (tenant_sharding),
+    routing whole tenants to devices — no cross-device communication at
+    all, the cheapest collective there is.
+
+Alignment requirement: all tenants must share identical bucket shapes —
+build them with one explicit `Buckets` floor (the same discipline the
+serving sidecar already uses to pin compile shapes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusched.config import EngineConfig
+from tpusched.engine import _sat_tables
+from tpusched.kernels.assign import solve_rounds, solve_sequential
+from tpusched.snapshot import ClusterSnapshot
+
+
+def stack_snapshots(snaps: list[ClusterSnapshot]) -> ClusterSnapshot:
+    """Stack bucket-aligned snapshots along a new leading tenant axis.
+    Raises if any leaf shapes disagree (different buckets)."""
+    if not snaps:
+        raise ValueError("no snapshots to stack")
+    first = jax.tree.leaves(snaps[0])
+    for i, s in enumerate(snaps[1:], 1):
+        for a, b in zip(first, jax.tree.leaves(s)):
+            if np.shape(a) != np.shape(b):
+                raise ValueError(
+                    f"tenant {i} bucket shapes differ: {np.shape(b)} vs "
+                    f"{np.shape(a)} — build all tenants with one explicit "
+                    "Buckets floor"
+                )
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *snaps)
+
+
+def _solve_one(cfg: EngineConfig, snap: ClusterSnapshot):
+    node_sat_t, member_sat_t = _sat_tables(snap)
+    if cfg.mode == "fast":
+        a, c, u, o, _, rounds, ev = solve_rounds(
+            cfg, snap, node_sat_t, member_sat_t
+        )
+        return a, c, u, o, rounds, ev
+    a, c, u, o, ev = solve_sequential(cfg, snap, node_sat_t, member_sat_t)
+    P = a.shape[0]
+    return a, c, u, o, jnp.int32(P), ev
+
+
+def solve_many(cfg: EngineConfig, stacked: ClusterSnapshot):
+    """Solve B independent tenants at once: returns per-tenant
+    (assignment [B, P], chosen [B, P], used [B, N, R], order [B, P],
+    rounds [B], evicted [B, M]). jit/vmap-compiled; call through
+    jax.jit for caching (solve_many_jit does)."""
+    return jax.vmap(lambda s: _solve_one(cfg, s))(stacked)
+
+
+def solve_many_jit(cfg: EngineConfig):
+    """Jitted entry closed over the config (compile-time constants)."""
+    return jax.jit(lambda stacked: solve_many(cfg, stacked))
+
+
+def tenant_sharding(mesh, stacked: ClusterSnapshot):
+    """NamedShardings putting the TENANT axis on the mesh's 'p' axis:
+    whole problems route to devices, zero cross-device collectives."""
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    from tpusched.mesh import POD_AXIS
+
+    return jax.tree.map(
+        lambda _: NamedSharding(mesh, PS(POD_AXIS)), stacked
+    )
