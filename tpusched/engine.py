@@ -56,6 +56,23 @@ def _sat_tables(snap: ClusterSnapshot):
     return node_sat_t, member_sat_t
 
 
+def solve_core(cfg: EngineConfig, snap: ClusterSnapshot):
+    """Mode dispatch shared by Engine and tenants.solve_many: returns
+    (assigned, chosen, used, order, commit_key, rounds, evicted) in
+    either mode (parity synthesizes commit_key from pop order and
+    rounds=P)."""
+    node_sat_t, member_sat_t = _sat_tables(snap)
+    if cfg.mode == "fast":
+        return solve_rounds(cfg, snap, node_sat_t, member_sat_t)
+    a, c, u, o, ev = solve_sequential(cfg, snap, node_sat_t, member_sat_t)
+    # parity commit key = position in pop order (strictly serial)
+    P = a.shape[0]
+    rank = jnp.zeros(P, jnp.int32).at[o].set(
+        jnp.arange(P, dtype=jnp.int32)
+    )
+    return a, c, u, o, rank, jnp.int32(P), ev
+
+
 class Engine:
     def __init__(self, config: EngineConfig | None = None):
         self.config = config or EngineConfig()
@@ -73,18 +90,7 @@ class Engine:
             )
 
         def _solve(snap: ClusterSnapshot):
-            node_sat_t, member_sat_t = _sat_tables(snap)
-            if cfg.mode == "fast":
-                return solve_rounds(cfg, snap, node_sat_t, member_sat_t)
-            a, c, u, o, ev = solve_sequential(
-                cfg, snap, node_sat_t, member_sat_t
-            )
-            # parity commit key = position in pop order (strictly serial)
-            P = a.shape[0]
-            rank = jnp.zeros(P, jnp.int32).at[o].set(
-                jnp.arange(P, dtype=jnp.int32)
-            )
-            return a, c, u, o, rank, jnp.int32(P), ev
+            return solve_core(cfg, snap)
 
         def _solve_packed(snap: ClusterSnapshot):
             # One flat f32 output = ONE device->host fetch. The transport

@@ -26,8 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpusched.config import EngineConfig
-from tpusched.engine import _sat_tables
-from tpusched.kernels.assign import solve_rounds, solve_sequential
+from tpusched.engine import solve_core
 from tpusched.snapshot import ClusterSnapshot
 
 
@@ -49,28 +48,32 @@ def stack_snapshots(snaps: list[ClusterSnapshot]) -> ClusterSnapshot:
 
 
 def _solve_one(cfg: EngineConfig, snap: ClusterSnapshot):
-    node_sat_t, member_sat_t = _sat_tables(snap)
-    if cfg.mode == "fast":
-        a, c, u, o, _, rounds, ev = solve_rounds(
-            cfg, snap, node_sat_t, member_sat_t
-        )
-        return a, c, u, o, rounds, ev
-    a, c, u, o, ev = solve_sequential(cfg, snap, node_sat_t, member_sat_t)
-    P = a.shape[0]
-    return a, c, u, o, jnp.int32(P), ev
+    a, c, u, o, _, rounds, ev = solve_core(cfg, snap)
+    return a, c, u, o, rounds, ev
 
 
 def solve_many(cfg: EngineConfig, stacked: ClusterSnapshot):
     """Solve B independent tenants at once: returns per-tenant
     (assignment [B, P], chosen [B, P], used [B, N, R], order [B, P],
     rounds [B], evicted [B, M]). jit/vmap-compiled; call through
-    jax.jit for caching (solve_many_jit does)."""
+    solve_many_jit for compile caching."""
     return jax.vmap(lambda s: _solve_one(cfg, s))(stacked)
 
 
+_JIT_CACHE: dict[str, object] = {}
+
+
 def solve_many_jit(cfg: EngineConfig):
-    """Jitted entry closed over the config (compile-time constants)."""
-    return jax.jit(lambda stacked: solve_many(cfg, stacked))
+    """Jitted entry closed over the config (compile-time constants);
+    memoized so repeated calls share one jit/compile cache. Keyed by
+    repr (EngineConfig is frozen but holds a dict field, so it is not
+    hashable; its repr is deterministic and value-complete)."""
+    key = repr(cfg)
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(lambda stacked: solve_many(cfg, stacked))
+        _JIT_CACHE[key] = fn
+    return fn
 
 
 def tenant_sharding(mesh, stacked: ClusterSnapshot):
