@@ -154,6 +154,7 @@ struct NodeRec {
   std::vector<Res> alloc, used;
   std::vector<Lab> labels;
   std::vector<TaintR> taints;
+  bool unschedulable = false;
 };
 struct PodRec {
   std::string name;
@@ -327,6 +328,7 @@ NodeRec parse_node(Reader r) {
       case (3 << 3) | 2: out.labels.push_back(parse_lab(r.sub())); break;
       case (4 << 3) | 2: out.taints.push_back(parse_taint(r.sub())); break;
       case (5 << 3) | 2: out.used.push_back(parse_res(r.sub())); break;
+      case (6 << 3) | 0: out.unschedulable = r.varint() != 0; break;
       default: r.skip(tag & 7);
     }
   }
@@ -1034,6 +1036,7 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
   // behind an always-matching toleration is never seen, and an empty
   // taint vocab validates nothing.
   std::vector<std::vector<bool>> pod_tolerated(n_pods);
+  std::vector<bool> pod_tol_unsched(n_pods, false);
   {
     std::unordered_map<std::string, int32_t> names;
     for (int64_t i = 0; i < n_nodes; ++i) names.emplace(nodes[i].name, 1);
@@ -1055,6 +1058,8 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
       if (!tol.effect.empty() && tol.effect != t.e) return false;
       return true;
     };
+    const TaintR cordon_taint{"node.kubernetes.io/unschedulable", "",
+                              "NoSchedule"};
     for (int64_t i = 0; i < n_pods; ++i) {
       pod_tolerated[i].assign(taint_list.size(), false);
       for (size_t t = 0; t < taint_list.size(); ++t)
@@ -1063,6 +1068,12 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
             pod_tolerated[i][t] = true;
             break;  // any() short-circuit
           }
+      // NodeUnschedulable escape hatch (same short-circuit semantics).
+      for (const auto& tol : pods[i].tolerations)
+        if (tolerates(tol, cordon_taint)) {
+          pod_tol_unsched[i] = true;
+          break;
+        }
     }
   }
 
@@ -1107,11 +1118,13 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
   PyObject* node_ln = np_full_f32(2, dNL, std::numeric_limits<float>::quiet_NaN());
   PyObject* node_t = np_full_i32(2, dNT, -1);
   PyObject* node_dom = np_full_i32(2, dNK, -1);
+  PyObject* node_sched = np_zeros(1, dN, NPY_BOOL);
   PyObject* node_valid = np_zeros(1, dN, NPY_BOOL);
   for (int64_t i = 0; i < n_nodes; ++i) {
     NodeRec& n = nodes[i];
     node_index[n.name] = int32_t(i);
     b8p(node_valid)[i] = true;
+    b8p(node_sched)[i] = !n.unschedulable;
     for (int64_t r = 0; r < R; ++r) {
       double dflt = (resources[r] == "pods") ? 110.0 : 0.0;
       // add_node: alloc.setdefault("pods", 110.0)
@@ -1240,6 +1253,7 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
   PyObject* p_iav = np_zeros(2, dPI, NPY_BOOL);
   PyObject* p_group = np_full_i32(1, dP, -1);
   PyObject* p_ns = np_full_i32(1, dP, -1);
+  PyObject* p_tolu = np_zeros(1, dP, NPY_BOOL);
   PyObject* p_valid = np_zeros(1, dP, NPY_BOOL);
 
   for (int64_t i = 0; i < n_pods; ++i) {
@@ -1306,6 +1320,7 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
     if (!p.pod_group.empty())
       i32p(p_group)[i] = group_idx[p.pod_group];
     i32p(p_ns)[i] = ns_ids.get(p.ns);
+    b8p(p_tolu)[i] = pod_tol_unsched[i];
   }
 
   // ---- Gang / PDB tables. ----
@@ -1385,6 +1400,7 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
   dset(out, "node_label_nums", node_ln);
   dset(out, "node_taint_ids", node_t);
   dset(out, "node_domain", node_dom);
+  dset(out, "node_schedulable", node_sched);
   dset(out, "node_valid", node_valid);
 
   dset(out, "pod_requests", p_req);
@@ -1414,6 +1430,7 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
   dset(out, "pod_ia_valid", p_iav);
   dset(out, "pod_group", p_group);
   dset(out, "pod_namespace", p_ns);
+  dset(out, "pod_tolerates_unsched", p_tolu);
   dset(out, "pod_valid", p_valid);
 
   dset(out, "run_node_idx", r_node);

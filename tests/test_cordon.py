@@ -1,0 +1,147 @@
+"""Node cordon (upstream node.spec.unschedulable): no new placements on
+a cordoned node, while its running pods keep counting toward capacity,
+spread domains, affinity matches, and preemption victims stay off-limits
+(the node is not a candidate at all)."""
+
+import numpy as np
+import pytest
+
+from tpusched import Engine, EngineConfig
+from tpusched.oracle import Oracle, validate_assignment
+from tpusched.rpc.codec import snapshot_from_proto, snapshot_to_proto
+from tpusched.snapshot import MatchExpression, PodAffinityTerm, SnapshotBuilder
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_cordoned_node_takes_no_new_pods(mode):
+    cfg = EngineConfig(mode=mode)
+    b = SnapshotBuilder(cfg)
+    # The cordoned node is far emptier — it would win every score.
+    b.add_node("cordoned", {"cpu": 64000, "memory": 256 << 30},
+               unschedulable=True)
+    b.add_node("small", {"cpu": 4000, "memory": 16 << 30})
+    for i in range(3):
+        b.add_pod(f"p{i}", {"cpu": 500, "memory": 1 << 30})
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert (res.assignment[:3] == 1).all(), "all pods must avoid the cordon"
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+    assert validate_assignment(snap, cfg, res.assignment,
+                               commit_key=res.commit_key) == []
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_cordoned_nodes_running_pods_still_count(mode):
+    """A running web pod on a cordoned node must still satisfy another
+    pod's required affinity toward its zone (the zone's OTHER node)."""
+    cfg = EngineConfig(mode=mode)
+    b = SnapshotBuilder(cfg)
+    b.add_node("a0", {"cpu": 4000, "memory": 16 << 30},
+               labels={ZONE: "a"}, unschedulable=True)
+    b.add_node("a1", {"cpu": 4000, "memory": 16 << 30}, labels={ZONE: "a"})
+    b.add_node("b0", {"cpu": 4000, "memory": 16 << 30}, labels={ZONE: "b"})
+    b.add_running_pod("a0", {"cpu": 100, "memory": 1 << 28},
+                      labels={"app": "web"})
+    b.add_pod("wants-web", {"cpu": 100, "memory": 1 << 28},
+              labels={"app": "api"},
+              pod_affinity=[PodAffinityTerm(
+                  ZONE, (MatchExpression("app", "In", ("web",)),),
+                  required=True)])
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert res.assignment[0] == 1, "zone a is satisfied via node a1"
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_no_preemption_onto_cordoned_node(mode):
+    cfg = EngineConfig(mode=mode, preemption=True)
+    b = SnapshotBuilder(cfg)
+    b.add_node("n0", {"cpu": 4000, "memory": 64 << 30}, unschedulable=True)
+    b.add_running_pod("n0", {"cpu": 4000, "memory": 1 << 30},
+                      priority=1, slack=0.5)
+    b.add_pod("p", {"cpu": 2000, "memory": 1 << 30}, priority=500)
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert res.assignment[0] == -1
+    assert not res.evicted.any()
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_unschedulable_toleration_admits_daemonset_pod(mode):
+    """Upstream NodeUnschedulable plugin: a pod tolerating
+    node.kubernetes.io/unschedulable places on a cordoned node (the
+    DaemonSet/critical-pod pattern); an ordinary pod does not."""
+    from tpusched.snapshot import Toleration
+
+    cfg = EngineConfig(mode=mode)
+    b = SnapshotBuilder(cfg)
+    # The cordoned node is the ONLY node: placement requires the escape.
+    b.add_node("cordoned", {"cpu": 64000, "memory": 256 << 30},
+               unschedulable=True)
+    b.add_pod("daemon", {"cpu": 100, "memory": 1 << 28},
+              tolerations=[Toleration("node.kubernetes.io/unschedulable",
+                                      "Exists", "", "NoSchedule")])
+    b.add_pod("plain", {"cpu": 100, "memory": 1 << 28})
+    snap, _ = b.build()
+    res = Engine(cfg).solve(snap)
+    assert res.assignment[0] == 0, "tolerant pod lands on the cordon"
+    assert res.assignment[1] == -1, "plain pod cannot place anywhere"
+    ora = Oracle(snap, cfg).solve()
+    np.testing.assert_array_equal(res.assignment, ora.assignment)
+    assert validate_assignment(snap, cfg, res.assignment,
+                               commit_key=res.commit_key) == []
+
+
+def test_cordon_parity_fuzz():
+    """Random clusters with cordoned nodes across the full constraint
+    mix: parity == oracle; fast stays valid."""
+    from tpusched.synth import make_cluster
+
+    for seed in range(3):
+        rng = np.random.default_rng(9700 + seed)
+        snap, _ = make_cluster(
+            rng, 40, 12, cordon_frac=0.3, spread_frac=0.3,
+            interpod_frac=0.3, taint_frac=0.2, toleration_frac=0.3,
+            gang_frac=0.2, initial_utilization=0.6, n_running_per_node=3,
+        )
+        cfg = EngineConfig(mode="parity", preemption=True)
+        res = Engine(cfg).solve(snap)
+        ora = Oracle(snap, cfg).solve()
+        np.testing.assert_array_equal(res.assignment, ora.assignment)
+        np.testing.assert_array_equal(res.evicted, ora.evicted)
+        fcfg = EngineConfig(mode="fast", preemption=True)
+        fres = Engine(fcfg).solve(snap)
+        violations = validate_assignment(
+            snap, fcfg, fres.assignment, commit_key=fres.commit_key,
+            evicted=fres.evicted,
+        )
+        assert violations == [], violations
+
+
+def test_cordon_survives_the_wire_and_native_decode():
+    from tpusched import native
+
+    nodes = [dict(name="big", allocatable={"cpu": 64000.0},
+                  unschedulable=True),
+             dict(name="small", allocatable={"cpu": 4000.0})]
+    pods = [dict(name="p", requests={"cpu": 500.0}, observed_avail=1.0)]
+    msg = snapshot_to_proto(nodes, pods, [])
+    assert msg.nodes[0].unschedulable
+    cfg = EngineConfig()
+    snap, meta = snapshot_from_proto(msg, cfg)
+    assert np.asarray(snap.nodes.schedulable)[:2].tolist() == [False, True]
+    res = Engine(cfg).solve(snap)
+    assert meta.node_names[int(res.assignment[0])] == "small"
+    if native.available():
+        snap2, _ = native.decode_snapshot_bytes(msg.SerializeToString(), cfg)
+        np.testing.assert_array_equal(
+            np.asarray(snap2.nodes.schedulable),
+            np.asarray(snap.nodes.schedulable),
+        )

@@ -44,7 +44,7 @@ PRESETS: dict[str, dict] = {
         initial_utilization=0.5, n_running_per_node=3, taint_frac=0.2,
         toleration_frac=0.3, selector_frac=0.2, affinity_frac=0.3,
         spread_frac=0.4, interpod_frac=0.4, run_anti_frac=0.15,
-        namespace_count=2,
+        namespace_count=2, cordon_frac=0.15,
     ),
 }
 

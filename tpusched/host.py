@@ -182,6 +182,7 @@ class HostScheduler:
             name=n["name"], allocatable=n.get("allocatable", {}),
             labels=n.get("labels", {}), taints=n.get("taints", []),
             used=n.get("used", {}),
+            unschedulable=n.get("unschedulable", False),
         )
 
     @staticmethod

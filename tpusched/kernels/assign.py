@@ -77,10 +77,16 @@ def precompute_static(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
     aff_ok = kfilter.node_affinity_mask(
         node_sat_t, pods.req_term_atoms, pods.req_term_valid
     )
+    # Cordon (NodeUnschedulable plugin): closed to new pods UNLESS the
+    # pod tolerates node.kubernetes.io/unschedulable (DaemonSet pattern).
+    cordon_ok = (
+        nodes.schedulable[None, :] | pods.tolerates_unsched[:, None]
+    )
     mask = (
         aff_ok
         & kfilter.taint_mask(nodes.taint_ids, snap.taint_effect, pods.tolerated)
         & nodes.valid[None, :]
+        & cordon_ok
         & pods.valid[:, None]
     )
     w = effective_weights(

@@ -121,7 +121,8 @@ def decode_snapshot_bytes(
             allocatable=d["node_allocatable"], used=d["node_used"],
             label_pairs=d["node_label_pairs"], label_keys=d["node_label_keys"],
             label_nums=d["node_label_nums"], taint_ids=d["node_taint_ids"],
-            domain=d["node_domain"], valid=d["node_valid"],
+            domain=d["node_domain"], schedulable=d["node_schedulable"],
+            valid=d["node_valid"],
         ),
         pods=PodArrays(
             requests=d["pod_requests"], base_priority=d["pod_base_priority"],
@@ -141,7 +142,9 @@ def decode_snapshot_bytes(
             ia_sig=d["pod_ia_sig"], ia_anti=d["pod_ia_anti"],
             ia_required=d["pod_ia_required"], ia_weight=d["pod_ia_weight"],
             ia_valid=d["pod_ia_valid"], group=d["pod_group"],
-            namespace=d["pod_namespace"], valid=d["pod_valid"],
+            namespace=d["pod_namespace"],
+            tolerates_unsched=d["pod_tolerates_unsched"],
+            valid=d["pod_valid"],
         ),
         running=RunningPodArrays(
             node_idx=d["run_node_idx"], requests=d["run_requests"],

@@ -442,6 +442,9 @@ class Oracle:
         ia_ok, ia_raw = self.interpod_ok_and_raw(p, assigned_nodes, assigned_pods)
         feasible = (
             nvalid
+            # Cordon filter with the NodeUnschedulable toleration escape.
+            & (_np(self.nodes.schedulable)
+               | bool(_np(self.pods.tolerates_unsched)[p]))
             & self.resource_fit(p, used)
             & self.taints_ok(p)
             & self.node_affinity_ok(p)
@@ -492,6 +495,8 @@ class Oracle:
         ia_ok, _ = self.interpod_ok_and_raw(p, assigned_nodes, assigned_pods)
         allowed = (
             _np(self.nodes.valid)
+            & (_np(self.nodes.schedulable)
+               | bool(_np(self.pods.tolerates_unsched)[p]))
             & self.taints_ok(p)
             & self.node_affinity_ok(p)
             & spread_ok
@@ -730,6 +735,10 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
         if not _np(nodes.valid)[n]:
             out.append(f"pod {p}: placed on invalid node {n}")
             continue
+        if not _np(nodes.schedulable)[n] and not _np(
+            pods.tolerates_unsched
+        )[p]:
+            out.append(f"pod {p}: placed on cordoned node {n}")
         if not ora.taints_ok(p)[n]:
             out.append(f"pod {p}: node {n} has untolerated taint")
         if not ora.node_affinity_ok(p)[n]:

@@ -117,6 +117,7 @@ def snapshot_from_proto(
             labels=_labels(n.labels),
             taints=[(t.key, t.value, t.effect) for t in n.taints],
             used=_res_map(n.used),
+            unschedulable=n.unschedulable,
         )
     for p in _by_name(msg.pods):
         b.add_pod(
@@ -341,6 +342,8 @@ def snapshot_to_proto(
         for (k, v, e) in n.get("taints", []):
             t = nm.taints.add()
             t.key, t.value, t.effect = k, v, e
+        if n.get("unschedulable"):
+            nm.unschedulable = True
     for p in pods:
         pm = msg.pods.add()
         pm.name = p["name"]

@@ -161,6 +161,11 @@ class NodeArrays:
     label_nums: Any    # [N, LN] f32 (numeric label value or NaN)
     taint_ids: Any     # [N, TN] int32 into taint vocab (-1 pad)
     domain: Any        # [N, TK] int32 topology-domain id per topo key (-1 none)
+    # [N] bool: node accepts NEW pods (false = cordoned, the upstream
+    # node.spec.unschedulable flag). A cordoned node stays `valid`: its
+    # running pods still count toward capacity, spread domains, and
+    # affinity matches — it just takes no new placements.
+    schedulable: Any
     valid: Any         # [N] bool
 
 
@@ -198,6 +203,10 @@ class PodArrays:
     # Gang scheduling.
     group: Any           # [P] int32 pod-group id (-1 = none)
     namespace: Any       # [P] int32 namespace id
+    # [P] bool: tolerates the node.kubernetes.io/unschedulable:NoSchedule
+    # taint — upstream's NodeUnschedulable plugin admits such pods
+    # (DaemonSet pattern) onto cordoned nodes.
+    tolerates_unsched: Any
     valid: Any           # [P] bool
 
 
@@ -288,12 +297,17 @@ class SnapshotBuilder:
         labels: Mapping[str, str] | None = None,
         taints: Sequence[tuple[str, str, str]] = (),
         used: Mapping[str, float] | None = None,
+        unschedulable: bool = False,
     ) -> None:
+        """unschedulable: the upstream node.spec.unschedulable flag
+        (kubectl cordon) — the node takes no new pods but its running
+        pods keep counting everywhere."""
         alloc = dict(allocatable)
         alloc.setdefault(RESOURCE_PODS, 110.0)  # upstream kubelet default
         self._nodes.append(
             dict(name=name, allocatable=alloc, labels=dict(labels or {}),
-                 taints=list(taints), used=dict(used or {}))
+                 taints=list(taints), used=dict(used or {}),
+                 unschedulable=bool(unschedulable))
         )
 
     def add_pod(
@@ -609,11 +623,13 @@ class SnapshotBuilder:
         node_ln = np.full((N, bk.node_labels), np.nan, np.float32)
         node_t = np.full((N, bk.node_taints), -1, np.int32)
         node_dom = np.full((N, bk.topo_keys), -1, np.int32)
+        node_sched = np.zeros(N, bool)
         node_valid = np.zeros(N, bool)
         node_index = {}
         for i, nrec in enumerate(self._nodes):
             node_index[nrec["name"]] = i
             node_valid[i] = True
+            node_sched[i] = not nrec["unschedulable"]
             for r, rn in enumerate(cfg.resources):
                 node_alloc[i, r] = float(nrec["allocatable"].get(rn, 0.0))
                 node_used[i, r] = float(nrec["used"].get(rn, 0.0))
@@ -693,6 +709,11 @@ class SnapshotBuilder:
             if p["pod_group"] is not None:
                 pods.group[i] = group_idx[p["pod_group"]]
             pods.namespace[i] = ns_ids[p["namespace"]]
+            pods.tolerates_unsched[i] = any(
+                _tolerates(tol, "node.kubernetes.io/unschedulable", "",
+                           "NoSchedule")
+                for tol in p["tolerations"]
+            )
 
         group_min = np.zeros(bk.pod_groups, np.int32)
         for g, name in enumerate(group_list):
@@ -737,7 +758,7 @@ class SnapshotBuilder:
             nodes=NodeArrays(
                 allocatable=node_alloc, used=node_used, label_pairs=node_lp,
                 label_keys=node_lk, label_nums=node_ln, taint_ids=node_t,
-                domain=node_dom, valid=node_valid,
+                domain=node_dom, schedulable=node_sched, valid=node_valid,
             ),
             pods=PodArrays(
                 requests=pods.requests, base_priority=pods.base_priority,
@@ -754,7 +775,8 @@ class SnapshotBuilder:
                 ia_sig=pods.ia_sig, ia_anti=pods.ia_anti,
                 ia_required=pods.ia_required, ia_weight=pods.ia_weight,
                 ia_valid=pods.ia_valid, group=pods.group,
-                namespace=pods.namespace, valid=pods.valid,
+                namespace=pods.namespace,
+                tolerates_unsched=pods.tolerates_unsched, valid=pods.valid,
             ),
             running=RunningPodArrays(
                 node_idx=run_node, requests=run_req, priority=run_prio,
@@ -813,6 +835,7 @@ class _PodArraysNP:
         self.ia_valid = np.zeros((P, bk.affinity_terms), bool)
         self.group = np.full(P, -1, np.int32)
         self.namespace = np.full(P, -1, np.int32)
+        self.tolerates_unsched = np.zeros(P, bool)
         self.valid = np.zeros(P, bool)
 
 

@@ -53,6 +53,7 @@ def make_cluster(
     keyless_node_frac: float = 0.0,
     namespace_count: int = 1,
     pdb_frac: float = 0.0,
+    cordon_frac: float = 0.0,
 ):
     """General-purpose random cluster. Fractions control what share of
     pods/nodes carry each constraint type, so the same generator covers
@@ -84,6 +85,7 @@ def make_cluster(
             allocatable={"cpu": float(cpu), "memory": float(mem)},
             labels=labels,
             taints=taints,
+            unschedulable=bool(rng.random() < cordon_frac),
         )
 
     # Background running pods establishing initial utilization + labels
