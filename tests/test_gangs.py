@@ -146,3 +146,55 @@ def test_gang_with_pairwise_constraints_rolls_back_counts():
     ora = Oracle(snap, cfg).solve()
     np.testing.assert_array_equal(res.assignment, ora.assignment)
     assert (res.assignment[:4] == -1).all()
+
+
+@pytest.mark.parametrize("mode", ["parity", "fast"])
+def test_gang_rollback_audit_caveat(mode):
+    """Documented optimistic-assume edge (COVERAGE.md): a pod whose
+    required affinity was satisfied by a gang that later rolled back
+    keeps its placement — in BOTH modes, matching the oracle — and the
+    final-state audit reports it. Upstream has the same optimism: an
+    unreserved gang member does not re-schedule dependents."""
+    from tpusched.oracle import Oracle, validate_assignment
+    from tpusched.snapshot import MatchExpression, PodAffinityTerm
+
+    ZONE = "topology.kubernetes.io/zone"
+    cfg = EngineConfig(mode=mode)
+    b = SnapshotBuilder(cfg)
+    b.add_node("n0", {"cpu": 4000, "memory": 16 << 30}, labels={ZONE: "a"})
+    b.add_node("n1", {"cpu": 4000, "memory": 16 << 30}, labels={ZONE: "b"})
+    # Gang of 2 (minMember 2) but only ONE member fits anywhere after
+    # the follower pod commits? Construct: gang needs 2x3000 cpu; only
+    # one node has room after... simpler: gang min 2 with only one
+    # member schedulable (the other demands too much) -> full rollback.
+    b.add_pod("g-big", {"cpu": 99999, "memory": 1 << 30}, priority=300,
+              labels={"app": "web"}, pod_group="gang",
+              pod_group_min_member=2)
+    b.add_pod("g-ok", {"cpu": 100, "memory": 1 << 30}, priority=200,
+              labels={"app": "web"}, pod_group="gang",
+              pod_group_min_member=2)
+    # Depends on app=web presence in its zone; pops AFTER the gang
+    # member places, BEFORE the rollback.
+    b.add_pod("dep", {"cpu": 100, "memory": 1 << 30}, priority=100,
+              labels={"app": "api"},
+              pod_affinity=[PodAffinityTerm(
+                  ZONE, (MatchExpression("app", "In", ("web",)),),
+                  required=True)])
+    snap, meta = b.build()
+    res = Engine(cfg).solve(snap)
+    ora = Oracle(snap, cfg).solve()
+    if mode == "parity":
+        np.testing.assert_array_equal(res.assignment, ora.assignment)
+    assert res.assignment[0] == -1 and res.assignment[1] == -1, (
+        "gang must roll back entirely"
+    )
+    assert res.assignment[2] >= 0, (
+        "dependent keeps its optimistic placement (upstream assume "
+        "semantics)"
+    )
+    violations = validate_assignment(
+        snap, cfg, res.assignment, commit_key=res.commit_key
+    )
+    assert any("required pod affinity" in v for v in violations), (
+        "the final-state audit reports the documented caveat"
+    )

@@ -707,6 +707,17 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
     against the FINAL state (strictly stronger; holds for parity mode
     only in the absence of retroactive skew).
 
+    GANG-ROLLBACK CAVEAT: a pod whose required affinity (or spread
+    headroom) was satisfied at commit time by gang members that the
+    all-or-nothing gate later rolled back can be reported as violating
+    here, in BOTH modes and in the oracle itself — the audit only sees
+    the final placed set. This mirrors upstream optimism: a pod that
+    passed Filter using an assumed gang member binds even if that gang
+    later un-reserves; nothing re-schedules the dependent. Audits of
+    gang-bearing snapshots should treat such reports as the documented
+    optimistic-assume edge, not an engine defect (see
+    tests/test_gangs.py::test_gang_rollback_audit_caveat).
+
     Returns human-readable violation strings (empty = valid)."""
     ora = Oracle(snap, cfg)
     pods, nodes = snap.pods, snap.nodes
