@@ -189,6 +189,64 @@ def test_unnamed_running_pods_disable_delta(sidecar):
     assert sess.full_sends == 2 and sess.delta_sends == 0
 
 
+def test_unsafe_snapshot_after_safe_base_sends_full(sidecar):
+    """Regression (advisor, round 2): a snapshot that turns delta-UNSAFE
+    (duplicate/unnamed records) after a safe base was remembered must NOT
+    ride the delta path — the server's name-keyed store would silently
+    collapse the duplicates and solve a corrupted snapshot for a cycle."""
+    client, _ = sidecar
+    sess = DeltaSession(client)
+    nodes, pods, running = _cluster_msg(n_pods=4, n_nodes=2)
+    sess.assign(snapshot_to_proto(nodes, pods, running))
+    assert sess.full_sends == 1
+
+    # Three running pods on the wire, two sharing a name: collapsing to
+    # two would under-count node usage.
+    running2 = running + [
+        dict(name="dup", node="n0", requests={"cpu": 300.0}),
+        dict(name="dup", node="n1", requests={"cpu": 400.0}),
+    ]
+    msg2 = snapshot_to_proto(nodes, pods, running2)
+    resp2 = sess.assign(msg2)
+    assert sess.delta_sends == 0, "unsafe snapshot must not ship as delta"
+    assert sess.full_sends == 2
+    assert resp2.snapshot_id == "", "server must not register unsafe base"
+    # All three running pods reached the engine: solve equals a direct
+    # full-snapshot solve of the same (uncollapsed) state.
+    cfg = EngineConfig(mode="fast")
+    snap, meta = snapshot_from_proto(msg2, cfg)
+    assert meta.n_running == 3
+    direct = Engine(cfg).solve(snap)
+    direct_by_name = {
+        meta.pod_names[i]: (meta.node_names[int(n)] if n >= 0 else "")
+        for i, n in enumerate(direct.assignment[: meta.n_pods])
+    }
+    assert {a.pod: a.node for a in resp2.assignments} == direct_by_name
+
+
+def test_server_rejects_unsafe_delta_upserts(sidecar):
+    """Defense-in-depth: a hand-crafted delta whose upserts carry empty
+    or duplicate names is rejected INVALID_ARGUMENT, never solved."""
+    import grpc
+
+    client, _ = sidecar
+    nodes, pods, running = _cluster_msg(n_pods=4, n_nodes=2)
+    resp = client.assign(snapshot_to_proto(nodes, pods, running))
+    assert resp.snapshot_id
+
+    for bad_running in (
+        [dict(name="dup", node="n0", requests={"cpu": 1.0}),
+         dict(name="dup", node="n1", requests={"cpu": 2.0})],
+        [dict(name="", node="n0", requests={"cpu": 1.0})],
+    ):
+        delta = pb.SnapshotDelta(base_id=resp.snapshot_id)
+        bad = snapshot_to_proto([], [], bad_running)
+        delta.upsert_running.extend(bad.running)
+        with pytest.raises(grpc.RpcError) as ei:
+            client.assign_delta(delta)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
 def test_reordered_full_send_schedules_identically(sidecar):
     """Same state, different wire order -> identical placements (codec
     canonicalizes record order by name)."""

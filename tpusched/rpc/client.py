@@ -104,6 +104,12 @@ class DeltaSession:
             self._base is not None
             and self._base_id is not None
             and self._skip_delta == 0
+            # The NEW snapshot must itself be delta-safe: the server's
+            # name-keyed store would silently collapse unnamed/duplicate
+            # records arriving as delta upserts and solve a corrupted
+            # snapshot for this cycle. (_remember only drops the base
+            # for the NEXT cycle — one cycle too late.)
+            and codec.delta_safe(snapshot)
         ):
             new_bytes = codec.SnapshotStore()
             delta = codec.delta_between(
@@ -141,7 +147,9 @@ class DeltaSession:
         when the snapshot is delta-safe (unique non-empty names — the
         stores key by name). `prebuilt` reuses the bytes delta_between
         already serialized for the diff (no second serialization pass)."""
-        if not sid or not codec.delta_safe(snapshot):
+        # prebuilt only arrives from the delta branch, which already
+        # verified delta_safe this cycle — don't re-scan all records.
+        if not sid or (prebuilt is None and not codec.delta_safe(snapshot)):
             self._base = self._base_id = None
             return
         if prebuilt is not None:

@@ -141,6 +141,25 @@ class SchedulerService:
                 self._stores.pop(next(iter(self._stores)))
         return sid
 
+    @staticmethod
+    def _check_delta_upserts(delta, context) -> None:
+        """Defense-in-depth behind DeltaSession's client-side guard: a
+        delta upsert with an empty or duplicate name would silently
+        collapse in the name-keyed store and solve a corrupted snapshot.
+        Reject loudly instead (INVALID_ARGUMENT — retrying the same
+        delta cannot succeed, unlike an expired base)."""
+        for coll in (delta.upsert_nodes, delta.upsert_pods,
+                     delta.upsert_running):
+            seen = set()
+            for rec in coll:
+                if not rec.name or rec.name in seen:
+                    context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        "delta upserts must carry unique non-empty names "
+                        f"(offending record name: {rec.name!r})",
+                    )
+                seen.add(rec.name)
+
     def _resolve(self, request, context):
         """Full-or-delta request -> (ClusterSnapshot msg, snapshot_id).
         Unknown/expired base_id aborts FAILED_PRECONDITION so the client
@@ -149,6 +168,7 @@ class SchedulerService:
         registered (empty snapshot_id): name-keyed stores would collapse
         them (DeltaSession refuses to delta against those too)."""
         if request.HasField("delta") and request.delta.base_id:
+            self._check_delta_upserts(request.delta, context)
             with self._store_lock:
                 base = self._stores.get(request.delta.base_id)
                 if base is not None:
