@@ -3,16 +3,23 @@
 
 Default run (`python bench.py`) benches ALL BASELINE configs
 (BASELINE.json:"configs"[1..4]; config[0] runs through the host shim when
-available) and prints one JSON line per metric on stdout, diagnostics on
-stderr. The HEADLINE metric — p99 schedule-cycle latency for the 10k
-pending-pods x 5k nodes batched solve (BASELINE.json:"metric") — is
-printed LAST so a last-line parse reads it. vs_baseline =
-target_latency / measured_p99 against the driver-set 500 ms north-star
-budget (>1.0 means under budget).
+available) plus fast-vs-parity divergence rows, and prints one JSON line
+per metric on stdout, diagnostics on stderr. The HEADLINE metric — p99
+schedule-cycle latency for the 10k pending-pods x 5k nodes batched solve
+(BASELINE.json:"metric") — is printed LAST so a last-line parse reads it.
+
+The headline is PARITY mode: exact stock kube-scheduler semantics (the
+north star conjoins "<500 ms p99" with "placement parity"; EngineConfig
+defaults to mode="parity" for the same reason). Fast mode — the opt-in
+bounded-rounds throughput mode — is emitted alongside with a `_fast`
+metric suffix. vs_baseline = 500 ms north-star budget / measured p99
+(>1.0 means under budget); it is reported ONLY for metrics at the
+10k x 5k headline shape — other shapes have no baseline and emit null.
 
 Usage: python bench.py [--pods N] [--nodes N] [--iters N] [--only NAME]
-       [--what score|score_top1|solve] [--mode fast|parity]
-NAME in {headline, pairwise, gangs, preemption, pipeline, e2e}.
+       [--what score|score_top1|solve] [--mode both|fast|parity]
+NAME in {headline, pairwise, gangs, preemption, pipeline, e2e,
+divergence}.
 """
 
 from __future__ import annotations
@@ -63,8 +70,13 @@ def bench_fn(fn, iters: int, warmup: int = 3, label: str = ""):
     )
 
 
-def emit(metric: str, stats: dict, extra: dict | None = None):
-    """One JSON line on stdout; full stats on stderr."""
+def emit(metric: str, stats: dict, extra: dict | None = None,
+         against_budget: bool = False):
+    """One JSON line on stdout; full stats on stderr. vs_baseline is the
+    500 ms north-star budget over p99 ONLY when against_budget (the
+    metric is at the 10k x 5k headline shape the budget talks about);
+    other shapes have no baseline and report null rather than implying
+    one (round-2 verdict, weak #2)."""
     log(f"{metric}: p50={stats['p50']*1e3:.1f}ms p90={stats['p90']*1e3:.1f}ms "
         f"p99={stats['p99']*1e3:.1f}ms max={stats['max']*1e3:.1f}ms "
         f"iters={stats['iters']}")
@@ -72,13 +84,22 @@ def emit(metric: str, stats: dict, extra: dict | None = None):
         "metric": metric,
         "value": round(stats["p99"] * 1e3, 3),
         "unit": "ms",
-        "vs_baseline": round(TARGET_P99_S / stats["p99"], 3),
+        "vs_baseline": (
+            round(TARGET_P99_S / stats["p99"], 3) if against_budget else None
+        ),
         "p50_ms": round(stats["p50"] * 1e3, 3),
         "iters": stats["iters"],
     }
     if extra:
         line.update(extra)
     print(json.dumps(line), flush=True)
+
+
+def _modes(args) -> list[str]:
+    """Expand --mode both into [fast, parity]; parity LAST so that when
+    the headline bench iterates these, the final stdout line is the
+    parity-mode (stock-semantics) headline."""
+    return ["fast", "parity"] if args.mode == "both" else [args.mode]
 
 
 def _build(make, *a, **kw):
@@ -106,11 +127,13 @@ def _prep(engine, snap, what: str):
 
 
 def bench_headline(args):
-    """configs[1]: NodeResourcesFit + BalancedAllocation at 10k x 5k."""
+    """configs[1]: NodeResourcesFit + BalancedAllocation at 10k x 5k.
+    With --mode both (default): fast first, then PARITY LAST — exact
+    stock semantics under the 500 ms budget is the north-star claim, so
+    the parity number is the final (driver-parsed) stdout line."""
     from tpusched import Engine, EngineConfig
     from tpusched.synth import config2_scale
 
-    log(f"[headline] {args.what}@{args.pods}x{args.nodes} mode={args.mode}")
     n_pods, n_nodes = args.pods, args.nodes
     if args.replay:
         from tpusched.dump import load_snapshot
@@ -128,21 +151,31 @@ def bench_headline(args):
 
             save_snapshot(args.dump, snap, meta)
             log(f"  dumped snapshot to {args.dump}")
-    engine = Engine(EngineConfig(mode=args.mode))
-    fn = _prep(engine, snap, args.what)
-    if args.profile:
-        import jax
+    headline_shape = n_pods == 10_000 and n_nodes == 5_000
+    stats = None
+    for mode in _modes(args):
+        log(f"[headline] {args.what}@{n_pods}x{n_nodes} mode={mode}")
+        engine = Engine(EngineConfig(mode=mode))
+        fn = _prep(engine, snap, args.what)
+        if args.profile:
+            import jax
 
-        with jax.profiler.trace(args.profile):
-            stats = bench_fn(fn, min(args.iters, 10), label="headline")
-        log(f"  profiler trace written to {args.profile}")
-    else:
-        stats = bench_fn(fn, args.iters, label="headline")
-    log(f"  throughput ~{n_pods / stats['p50']:,.0f} placements/sec")
-    emit(
-        f"{args.what}_p99_latency_{n_pods}x{n_nodes}", stats,
-        {"placements_per_sec": round(n_pods / stats["p50"], 1)},
-    )
+            with jax.profiler.trace(f"{args.profile}-{mode}"):
+                stats = bench_fn(fn, min(args.iters, 10), label="headline")
+            log(f"  profiler trace written to {args.profile}-{mode}")
+        else:
+            stats = bench_fn(fn, args.iters, label="headline")
+        log(f"  throughput ~{n_pods / stats['p50']:,.0f} placements/sec")
+        # The bare headline metric name is reserved for parity mode (the
+        # stock-semantics north-star claim); fast-mode numbers always
+        # carry the suffix so time series keyed by name never conflate.
+        suffix = "" if mode == "parity" else "_fast"
+        emit(
+            f"{args.what}_p99_latency_{n_pods}x{n_nodes}{suffix}", stats,
+            {"placements_per_sec": round(n_pods / stats["p50"], 1),
+             "mode": mode},
+            against_budget=headline_shape,
+        )
     return stats
 
 
@@ -152,13 +185,15 @@ def bench_pairwise(args):
     from tpusched.synth import config3_pairwise
 
     pods, nodes = 2000, 500
-    log(f"[pairwise] solve@{pods}x{nodes} spread+interpod mode={args.mode}")
     rng = np.random.default_rng(43)
     snap, _ = _build(config3_pairwise, rng, pods, nodes)
-    engine = Engine(EngineConfig(mode=args.mode))
-    fn = _prep(engine, snap, "solve")
-    stats = bench_fn(fn, max(20, args.iters // 3), label="pairwise")
-    emit(f"pairwise_solve_p99_latency_{pods}x{nodes}", stats)
+    for mode in _modes(args):
+        log(f"[pairwise] solve@{pods}x{nodes} spread+interpod mode={mode}")
+        engine = Engine(EngineConfig(mode=mode))
+        fn = _prep(engine, snap, "solve")
+        stats = bench_fn(fn, max(20, args.iters // 3), label="pairwise")
+        emit(f"pairwise_solve_p99_latency_{pods}x{nodes}_{mode}", stats,
+             {"mode": mode})
 
 
 def bench_gangs(args):
@@ -166,13 +201,15 @@ def bench_gangs(args):
     from tpusched import Engine, EngineConfig
     from tpusched.synth import config4_gangs
 
-    log(f"[gangs] solve@4000(1k groups)x1000 mode={args.mode}")
     rng = np.random.default_rng(44)
     snap, _ = _build(config4_gangs, rng, n_groups=1000, gang_size=4, n_nodes=1000)
-    engine = Engine(EngineConfig(mode=args.mode))
-    fn = _prep(engine, snap, "solve")
-    stats = bench_fn(fn, max(20, args.iters // 3), label="gangs")
-    emit("gang_solve_p99_latency_4000x1000", stats)
+    for mode in _modes(args):
+        log(f"[gangs] solve@4000(1k groups)x1000 mode={mode}")
+        engine = Engine(EngineConfig(mode=mode))
+        fn = _prep(engine, snap, "solve")
+        stats = bench_fn(fn, max(20, args.iters // 3), label="gangs")
+        emit(f"gang_solve_p99_latency_4000x1000_{mode}", stats,
+             {"mode": mode})
 
 
 def bench_preemption(args):
@@ -180,13 +217,15 @@ def bench_preemption(args):
     from tpusched import Engine, EngineConfig
     from tpusched.synth import config5_preemption
 
-    log(f"[preemption] solve@1000x200 @90% util mode={args.mode}")
     rng = np.random.default_rng(45)
     snap, _ = _build(config5_preemption, rng, n_pods=1000, n_nodes=200)
-    engine = Engine(EngineConfig(mode=args.mode, preemption=True))
-    fn = _prep(engine, snap, "solve")
-    stats = bench_fn(fn, max(20, args.iters // 3), label="preemption")
-    emit("preemption_solve_p99_latency_1000x200", stats)
+    for mode in _modes(args):
+        log(f"[preemption] solve@1000x200 @90% util mode={mode}")
+        engine = Engine(EngineConfig(mode=mode, preemption=True))
+        fn = _prep(engine, snap, "solve")
+        stats = bench_fn(fn, max(20, args.iters // 3), label="preemption")
+        emit(f"preemption_solve_p99_latency_1000x200_{mode}", stats,
+             {"mode": mode})
 
 
 def bench_pipeline(args):
@@ -197,8 +236,11 @@ def bench_pipeline(args):
     from tpusched.synth import config2_scale
 
     pods, nodes = 5000, 2000
-    log(f"[pipeline] stream of 8 batches @{pods}x{nodes} mode={args.mode}")
-    eng = Engine(EngineConfig(mode=args.mode))
+    # Overlap is measured in fast mode: the shorter the solve, the less
+    # room there is to hide decode behind it — the harder case.
+    mode = "fast" if args.mode == "both" else args.mode
+    log(f"[pipeline] stream of 8 batches @{pods}x{nodes} mode={mode}")
+    eng = Engine(EngineConfig(mode=mode))
 
     def decode(seed):
         return config2_scale(np.random.default_rng(seed), pods, nodes,
@@ -230,13 +272,44 @@ def bench_e2e(args):
          {"placements_per_sec": stats.get("placements_per_sec")})
 
 
+def bench_divergence(args):
+    """Fast-vs-parity agreement as NUMBERS per round (round-2 verdict
+    next-step #2): identical-placement rate, placed delta, per-seed
+    worst-case placed ratio, and the validity-violation count (must stay
+    0) for each contention preset."""
+    from tpusched import Engine, EngineConfig
+    from tpusched.divergence import PRESETS, measure
+
+    engines = (Engine(EngineConfig(mode="fast")),
+               Engine(EngineConfig(mode="parity")))
+    seeds = 6
+    for preset in sorted(PRESETS):
+        log(f"[divergence] preset={preset} seeds={seeds} @80x16")
+        stats = measure(preset, seeds=seeds, engines=engines)
+        row = stats.row()
+        log(f"  identical_rate={row['identical_rate']} "
+            f"placed_delta={row['placed_delta']} "
+            f"min_placed_ratio={row['min_placed_ratio']} "
+            f"violations={row['fast_violations']}")
+        line = {
+            "metric": f"divergence_{preset}",
+            "value": row["identical_rate"],
+            "unit": "identical_rate",
+            "vs_baseline": None,
+        }
+        line.update({k: v for k, v in row.items() if k != "preset"})
+        print(json.dumps(line), flush=True)
+
+
 BENCHES = {
+    "divergence": bench_divergence,
     "pairwise": bench_pairwise,
     "gangs": bench_gangs,
     "preemption": bench_preemption,
     "pipeline": bench_pipeline,
     "e2e": bench_e2e,
     # headline runs last so the final stdout line is the headline metric
+    # (parity mode last within it — the stock-semantics north-star claim)
     "headline": bench_headline,
 }
 
@@ -255,7 +328,10 @@ def main():
     ap.add_argument("--iters", type=int, default=200)
     ap.add_argument("--what", choices=["score", "score_top1", "solve"],
                     default="solve")
-    ap.add_argument("--mode", choices=["fast", "parity"], default="fast")
+    ap.add_argument("--mode", choices=["both", "fast", "parity"],
+                    default="both",
+                    help="both = fast then parity (parity last: the "
+                         "stock-semantics headline is the final line)")
     ap.add_argument("--only", choices=sorted(BENCHES), default=None,
                     help="run a single bench instead of all")
     ap.add_argument("--dump", default=None,

@@ -246,6 +246,12 @@ def test_server_rejects_unsafe_delta_upserts(sidecar):
             client.assign_delta(delta)
         assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
 
+    # A delta without a base_id can never resolve — rejected loudly
+    # rather than silently solving the empty default snapshot.
+    with pytest.raises(grpc.RpcError) as ei:
+        client.assign_delta(pb.SnapshotDelta())
+    assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
 
 def test_reordered_full_send_schedules_identically(sidecar):
     """Same state, different wire order -> identical placements (codec

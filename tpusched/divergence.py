@@ -9,12 +9,16 @@ contention for bounded rounds; its guarantees are:
   * validity — capacity, static predicates, DoNotSchedule spread,
     required (anti-)affinity all hold against commit-time state
     (audited by oracle.validate_assignment);
-  * schedulability agreement — the same SET of pods places (fast_only /
-    parity_only stay 0 in practice);
+  * near-equal throughput — the same NUMBER of pods places to within a
+    few percent, but not the same SET: measured on 6 seeds/preset
+    (round 2), the `mixed` preset nets -3.3% placements for fast mode
+    (35 pods parity places that fast strands vs 19 the other way);
   * exact node agreement whenever pods' decisions don't interact — note
     that load-balancing scores couple every pod to all earlier commits,
     so on busy clusters node choices differ by design while remaining
-    equally valid and equally balanced.
+    equally valid and equally balanced. Measured: even the `plain`
+    preset (no constraints at all) is only ~11% node-identical, because
+    per-node agreement collapses once any commit order diverges.
 
 This module puts NUMBERS on the divergence: run both modes over seeded
 snapshots and report how often placements differ and by how much.
@@ -34,8 +38,9 @@ from tpusched.oracle import validate_assignment
 from tpusched.synth import make_cluster
 
 # Contention presets: fractions chosen so the interesting regimes are
-# all covered — no constraints (must agree exactly), capacity pressure
-# only, pairwise-heavy, and everything at once.
+# all covered — no constraints (same placement COUNT; node choices still
+# diverge via load-balance coupling), capacity pressure only,
+# pairwise-heavy, and everything at once.
 PRESETS: dict[str, dict] = {
     "plain": dict(),
     "tight": dict(initial_utilization=0.7, n_running_per_node=4),
@@ -61,6 +66,11 @@ class DivergenceStats:
     fast_placed: int = 0
     parity_placed: int = 0
     fast_violations: int = 0      # MUST stay 0
+    # Worst single-seed fast/parity placed ratio (advisor round 2: track
+    # the per-seed worst case as a number so erosion of the fast-mode
+    # throughput floor shows up in BENCH output, not just in a loosened
+    # test threshold).
+    min_placed_ratio: float = 1.0
 
     @property
     def identical_rate(self) -> float:
@@ -80,6 +90,7 @@ class DivergenceStats:
             parity_only_placed=self.parity_only_placed,
             placed_delta=self.placed_delta,
             fast_violations=self.fast_violations,
+            min_placed_ratio=round(self.min_placed_ratio, 4),
         )
 
 
@@ -89,13 +100,18 @@ def measure(
     n_pods: int = 80,
     n_nodes: int = 16,
     base_seed: int = 3000,
+    engines: "tuple[Engine, Engine] | None" = None,
 ) -> DivergenceStats:
     """Run fast and parity over `seeds` random snapshots of a preset and
     accumulate agreement statistics. Every fast assignment is also run
-    through the independent validity audit."""
+    through the independent validity audit. `engines` = (fast, parity)
+    to reuse jit caches across presets (bench.py does)."""
     kw = PRESETS[preset]
-    fast = Engine(EngineConfig(mode="fast"))
-    parity = Engine(EngineConfig(mode="parity"))
+    if engines is not None:
+        fast, parity = engines
+    else:
+        fast = Engine(EngineConfig(mode="fast"))
+        parity = Engine(EngineConfig(mode="parity"))
     out = DivergenceStats(preset=preset, seeds=seeds)
     for s in range(seeds):
         rng = np.random.default_rng(base_seed + s)
@@ -110,8 +126,14 @@ def measure(
         out.both_placed_diff_node += int(((fa >= 0) & (pa >= 0) & (fa != pa)).sum())
         out.fast_only_placed += int(((fa >= 0) & (pa < 0)).sum())
         out.parity_only_placed += int(((fa < 0) & (pa >= 0)).sum())
-        out.fast_placed += int((fa >= 0).sum())
-        out.parity_placed += int((pa >= 0).sum())
+        seed_fast = int((fa >= 0).sum())
+        seed_parity = int((pa >= 0).sum())
+        out.fast_placed += seed_fast
+        out.parity_placed += seed_parity
+        if seed_parity > 0:
+            out.min_placed_ratio = min(
+                out.min_placed_ratio, seed_fast / seed_parity
+            )
         violations = validate_assignment(
             snap, fast.config, fres.assignment,
             commit_key=fres.commit_key, evicted=fres.evicted,

@@ -5,8 +5,13 @@ the reference scheduler's one-pod-at-a-time cycle (SURVEY.md §3.1
 `scheduleOne`) — pop highest dynamic-priority pod, Filter every node,
 Score, NormalizeScore, weighted sum, pick the max, commit to the cache —
 in plain NumPy with zero batching tricks. The batched TPU engine must
-produce identical placements (parity mode: bit-identical; fast mode:
-identical on non-contended snapshots).
+produce identical placements in parity mode (bit-identical, fuzz-tested).
+Fast mode does NOT promise node-identical placements: every commit
+couples later pods through load-balancing scores, so node agreement
+collapses once commit order diverges (measured ~11% node-identical even
+with no constraints; net placed-pod delta -3.3% on the mixed preset —
+tpusched/divergence.py has the numbers). Fast mode's contract is
+validity (audited) and near-equal placement COUNT, not the same nodes.
 
 Semantics notes (each mirrors an upstream plugin, SURVEY.md C2-C7):
   * NodeResourcesFit filter: forall r: used_r + req_r <= allocatable_r.

@@ -132,6 +132,12 @@ class DeltaSession:
                         2 ** (self._consec_fallbacks - 1), 64
                     )
                 self._base = self._base_id = None
+                resp = send_full(snapshot)
+                self.full_sends += 1
+                self.bytes_sent += full_bytes
+                # delta_safe already verified this cycle (guard above).
+                self._remember(snapshot, resp.snapshot_id, verified=True)
+                return resp
         elif self._skip_delta > 0:
             self._skip_delta -= 1
         resp = send_full(snapshot)
@@ -141,15 +147,17 @@ class DeltaSession:
         return resp
 
     def _remember(self, snapshot: pb.ClusterSnapshot, sid: str,
-                  prebuilt: "codec.SnapshotStore | None" = None) -> None:
+                  prebuilt: "codec.SnapshotStore | None" = None,
+                  verified: bool = False) -> None:
         """Record what was sent, as per-record BYTES: immune to the
         caller mutating its message in place afterwards, and usable only
         when the snapshot is delta-safe (unique non-empty names — the
         stores key by name). `prebuilt` reuses the bytes delta_between
         already serialized for the diff (no second serialization pass)."""
-        # prebuilt only arrives from the delta branch, which already
-        # verified delta_safe this cycle — don't re-scan all records.
-        if not sid or (prebuilt is None and not codec.delta_safe(snapshot)):
+        # prebuilt/verified only arrive from paths that already checked
+        # delta_safe this cycle — don't re-scan all records.
+        if not sid or (prebuilt is None and not verified
+                       and not codec.delta_safe(snapshot)):
             self._base = self._base_id = None
             return
         if prebuilt is not None:

@@ -167,7 +167,14 @@ class SchedulerService:
         whose records lack unique non-empty names are served but not
         registered (empty snapshot_id): name-keyed stores would collapse
         them (DeltaSession refuses to delta against those too)."""
-        if request.HasField("delta") and request.delta.base_id:
+        if request.HasField("delta"):
+            if not request.delta.base_id:
+                # Falling through would silently solve the empty default
+                # snapshot; a delta without a base cannot be resolved.
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "delta request carries no base_id",
+                )
             self._check_delta_upserts(request.delta, context)
             with self._store_lock:
                 base = self._stores.get(request.delta.base_id)
