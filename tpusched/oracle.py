@@ -723,6 +723,15 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
     optimistic-assume edge, not an engine defect (see
     tests/test_gangs.py::test_gang_rollback_audit_caveat).
 
+    Violations consistent with that caveat carry a machine-readable
+    " [gang-optimism]" suffix: the constraint flips to satisfied when the
+    snapshot's UNPLACED gang members are hypothetically restored to the
+    placed set, so the report is exactly what a rolled-back gang would
+    produce. Downstream audits filter with
+    `[v for v in violations if "[gang-optimism]" not in v]` to get the
+    hard-violation set. Untagged reports are never tagged spuriously on
+    gang-free snapshots (there is nothing to restore).
+
     Returns human-readable violation strings (empty = valid)."""
     ora = Oracle(snap, cfg)
     pods, nodes = snap.pods, snap.nodes
