@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 import time
 
@@ -34,9 +35,53 @@ import numpy as np
 
 TARGET_P99_S = 0.5  # BASELINE.json:"north_star": <500 ms p99 @ 10k x 5k
 
+# Transport characterization of this process's backend, filled by
+# measure_transport() before any bench runs and attached to every
+# latency metric line as context. Motivated by the round-3 "regression":
+# fast-mode p99 went 254.8 -> 412.8 ms between rounds with BYTE-IDENTICAL
+# engine code, because the axon tunnel's fixed result-fetch round trip
+# drifted ~40 -> ~103 ms between sessions. Every measured latency here is
+# device_compute + one such RTT; recording the RTT per run makes
+# cross-round comparisons attributable (engine vs environment).
+TRANSPORT: dict = {}
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def measure_transport(iters: int = 12) -> dict:
+    """Fixed per-fetch RTT (trivial jit call + materialize) and D2H
+    bandwidth (fresh 8 MB result) of the current backend. On a local
+    TPU host these are ~0; on the axon tunnel RTT is tens-to-hundreds
+    of ms and bandwidth ~10-15 MB/s, and they dominate small-result
+    serving latency (e.g. the 100x10 e2e config)."""
+    import jax
+
+    x = jax.device_put(np.float32(1.0))
+    f = jax.jit(lambda v: v + 1.0)
+    np.asarray(f(x))  # compile
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        ts.append(time.perf_counter() - t0)
+    rtt_ms = float(np.percentile(ts, 50) * 1e3)
+    big = jax.jit(
+        lambda k: jax.random.uniform(k, (1024, 2048))  # 8 MB fresh result
+    )
+    key = jax.random.PRNGKey(0)
+    out = big(key)
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    a = np.asarray(out)
+    dt = time.perf_counter() - t0
+    d2h = a.nbytes / 1e6 / max(dt - rtt_ms / 1e3, 1e-6)
+    TRANSPORT.update(rtt_ms=round(rtt_ms, 2), d2h_mbps=round(d2h, 1))
+    log(f"transport: result-fetch RTT {rtt_ms:.1f}ms, "
+        f"D2H ~{d2h:.0f} MB/s (subtract RTT from any p50 below to "
+        f"estimate device compute)")
+    return TRANSPORT
 
 
 def materialize(out):
@@ -90,6 +135,8 @@ def emit(metric: str, stats: dict, extra: dict | None = None,
         "p50_ms": round(stats["p50"] * 1e3, 3),
         "iters": stats["iters"],
     }
+    if TRANSPORT:
+        line["rtt_ms"] = TRANSPORT["rtt_ms"]
     if extra:
         line.update(extra)
     print(json.dumps(line), flush=True)
@@ -126,13 +173,58 @@ def _prep(engine, snap, what: str):
     return fn
 
 
+def _run_isolated(args, mode: str) -> None:
+    """Re-run the headline bench for one mode in a FRESH subprocess and
+    relay its metric lines. Round-3 verdict (weak #1) asked for mode
+    isolation to rule out cross-mode harness effects (shared jit caches,
+    device memory pressure from earlier benches); a clean process is the
+    strongest isolation available."""
+    cmd = [
+        sys.executable, __file__, "--only", "headline", "--mode", mode,
+        "--pods", str(args.pods), "--nodes", str(args.nodes),
+        "--iters", str(args.iters), "--what", args.what, "--no-isolate",
+    ]
+    if args.replay:
+        cmd += ["--replay", args.replay]
+    if args.profile:
+        cmd += ["--profile", args.profile]
+    log(f"[headline] mode={mode} in isolated subprocess")
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    for ln in proc.stderr.splitlines():
+        log(f"  [sub] {ln}")
+    for ln in proc.stdout.splitlines():
+        if ln.strip():
+            print(ln, flush=True)
+    if proc.returncode != 0:
+        # On single-host TPUs libtpu is exclusive-access: the parent
+        # already holds the chip and the child cannot initialize. Fall
+        # back to the in-process run rather than losing the mode (and,
+        # for the parity-last contract, the headline line itself).
+        raise _IsolationUnavailable(
+            f"isolated headline mode={mode} failed (rc={proc.returncode})"
+        )
+
+
+class _IsolationUnavailable(RuntimeError):
+    pass
+
+
 def bench_headline(args):
     """configs[1]: NodeResourcesFit + BalancedAllocation at 10k x 5k.
-    With --mode both (default): fast first, then PARITY LAST — exact
-    stock semantics under the 500 ms budget is the north-star claim, so
-    the parity number is the final (driver-parsed) stdout line."""
+    With --mode both (default): fast first — in an ISOLATED fresh
+    subprocess, so its number carries no state from earlier benches —
+    then PARITY LAST in-process; exact stock semantics under the 500 ms
+    budget is the north-star claim, so the parity number is the final
+    (driver-parsed) stdout line."""
     from tpusched import Engine, EngineConfig
     from tpusched.synth import config2_scale
+
+    if args.mode == "both" and not args.no_isolate:
+        try:
+            _run_isolated(args, "fast")
+            args = argparse.Namespace(**{**vars(args), "mode": "parity"})
+        except _IsolationUnavailable as e:
+            log(f"[headline] {e}; falling back to in-process fast mode")
 
     n_pods, n_nodes = args.pods, args.nodes
     if args.replay:
@@ -257,6 +349,7 @@ def bench_pipeline(args):
         "vs_baseline": round(stats["speedup"], 3),
         "sequential_s": round(stats["sequential_s"], 3),
         "pipelined_s": round(stats["pipelined_s"], 3),
+        **({"rtt_ms": TRANSPORT["rtt_ms"]} if TRANSPORT else {}),
     }), flush=True)
 
 
@@ -297,6 +390,8 @@ def bench_divergence(args):
             "unit": "identical_rate",
             "vs_baseline": None,
         }
+        if TRANSPORT:
+            line["rtt_ms"] = TRANSPORT["rtt_ms"]
         line.update({k: v for k, v in row.items() if k != "preset"})
         print(json.dumps(line), flush=True)
 
@@ -340,11 +435,15 @@ def main():
                     help="load the headline snapshot from this .npz")
     ap.add_argument("--profile", default=None,
                     help="write a jax.profiler trace to this directory")
+    ap.add_argument("--no-isolate", action="store_true",
+                    help="run headline modes in-process even with "
+                         "--mode both (isolation subprocess off)")
     args = ap.parse_args()
 
     import jax
 
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
+    measure_transport()
     if args.only:
         BENCHES[args.only](args)
         return
