@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -125,6 +126,8 @@ class Engine:
         self._solve_packed_jit = jax.jit(_solve_packed)
         self._score_jit = jax.jit(_score)
         self._score_top1_jit = jax.jit(_score_top1)
+        self._score_fn = _score
+        self._topk_jits: dict[int, Any] = {}  # k -> jitted top-k path
 
     # -- public API ---------------------------------------------------------
 
@@ -170,6 +173,45 @@ class Engine:
         )
         out.solve_seconds = time.perf_counter() - t0
         return out
+
+    def score_topk(self, snap: ClusterSnapshot, k: int):
+        """Top-k of the ScoreBatch matrix computed ON DEVICE: each
+        pod's best k feasible nodes (descending) and their scores,
+        fetched as one packed [2*P*k] f32 buffer (node indices are
+        exact in f32: N < 2^24). This is the O(P) serving form of the
+        Score-plugin surface — the [P, N] matrix never leaves the
+        device; upstream's percentageOfNodesToScore likewise narrows
+        the scored-node set at scale. Returns (idx[P,k] int32 with -1
+        where fewer than k feasible, scores[P,k] f32 with 0 at -1
+        slots, seconds)."""
+        k = int(k)
+        if not 1 <= k <= snap.nodes.valid.shape[0]:
+            raise ValueError(
+                f"top_k={k} out of range for {snap.nodes.valid.shape[0]} "
+                "node slots"
+            )
+        fn = self._topk_jits.get(k)
+        if fn is None:
+            score = self._score_fn
+
+            def _topk(s: ClusterSnapshot):
+                feasible, scores = score(s)
+                masked = jnp.where(feasible, scores, -jnp.inf)
+                v, i = jax.lax.top_k(masked, k)
+                ok = jnp.isfinite(v)
+                return jnp.concatenate([
+                    jnp.where(ok, i, -1).astype(jnp.float32).ravel(),
+                    jnp.where(ok, v, 0.0).ravel(),
+                ])
+
+            fn = self._topk_jits[k] = jax.jit(_topk)
+        t0 = time.perf_counter()
+        buf = np.asarray(fn(snap))
+        P = snap.pods.valid.shape[0]
+        half = P * k
+        idx = buf[:half].astype(np.int32).reshape(P, k)
+        val = buf[half:].reshape(P, k).astype(np.float32)
+        return idx, val, time.perf_counter() - t0
 
     def score_top1(self, snap: ClusterSnapshot):
         """Full [P, N] scoring on device, returning only each pod's best

@@ -8,10 +8,75 @@ full batched solve), read back scores/assignments by name.
 from __future__ import annotations
 
 import grpc
+import numpy as np
 
 from tpusched.rpc import codec
 from tpusched.rpc import tpusched_pb2 as pb
 from tpusched.rpc.server import SERVICE
+
+
+def score_response_arrays(resp: pb.ScoreResponse):
+    """(feasible[P,N] bool, scores[P,N] f32) from either the row or the
+    packed-bytes ScoreResponse form."""
+    P, N = len(resp.pod_names), len(resp.node_names)
+    if resp.scores_packed:
+        feas = np.frombuffer(resp.feasible_packed, np.uint8)
+        return (
+            feas.reshape(P, N).astype(bool),
+            np.frombuffer(resp.scores_packed, "<f4")
+            .reshape(P, N).astype(np.float32),
+        )
+    if resp.k:
+        raise ValueError(
+            "response carries the top-k form; use score_topk_arrays"
+        )
+    feas = np.zeros((P, N), bool)
+    scores = np.zeros((P, N), np.float32)
+    for i, row in enumerate(resp.rows):
+        feas[i] = row.feasible
+        scores[i] = row.scores
+    return feas, scores
+
+
+def score_topk_arrays(resp: pb.ScoreResponse):
+    """(idx[P,k] int32 node indices with -1 padding, scores[P,k] f32)
+    from the top-k ScoreResponse form (request.top_k > 0). Indices
+    resolve against resp.node_names (the decoder's canonical sorted
+    order). A zero-node snapshot yields [P,0] arrays."""
+    P = len(resp.pod_names)
+    if not resp.k:
+        if not resp.node_names and not resp.rows:
+            # top_k requested on a drained cluster: nothing to rank.
+            return (np.zeros((P, 0), np.int32), np.zeros((P, 0), np.float32))
+        raise ValueError("response carries no top-k form (request had "
+                         "top_k unset)")
+    k = resp.k
+    return (
+        np.frombuffer(resp.topk_idx_packed, "<i4").reshape(P, k),
+        np.frombuffer(resp.topk_score_packed, "<f4").reshape(P, k),
+    )
+
+
+def assign_response_arrays(resp: pb.AssignResponse):
+    """(pod_names, node_names, node_idx[P] int32 (-1 unplaced),
+    score[P] f32, commit_key[P] int32) from the packed AssignResponse
+    form (request.packed_ok). node_idx values index into the returned
+    node_names — the decoder's canonical sorted order, NOT the request
+    wire order. The repeated-Assignment form carries node names inline;
+    use .assignments for it. A zero-pod response decodes to empty
+    arrays (valid for either form)."""
+    if resp.assignments:
+        raise ValueError(
+            "response carries the repeated-Assignment form; read "
+            ".assignments (request had packed_ok unset)"
+        )
+    return (
+        list(resp.pod_names),
+        list(resp.node_names),
+        np.frombuffer(resp.node_idx_packed, "<i4"),
+        np.frombuffer(resp.score_packed, "<f4"),
+        np.frombuffer(resp.commit_key_packed, "<i4"),
+    )
 
 
 class SchedulerClient:
@@ -40,21 +105,36 @@ class SchedulerClient:
     def health(self) -> pb.HealthResponse:
         return self._health(pb.HealthRequest(), timeout=self.timeout)
 
-    def score_batch(self, snapshot: pb.ClusterSnapshot) -> pb.ScoreResponse:
+    def score_batch(self, snapshot: pb.ClusterSnapshot, *,
+                    packed_ok: bool = False,
+                    top_k: int = 0) -> pb.ScoreResponse:
         return self._score(
-            pb.ScoreRequest(snapshot=snapshot), timeout=self.timeout
+            pb.ScoreRequest(snapshot=snapshot, packed_ok=packed_ok,
+                            top_k=top_k),
+            timeout=self.timeout,
         )
 
-    def assign(self, snapshot: pb.ClusterSnapshot) -> pb.AssignResponse:
+    def assign(self, snapshot: pb.ClusterSnapshot, *,
+               packed_ok: bool = False) -> pb.AssignResponse:
         return self._assign(
-            pb.AssignRequest(snapshot=snapshot), timeout=self.timeout
+            pb.AssignRequest(snapshot=snapshot, packed_ok=packed_ok),
+            timeout=self.timeout,
         )
 
-    def score_batch_delta(self, delta: pb.SnapshotDelta) -> pb.ScoreResponse:
-        return self._score(pb.ScoreRequest(delta=delta), timeout=self.timeout)
+    def score_batch_delta(self, delta: pb.SnapshotDelta, *,
+                          packed_ok: bool = False,
+                          top_k: int = 0) -> pb.ScoreResponse:
+        return self._score(
+            pb.ScoreRequest(delta=delta, packed_ok=packed_ok, top_k=top_k),
+            timeout=self.timeout,
+        )
 
-    def assign_delta(self, delta: pb.SnapshotDelta) -> pb.AssignResponse:
-        return self._assign(pb.AssignRequest(delta=delta), timeout=self.timeout)
+    def assign_delta(self, delta: pb.SnapshotDelta, *,
+                     packed_ok: bool = False) -> pb.AssignResponse:
+        return self._assign(
+            pb.AssignRequest(delta=delta, packed_ok=packed_ok),
+            timeout=self.timeout,
+        )
 
     def metrics_text(self) -> str:
         return self._metrics(
@@ -97,7 +177,8 @@ class DeltaSession:
         self.bytes_sent = 0
         self.bytes_full_equiv = 0
 
-    def _call(self, snapshot: pb.ClusterSnapshot, send_full, send_delta):
+    def _call(self, snapshot: pb.ClusterSnapshot, send_full, send_delta,
+              changed: "set[str] | None" = None):
         full_bytes = snapshot.ByteSize()
         self.bytes_full_equiv += full_bytes
         if (
@@ -113,7 +194,8 @@ class DeltaSession:
         ):
             new_bytes = codec.SnapshotStore()
             delta = codec.delta_between(
-                self._base, snapshot, self._base_id, new_bytes=new_bytes
+                self._base, snapshot, self._base_id, new_bytes=new_bytes,
+                changed=changed,
             )
             self.bytes_sent += delta.ByteSize()  # transmitted even on reject
             try:
@@ -172,12 +254,25 @@ class DeltaSession:
         self._base = st
         self._base_id = sid
 
-    def assign(self, snapshot: pb.ClusterSnapshot) -> pb.AssignResponse:
+    def assign(self, snapshot: pb.ClusterSnapshot,
+               changed: "set[str] | None" = None,
+               **kw) -> pb.AssignResponse:
+        """changed: optional names of records the caller knows it
+        touched since the last call (watch-event driven); makes the
+        diff O(churn) — see codec.delta_between."""
         return self._call(
-            snapshot, self.client.assign, self.client.assign_delta
+            snapshot,
+            lambda s: self.client.assign(s, **kw),
+            lambda d: self.client.assign_delta(d, **kw),
+            changed=changed,
         )
 
-    def score_batch(self, snapshot: pb.ClusterSnapshot) -> pb.ScoreResponse:
+    def score_batch(self, snapshot: pb.ClusterSnapshot,
+                    changed: "set[str] | None" = None,
+                    **kw) -> pb.ScoreResponse:
         return self._call(
-            snapshot, self.client.score_batch, self.client.score_batch_delta
+            snapshot,
+            lambda s: self.client.score_batch(s, **kw),
+            lambda d: self.client.score_batch_delta(d, **kw),
+            changed=changed,
         )

@@ -23,7 +23,7 @@ from tpusched.snapshot import (
 
 
 def decode_snapshot(
-    msg: pb.ClusterSnapshot,
+    msg: "pb.ClusterSnapshot | bytes",
     config: EngineConfig | None = None,
     buckets: Buckets | None = None,
     prefer_native: bool | None = None,
@@ -33,9 +33,13 @@ def decode_snapshot(
     Python path) when it is available. prefer_native=None consults the
     TPUSCHED_NO_NATIVE env toggle; False forces the Python path.
 
-    The re-serialization feeding the native parser is upb-backed and
-    costs ~5 ms at 10k x 5k (measured) — noise next to the ~350 ms of
-    Python decode it replaces.
+    `msg` may be the parsed message or its serialized BYTES: the
+    sidecar's delta path composes snapshots as concatenated per-record
+    wire bytes (SnapshotStore.compose_bytes) and hands them straight to
+    the native parser — no Python message is ever materialized there.
+    For a parsed message, the re-serialization feeding the native
+    parser is upb-backed and costs ~5 ms at 10k x 5k (measured) — noise
+    next to the ~350 ms of Python decode it replaces.
 
     A native decode error falls back to the Python path: if the input
     is genuinely bad, Python raises the authoritative error; if it was
@@ -50,9 +54,9 @@ def decode_snapshot(
 
         if native.available():
             try:
-                return native.decode_snapshot_bytes(
-                    msg.SerializeToString(), config, buckets
-                )
+                data = (msg if isinstance(msg, bytes)
+                        else msg.SerializeToString())
+                return native.decode_snapshot_bytes(data, config, buckets)
             except Exception:
                 # The fallback must be LOUD: a native decode failure is
                 # either a contract bug (native.py calls it "a bug in
@@ -65,6 +69,8 @@ def decode_snapshot(
                     "decoder for this request:\n%s",
                     traceback.format_exc(limit=3),
                 )
+    if isinstance(msg, bytes):
+        msg = pb.ClusterSnapshot.FromString(msg)
     return snapshot_from_proto(msg, config, buckets)
 
 
@@ -207,16 +213,35 @@ def delta_safe(msg: pb.ClusterSnapshot) -> bool:
     return True
 
 
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
 class SnapshotStore:
-    """Name-keyed record store of one snapshot's proto sub-messages, so a
-    SnapshotDelta can be applied and the full ClusterSnapshot recomposed
-    server-side. Wire savings: the client ships only changed records;
-    the recompose + re-intern cost stays on the sidecar host."""
+    """Name-keyed record store of one snapshot's proto sub-messages
+    (messages OR their serialized bytes), so a SnapshotDelta can be
+    applied and the full ClusterSnapshot recomposed server-side. Wire
+    savings: the client ships only changed records; the recompose +
+    re-intern cost stays on the sidecar host.
+
+    The sidecar stores BYTES (set_full_bytes): applying a delta then
+    serializes only the churned records, and compose_bytes() builds the
+    full serialized snapshot by pure concatenation — protobuf wire
+    format allows a repeated field's entries to appear anywhere in the
+    stream — feeding the native decoder with no Python message at all."""
 
     def __init__(self, msg: pb.ClusterSnapshot | None = None):
-        self.nodes: dict[str, pb.Node] = {}
-        self.pods: dict[str, pb.PendingPod] = {}
-        self.running: dict[str, pb.RunningPod] = {}
+        self.nodes: dict[str, "pb.Node | bytes"] = {}
+        self.pods: dict[str, "pb.PendingPod | bytes"] = {}
+        self.running: dict[str, "pb.RunningPod | bytes"] = {}
         if msg is not None:
             self.set_full(msg)
 
@@ -224,6 +249,13 @@ class SnapshotStore:
         self.nodes = {n.name: n for n in msg.nodes}
         self.pods = {p.name: p for p in msg.pods}
         self.running = {r.name: r for r in msg.running}
+
+    def set_full_bytes(self, msg: pb.ClusterSnapshot) -> None:
+        """Store serialized records (one upb serialize pass per record,
+        full sends only); later delta cycles reuse the bytes."""
+        self.nodes = {n.name: n.SerializeToString() for n in msg.nodes}
+        self.pods = {p.name: p.SerializeToString() for p in msg.pods}
+        self.running = {r.name: r.SerializeToString() for r in msg.running}
 
     def copy(self) -> "SnapshotStore":
         st = SnapshotStore()
@@ -233,25 +265,59 @@ class SnapshotStore:
         return st
 
     def apply_delta(self, delta: pb.SnapshotDelta) -> None:
+        """Upserts are stored as bytes when the store holds bytes
+        (serialize churn only), as messages otherwise."""
+        as_bytes = any(
+            isinstance(next(iter(d.values()), None), bytes)
+            for d in (self.nodes, self.pods, self.running)
+        )
+
+        def put(d, rec):
+            d[rec.name] = rec.SerializeToString() if as_bytes else rec
+
         for n in delta.upsert_nodes:
-            self.nodes[n.name] = n
+            put(self.nodes, n)
         for name in delta.remove_nodes:
             self.nodes.pop(name, None)
         for p in delta.upsert_pods:
-            self.pods[p.name] = p
+            put(self.pods, p)
         for name in delta.remove_pods:
             self.pods.pop(name, None)
         for r in delta.upsert_running:
-            self.running[r.name] = r
+            put(self.running, r)
         for name in delta.remove_running:
             self.running.pop(name, None)
 
     def compose(self) -> pb.ClusterSnapshot:
         msg = pb.ClusterSnapshot()
+        if any(isinstance(v, bytes) for v in
+               (*self.nodes.values(), *self.pods.values(),
+                *self.running.values())):
+            return pb.ClusterSnapshot.FromString(self.compose_bytes())
         msg.nodes.extend(self.nodes.values())
         msg.pods.extend(self.pods.values())
         msg.running.extend(self.running.values())
         return msg
+
+    # ClusterSnapshot field tags, wire type 2 (length-delimited):
+    # (1<<3)|2, (2<<3)|2, (3<<3)|2.
+    _TAGS = (b"\x0a", b"\x12", b"\x1a")
+
+    def compose_bytes(self) -> bytes:
+        """Serialized ClusterSnapshot by concatenating length-delimited
+        record fields — a few ms at 10k x 5k vs ~25 ms for message
+        compose + re-serialize. Record order is irrelevant: the decoder
+        canonicalizes by name (snapshot_from_proto sorts; the native
+        decoder matches it)."""
+        parts = []
+        for tag, d in zip(self._TAGS,
+                          (self.nodes, self.pods, self.running)):
+            for rec in d.values():
+                raw = _ser(rec)
+                parts.append(tag)
+                parts.append(_varint(len(raw)))
+                parts.append(raw)
+        return b"".join(parts)
 
 
 def _ser(rec) -> bytes:
@@ -260,7 +326,8 @@ def _ser(rec) -> bytes:
 
 def delta_between(prev: SnapshotStore, msg: pb.ClusterSnapshot,
                   base_id: str,
-                  new_bytes: SnapshotStore | None = None) -> pb.SnapshotDelta:
+                  new_bytes: SnapshotStore | None = None,
+                  changed: "set[str] | None" = None) -> pb.SnapshotDelta:
     """Client-side diff: the SnapshotDelta turning `prev` into `msg`.
     Record equality by serialized bytes. `prev` values may be messages
     or pre-serialized bytes (DeltaSession stores bytes so that a caller
@@ -269,17 +336,34 @@ def delta_between(prev: SnapshotStore, msg: pb.ClusterSnapshot,
 
     new_bytes: optional empty SnapshotStore; when given, filled with
     msg's per-record serialized bytes so the caller can remember them
-    as the next base without serializing everything a second time."""
+    as the next base without serializing everything a second time.
+
+    changed: optional set of record names the caller knows may have
+    changed since the base (an informer-driven client knows exactly
+    which objects its watch events touched). Base records NOT named are
+    trusted byte-identical and skipped without re-serialization, making
+    the per-cycle diff O(churn) instead of O(cluster) serialization
+    work (~100 ms at 10k x 5k). Additions and removals are still
+    detected by name regardless. CONTRACT: a caller that mutates a
+    record without naming it here ships a stale record and the sidecar
+    solves a stale snapshot — name everything you touch."""
     delta = pb.SnapshotDelta(base_id=base_id)
+    if changed is not None and not isinstance(changed, set):
+        changed = set(changed)
 
     def diff(prev_d, coll, upserts, removes, out_d):
         new_names = set()
         for rec in coll:
             new_names.add(rec.name)
+            old = prev_d.get(rec.name)
+            if (changed is not None and old is not None
+                    and rec.name not in changed):
+                if out_d is not None:
+                    out_d[rec.name] = _ser(old)
+                continue
             raw = rec.SerializeToString()
             if out_d is not None:
                 out_d[rec.name] = raw
-            old = prev_d.get(rec.name)
             if old is None or _ser(old) != raw:
                 upserts.append(rec)
         removes.extend(k for k in prev_d if k not in new_names)

@@ -54,10 +54,16 @@ def make_cluster(
     namespace_count: int = 1,
     pdb_frac: float = 0.0,
     cordon_frac: float = 0.0,
+    as_records: bool = False,
 ):
     """General-purpose random cluster. Fractions control what share of
     pods/nodes carry each constraint type, so the same generator covers
-    BASELINE configs 1-5 (resource-only through gangs)."""
+    BASELINE configs 1-5 (resource-only through gangs).
+
+    as_records=True returns (node_records, pod_records, running_records)
+    — builder-style dicts ready for rpc.codec.snapshot_to_proto — instead
+    of building the array snapshot; the wire benches use this to drive
+    the full gRPC cycle with the same synthetic clusters."""
     config = config or EngineConfig()
     b = SnapshotBuilder(config, buckets)
 
@@ -218,6 +224,25 @@ def make_cluster(
             namespace=f"ns-{rng.integers(namespace_count)}",
             **kwargs,
         )
+    if as_records:
+        # Reshape builder-internal records into the wire-record dialect
+        # snapshot_to_proto takes: gang min_member is builder-global,
+        # running pods need unique names (delta-safety), and running
+        # pdb_group is stored namespace-qualified as a tuple.
+        pod_recs = []
+        for p in b._pods:
+            q = dict(p)
+            if q.get("pod_group"):
+                q["pod_group_min_member"] = b._groups[q["pod_group"]]
+            pod_recs.append(q)
+        run_recs = []
+        for i, r in enumerate(b._running):
+            q = dict(r)
+            q["name"] = f"run-{i}"
+            if q.get("pdb_group"):
+                q["pdb_group"] = q["pdb_group"][1]
+            run_recs.append(q)
+        return b._nodes, pod_recs, run_recs
     return b.build()
 
 
