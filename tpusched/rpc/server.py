@@ -237,19 +237,23 @@ class SchedulerService:
         resp.pod_names.extend(meta.pod_names)
         resp.node_names.extend(meta.node_names)
         P, N = meta.n_pods, meta.n_nodes
-        if request.top_k > 0 and N > 0:
+        if request.top_k > 0:
             # O(P) response: top-k computed on device, [P,N] never
             # fetched. The only form that serves the headline shape
-            # under budget on bandwidth-limited links.
-            k = min(int(request.top_k), N)
-            idx, val, solve_s = self._engine.score_topk(snap, k)
-            resp.k = k
-            resp.topk_idx_packed = np.ascontiguousarray(
-                idx[:P], dtype="<i4"
-            ).tobytes()
-            resp.topk_score_packed = np.ascontiguousarray(
-                val[:P], dtype="<f4"
-            ).tobytes()
+            # under budget on bandwidth-limited links. A drained
+            # cluster (N == 0) has nothing to rank: k stays 0 with no
+            # rows, which the client decodes as [P, 0] arrays.
+            solve_s = 0.0
+            if N > 0:
+                k = min(int(request.top_k), N)
+                idx, val, solve_s = self._engine.score_topk(snap, k)
+                resp.k = k
+                resp.topk_idx_packed = np.ascontiguousarray(
+                    idx[:P], dtype="<i4"
+                ).tobytes()
+                resp.topk_score_packed = np.ascontiguousarray(
+                    val[:P], dtype="<f4"
+                ).tobytes()
         else:
             res = self._engine.score(snap)
             solve_s = res.solve_seconds

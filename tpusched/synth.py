@@ -240,6 +240,10 @@ def make_cluster(
             q = dict(r)
             q["name"] = f"run-{i}"
             if q.get("pdb_group"):
+                # The builder aggregates budgets in b._pdbs keyed by the
+                # namespace-qualified tuple; the wire record carries the
+                # bare name plus the aggregated budget.
+                q["pdb_disruptions_allowed"] = b._pdbs[q["pdb_group"]]
                 q["pdb_group"] = q["pdb_group"][1]
             run_recs.append(q)
         return b._nodes, pod_recs, run_recs
