@@ -1,0 +1,82 @@
+"""Worker process for the two-process jax.distributed smoke test
+(tests/test_distributed.py). Each worker owns 4 virtual CPU devices and
+joins a 2-process cluster via a localhost coordinator; the 8-device
+global mesh then spans BOTH processes, exercising the real
+multi-controller path (mesh.init_distributed — SURVEY.md §5
+'Distributed communication backend') instead of the single-process
+8-device simulation the rest of the suite uses.
+
+Prints one JSON line: {pid, global_devices, local_devices, placed,
+equal_to_single} — the parent asserts on it.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    from tpusched import EngineConfig
+    from tpusched.engine import solve_core
+    from tpusched.mesh import init_distributed, make_mesh, snapshot_shardings
+    from tpusched.synth import make_cluster
+
+    init_distributed(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    n_global = jax.device_count()
+    n_local = len(jax.local_devices())
+
+    rng = np.random.default_rng(5)
+    snap, _ = make_cluster(
+        rng, 24, 8, taint_frac=0.3, selector_frac=0.2, spread_frac=0.3,
+        interpod_frac=0.3,
+    )
+    cfg = EngineConfig()
+
+    # Single-process reference on this worker's local device 0.
+    ref = np.asarray(jax.jit(lambda s: solve_core(cfg, s)[0])(snap))
+
+    # Global mesh across BOTH processes; every leaf becomes a global
+    # array assembled from process-local shards.
+    mesh = make_mesh((n_global, 1), devices=jax.devices())
+    specs = snapshot_shardings(mesh, snap)
+
+    def to_global(a, sharding):
+        a = np.asarray(a)
+        return jax.make_array_from_callback(
+            a.shape, sharding, lambda idx: a[idx]
+        )
+
+    gsnap = jax.tree.map(to_global, snap, specs)
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    rep = NamedSharding(mesh, PS())
+    step = jax.jit(lambda s: solve_core(cfg, s)[0], out_shardings=rep)
+    out = np.asarray(step(gsnap))
+    print(json.dumps({
+        "pid": pid,
+        "global_devices": n_global,
+        "local_devices": n_local,
+        "placed": int((out >= 0).sum()),
+        "equal_to_single": bool((out == ref).all()),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -222,6 +222,13 @@ class EngineConfig:
     # Mesh shape for multi-device runs: (pods-axis, nodes-axis). (1,1)
     # means single device.
     mesh_shape: tuple[int, int] = (1, 1)
+    # Route the initial pairwise domain counts through the blockwise
+    # ring kernel (tpusched.ring): signature blocks rotate around the
+    # 'p' mesh axis via lax.ppermute, so the [S, members] match matrix
+    # never materializes on one device (SURVEY.md §2.3 SP/CP row, §5
+    # long-context analogue). Requires Engine(mesh=...) with a
+    # multi-device mesh; counts are bit-identical to the dense path.
+    ring_counts: bool = False
 
     def resource_index(self, name: str) -> int:
         return self.resources.index(name)

@@ -57,15 +57,29 @@ def _sat_tables(snap: ClusterSnapshot):
     return node_sat_t, member_sat_t
 
 
-def solve_core(cfg: EngineConfig, snap: ClusterSnapshot):
+def solve_core(cfg: EngineConfig, snap: ClusterSnapshot, mesh=None):
     """Mode dispatch shared by Engine and tenants.solve_many: returns
     (assigned, chosen, used, order, commit_key, rounds, evicted) in
     either mode (parity synthesizes commit_key from pop order and
-    rounds=P)."""
+    rounds=P). With cfg.ring_counts and a multi-device mesh, the
+    initial pairwise domain counts come from the blockwise ring kernel
+    (sig blocks rotating over the 'p' axis via ppermute) instead of the
+    dense contraction — bit-identical results, O(S/ndev x members/ndev)
+    peak memory (SURVEY.md §2.3 SP/CP row)."""
     node_sat_t, member_sat_t = _sat_tables(snap)
+    init_counts = None
+    if cfg.ring_counts and snap.sigs.key.shape[0]:
+        from tpusched.ring import ring_sig_counts
+
+        P = snap.pods.valid.shape[0]
+        init_counts = ring_sig_counts(
+            snap, member_sat_t, jnp.full(P, -1, jnp.int32), mesh
+        )
     if cfg.mode == "fast":
-        return solve_rounds(cfg, snap, node_sat_t, member_sat_t)
-    a, c, u, o, ev = solve_sequential(cfg, snap, node_sat_t, member_sat_t)
+        return solve_rounds(cfg, snap, node_sat_t, member_sat_t,
+                            init_counts=init_counts)
+    a, c, u, o, ev = solve_sequential(cfg, snap, node_sat_t, member_sat_t,
+                                      init_counts=init_counts)
     # parity commit key = position in pop order (strictly serial)
     P = a.shape[0]
     rank = jnp.zeros(P, jnp.int32).at[o].set(
@@ -75,11 +89,20 @@ def solve_core(cfg: EngineConfig, snap: ClusterSnapshot):
 
 
 class Engine:
-    def __init__(self, config: EngineConfig | None = None):
+    def __init__(self, config: EngineConfig | None = None, mesh=None):
+        """mesh: optional jax.sharding.Mesh for multi-device solves;
+        required when config.ring_counts routes the pairwise counts
+        through the ring kernel."""
         self.config = config or EngineConfig()
+        self.mesh = mesh
         cfg = self.config
         if cfg.mode not in ("parity", "fast"):
             raise ValueError(f"mode={cfg.mode!r}: want 'parity' or 'fast'")
+        if cfg.ring_counts and mesh is None:
+            raise ValueError(
+                "ring_counts=True needs Engine(mesh=...): the ring "
+                "rotates sig blocks over the mesh's 'p' axis"
+            )
         if cfg.tie_break not in ("first", "seeded"):
             raise NotImplementedError(
                 f"tie_break={cfg.tie_break!r}: want 'first' or 'seeded'"
@@ -91,7 +114,7 @@ class Engine:
             )
 
         def _solve(snap: ClusterSnapshot):
-            return solve_core(cfg, snap)
+            return solve_core(cfg, snap, mesh=mesh)
 
         def _solve_packed(snap: ClusterSnapshot):
             # One flat f32 output = ONE device->host fetch. The transport
@@ -109,7 +132,17 @@ class Engine:
 
         def _score(snap: ClusterSnapshot):
             node_sat_t, member_sat_t = _sat_tables(snap)
-            return score_batch(cfg, snap, node_sat_t, member_sat_t)
+            ic = None
+            if cfg.ring_counts and snap.sigs.key.shape[0]:
+                from tpusched.ring import ring_sig_counts
+
+                ic = ring_sig_counts(
+                    snap, member_sat_t,
+                    jnp.full(snap.pods.valid.shape[0], -1, jnp.int32),
+                    mesh,
+                )
+            return score_batch(cfg, snap, node_sat_t, member_sat_t,
+                               init_counts=ic)
 
         def _score_top1(snap: ClusterSnapshot):
             feasible, scores = _score(snap)

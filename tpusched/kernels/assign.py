@@ -54,6 +54,13 @@ from tpusched.snapshot import ClusterSnapshot
 
 NEG_INF = -jnp.inf
 
+# Per-round commit/revert tracing via jax.debug.print. Read at TRACE
+# time: set it before the Engine's first solve at a given shape — an
+# already-compiled executable keeps whatever the flag was when traced.
+import os as _os_mod
+
+_DEBUG_ROUNDS = bool(_os_mod.environ.get("TPUSCHED_DEBUG_ROUNDS"))
+
 
 @struct.dataclass
 class StaticCtx:
@@ -113,9 +120,16 @@ def precompute_static(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
 
 def batched_cycle(cfg: EngineConfig, snap: ClusterSnapshot,
                   static: StaticCtx, used, pair_st,
-                  exclude_self_node=None):
+                  exclude_self_node=None, return_relaxed: bool = False):
     """Full [P, N] Filter + Score against the given state. Score-sum
-    grouping mirrors oracle.feasible_and_score exactly."""
+    grouping mirrors oracle.feasible_and_score exactly.
+
+    return_relaxed=True additionally returns the SPREAD-RELAXED
+    feasibility (all predicates except the DoNotSchedule skew filter):
+    the fast mode's water-fill dealer may target domains whose skew is
+    over the bound against ROUND-START counts but legal against
+    end-of-round counts (the state its validator — and the fast-mode
+    contract — actually checks); see _spread_waterfill_deal."""
     nodes = snap.nodes
     nvalid = nodes.valid
     base_feasible = static.mask & kfilter.resource_fit(
@@ -133,6 +147,8 @@ def batched_cycle(cfg: EngineConfig, snap: ClusterSnapshot,
         # 0 everywhere -> inverse_normalize == 100, raw 0 -> minmax == 0,
         # matching the oracle's formulas exactly without [P, N] work.
         score = base_score + static.w_ts[:, None] * 100.0
+        if return_relaxed:
+            return base_feasible, score.astype(jnp.float32), base_feasible
         return base_feasible, score.astype(jnp.float32)
     spread_ok, spread_pen, ia_ok, ia_raw = kpair.pairwise_from_counts(
         snap, pair_st, static.aff_ok, static.sig_match, exclude_self_node
@@ -143,6 +159,8 @@ def batched_cycle(cfg: EngineConfig, snap: ClusterSnapshot,
         + static.w_ts[:, None] * kscore.inverse_normalize(spread_pen, nvalid)
         + static.w_ia[:, None] * kscore.minmax_normalize(ia_raw, nvalid)
     ).astype(jnp.float32)
+    if return_relaxed:
+        return feasible, score, base_feasible & ia_ok
     return feasible, score
 
 
@@ -244,7 +262,7 @@ def _preempt_branch(cfg: EngineConfig, snap: ClusterSnapshot, static,
 
 
 def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
-                     node_sat_t, member_sat_t):
+                     node_sat_t, member_sat_t, init_counts=None):
     """Exact sequential commit: stock scheduleOne semantics on device,
     including inline PostFilter preemption (cfg.preemption) at the exact
     point upstream runs it — immediately after a pod fails Filter.
@@ -253,7 +271,7 @@ def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
     P = snap.pods.valid.shape[0]
     M = snap.running.valid.shape[0]
     order = pop_order(cfg, snap)
-    st0 = kpair.pair_state_init(snap, static.sig_match)
+    st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts)
     do_preempt = cfg.preemption and M > 0
     if do_preempt:
         pctx = kpreempt.precompute(cfg, snap)
@@ -307,16 +325,147 @@ def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
 
 
 def score_batch(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
-                member_sat_t):
+                member_sat_t, init_counts=None):
     """One-shot [P, N] feasibility + scores against the current snapshot
     (no commits): the ScoreBatch gRPC surface (SURVEY.md C12)."""
     static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
-    st0 = kpair.pair_state_init(snap, static.sig_match)
+    st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts)
     return batched_cycle(cfg, snap, static, snap.nodes.used, st0)
 
 
+def _spread_waterfill_deal(snap: ClusterSnapshot, pair_st, used, relaxed,
+                           score, allowed, rank, K: int):
+    """Domain-balanced dealing for spread-constrained pods (round-4):
+    the global capacity dealer sends same-sig members to ADJACENT
+    ranked nodes — one topology domain — and the skew validator then
+    reverts all but ~maxSkew of them, draining spread-heavy workloads
+    at ~(sigs x domains) commits per round (146 rounds at 10k x 5k).
+    Instead, each sig's members (rank order) are water-filled across
+    its existing domains — member q goes to the domain that keeps the
+    per-domain fill levels flattest given current counts — and each
+    member gets K+1 candidate nodes INSIDE its assigned domain
+    (successive free-capacity rotation positions, so capacity misses
+    spill to the domain's next node within the same round instead of
+    escaping to a global — wrong-domain — fallback and being reverted).
+
+    `relaxed` is the SPREAD-RELAXED feasibility (batched_cycle
+    return_relaxed): the start-state DoNotSchedule filter forbids every
+    domain above min_start + maxSkew, which under imbalance is ALL
+    domains but the emptiest — upstream's sequential loop escapes this
+    because its counts move per pod, and the fast mode escapes it here
+    by targeting against end-of-round semantics and letting the skew
+    validator (which checks exactly that state) confirm or revert.
+    Returns (cand[P, K+1] int32, val[P, K+1] f32 scores at those
+    candidates, ok[P] bool); ok=False falls back to the capacity
+    dealer's choice (e.g. no relax-feasible node in the domain)."""
+    pods = snap.pods
+    S = snap.sigs.key.shape[0]
+    P = rank.shape[0]
+    if pods.ts_valid.shape[1] == 0 or S == 0:
+        # No spread-constraint slots in this snapshot (trace-time):
+        # nothing to water-fill.
+        return (jnp.zeros((P, K + 1), jnp.int32),
+                jnp.full((P, K + 1), NEG_INF, jnp.float32),
+                jnp.zeros(P, bool))
+    BIG = jnp.int32(2**31 - 1)
+    LARGE = jnp.float32(1e9)  # finite stand-in for "domain absent"
+    dom_s = kpair.sig_domains(snap)                          # [S, N]
+    N = dom_s.shape[1]
+    # Members are pods with a DoNotSchedule constraint, keyed by their
+    # FIRST DNS slot — that is the filter that serializes them.
+    # ScheduleAnyway-only pods keep the normal score-driven dealing
+    # (their spread score already penalizes crowded domains, and the
+    # skew validator never reverts them).
+    dns = pods.ts_valid & (pods.ts_when == DO_NOT_SCHEDULE)
+    has_dns = jnp.any(dns, axis=1)
+    first_c = jnp.argmax(dns, axis=1)
+    s_p = jnp.clip(
+        pods.ts_sig[jnp.arange(P), first_c], 0, None
+    )                                                        # [P]
+    member = allowed & has_dns
+    # In-sig 0-based rank positions among this round's members.
+    gid = jnp.where(member, s_p, S)
+    perm = jnp.lexsort((rank, gid))
+    gid_sorted = gid[perm]
+    mem_sorted = member[perm]
+    boundary = jnp.concatenate(
+        [jnp.ones(1, bool), gid_sorted[1:] != gid_sorted[:-1]]
+    )
+    idx = jnp.arange(P, dtype=jnp.int32)
+    cum = jnp.cumsum(mem_sorted.astype(jnp.float32))
+    seg_start = jax.lax.cummax(jnp.where(boundary, idx, 0))
+    q_off = jnp.where(
+        seg_start > 0, cum[jnp.clip(seg_start - 1, 0, None)], 0.0
+    )
+    q_sorted = cum - q_off - 1.0                             # 0-based
+    q = jnp.zeros(P, jnp.float32).at[perm].set(q_sorted)
+    # Per-sig water-fill tables over the domain-count rows.
+    exist = jnp.zeros((S, N), bool).at[
+        jnp.arange(S)[:, None], jnp.clip(dom_s, 0, None)
+    ].max(dom_s >= 0)
+    cnt = jnp.where(exist, pair_st.counts, LARGE)            # [S, N]
+    ord_dom = jnp.argsort(cnt, axis=1)                       # [S, N]
+    csort = jnp.take_along_axis(cnt, ord_dom, axis=1)
+    presum = jnp.concatenate(
+        [jnp.zeros((S, 1), jnp.float32),
+         jnp.cumsum(csort, axis=1)[:, :-1]], axis=1
+    )
+    js = jnp.arange(N, dtype=jnp.float32)[None, :]
+    fill = js * csort - presum                               # [S, N] nondecr.
+    fill_p = fill[s_p]                                       # [P, N]
+    j_p = jnp.clip(
+        jax.vmap(lambda f, v: jnp.searchsorted(f, v, side="right"))(
+            fill_p, q
+        ).astype(jnp.int32) - 1,
+        0, N - 1,
+    )
+    r_p = (q - jnp.take_along_axis(fill_p, j_p[:, None], axis=1)[:, 0])
+    r_i = r_p.astype(jnp.int32)
+    slot = jnp.mod(r_i, j_p + 1)
+    dchoice = jnp.take_along_axis(
+        ord_dom[s_p], slot[:, None], axis=1
+    )[:, 0]                                                  # [P] domain id
+    in_dom = dom_s[s_p] == dchoice[:, None]                  # [P, N]
+    sel = relaxed & in_dom
+    # Within the domain, members must also fan out across NODES: the
+    # best-scoring node is nearly the same for every member (the load
+    # balancing scores barely separate them), and one node holds only a
+    # few pods — argmax here re-creates the herding one level down
+    # (observed: the commit rate stayed capacity-capped at ~15/round).
+    # Member m of its (sig, domain) takes the (m mod n_feasible)-th
+    # feasible domain node in free-capacity order, with the next K
+    # rotation positions as its spill candidates.
+    m_p = r_i // (j_p + 1)                                   # [P] level offset
+    alloc = snap.nodes.allocatable
+    free_frac = jnp.mean(
+        jnp.where(alloc > 0, (alloc - used) / jnp.maximum(alloc, 1e-9), 0.0),
+        axis=1,
+    )                                                        # [N]
+    cap_order = jnp.argsort(-free_frac).astype(jnp.int32)    # [N]
+    sel_sorted = sel[:, cap_order]                           # [P, N]
+    csum = jnp.cumsum(sel_sorted.astype(jnp.float32), axis=1)
+    n_feas = csum[:, -1]
+    targets = jnp.mod(
+        m_p.astype(jnp.float32)[:, None]
+        + jnp.arange(K + 1, dtype=jnp.float32)[None, :],
+        jnp.maximum(n_feas, 1.0)[:, None],
+    ) + 1.0                                                  # [P, K+1]
+    j_node = jax.vmap(
+        lambda c, t: jnp.searchsorted(c, t, side="left")
+    )(csum, targets).astype(jnp.int32)
+    cand = cap_order[jnp.clip(j_node, 0, cap_order.shape[0] - 1)]
+    ok = member & (n_feas > 0)
+    sel_at = jnp.take_along_axis(sel, cand, axis=1)
+    val = jnp.where(
+        sel_at, jnp.take_along_axis(score, cand, axis=1), NEG_INF
+    )
+    return cand, val, ok
+
+
 def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
-                 rank, K: int):
+                 rank, K: int, dealt_override=None,
+                 dealt_override_val=None, dealt_override_ok=None,
+                 score_full=None):
     """One round's dealing + capacity-prefix conflict resolution +
     rescue, shape-generic over the pod axis (used on the full [P, N]
     matrices and on the compacted residual view — same math per pod;
@@ -381,6 +530,14 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
         [jnp.where(dealt_ok, dealt_score[:, 0], topv[:, 0])[:, None], topv],
         axis=1,
     )
+    if dealt_override is not None:
+        # Spread water-fill (see _spread_waterfill_deal): a constrained
+        # pod's WHOLE candidate list becomes its in-domain rotation —
+        # spills stay inside the assigned domain. Values come with the
+        # candidates (relaxed placements are -inf in `masked`).
+        okc = dealt_override_ok[:, None]
+        topi = jnp.where(okc, dealt_override, topi)
+        topv = jnp.where(okc, dealt_override_val, topv)
 
     KC = K + 1  # dealt candidate + top-K fallbacks
 
@@ -466,8 +623,11 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
     choice = choice.at[p_star].set(
         jnp.where(can_rescue, n_star, choice[p_star])
     )
+    # Relaxed (water-fill) placements are -inf in `masked`; their real
+    # score lives in score_full when the caller provides it.
     chosen_val = jnp.take_along_axis(
-        masked, jnp.clip(choice, 0, N - 1)[:, None], axis=1
+        masked if score_full is None else score_full,
+        jnp.clip(choice, 0, N - 1)[:, None], axis=1
     )[:, 0]
     return used2, choice, chosen_val
 
@@ -484,6 +644,207 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
 # at the headline shape, all audit-valid — validate_assignment: 0
 # violations).
 _RESIDUAL_CAP = 1024
+
+# Bid width and round cap of the fast-mode batched preemption auction
+# (_preempt_rounds): per round, the top _PREEMPT_BATCH unplaced pods
+# bid in parallel; upstream preempts ONE pod per scheduling cycle, so
+# 64 rounds x 256 bids is far past parity behavior.
+_PREEMPT_BATCH = 256
+_PREEMPT_MAX_ROUNDS = 128
+
+
+def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
+                    static: StaticCtx, rank, base_rounds,
+                    used, assigned, st, evicted, round_of, chosen):
+    """Fast-mode PostFilter as BATCHED AUCTION ROUNDS (round-4; replaces
+    a sequential per-pod scan that cost ~3 ms per preemptor — 9.6 s for
+    2.7k preemptors at 10k x 5k). Each round:
+
+      1. The top _PREEMPT_BATCH still-unplaced pods (dynamic-priority
+         order) are evaluated IN PARALLEL against round-start state:
+         plain feasibility first (an earlier round's evictions may have
+         left room), else the batched victim-prefix auction
+         (kpreempt.preempt_auction): every bidder's per-node tableau is
+         vmapped ([C, M] prefix masses become MXU matmuls) and a rank-
+         ordered scan assigns each bidder its cheapest STILL-UNCLAIMED
+         node — one claimant per node, so same-round victim sets never
+         overlap.
+      2. A second rank-ordered scan (O(GP) carry) enforces
+         PodDisruptionBudgets as a priority prefix over the claimants;
+         a bid whose exact budget accounting went stale is deferred and
+         re-bids next round.
+      3. Kept bids apply as BATCHED scatters (evictions, capacity,
+         pair state); deferred pods re-bid against the updated state.
+
+    Victim sets of same-round keeps cannot overlap (victims are node-
+    local and each node keeps one bid) and every kept bid was feasible
+    against its round-start state, so validity matches the sequential
+    pass; under contention the ORDER of preemptors can differ — the
+    standard fast-mode divergence contract. Terminates when a round
+    keeps nothing or the cap hits (leftovers stay unplaced)."""
+    pods, nodes = snap.pods, snap.nodes
+    P = pods.valid.shape[0]
+    N = nodes.valid.shape[0]
+    BIG = jnp.int32(2**31 - 1)
+    C = min(P, _PREEMPT_BATCH)
+    pctx = kpreempt.precompute(cfg, snap)
+    prio = effective_priority(
+        cfg, pods.base_priority, pods.slo_target, pods.observed_avail
+    )
+    GP = snap.pdb_allowed.shape[0]
+    run_pdb = snap.running.pdb_group
+    run_valid = snap.running.valid
+    S = snap.sigs.key.shape[0]
+
+    def cond(carry):
+        return carry[-2] & (carry[-1] < _PREEMPT_MAX_ROUNDS)
+
+    def body(carry):
+        used, assigned, st, evicted, round_of, chosen, tried, _, r = carry
+        # Like the sequential pass, each pod gets ONE bid (tried); a bid
+        # deferred by the conflict scan is NOT tried — it re-bids
+        # against the updated state next round.
+        pend = (assigned < 0) & pods.valid & ~tried
+        sel = jnp.argsort(jnp.where(pend, rank, BIG))[:C]
+        real = pend[sel]
+
+        def eval_plain(p):
+            feasible, score, allowed = pod_cycle(
+                cfg, snap, static, p, used, st
+            )
+            masked = jnp.where(feasible, score, NEG_INF)
+            n_plain = jnp.argmax(masked).astype(jnp.int32)
+            return n_plain, jnp.any(feasible), masked[n_plain], allowed
+
+        n_plain, can_plain, sc_plain, allowed_rows = jax.vmap(eval_plain)(
+            sel
+        )
+        can_plain &= real
+        # Gangs never preempt (see solve_sequential); inactive bidders
+        # enter the auction with all-False allowed rows.
+        pre_active = real & ~can_plain & (pods.group[sel] < 0)
+        allowed_rows &= pre_active[:, None]
+        target, claimed, takes_evict, evict_m, could_bid = (
+            kpreempt.preempt_auction(
+                cfg, snap, pctx, prio[sel], pods.requests[sel],
+                allowed_rows, used, evicted, can_plain, n_plain,
+            )
+        )
+        ev_f = (evict_m & takes_evict[:, None]).astype(jnp.float32)
+        freed_req = ev_f @ snap.running.requests              # [C, R]
+        if GP:
+            onehot = (
+                (run_pdb[:, None] == jnp.arange(GP)[None, :])
+                & (run_pdb >= 0)[:, None] & run_valid[:, None]
+            ).astype(jnp.float32)                             # [M, GP]
+            usage = ev_f @ onehot                             # [C, GP]
+            consumed0 = jnp.zeros(GP, jnp.float32).at[
+                jnp.clip(run_pdb, 0, None)
+            ].add(
+                (evicted & (run_pdb >= 0) & run_valid).astype(jnp.float32)
+            )
+            remaining0 = snap.pdb_allowed.astype(jnp.float32) - consumed0
+
+        if GP:
+            def cstep(cc, i):
+                consumed, touched = cc
+                # Budget-respecting bids parallelize as a prefix: keep
+                # while the running consumption stays inside every
+                # touched budget's remaining allowance. A bid that
+                # DECLARED a violation (its own prefix alone overdraws —
+                # upstream's evict-PDB-pods-as-last-resort) keeps only
+                # if no earlier keep touched its budgets (its violation
+                # accounting would be stale otherwise); deferred bids
+                # re-bid next round against exact consumption. Node
+                # exclusivity was already resolved by the auction.
+                ok = claimed[i]
+                touch_i = usage[i] > 0.0
+                fits_budget = jnp.all(
+                    consumed + usage[i] <= remaining0 + 1e-6
+                )
+                alone_viol = jnp.any(usage[i] > remaining0 + 1e-6)
+                clean = ~jnp.any(touch_i & touched)
+                ok &= fits_budget | (alone_viol & clean)
+                consumed = consumed + jnp.where(ok, usage[i], 0.0)
+                touched = touched | (touch_i & ok)
+                return (consumed, touched), ok
+
+            (_, _), keep = jax.lax.scan(
+                cstep,
+                (jnp.zeros(GP, jnp.float32), jnp.zeros(GP, bool)),
+                jnp.arange(C),
+            )
+        else:
+            keep = claimed
+        keep_evict = keep & takes_evict
+        ev_round = jnp.any(evict_m & keep_evict[:, None], axis=0)
+        evicted2 = evicted | ev_round
+        tgt_c = jnp.clip(target, 0, N - 1)
+        used2 = used.at[tgt_c].add(
+            jnp.where(keep_evict[:, None], -freed_req, 0.0)
+        )
+        used2 = used2.at[tgt_c].add(
+            jnp.where(keep[:, None], pods.requests[sel], 0.0)
+        )
+        st2 = st
+        if S:
+            st2 = kpair.pair_state_evict(
+                snap, st2, static.sig_match, ev_round
+            )
+            choice_full = jnp.full(P, -1, jnp.int32).at[sel].set(
+                jnp.where(keep, target, -1)
+            )
+            keep_full = jnp.zeros(P, bool).at[sel].set(keep)
+            st2 = kpair.pair_state_commit(
+                snap, st2, static.sig_match, choice_full, keep_full
+            )
+        assigned2 = assigned.at[sel].set(
+            jnp.where(keep, target, assigned[sel])
+        )
+        # Preempted placements carry no score (upstream nominates
+        # without rescoring), matching the sequential path.
+        chosen2 = chosen.at[sel].set(
+            jnp.where(keep & can_plain, sc_plain,
+                      jnp.where(keep, NEG_INF, chosen[sel]))
+        )
+        # Commit keys: strictly after the main rounds, ordered by
+        # (preemption round, rank) — later-round keeps saw earlier
+        # keeps' state.
+        round_of2 = round_of.at[sel].set(
+            jnp.where(keep, base_rounds + r * P + rank[sel],
+                      round_of[sel])
+        )
+        # A no-bid pod (nothing feasible, no victim prefix anywhere) is
+        # spent; a kept pod is placed; a DEFERRED pod (could bid but
+        # lost the node race or the budget prefix) bids again. If a
+        # round keeps nothing, the first claimant would have kept, so
+        # there were no claims: every real pod was a no-bid and gets
+        # marked — progress is monotone and the loop terminates.
+        if _DEBUG_ROUNDS:
+            jax.debug.print(
+                "preempt round {r}: real={re} plain={pl} pre={pr} "
+                "claimed={a} keep={k} evicts={e}",
+                r=r, re=real.sum(), pl=(real & can_plain).sum(),
+                pr=takes_evict.sum(), a=claimed.sum(), k=keep.sum(),
+                e=ev_round.sum(),
+            )
+        newly_tried = real & (keep | ~could_bid)
+        tried2 = tried.at[sel].set(tried[sel] | newly_tried)
+        # Any keep changes the state (evictions free capacity), so
+        # earlier no-bid verdicts are stale: clear them and re-bid.
+        # Termination: a keep-less round marks every real pod tried
+        # (monotone), and rounds with keeps shrink the pending set.
+        tried2 = jnp.where(jnp.any(keep), jnp.zeros_like(tried2), tried2)
+        progress = jnp.any(keep) | jnp.any(newly_tried)
+        return (used2, assigned2, st2, evicted2, round_of2, chosen2,
+                tried2, progress, r + 1)
+
+    out = jax.lax.while_loop(
+        cond, body,
+        (used, assigned, st, evicted, round_of, chosen,
+         jnp.zeros(P, bool), jnp.array(True), jnp.int32(0)),
+    )
+    return out[:6]
 
 
 def _cycle_nosig(alloc, used, req, mask, sscore, w_lr, w_ba, w_ts, rw):
@@ -600,7 +961,7 @@ def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
 
 
 def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
-                 node_sat_t, member_sat_t):
+                 node_sat_t, member_sat_t, init_counts=None):
     """Fast mode: optimistic batched rounds with validate-and-rollback.
     Returns (assigned, chosen, used, order, rounds)."""
     static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
@@ -610,7 +971,7 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
     order = pop_order(cfg, snap)
     rank = jnp.zeros(P, jnp.int32).at[order].set(jnp.arange(P, dtype=jnp.int32))
     has_pair = jnp.any(pods.ts_valid, axis=1) | jnp.any(pods.ia_valid, axis=1)
-    st0 = kpair.pair_state_init(snap, static.sig_match)
+    st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts)
     # A pod with NO constraints of its own can still be displaced by
     # symmetric anti-affinity: it must revalidate if any live anti term
     # (running holders via st0.anti — domain-aware, so key-less holders
@@ -660,8 +1021,11 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         used, assigned, pair_st, conservative, chosen, round_of, _, r = state
         pending = assigned == -1
 
-        feasible, score = batched_cycle(cfg, snap, static, used, pair_st)
+        feasible, score, relaxed = batched_cycle(
+            cfg, snap, static, used, pair_st, return_relaxed=True
+        )
         feasible &= pending[:, None]
+        relaxed &= pending[:, None]
         masked = jnp.where(feasible, score, NEG_INF)
         want = jnp.any(feasible, axis=1)
 
@@ -685,9 +1049,19 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             )
         allowed = want & (~conservative | ok_cons)
 
+        # Water-fill membership and activation use the RELAXED rows: a
+        # DNS pod whose every in-bound domain is skew-blocked against
+        # round-start counts can still legally place under end-of-round
+        # semantics (the validator's state) — see _spread_waterfill_deal.
+        allowed_r = jnp.any(relaxed, axis=1) & (~conservative | ok_cons)
+        sp_cand, sp_val, sp_ok = _spread_waterfill_deal(
+            snap, pair_st, used, relaxed, score, allowed_r, rank, K
+        )
         used2, choice, chosen_val = _deal_commit(
             nodes.allocatable, pods.requests, used, feasible, masked,
-            allowed, rank, K,
+            allowed | sp_ok, rank, K, dealt_override=sp_cand,
+            dealt_override_val=sp_val, dealt_override_ok=sp_ok,
+            score_full=score,
         )
         commit = choice >= 0
         if snap.sigs.key.shape[0] == 0:
@@ -710,9 +1084,13 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         # positive affinity, so each pass re-checks the still-kept pods
         # until no new violations (each pass reverts >= 1 pod, so it
         # terminates). Two violation classes with different policies:
-        #   * AFFINITY (required inter-pod / symmetric anti): revert and
-        #     mark conservative — these interactions are adversarial and
-        #     need the ordered one-per-cluster retry.
+        #   * AFFINITY (required inter-pod / symmetric anti):
+        #     rank-ordered partial reverts — the cluster-minimal
+        #     violator is protected (its violation is usually induced
+        #     by same-round lower-priority commits) and the rest revert
+        #     and retry optimistically next round; see vbody. The
+        #     conservative one-per-cluster gate survives only as the
+        #     zero-progress backstop after the loop.
         #   * DoNotSchedule SPREAD: revert only the EXCESS members per
         #     (sig, domain) — keep the highest-priority prefix whose
         #     size respects every kept member's skew bound. Reverted
@@ -794,11 +1172,10 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             return bad
 
         def vcond(vs):
-            _, _, _, _, again = vs
-            return again
+            return vs[-1]
 
         def vbody(vs):
-            st_v, used_v, kept_v, ia_mark, _ = vs
+            st_v, used_v, kept_v, _ = vs
             _, _, ia_ok2, _ = kpair.pairwise_from_counts(
                 snap, st_v, static.aff_ok, static.sig_match,
                 exclude_self_node=jnp.where(kept_v, choice, -1),
@@ -806,8 +1183,31 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             ia_ok_at = jnp.take_along_axis(
                 ia_ok2, jnp.clip(choice, 0, N - 1)[:, None], axis=1
             )[:, 0]
-            ia_bad = kept_v & has_pair & ~ia_ok_at
-            sp_bad = spread_excess(st_v, kept_v) & ~ia_bad
+            ia_bad_all = kept_v & has_pair & ~ia_ok_at
+            # Rank-ordered partial reverts (round-4: replaces marking
+            # every IA violator conservative, which serialized them
+            # one-per-sig-cluster per round — 146 rounds on the 10k x 5k
+            # pairwise config). PROTECT the violator that precedes every
+            # other violator it could interact with (minimal rank across
+            # all its involved sigs): its violation is usually induced
+            # by same-round higher-rank commits, which revert first; the
+            # fixpoint then re-checks it against the surviving state.
+            # If a pass finds only protected violators left, they are
+            # genuinely invalid against the kept state — revert them too
+            # (also guarantees each pass reverts >= 1, so the loop
+            # terminates).
+            bad_rank = jnp.where(ia_bad_all, rank, BIG)
+            min_bad_sig = jnp.min(
+                jnp.where(invol, bad_rank[:, None], BIG), axis=0
+            )                                                   # [S]
+            protected = ia_bad_all & jnp.all(
+                jnp.where(invol, rank[:, None] == min_bad_sig[None, :], True),
+                axis=1,
+            )
+            ia_bad = ia_bad_all & ~protected
+            sp_bad = spread_excess(st_v, kept_v) & ~ia_bad_all
+            stuck = ~jnp.any(ia_bad | sp_bad) & jnp.any(ia_bad_all)
+            ia_bad = ia_bad | (ia_bad_all & stuck)
             new_viol = ia_bad | sp_bad
             used_v = used_v.at[jnp.clip(choice, 0, N - 1)].add(
                 -jnp.where(new_viol[:, None], pods.requests, 0.0)
@@ -815,28 +1215,33 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             st_v = kpair.pair_state_commit(
                 snap, st_v, static.sig_match, choice, new_viol, sign=-1.0
             )
-            return (st_v, used_v, kept_v & ~new_viol, ia_mark | ia_bad,
-                    jnp.any(new_viol))
+            return (st_v, used_v, kept_v & ~new_viol, jnp.any(new_viol))
 
         any_pair_committed = jnp.any(commit & has_pair)
-        st3, used3, kept, ia_mark, _ = jax.lax.while_loop(
-            vcond, vbody,
-            (st2, used2, commit, jnp.zeros(P, bool), any_pair_committed),
+        st3, used3, kept, _ = jax.lax.while_loop(
+            vcond, vbody, (st2, used2, commit, any_pair_committed),
         )
         viol = commit & ~kept
         assigned2 = jnp.where(kept, choice, assigned)
         chosen2 = jnp.where(kept, chosen_val, chosen)
-        # Progress backstop: if EVERY commit was reverted as spread
-        # excess (possible when non-revertable members crowded the
-        # domains) and nothing else moved, mark the first reverted pod
-        # conservative so the round loop keeps the old one-at-a-time
-        # guarantee instead of exiting with placeable pods stranded.
-        sp_rev = viol & ~ia_mark
-        need_fb = ~jnp.any(kept) & jnp.any(sp_rev)
-        fb_first = rank == jnp.min(jnp.where(sp_rev, rank, BIG))
-        fb_mask = sp_rev & fb_first & need_fb
-        new_conservative = (ia_mark | fb_mask) & ~conservative
-        conservative2 = conservative | ia_mark | fb_mask
+        # Progress backstop: reverted pods retry optimistically against
+        # next round's start-state counts (which now mask the domains
+        # they lost), so they normally converge without any gating. But
+        # if EVERY commit of this round was reverted, optimism alone
+        # proves nothing placed — mark the first reverted pod (by rank)
+        # conservative so the ordered one-per-cluster path guarantees
+        # progress, exactly the old behavior as a fallback.
+        if _DEBUG_ROUNDS:
+            jax.debug.print(
+                "round {r}: allowed={a} commit={c} kept={k} viol={v}",
+                r=r, a=allowed.sum(), c=commit.sum(), k=kept.sum(),
+                v=viol.sum(),
+            )
+        need_fb = ~jnp.any(kept) & jnp.any(viol)
+        fb_first = rank == jnp.min(jnp.where(viol, rank, BIG))
+        fb_mask = viol & fb_first & need_fb
+        new_conservative = fb_mask & ~conservative
+        conservative2 = conservative | fb_mask
         round_of2 = jnp.where(kept, r, round_of)
         all_done = jnp.all((assigned2 >= 0) | ~pods.valid)
         progress = (jnp.any(kept) | jnp.any(new_conservative)) & ~all_done
@@ -863,71 +1268,9 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
     M = snap.running.valid.shape[0]
     evicted = jnp.zeros(M, bool)
     if cfg.preemption and M > 0:
-        # PostFilter pass over still-unplaced pods in priority order
-        # (fast mode runs it after the commit rounds; parity mode runs
-        # it inline like upstream scheduleOne). Each leftover pod first
-        # re-checks PLAIN feasibility against the now-current state — an
-        # earlier preemptor's eviction (or a max_rounds cap) may have
-        # left room, in which case it commits without evicting anyone.
-        pctx = kpreempt.precompute(cfg, snap)
-        prio = effective_priority(
-            cfg, pods.base_priority, pods.slo_target, pods.observed_avail
-        )
-
-        def pbody(carry, p):
-            used, assigned, st, evicted, round_of, chosen = carry
-            active = (assigned[p] < 0) & pods.valid[p]
-
-            def act(ops):
-                used, st, evicted = ops
-                feasible, score, allowed = pod_cycle(
-                    cfg, snap, static, p, used, st
-                )
-                masked = jnp.where(feasible, score, NEG_INF)
-                n = jnp.argmax(masked)
-                commit = jnp.any(feasible)
-                used2 = used.at[n].add(
-                    jnp.where(commit, pods.requests[p], 0.0)
-                )
-                st2 = kpair.pair_state_add_pod(
-                    snap, st, static.sig_match, p, n, commit
-                )
-                # Gang members never preempt (see solve_sequential).
-                used3, st3, evicted3, pn = jax.lax.cond(
-                    ~commit & (pods.group[p] < 0),
-                    lambda ops2: _preempt_branch(
-                        cfg, snap, static, pctx, prio[p], p, allowed, *ops2
-                    ),
-                    lambda ops2: (*ops2, jnp.int32(-1)),
-                    (used2, st2, evicted),
-                )
-                a_p = jnp.where(commit, n.astype(jnp.int32), pn)
-                ch = jnp.where(commit, masked[n], NEG_INF)
-                return used3, st3, evicted3, a_p, ch
-
-            used, st, evicted, a_p, ch = jax.lax.cond(
-                active, act,
-                lambda ops: (
-                    *ops, jnp.int32(-1), jnp.float32(NEG_INF)
-                ),
-                (used, st, evicted),
-            )
-            assigned = assigned.at[p].set(
-                jnp.where(a_p >= 0, a_p, assigned[p])
-            )
-            chosen = chosen.at[p].set(
-                jnp.where(a_p >= 0, ch, chosen[p])
-            )
-            # Post-pass commits land strictly after all rounds, in pop
-            # order (commit_key = rounds + rank).
-            round_of = round_of.at[p].set(
-                jnp.where(a_p >= 0, rounds + rank[p], round_of[p])
-            )
-            return (used, assigned, st, evicted, round_of, chosen), a_p
-
-        (used, assigned, st_f, evicted, round_of, chosen), _ = jax.lax.scan(
-            pbody, (used, assigned, st_f, evicted, round_of, chosen), order,
-            unroll=4,
+        used, assigned, st_f, evicted, round_of, chosen = _preempt_rounds(
+            cfg, snap, static, rank, rounds,
+            used, assigned, st_f, evicted, round_of, chosen,
         )
     used, assigned, chosen, st_f, rolled = gang_rollback(
         snap, used, assigned, chosen, st_f, static.sig_match

@@ -132,8 +132,12 @@ def _anti_counts_running(snap: ClusterSnapshot, dom_s):
     return out.at[sclip, jnp.clip(dom_m, 0, None)].add(ok.astype(jnp.float32))
 
 
-def pair_state_init(snap: ClusterSnapshot, sig_match) -> PairState:
-    """State with no pending pods committed: counts from running pods."""
+def pair_state_init(snap: ClusterSnapshot, sig_match,
+                    counts=None) -> PairState:
+    """State with no pending pods committed: counts from running pods.
+    `counts`: optional precomputed [S, N] initial domain counts (the
+    ring path, tpusched.ring.ring_sig_counts, is bit-identical to the
+    dense sig_counts and is routed here via EngineConfig.ring_counts)."""
     P = snap.pods.valid.shape[0]
     dom_s = sig_domains(snap)
     M = snap.running.valid.shape[0]
@@ -141,8 +145,10 @@ def pair_state_init(snap: ClusterSnapshot, sig_match) -> PairState:
         (sig_match[:, :M] & snap.running.valid[None, :]).astype(jnp.float32),
         axis=1,
     )
+    if counts is None:
+        counts = sig_counts(snap, sig_match, jnp.full(P, -1, jnp.int32))
     return PairState(
-        counts=sig_counts(snap, sig_match, jnp.full(P, -1, jnp.int32)),
+        counts=counts,
         anti=_anti_counts_running(snap, dom_s),
         match_tot=match_tot,
     )

@@ -99,12 +99,14 @@ def precompute(cfg: EngineConfig, snap: ClusterSnapshot) -> PreemptCtx:
     )
 
 
-def preempt_step(cfg: EngineConfig, snap: ClusterSnapshot, ctx: PreemptCtx,
-                 p_prio, p_req, allowed_row, used, evicted):
-    """One preemptor's victim search. Returns
-    (best_n, can, evict_m, freed) — chosen node (int32), whether
-    preemption succeeds (bool), the [M] eviction mask, and the [N, R]
-    capacity freed on the chosen node (zeros elsewhere)."""
+def _tableau(cfg: EngineConfig, snap: ClusterSnapshot, ctx: PreemptCtx,
+             p_prio, p_req, used, evicted):
+    """One preemptor's victim-prefix tableau: everything preempt_step
+    derives before node selection. Shared verbatim by the sequential
+    step and the batched auction (preempt_auction) so their per-node
+    rankings agree exactly. Returns
+    (elig [M], within_cost [M], within_viol [M], fits [M],
+    node_viol [N], node_cost [N])."""
     nodes = snap.nodes
     M = ctx.perm.shape[0]
     N = nodes.valid.shape[0]
@@ -179,6 +181,21 @@ def preempt_step(cfg: EngineConfig, snap: ClusterSnapshot, ctx: PreemptCtx,
     node_cost = jnp.full(N + 1, jnp.inf).at[ctx.node_s].min(
         jnp.where(fits_v, within_cost, jnp.inf)
     )[:N]
+    return elig, within_cost, within_viol, fits, node_viol, node_cost
+
+
+def preempt_step(cfg: EngineConfig, snap: ClusterSnapshot, ctx: PreemptCtx,
+                 p_prio, p_req, allowed_row, used, evicted):
+    """One preemptor's victim search. Returns
+    (best_n, can, evict_m, freed) — chosen node (int32), whether
+    preemption succeeds (bool), the [M] eviction mask, and the [N, R]
+    capacity freed on the chosen node (zeros elsewhere)."""
+    nodes = snap.nodes
+    M = ctx.perm.shape[0]
+    idx = jnp.arange(M, dtype=jnp.int32)
+    elig, within_cost, within_viol, fits, node_viol, node_cost = _tableau(
+        cfg, snap, ctx, p_prio, p_req, used, evicted
+    )
     # Across nodes: global fewest violations, then cheapest. (inf ==
     # inf is True, so the allowed mask must gate `total` as well —
     # otherwise a disallowed node's finite prefix wins when NO allowed
@@ -204,3 +221,85 @@ def preempt_step(cfg: EngineConfig, snap: ClusterSnapshot, ctx: PreemptCtx,
         jnp.where(can, freed_on_best, 0.0)
     )
     return best_n, can, evict_m, freed
+
+
+def preempt_auction(cfg: EngineConfig, snap: ClusterSnapshot,
+                    ctx: PreemptCtx, p_prio, p_req, allowed,
+                    used, evicted, can_plain, n_plain,
+                    k_cand: int = 64):
+    """Batched bidding for C preemptors at once (the fast mode's
+    auction round; SURVEY.md §7 hard part 4 — parallel bids, global
+    resolution). Every bidder computes its full per-node tableau
+    (vmapped _tableau — the prefix sums batch into [C, M] matrix work),
+    then a rank-ordered scan with an O(N) carry assigns each bidder its
+    cheapest still-unclaimed candidate node: one claimant per node, no
+    two same-round victim sets can overlap (victims are node-local).
+    The sequential scan would give every bidder the GLOBALLY cheapest
+    node — and one keep per round; taking the i-th bidder's best
+    still-free node instead trades a slightly costlier victim set for
+    ~C-way parallelism, the same deal the capacity dealer makes for
+    placement. Plain placements (can_plain, from the caller's
+    feasibility re-check) claim their scored node through the same
+    scan.
+
+    p_prio/p_req/allowed/can_plain/n_plain: [C]/[C,R]/[C,N]/[C]/[C] in
+    descending rank order; inactive bidders must arrive with allowed
+    all-False and can_plain False. Returns (target [C] int32 (-1 =
+    no claim), claimed [C] bool, takes_evict [C] bool,
+    evict_m [C, M] bool, could_bid [C] bool — False means the pod has
+    NO placement or victim prefix at all (spent), as opposed to losing
+    this round's node race (retry))."""
+    nodes = snap.nodes
+    N = nodes.valid.shape[0]
+    M = ctx.perm.shape[0]
+    C = p_prio.shape[0]
+    elig, within_cost, within_viol, fits, node_viol, node_cost = jax.vmap(
+        lambda pp, pr: _tableau(cfg, snap, ctx, pp, pr, used, evicted)
+    )(p_prio, p_req)                                         # [C, ...]
+    ok_node = allowed & nodes.valid[None, :]
+    viol_total = jnp.where(ok_node, node_viol, jnp.inf)
+    min_viol = jnp.min(viol_total, axis=1, keepdims=True)    # [C, 1]
+    total = jnp.where(
+        ok_node & (viol_total == min_viol), node_cost, jnp.inf
+    )
+    K = min(k_cand, N)
+    neg_v, cand_i = jax.lax.top_k(-total, K)                 # [C, K]
+    cand_finite = jnp.isfinite(neg_v)
+
+    def nstep(taken, i):
+        pl = can_plain[i]
+        cands = cand_i[i]
+        cok = cand_finite[i] & ~taken[cands]
+        j = jnp.argmax(cok)
+        pre_ok = jnp.any(cok) & ~pl
+        t = jnp.where(pl, n_plain[i], cands[j]).astype(jnp.int32)
+        ok = jnp.where(pl, ~taken[jnp.clip(n_plain[i], 0, N - 1)], pre_ok)
+        taken = taken.at[jnp.clip(t, 0, N - 1)].set(
+            taken[jnp.clip(t, 0, N - 1)] | ok
+        )
+        return taken, (t, ok)
+
+    _, (target, claimed) = jax.lax.scan(
+        nstep, jnp.zeros(N, bool), jnp.arange(C)
+    )
+    takes_evict = claimed & ~can_plain
+    # Victim prefix of each bidder's CLAIMED node (same lexicographic
+    # rule as preempt_step: min-viol prefixes, then cheapest; the
+    # claimed node's viol equals the bidder's min_viol by construction).
+    tgt = jnp.clip(target, 0, N - 1)
+    in_node = ctx.node_s[None, :] == tgt[:, None]            # [C, M]
+    best_pos = jnp.argmin(
+        jnp.where(
+            fits & in_node & (within_viol == min_viol),
+            within_cost, jnp.inf,
+        ),
+        axis=1,
+    ).astype(jnp.int32)                                      # [C]
+    idx = jnp.arange(M, dtype=jnp.int32)
+    sel_s = (
+        takes_evict[:, None] & in_node & elig
+        & (idx[None, :] <= best_pos[:, None])
+    )
+    evict_m = jnp.zeros((C, M), bool).at[:, ctx.perm].set(sel_s)
+    could_bid = can_plain | jnp.any(jnp.isfinite(total), axis=1)
+    return target, claimed, takes_evict, evict_m, could_bid

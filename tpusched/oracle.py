@@ -724,13 +724,18 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
     tests/test_gangs.py::test_gang_rollback_audit_caveat).
 
     Violations consistent with that caveat carry a machine-readable
-    " [gang-optimism]" suffix: the constraint flips to satisfied when the
-    snapshot's UNPLACED gang members are hypothetically restored to the
-    placed set, so the report is exactly what a rolled-back gang would
-    produce. Downstream audits filter with
+    " [gang-optimism]" suffix: the constraint flips to satisfied when
+    the snapshot's UNPLACED gang members are hypothetically restored to
+    the placed set (the audit cannot know their rolled-back provisional
+    nodes, so it tries a small greedy family of candidate placements —
+    each member alone at each domain-representative node, all members
+    at one node, and members round-robin across domains). A flip under
+    any tried restoration applies the tag; exotic multi-member cases
+    may stay untagged, erring toward reporting a hard violation — the
+    tag is never spurious, and gang-free snapshots are never tagged
+    (there is nothing to restore). Downstream audits filter with
     `[v for v in violations if "[gang-optimism]" not in v]` to get the
-    hard-violation set. Untagged reports are never tagged spuriously on
-    gang-free snapshots (there is nothing to restore).
+    hard-violation set.
 
     Returns human-readable violation strings (empty = valid)."""
     ora = Oracle(snap, cfg)
@@ -756,6 +761,53 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
     for n in np.argwhere(over.any(axis=1)).ravel():
         if _np(nodes.valid)[n]:
             out.append(f"node {n}: capacity exceeded {used[n]}")
+    # Gang-optimism tagging support (see docstring): the unplaced valid
+    # gang members a rollback could have removed, and restoration
+    # candidates (one representative node per topology domain). Both
+    # lists are CAPPED — the search is a diagnostic aid, and each
+    # family costs a full oracle re-check over the placed set; beyond
+    # the caps a report simply stays untagged (conservative direction).
+    _TAG_MEMBER_CAP, _TAG_CAND_CAP = 32, 16
+    group = _np(pods.group)
+    gmin = _np(snap.group_min_member)
+    pods_valid = _np(pods.valid)
+    restorable = (
+        [int(q) for q in range(assignment.shape[0])
+         if group[q] >= 0 and assignment[q] < 0 and pods_valid[q]]
+        [:_TAG_MEMBER_CAP]
+        if gmin.shape[0] else []
+    )
+
+    def _restore_candidates(n: int) -> list[int]:
+        dom = _np(nodes.domain)
+        nvalid = _np(nodes.valid)
+        cands = {int(n)}
+        for k in range(dom.shape[1]):
+            seen: set[int] = set()
+            for m in np.argwhere(nvalid).ravel():
+                d = int(dom[m, k])
+                if d >= 0 and d not in seen:
+                    seen.add(d)
+                    cands.add(int(m))
+        return sorted(cands)[:_TAG_CAND_CAP]
+
+    def _gang_tag(p: int, n: int, others: list, check) -> str:
+        """' [gang-optimism]' iff some tried hypothetical restoration
+        of the unplaced gang members satisfies the constraint."""
+        if not restorable:
+            return ""
+        cands = _restore_candidates(n)
+        families = [[(u, c)] for u in restorable for c in cands]
+        families += [[(u, c) for u in restorable] for c in cands]
+        families.append(
+            [(u, cands[i % len(cands)]) for i, u in enumerate(restorable)]
+        )
+        for fam in families:
+            aug = others + fam
+            if check([m for _, m in aug], [q for q, _ in aug]):
+                return " [gang-optimism]"
+        return ""
+
     for p, n in placed:
         if not _np(nodes.valid)[n]:
             out.append(f"pod {p}: placed on invalid node {n}")
@@ -779,18 +831,31 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
         others_p = [q for q, _ in others]
         sp_ok, _ = ora.spread_ok_and_penalty(p, others_n, others_p)
         if not sp_ok[n]:
-            out.append(f"pod {p}: node {n} violates DoNotSchedule spread")
+            tag = _gang_tag(
+                p, n, others,
+                lambda on, op: ora.spread_ok_and_penalty(p, on, op)[0][n],
+            )
+            out.append(
+                f"pod {p}: node {n} violates DoNotSchedule spread{tag}"
+            )
         ia_ok, _ = ora.interpod_ok_and_raw(p, others_n, others_p)
         if not ia_ok[n]:
-            out.append(f"pod {p}: node {n} violates required pod affinity")
+            tag = _gang_tag(
+                p, n, others,
+                lambda on, op: ora.interpod_ok_and_raw(p, on, op)[0][n],
+            )
+            out.append(
+                f"pod {p}: node {n} violates required pod affinity{tag}"
+            )
         if not ora.symmetric_anti_ok(p, others_n, others_p)[n]:
+            # Restoring members can only ADD anti holders, never remove
+            # them, so a symmetric-anti violation cannot be
+            # gang-optimism: always untagged.
             out.append(
                 f"pod {p}: node {n} violates a member's symmetric anti-affinity"
             )
     # Gang all-or-nothing: a group with ANY placed member must have at
     # least minMember placed (SURVEY.md C8).
-    group = _np(pods.group)
-    gmin = _np(snap.group_min_member)
     if gmin.shape[0]:
         cnt: dict[int, int] = {}
         for p, n in placed:

@@ -55,6 +55,7 @@ def make_cluster(
     pdb_frac: float = 0.0,
     cordon_frac: float = 0.0,
     as_records: bool = False,
+    tight_utilization: bool = False,
 ):
     """General-purpose random cluster. Fractions control what share of
     pods/nodes carry each constraint type, so the same generator covers
@@ -115,8 +116,19 @@ def make_cluster(
             rem = remaining[name]
             want_cpu = int(cap_cpu * initial_utilization / max(n_running_per_node, 1))
             want_mem = int(cap_mem * initial_utilization / max(n_running_per_node, 1))
-            cpu_req = float(rng.integers(100, max(101, want_cpu + 1)))
-            mem_req = float(rng.integers(1 << 28, max((1 << 28) + 1, want_mem + 1)))
+            if tight_utilization:
+                # Deterministic sizing AT the target fraction: the
+                # random draw below averages half the target, which at
+                # large node counts leaves so much headroom that the
+                # preemption config never actually preempts.
+                cpu_req, mem_req = float(max(100, want_cpu)), float(
+                    max(1 << 28, want_mem)
+                )
+            else:
+                cpu_req = float(rng.integers(100, max(101, want_cpu + 1)))
+                mem_req = float(
+                    rng.integers(1 << 28, max((1 << 28) + 1, want_mem + 1))
+                )
             cpu_req = min(cpu_req, max(rem[0] - 100.0, 0.0))
             mem_req = min(mem_req, max(rem[1] - float(1 << 28), 0.0))
             if cpu_req <= 0 or mem_req <= 0:
@@ -289,4 +301,5 @@ def config5_preemption(rng: np.random.Generator, n_pods: int = 1_000, n_nodes: i
     kw.setdefault("initial_utilization", 0.9)
     kw.setdefault("n_running_per_node", 8)
     kw.setdefault("pdb_frac", 0.3)
+    kw.setdefault("tight_utilization", True)
     return make_cluster(rng, n_pods, n_nodes, **kw)
