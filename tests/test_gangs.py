@@ -193,7 +193,8 @@ def test_gang_rollback_audit_caveat(mode):
         "semantics)"
     )
     violations = validate_assignment(
-        snap, cfg, res.assignment, commit_key=res.commit_key
+        snap, cfg, res.assignment, commit_key=res.commit_key,
+        hard_only=False,
     )
     caveats = [v for v in violations if "required pod affinity" in v]
     assert caveats, "the final-state audit reports the documented caveat"
@@ -234,6 +235,6 @@ def test_gang_optimism_tag_not_spurious():
     # anywhere and none restorable.
     assignment = np.full(snap.pods.valid.shape[0], -1, np.int32)
     assignment[2] = 0
-    violations = validate_assignment(snap, cfg, assignment)
+    violations = validate_assignment(snap, cfg, assignment, hard_only=False)
     bad = [v for v in violations if "required pod affinity" in v]
     assert bad and all("[gang-optimism]" not in v for v in bad)

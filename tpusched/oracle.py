@@ -697,7 +697,8 @@ class Oracle:
 def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
                         assignment: np.ndarray,
                         commit_key: np.ndarray | None = None,
-                        evicted: np.ndarray | None = None) -> list[str]:
+                        evicted: np.ndarray | None = None,
+                        hard_only: bool = True) -> list[str]:
     """Independent validity audit of any assignment (used to check the
     fast mode's guarantees): capacity respected, static predicates hold,
     and every placed pod's DoNotSchedule-spread / required inter-pod
@@ -733,9 +734,13 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
     any tried restoration applies the tag; exotic multi-member cases
     may stay untagged, erring toward reporting a hard violation — the
     tag is never spurious, and gang-free snapshots are never tagged
-    (there is nothing to restore). Downstream audits filter with
-    `[v for v in violations if "[gang-optimism]" not in v]` to get the
-    hard-violation set.
+    (there is nothing to restore).
+
+    hard_only (default True): drop tagged gang-optimism caveats from
+    the returned list, so every consumer audits the HARD-violation set
+    by default. Pass hard_only=False to also see the tagged caveats
+    (opt-in diagnostics; see
+    tests/test_gangs.py::test_gang_rollback_audit_caveat).
 
     Returns human-readable violation strings (empty = valid)."""
     ora = Oracle(snap, cfg)
@@ -867,6 +872,8 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
                     f"group {g}: {c} placed < minMember {gmin[g]} "
                     "(partial gang placement)"
                 )
+    if hard_only:
+        out = [v for v in out if "[gang-optimism]" not in v]
     return out
 
 
