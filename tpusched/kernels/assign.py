@@ -648,14 +648,26 @@ _RESIDUAL_CAP = 1024
 # Bid width and round cap of the fast-mode batched preemption auction
 # (_preempt_rounds): per round, the top _PREEMPT_BATCH unplaced pods
 # bid in parallel; upstream preempts ONE pod per scheduling cycle, so
-# 64 rounds x 256 bids is far past parity behavior.
-_PREEMPT_BATCH = 256
+# even one round x 512 bids is far past parity behavior. 512 (round
+# 5, was 256): plain-feasible bidders share the same slots, and at
+# 90% utilization they crowd out preemptors mid-drain — the wider
+# batch keeps eviction throughput up; per-round cost grows sublinearly
+# now that claim resolution is parallel (preempt_auction claim_it).
+_PREEMPT_BATCH = 512
 _PREEMPT_MAX_ROUNDS = 128
+# Per-node victim cap of the node-major fast-auction tableau
+# (kpreempt.PreemptCtxNV): victims are slotted per node in ascending
+# cost order and a fast-mode preemptor can evict at most this many on
+# one node. Prefixes needing more fall back to other nodes or stay
+# pending (the parity path has no cap). 16 covers every BASELINE
+# workload (config 5 runs 8 victims/node).
+_PREEMPT_VICTIM_CAP = 16
 
 
 def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
                     static: StaticCtx, rank, base_rounds,
-                    used, assigned, st, evicted, round_of, chosen):
+                    used, assigned, st, evicted, round_of, chosen,
+                    has_pair=None):
     """Fast-mode PostFilter as BATCHED AUCTION ROUNDS (round-4; replaces
     a sequential per-pod scan that cost ~3 ms per preemptor — 9.6 s for
     2.7k preemptors at 10k x 5k). Each round:
@@ -664,15 +676,27 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
          order) are evaluated IN PARALLEL against round-start state:
          plain feasibility first (an earlier round's evictions may have
          left room), else the batched victim-prefix auction
-         (kpreempt.preempt_auction): every bidder's per-node tableau is
-         vmapped ([C, M] prefix masses become MXU matmuls) and a rank-
-         ordered scan assigns each bidder its cheapest STILL-UNCLAIMED
-         node — one claimant per node, so same-round victim sets never
-         overlap.
-      2. A second rank-ordered scan (O(GP) carry) enforces
-         PodDisruptionBudgets as a priority prefix over the claimants;
-         a bid whose exact budget accounting went stale is deferred and
-         re-bids next round.
+         (kpreempt.preempt_auction): every bidder's per-node tableau
+         comes from the node-major table (_tableau_nv) and parallel
+         claim iterations deal bidders distinct cheap STILL-UNCLAIMED
+         nodes — one claimant per node, so same-round victim sets never
+         overlap (a bidder unclaimed after the fixed iteration count
+         defers to the next round, a retry the old rank-ordered scan
+         never needed). Plain bidders WITHOUT pairwise involvement (has_pair
+         False) bypass the one-claim-per-node scan entirely: the load-
+         balancing scores herd their argmaxes onto the same few nodes,
+         which capped keeps at ~one per node per round (a 25-round
+         drain tail for ~200 pods, measured round 5); a capacity-
+         prefix commit per node (the same rule as _deal_commit's sub-
+         step) admits every same-node bidder that fits, on nodes no
+         eviction bid claimed this round. Pairwise-involved plain
+         bidders stay on the claim scan — node exclusivity bounds
+         their same-round interactions.
+      2. A rank-ordered claimed-cumulative budget gate (O(1)-depth
+         cumsums over [C, GP]) enforces PodDisruptionBudgets as a
+         priority prefix over the claimants; a bid whose conservative
+         budget accounting overdraws is deferred and re-bids next
+         round against exact consumption.
       3. Kept bids apply as BATCHED scatters (evictions, capacity,
          pair state); deferred pods re-bid against the updated state.
 
@@ -687,7 +711,7 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
     N = nodes.valid.shape[0]
     BIG = jnp.int32(2**31 - 1)
     C = min(P, _PREEMPT_BATCH)
-    pctx = kpreempt.precompute(cfg, snap)
+    pctx = kpreempt.precompute_nv(cfg, snap, _PREEMPT_VICTIM_CAP)
     prio = effective_priority(
         cfg, pods.base_priority, pods.slo_target, pods.observed_avail
     )
@@ -695,6 +719,8 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
     run_pdb = snap.running.pdb_group
     run_valid = snap.running.valid
     S = snap.sigs.key.shape[0]
+    if has_pair is None:
+        has_pair = jnp.zeros(P, bool)
 
     def cond(carry):
         return carry[-2] & (carry[-1] < _PREEMPT_MAX_ROUNDS)
@@ -714,12 +740,18 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             )
             masked = jnp.where(feasible, score, NEG_INF)
             n_plain = jnp.argmax(masked).astype(jnp.int32)
-            return n_plain, jnp.any(feasible), masked[n_plain], allowed
+            return (n_plain, jnp.any(feasible), masked[n_plain], allowed,
+                    feasible, masked)
 
-        n_plain, can_plain, sc_plain, allowed_rows = jax.vmap(eval_plain)(
-            sel
-        )
+        (n_plain, can_plain, sc_plain, allowed_rows, feas_pl,
+         masked_pl) = jax.vmap(eval_plain)(sel)
         can_plain &= real
+        # Pairwise-involved plain bidders go through the auction's
+        # claim scan (node exclusivity bounds their same-round
+        # interactions); free plain bidders take the capacity-prefix
+        # commit below instead.
+        plain_excl = can_plain & has_pair[sel]
+        plain_cap = can_plain & ~has_pair[sel]
         # Gangs never preempt (see solve_sequential); inactive bidders
         # enter the auction with all-False allowed rows.
         pre_active = real & ~can_plain & (pods.group[sel] < 0)
@@ -727,9 +759,11 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         target, claimed, takes_evict, evict_m, could_bid = (
             kpreempt.preempt_auction(
                 cfg, snap, pctx, prio[sel], pods.requests[sel],
-                allowed_rows, used, evicted, can_plain, n_plain,
+                allowed_rows, used, evicted, plain_excl, n_plain,
+                rank=rank[sel],
             )
         )
+        could_bid = could_bid | plain_cap
         ev_f = (evict_m & takes_evict[:, None]).astype(jnp.float32)
         freed_req = ev_f @ snap.running.requests              # [C, R]
         if GP:
@@ -746,45 +780,74 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             remaining0 = snap.pdb_allowed.astype(jnp.float32) - consumed0
 
         if GP:
-            def cstep(cc, i):
-                consumed, touched = cc
-                # Budget-respecting bids parallelize as a prefix: keep
-                # while the running consumption stays inside every
-                # touched budget's remaining allowance. A bid that
-                # DECLARED a violation (its own prefix alone overdraws —
-                # upstream's evict-PDB-pods-as-last-resort) keeps only
-                # if no earlier keep touched its budgets (its violation
-                # accounting would be stale otherwise); deferred bids
-                # re-bid next round against exact consumption. Node
-                # exclusivity was already resolved by the auction.
-                ok = claimed[i]
-                touch_i = usage[i] > 0.0
-                fits_budget = jnp.all(
-                    consumed + usage[i] <= remaining0 + 1e-6
-                )
-                alone_viol = jnp.any(usage[i] > remaining0 + 1e-6)
-                clean = ~jnp.any(touch_i & touched)
-                ok &= fits_budget | (alone_viol & clean)
-                consumed = consumed + jnp.where(ok, usage[i], 0.0)
-                touched = touched | (touch_i & ok)
-                return (consumed, touched), ok
-
-            (_, _), keep = jax.lax.scan(
-                cstep,
-                (jnp.zeros(GP, jnp.float32), jnp.zeros(GP, bool)),
-                jnp.arange(C),
+            # Budget-respecting bids parallelize as a rank-ordered
+            # prefix (sel IS ascending-rank order): keep while the
+            # CLAIMED-cumulative consumption stays inside every touched
+            # budget's remaining allowance. Counting claimed (not just
+            # kept) bids in the cumulative is conservative — a bid the
+            # exact sequential accounting would keep can be deferred —
+            # and deferred bids re-bid next round against exact
+            # consumption; safety is one-sided (kept subset of claimed,
+            # so real consumption never exceeds the bound checked). A
+            # bid that DECLARED a violation (its own usage alone
+            # overdraws — upstream's evict-PDB-pods-as-last-resort)
+            # keeps only if no earlier claimed bid touched its budgets.
+            # This replaces a C-step lax.scan with O(1)-depth cumsums
+            # (the scan's sequential steps dominated the round wall).
+            usage_cl = jnp.where(claimed[:, None], usage, 0.0)
+            cum_usage = jnp.cumsum(usage_cl, axis=0)          # [C, GP]
+            # Only budgets the bid itself touches gate it: an earlier
+            # (kept or dropped) overdraw on budget g must not block
+            # bids that never evict from g.
+            fits_budget = jnp.all(
+                jnp.where(
+                    usage > 0.0,
+                    cum_usage <= remaining0[None, :] + 1e-6, True,
+                ),
+                axis=1,
             )
+            touch = usage_cl > 0.0
+            touched_before = (
+                jnp.cumsum(touch.astype(jnp.int32), axis=0)
+                - touch.astype(jnp.int32)
+            )
+            alone_viol = jnp.any(usage > remaining0[None, :] + 1e-6, axis=1)
+            clean = ~jnp.any(touch & (touched_before > 0), axis=1)
+            keep = claimed & (fits_budget | (alone_viol & clean))
         else:
             keep = claimed
         keep_evict = keep & takes_evict
         ev_round = jnp.any(evict_m & keep_evict[:, None], axis=0)
         evicted2 = evicted | ev_round
         tgt_c = jnp.clip(target, 0, N - 1)
+        # Pairwise-free plain bidders commit through a full dealing
+        # round on the compacted [C, N] view (see docstring): the same
+        # _deal_commit the main rounds use — demand-aware dealing
+        # across the node list, top-K fallback, capacity-prefix
+        # resolution, rescue. Nodes an auction keep claimed this round
+        # are excluded (their round-start capacity is stale after
+        # evictions/placement), so the two commit families touch
+        # disjoint nodes and their capacity deltas compose.
+        taken = jnp.zeros(N, bool).at[tgt_c].max(keep)
+        req_sel = pods.requests[sel]
+        feas_c = feas_pl & plain_cap[:, None] & ~taken[None, :]
+        masked_c = jnp.where(feas_c, masked_pl, NEG_INF)
+        allowed_c = plain_cap & jnp.any(feas_c, axis=1)
+        _, choice_pl, chosen_pl = _deal_commit(
+            nodes.allocatable, req_sel, used, feas_c, masked_c,
+            allowed_c, rank[sel], min(8, N),
+        )
+        keep_pl = choice_pl >= 0
+        keep_all = keep | keep_pl
+        target_all = jnp.where(keep_pl, choice_pl, target)
         used2 = used.at[tgt_c].add(
             jnp.where(keep_evict[:, None], -freed_req, 0.0)
         )
         used2 = used2.at[tgt_c].add(
-            jnp.where(keep[:, None], pods.requests[sel], 0.0)
+            jnp.where(keep[:, None], req_sel, 0.0)
+        )
+        used2 = used2.at[jnp.clip(choice_pl, 0, N - 1)].add(
+            jnp.where(keep_pl[:, None], req_sel, 0.0)
         )
         st2 = st
         if S:
@@ -792,26 +855,27 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
                 snap, st2, static.sig_match, ev_round
             )
             choice_full = jnp.full(P, -1, jnp.int32).at[sel].set(
-                jnp.where(keep, target, -1)
+                jnp.where(keep_all, target_all, -1)
             )
-            keep_full = jnp.zeros(P, bool).at[sel].set(keep)
+            keep_full = jnp.zeros(P, bool).at[sel].set(keep_all)
             st2 = kpair.pair_state_commit(
                 snap, st2, static.sig_match, choice_full, keep_full
             )
         assigned2 = assigned.at[sel].set(
-            jnp.where(keep, target, assigned[sel])
+            jnp.where(keep_all, target_all, assigned[sel])
         )
         # Preempted placements carry no score (upstream nominates
         # without rescoring), matching the sequential path.
         chosen2 = chosen.at[sel].set(
-            jnp.where(keep & can_plain, sc_plain,
-                      jnp.where(keep, NEG_INF, chosen[sel]))
+            jnp.where(keep_pl, chosen_pl,
+                      jnp.where(keep & can_plain, sc_plain,
+                                jnp.where(keep, NEG_INF, chosen[sel])))
         )
         # Commit keys: strictly after the main rounds, ordered by
         # (preemption round, rank) — later-round keeps saw earlier
         # keeps' state.
         round_of2 = round_of.at[sel].set(
-            jnp.where(keep, base_rounds + r * P + rank[sel],
+            jnp.where(keep_all, base_rounds + r * P + rank[sel],
                       round_of[sel])
         )
         # A no-bid pod (nothing feasible, no victim prefix anywhere) is
@@ -823,19 +887,21 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         if _DEBUG_ROUNDS:
             jax.debug.print(
                 "preempt round {r}: real={re} plain={pl} pre={pr} "
-                "claimed={a} keep={k} evicts={e}",
+                "claimed={a} keep={k} keep_pl={kp} evicts={e}",
                 r=r, re=real.sum(), pl=(real & can_plain).sum(),
                 pr=takes_evict.sum(), a=claimed.sum(), k=keep.sum(),
-                e=ev_round.sum(),
+                kp=keep_pl.sum(), e=ev_round.sum(),
             )
-        newly_tried = real & (keep | ~could_bid)
+        newly_tried = real & (keep_all | ~could_bid)
         tried2 = tried.at[sel].set(tried[sel] | newly_tried)
         # Any keep changes the state (evictions free capacity), so
         # earlier no-bid verdicts are stale: clear them and re-bid.
         # Termination: a keep-less round marks every real pod tried
         # (monotone), and rounds with keeps shrink the pending set.
-        tried2 = jnp.where(jnp.any(keep), jnp.zeros_like(tried2), tried2)
-        progress = jnp.any(keep) | jnp.any(newly_tried)
+        tried2 = jnp.where(
+            jnp.any(keep_all), jnp.zeros_like(tried2), tried2
+        )
+        progress = jnp.any(keep_all) | jnp.any(newly_tried)
         return (used2, assigned2, st2, evicted2, round_of2, chosen2,
                 tried2, progress, r + 1)
 
@@ -844,7 +910,7 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         (used, assigned, st, evicted, round_of, chosen,
          jnp.zeros(P, bool), jnp.array(True), jnp.int32(0)),
     )
-    return out[:6]
+    return out[:6] + (out[-1],)
 
 
 def _cycle_nosig(alloc, used, req, mask, sscore, w_lr, w_ba, w_ts, rw):
@@ -1268,10 +1334,15 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
     M = snap.running.valid.shape[0]
     evicted = jnp.zeros(M, bool)
     if cfg.preemption and M > 0:
-        used, assigned, st_f, evicted, round_of, chosen = _preempt_rounds(
+        (used, assigned, st_f, evicted, round_of, chosen,
+         preempt_r) = _preempt_rounds(
             cfg, snap, static, rank, rounds,
             used, assigned, st_f, evicted, round_of, chosen,
+            has_pair=has_pair,
         )
+        # Total commit rounds surfaces the preemption drain too (the
+        # bench and host logs read SolveResult.rounds).
+        rounds = rounds + preempt_r
     used, assigned, chosen, st_f, rolled = gang_rollback(
         snap, used, assigned, chosen, st_f, static.sig_match
     )
