@@ -1,0 +1,104 @@
+"""kind-cluster E2E (BASELINE.json:"configs"[0]: "kind cluster, CPU").
+
+Auto-skips when `kind`/`kubectl` are absent (they are not in this
+image); on a workstation with kind installed, this drives the REAL
+boundary end to end: kind cluster -> KubeApiClient/KubeInformer ->
+HostScheduler -> Binding subresource, asserting every pod schedules.
+The same client/informer/host path is covered against an in-process
+REST fake in tests/test_kube.py, so this file only has to prove the
+stack against a genuine kube-apiserver."""
+
+import json
+import shutil
+import subprocess
+import time
+
+import pytest
+
+kind = shutil.which("kind")
+kubectl = shutil.which("kubectl")
+
+pytestmark = pytest.mark.skipif(
+    not (kind and kubectl),
+    reason="kind/kubectl not installed (expected in this image)",
+)
+
+CLUSTER = "tpusched-e2e"
+N_PODS = 20
+
+
+def _sh(*args, timeout=300):
+    return subprocess.run(
+        args, capture_output=True, text=True, timeout=timeout, check=True
+    ).stdout
+
+
+@pytest.fixture(scope="module")
+def kind_cluster():
+    existing = _sh(kind, "get", "clusters").split()
+    created = False
+    if CLUSTER not in existing:
+        _sh(kind, "create", "cluster", "--name", CLUSTER, "--wait", "120s")
+        created = True
+    kubeconfig = _sh(kind, "get", "kubeconfig", "--name", CLUSTER)
+    import tempfile
+
+    f = tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False)
+    f.write(kubeconfig)
+    f.close()
+    try:
+        yield f.name
+    finally:
+        if created:
+            subprocess.run([kind, "delete", "cluster", "--name", CLUSTER],
+                           capture_output=True)
+
+
+def test_kind_host_schedules_all_pods(kind_cluster):
+    from tpusched import EngineConfig
+    from tpusched.host import HostScheduler
+    from tpusched.kube import KubeApiClient, KubeInformer
+
+    env = {"KUBECONFIG": kind_cluster}
+    for i in range(N_PODS):
+        manifest = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"tpusched-e2e-{i}",
+                         "labels": {"app": "tpusched-e2e"}},
+            "spec": {
+                "schedulerName": "tpu-scheduler",
+                "containers": [{
+                    "name": "pause",
+                    "image": "registry.k8s.io/pause:3.9",
+                    "resources": {"requests": {"cpu": "10m",
+                                               "memory": "16Mi"}},
+                }],
+            },
+        }
+        subprocess.run(
+            [kubectl, "apply", "-f", "-"], input=json.dumps(manifest),
+            text=True, capture_output=True, check=True,
+            env={**__import__("os").environ, **env},
+        )
+    informer = KubeInformer(
+        KubeApiClient(kubeconfig=kind_cluster), poll_timeout=5.0
+    ).start()
+    try:
+        host = HostScheduler(informer, EngineConfig(mode="fast"))
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            host.cycle()
+            bound = [r for r in informer.bound_pods()
+                     if r["name"].startswith("tpusched-e2e-")]
+            if len(bound) == N_PODS:
+                break
+            time.sleep(1.0)
+        assert len(bound) == N_PODS, f"only {len(bound)}/{N_PODS} bound"
+    finally:
+        informer.stop()
+        subprocess.run(
+            [kubectl, "delete", "pod", "-l", "app=tpusched-e2e",
+             "--wait=false"],
+            capture_output=True,
+            env={**__import__("os").environ, **env},
+        )

@@ -40,12 +40,19 @@ class FakeApiServer:
         self._pods: dict[str, dict] = {}      # pending + bound
         self.bind_count = 0
         self.delete_count = 0
+        # Change log for delta hints (the fake twin of KubeInformer's
+        # event accumulator): every mutation records the object name;
+        # drain_changed() empties it. First drain returns None ("no
+        # baseline"), matching the informer contract.
+        self._changed: set[str] = set()
+        self._dirty_all = True
 
     # -- cluster setup ------------------------------------------------------
 
     def add_node(self, name: str, **spec) -> None:
         with self._lock:
             self._nodes[name] = dict(spec, name=name)
+            self._changed.add(name)
 
     def add_pod(self, name: str, **spec) -> None:
         with self._lock:
@@ -53,6 +60,7 @@ class FakeApiServer:
                 spec, name=name, phase="Pending", node=None,
                 submitted=time.time(),
             )
+            self._changed.add(name)
 
     def add_bound_pod(self, name: str, node: str, **spec) -> None:
         """A pod already running on a node (pre-existing workload)."""
@@ -61,6 +69,29 @@ class FakeApiServer:
                 spec, name=name, phase="Bound", node=node,
                 submitted=time.time(),
             )
+            self._changed.add(name)
+
+    # -- delta hints --------------------------------------------------------
+
+    def drain_changed(self) -> "set[str] | None":
+        with self._lock:
+            if self._dirty_all:
+                self._dirty_all = False
+                self._changed.clear()
+                return None
+            out = self._changed
+            self._changed = set()
+            return out
+
+    def restore_changed(self, names: "set[str] | None") -> None:
+        """Un-drain hints a caller consumed but never shipped (e.g. a
+        cycle that returned early): without this, the next delta would
+        trust a stale base for these records."""
+        with self._lock:
+            if names is None:
+                self._dirty_all = True
+            else:
+                self._changed |= names
 
     # -- watch/list side ----------------------------------------------------
 
@@ -92,6 +123,7 @@ class FakeApiServer:
             pod["phase"] = "Bound"
             pod["node"] = node_name
             self.bind_count += 1
+            self._changed.add(pod_name)
 
     def delete_pod(self, pod_name: str) -> bool:
         """Eviction; returns False if already gone (idempotent)."""
@@ -100,6 +132,7 @@ class FakeApiServer:
                 return False
             del self._pods[pod_name]
             self.delete_count += 1
+            self._changed.add(pod_name)
             return True
 
 
@@ -141,6 +174,7 @@ class HostScheduler:
         backoff_initial: float = 1.0,
         backoff_max: float = 10.0,
         clock=None,
+        use_delta: bool = True,
     ):
         self.api = api
         self.config = config or EngineConfig()
@@ -154,6 +188,16 @@ class HostScheduler:
             self._engine = None
         else:
             self._engine = engine if engine is not None else Engine(self.config)
+        # Sidecar transport: wrap the client in a DeltaSession so each
+        # cycle ships only churned records (SURVEY.md §7 hard part 6),
+        # with changed-name hints from the api's change log (informer
+        # events or FakeApiServer's mutation log) making the diff
+        # O(churn). use_delta=False forces full sends every cycle.
+        self._delta = None
+        if client is not None and use_delta:
+            from tpusched.rpc.client import DeltaSession
+
+            self._delta = DeltaSession(client)
         self.cycles: list[CycleStats] = []
         # Queue semantics (SURVEY.md §1.2 L5: activeQ/backoffQ): a pod
         # that fails to place enters backoff with exponentially growing
@@ -232,6 +276,16 @@ class HostScheduler:
         (pods in their backoff window don't count — they re-enter the
         active queue when it expires)."""
         now = self._clock()
+        # Drain change hints BEFORE reading cluster state: an event
+        # landing between the drain and the reads stays in the
+        # accumulator for next cycle (harmless over-inclusion), whereas
+        # draining after the reads could consume a hint whose state the
+        # snapshot missed — shipping a stale delta record next cycle.
+        changed = None
+        if self._delta is not None:
+            drain = getattr(self.api, "drain_changed", None)
+            if drain is not None:
+                changed = drain()
         all_pending = self.api.pending_pods()
         # Prune backoff state for pods that vanished (deleted, or bound
         # by another actor) so the book can't grow without bound.
@@ -243,15 +297,36 @@ class HostScheduler:
             if self._backoff.get(self._backoff_key(p), (0.0, 0))[0] <= now
         ]
         if not pending:
+            # Nothing ships this cycle: un-drain the hints or the next
+            # delta would trust a stale base for those records.
+            if self._delta is not None:
+                restore = getattr(self.api, "restore_changed", None)
+                if restore is not None:
+                    restore(changed)
             return None
         pending = pending[: self.batch_size]
-        t0 = time.perf_counter()
-        msg = self._wire_snapshot(pending)
-        build_s = time.perf_counter() - t0
+        # Any failure before a successful send must un-drain the hints
+        # (same hazard as the early return above): DeltaSession's base
+        # only advances on success, so a lost hint would make the next
+        # delta trust a stale base for that record.
+        try:
+            t0 = time.perf_counter()
+            msg = self._wire_snapshot(pending)
+            build_s = time.perf_counter() - t0
 
-        t0 = time.perf_counter()
+            t0 = time.perf_counter()
+            if self.client is not None:
+                if self._delta is not None:
+                    resp = self._delta.assign(msg, changed=changed)
+                else:
+                    resp = self.client.assign(msg)
+        except BaseException:
+            if self._delta is not None:
+                restore = getattr(self.api, "restore_changed", None)
+                if restore is not None:
+                    restore(changed)
+            raise
         if self.client is not None:
-            resp = self.client.assign(msg)
             assignments = [(a.pod, a.node) for a in resp.assignments if a.node]
             evicted = list(resp.evicted)
             solve_s = time.perf_counter() - t0

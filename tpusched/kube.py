@@ -1,0 +1,726 @@
+"""Kubernetes API client for the host scheduler (SURVEY.md C13, §1.2 L1).
+
+The reference's only process boundary is the API-server client
+(client-go informers + the Bind subresource POST; SURVEY.md §3.1). This
+module is that boundary for the TPU host shim: `KubeApiClient` speaks
+the same read/write interface as `host.FakeApiServer` (list_nodes /
+pending_pods / bound_pods / bind / delete_pod) over plain Kubernetes
+REST — list, watch, the Binding subresource, and the Eviction
+subresource — translating V1Node/V1Pod JSON into the builder-style
+records the wire codec consumes (rpc.codec.snapshot_to_proto).
+
+No kubernetes client library exists in this image, so the transport is
+stdlib urllib with kubeconfig/in-cluster auth:
+
+  * kubeconfig (~/.kube/config or $KUBECONFIG): current-context server,
+    CA bundle, bearer token or client certificate;
+  * in-cluster: /var/run/secrets/kubernetes.io/serviceaccount token +
+    KUBERNETES_SERVICE_HOST, the same resolution order client-go uses.
+
+A `KubeWatcher` runs list+watch streams over pods/nodes and accumulates
+the names of objects each event touched; `drain_changed()` feeds the
+DeltaSession's `changed` hints so per-cycle diffs are O(churn)
+(rpc.codec.delta_between). On watch failure it re-lists and reports one
+`None` (hints unknown -> the session does a full byte-diff), mirroring
+informer resync semantics (SURVEY.md §5 "Failure detection").
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import ssl
+import tempfile
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from tpusched.snapshot import (
+    MatchExpression,
+    NodeSelectorTerm,
+    PodAffinityTerm,
+    PreferredTerm,
+    Toleration,
+    TopologySpreadConstraint,
+)
+
+# Annotations carrying the QoS-driven scheduler's per-pod SLO signal
+# (the reference stores availability targets/observations out of band;
+# annotations are the conventional k8s side channel for them).
+ANN_SLO_TARGET = "tpusched.io/slo-target"
+ANN_OBSERVED = "tpusched.io/observed-availability"
+# scheduler-plugins coscheduling convention for gang membership.
+LABEL_POD_GROUP = "scheduling.x-k8s.io/pod-group"
+ANN_MIN_MEMBER = "scheduling.x-k8s.io/min-member"
+
+DEFAULT_SCHEDULER_NAME = "tpu-scheduler"
+
+_SUFFIX = {
+    "Ki": 1024.0, "Mi": 1024.0**2, "Gi": 1024.0**3, "Ti": 1024.0**4,
+    "Pi": 1024.0**5, "Ei": 1024.0**6,
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+    "m": 1e-3,
+}
+
+
+def parse_quantity(q) -> float:
+    """Kubernetes resource.Quantity -> float (base units)."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q).strip()
+    for suf, mult in _SUFFIX.items():
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * mult
+    return float(s)
+
+
+def pod_requests(spec: dict) -> dict[str, float]:
+    """Sum container requests the way the scheduler does: max(sum of
+    containers, each initContainer) per resource, cpu in millicores,
+    memory in bytes, plus the implicit pods=1."""
+    total: dict[str, float] = {}
+
+    def acc(out, res):
+        for k, v in (res or {}).items():
+            val = parse_quantity(v)
+            if k == "cpu":
+                val *= 1000.0
+            out[k] = out.get(k, 0.0) + val
+
+    for c in spec.get("containers", []):
+        acc(total, c.get("resources", {}).get("requests"))
+    for c in spec.get("initContainers", []):
+        init: dict[str, float] = {}
+        acc(init, c.get("resources", {}).get("requests"))
+        for k, v in init.items():
+            total[k] = max(total.get(k, 0.0), v)
+    total["pods"] = 1.0
+    return total
+
+
+def _exprs(sel: dict | None) -> tuple[MatchExpression, ...]:
+    """labelSelector / nodeSelectorTerm -> MatchExpression tuple."""
+    if not sel:
+        return ()
+    out = []
+    for k, v in (sel.get("matchLabels") or {}).items():
+        out.append(MatchExpression(k, "In", (str(v),)))
+    for e in sel.get("matchExpressions") or []:
+        out.append(MatchExpression(
+            e["key"], e["operator"],
+            tuple(str(v) for v in e.get("values") or ()),
+        ))
+    return tuple(out)
+
+
+def node_record(obj: dict) -> dict:
+    """V1Node JSON -> builder node record."""
+    meta = obj.get("metadata", {})
+    spec = obj.get("spec", {})
+    status = obj.get("status", {})
+    alloc = {}
+    for k, v in (status.get("allocatable") or {}).items():
+        val = parse_quantity(v)
+        if k == "cpu":
+            val *= 1000.0
+        alloc[k] = val
+    return dict(
+        name=meta["name"],
+        allocatable=alloc,
+        labels=dict(meta.get("labels") or {}),
+        taints=[
+            (t["key"], t.get("value", ""), t.get("effect", "NoSchedule"))
+            for t in spec.get("taints") or []
+        ],
+        unschedulable=bool(spec.get("unschedulable", False)),
+    )
+
+
+def _affinity_terms(spec: dict) -> list[PodAffinityTerm]:
+    aff = spec.get("affinity") or {}
+    out: list[PodAffinityTerm] = []
+    for kind, anti in (("podAffinity", False), ("podAntiAffinity", True)):
+        a = aff.get(kind) or {}
+        for t in a.get("requiredDuringSchedulingIgnoredDuringExecution") or []:
+            out.append(PodAffinityTerm(
+                topology_key=t["topologyKey"],
+                selector=_exprs(t.get("labelSelector")),
+                anti=anti, required=True,
+                namespaces=tuple(t.get("namespaces") or ()),
+            ))
+        for w in a.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+            t = w.get("podAffinityTerm", {})
+            out.append(PodAffinityTerm(
+                topology_key=t.get("topologyKey", ""),
+                selector=_exprs(t.get("labelSelector")),
+                anti=anti, required=False,
+                weight=float(w.get("weight", 1)),
+                namespaces=tuple(t.get("namespaces") or ()),
+            ))
+    return out
+
+
+def pending_record(obj: dict) -> dict:
+    """Pending V1Pod JSON -> builder pod record."""
+    meta = obj.get("metadata", {})
+    spec = obj.get("spec", {})
+    ann = meta.get("annotations") or {}
+    labels = dict(meta.get("labels") or {})
+    aff = spec.get("affinity") or {}
+    node_aff = aff.get("nodeAffinity") or {}
+    req = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    required_terms = tuple(
+        NodeSelectorTerm(_exprs(t))
+        for t in req.get("nodeSelectorTerms") or []
+        if _exprs(t)
+    )
+    preferred_terms = tuple(
+        PreferredTerm(
+            float(w.get("weight", 1)),
+            NodeSelectorTerm(_exprs(w.get("preference"))),
+        )
+        for w in node_aff.get(
+            "preferredDuringSchedulingIgnoredDuringExecution"
+        ) or []
+    )
+    rec = dict(
+        name=meta["name"],
+        namespace=meta.get("namespace", "default"),
+        requests=pod_requests(spec),
+        priority=float(spec.get("priority", 0)),
+        slo_target=float(ann.get(ANN_SLO_TARGET, 0.0)),
+        observed_avail=float(ann.get(ANN_OBSERVED, 1.0)),
+        labels=labels,
+        node_selector=dict(spec.get("nodeSelector") or {}),
+        required_terms=required_terms,
+        preferred_terms=preferred_terms,
+        tolerations=[
+            Toleration(
+                key=t.get("key", ""),
+                operator=t.get("operator", "Equal"),
+                value=t.get("value", ""),
+                effect=t.get("effect", ""),
+            )
+            for t in spec.get("tolerations") or []
+        ],
+        topology_spread=[
+            TopologySpreadConstraint(
+                topology_key=c["topologyKey"],
+                max_skew=int(c.get("maxSkew", 1)),
+                when_unsatisfiable=c.get("whenUnsatisfiable", "DoNotSchedule"),
+                selector=_exprs(c.get("labelSelector")),
+            )
+            for c in spec.get("topologySpreadConstraints") or []
+        ],
+        pod_affinity=_affinity_terms(spec),
+        submitted=meta.get("creationTimestamp"),
+    )
+    group = labels.get(LABEL_POD_GROUP)
+    if group:
+        rec["pod_group"] = group
+        rec["pod_group_min_member"] = int(ann.get(ANN_MIN_MEMBER, 0))
+    return rec
+
+
+def running_record(obj: dict, pdb_of=None) -> dict:
+    """Bound V1Pod JSON -> builder running record. pdb_of: optional
+    callable (namespace, labels) -> (pdb_name, disruptions_allowed) for
+    PodDisruptionBudget coverage."""
+    meta = obj.get("metadata", {})
+    spec = obj.get("spec", {})
+    ann = meta.get("annotations") or {}
+    labels = dict(meta.get("labels") or {})
+    ns = meta.get("namespace", "default")
+    slo = float(ann.get(ANN_SLO_TARGET, 0.0))
+    observed = float(ann.get(ANN_OBSERVED, 1.0))
+    rec = dict(
+        name=meta["name"],
+        namespace=ns,
+        node=spec.get("nodeName", ""),
+        requests=pod_requests(spec),
+        priority=float(spec.get("priority", 0)),
+        labels=labels,
+        pod_affinity=_affinity_terms(spec),
+        slack=observed - slo,
+    )
+    if pdb_of is not None:
+        hit = pdb_of(ns, labels)
+        if hit is not None:
+            rec["pdb_group"], rec["pdb_disruptions_allowed"] = hit
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# Transport / auth.
+# ---------------------------------------------------------------------------
+
+
+class KubeConfigError(Exception):
+    pass
+
+
+def _b64_to_tempfile(data: str) -> str:
+    f = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
+    f.write(base64.b64decode(data))
+    f.close()
+    return f.name
+
+
+def load_kubeconfig(path: str | None = None) -> dict:
+    """Resolve (server, ssl_context, headers) from a kubeconfig file or
+    the in-cluster service account, client-go resolution order."""
+    import yaml
+
+    path = path or os.environ.get(
+        "KUBECONFIG", os.path.expanduser("~/.kube/config")
+    )
+    if os.path.exists(path):
+        with open(path) as f:
+            cfg = yaml.safe_load(f) or {}
+        ctx_name = cfg.get("current-context")
+        ctx = next(
+            (c["context"] for c in cfg.get("contexts", [])
+             if c["name"] == ctx_name), None,
+        )
+        if ctx is None:
+            raise KubeConfigError(f"no current-context in {path}")
+        cluster = next(
+            (c["cluster"] for c in cfg.get("clusters", [])
+             if c["name"] == ctx["cluster"]), None,
+        )
+        user = next(
+            (u["user"] for u in cfg.get("users", [])
+             if u["name"] == ctx.get("user")), {},
+        ) or {}
+        if cluster is None:
+            raise KubeConfigError(f"context {ctx_name} names no cluster")
+        server = cluster["server"]
+        sslctx = ssl.create_default_context()
+        if cluster.get("insecure-skip-tls-verify"):
+            sslctx.check_hostname = False
+            sslctx.verify_mode = ssl.CERT_NONE
+        elif cluster.get("certificate-authority-data"):
+            sslctx = ssl.create_default_context(
+                cafile=_b64_to_tempfile(cluster["certificate-authority-data"])
+            )
+        elif cluster.get("certificate-authority"):
+            sslctx = ssl.create_default_context(
+                cafile=cluster["certificate-authority"]
+            )
+        headers = {}
+        if user.get("token"):
+            headers["Authorization"] = f"Bearer {user['token']}"
+        cert = key = None
+        if user.get("client-certificate-data"):
+            cert = _b64_to_tempfile(user["client-certificate-data"])
+        elif user.get("client-certificate"):
+            cert = user["client-certificate"]
+        if user.get("client-key-data"):
+            key = _b64_to_tempfile(user["client-key-data"])
+        elif user.get("client-key"):
+            key = user["client-key"]
+        if cert and key:
+            sslctx.load_cert_chain(cert, key)
+        return dict(server=server, ssl=sslctx, headers=headers)
+    # In-cluster fallback.
+    sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    if host and os.path.exists(f"{sa}/token"):
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        with open(f"{sa}/token") as f:
+            token = f.read().strip()
+        sslctx = ssl.create_default_context(cafile=f"{sa}/ca.crt")
+        return dict(
+            server=f"https://{host}:{port}", ssl=sslctx,
+            headers={"Authorization": f"Bearer {token}"},
+        )
+    raise KubeConfigError(
+        f"no kubeconfig at {path} and not running in-cluster"
+    )
+
+
+class KubeApiClient:
+    """FakeApiServer-interface adapter over Kubernetes REST.
+
+    `base_url` (e.g. "http://127.0.0.1:8001" via `kubectl proxy`, or a
+    test server) bypasses kubeconfig resolution entirely — auth-free
+    plain HTTP, which is also what the contract tests use.
+    """
+
+    def __init__(
+        self,
+        base_url: str | None = None,
+        kubeconfig: str | None = None,
+        scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+        timeout: float = 30.0,
+    ):
+        if base_url is not None:
+            self._server = base_url.rstrip("/")
+            self._ssl = None
+            self._headers: dict[str, str] = {}
+        else:
+            resolved = load_kubeconfig(kubeconfig)
+            self._server = resolved["server"].rstrip("/")
+            self._ssl = resolved["ssl"]
+            self._headers = resolved["headers"]
+        self.scheduler_name = scheduler_name
+        self.timeout = timeout
+        self.bind_count = 0
+        self.delete_count = 0
+        # name -> namespace, learned from listings: the host addresses
+        # pods by bare name (FakeApiServer has no namespaces), REST
+        # paths need the namespace back.
+        self._ns_of: dict[str, str] = {}
+
+    # -- raw REST -----------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 timeout: float | None = None):
+        url = self._server + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        for k, v in self._headers.items():
+            req.add_header(k, v)
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        kw = {"timeout": timeout or self.timeout}
+        if self._ssl is not None:
+            kw["context"] = self._ssl
+        return urllib.request.urlopen(req, **kw)
+
+    def _json(self, method: str, path: str, body: dict | None = None):
+        with self._request(method, path, body) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    # -- reads (FakeApiServer interface) ------------------------------------
+
+    def list_nodes(self) -> list[dict]:
+        obj = self._json("GET", "/api/v1/nodes")
+        return [node_record(o) for o in obj.get("items", [])]
+
+    def _list_pods(self) -> dict:
+        return self._json("GET", "/api/v1/pods")
+
+    def pending_pods(self) -> list[dict]:
+        out = []
+        for o in self._list_pods().get("items", []):
+            spec = o.get("spec", {})
+            phase = o.get("status", {}).get("phase", "Pending")
+            if spec.get("nodeName") or phase != "Pending":
+                continue
+            if spec.get("schedulerName", "default-scheduler") != self.scheduler_name:
+                continue
+            rec = pending_record(o)
+            self._ns_of[rec["name"]] = rec["namespace"]
+            out.append(rec)
+        return out
+
+    def bound_pods(self) -> list[dict]:
+        pdb_of = self._pdb_resolver()
+        out = []
+        for o in self._list_pods().get("items", []):
+            if not o.get("spec", {}).get("nodeName"):
+                continue
+            if o.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+                continue
+            rec = running_record(o, pdb_of)
+            self._ns_of[rec["name"]] = rec["namespace"]
+            out.append(rec)
+        return out
+
+    def _pdb_resolver(self):
+        """(namespace, labels) -> (pdb name, disruptionsAllowed) from
+        policy/v1 PodDisruptionBudgets; None resolver on RBAC denial
+        (PDB awareness degrades gracefully to 'uncovered')."""
+        try:
+            obj = self._json("GET", "/apis/policy/v1/poddisruptionbudgets")
+        except (urllib.error.URLError, urllib.error.HTTPError, OSError):
+            return None
+        pdbs = []
+        for o in obj.get("items", []):
+            meta = o.get("metadata", {})
+            sel = _exprs(o.get("spec", {}).get("selector"))
+            allowed = int(o.get("status", {}).get("disruptionsAllowed", 0))
+            pdbs.append((meta.get("namespace", "default"),
+                         meta.get("name", ""), sel, allowed))
+        if not pdbs:
+            return None
+
+        def match(ns: str, labels: dict):
+            for pns, name, sel, allowed in pdbs:
+                if pns != ns:
+                    continue
+                ok = True
+                for e in sel:
+                    v = labels.get(e.key)
+                    if e.op == "In":
+                        ok = v in e.values
+                    elif e.op == "NotIn":
+                        ok = v is not None and v not in e.values
+                    elif e.op == "Exists":
+                        ok = v is not None
+                    elif e.op == "DoesNotExist":
+                        ok = v is None
+                    if not ok:
+                        break
+                if ok and sel:
+                    return name, allowed
+            return None
+
+        return match
+
+    # -- writes -------------------------------------------------------------
+
+    def bind(self, pod_name: str, node_name: str,
+             namespace: str | None = None) -> None:
+        """POST the Binding subresource; 409 -> host.Conflict (the
+        idempotent-bind story, SURVEY.md §5 'Failure detection')."""
+        from tpusched.host import Conflict
+
+        namespace = namespace or self._ns_of.get(pod_name, "default")
+        body = {
+            "apiVersion": "v1", "kind": "Binding",
+            "metadata": {"name": pod_name, "namespace": namespace},
+            "target": {"apiVersion": "v1", "kind": "Node",
+                       "name": node_name},
+        }
+        try:
+            self._json(
+                "POST",
+                f"/api/v1/namespaces/{namespace}/pods/"
+                f"{urllib.parse.quote(pod_name)}/binding",
+                body,
+            )
+        except urllib.error.HTTPError as e:
+            if e.code in (404, 409):
+                raise Conflict(
+                    f"bind {pod_name} -> {node_name}: HTTP {e.code}"
+                ) from e
+            raise
+        self.bind_count += 1
+
+    def delete_pod(self, pod_name: str,
+                   namespace: str | None = None) -> bool:
+        """Eviction subresource (honors PDBs server-side); falls back to
+        plain DELETE where the eviction API is unavailable. Idempotent:
+        missing pod -> False."""
+        namespace = namespace or self._ns_of.get(pod_name, "default")
+        ev = {
+            "apiVersion": "policy/v1", "kind": "Eviction",
+            "metadata": {"name": pod_name, "namespace": namespace},
+        }
+        quoted = urllib.parse.quote(pod_name)
+        try:
+            self._json(
+                "POST",
+                f"/api/v1/namespaces/{namespace}/pods/{quoted}/eviction",
+                ev,
+            )
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                try:
+                    self._json(
+                        "DELETE",
+                        f"/api/v1/namespaces/{namespace}/pods/{quoted}",
+                    )
+                except urllib.error.HTTPError as e2:
+                    if e2.code == 404:
+                        return False
+                    raise
+            elif e.code == 410:
+                return False
+            else:
+                raise
+        self.delete_count += 1
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Informer cache: list+watch -> local object cache + exact change hints.
+# ---------------------------------------------------------------------------
+
+
+class KubeInformer:
+    """Informer-fed cluster cache (the reference's L2 layer, SURVEY.md
+    §1.2): one list establishes the cache, watch streams apply events
+    to it, and each cycle's snapshot is served FROM the cache — so
+    drain_changed() is exactly the set of objects whose events arrived
+    since the last drain, the hint contract codec.delta_between wants
+    (a fresh re-list per cycle could include state whose watch event
+    had not arrived yet, shipping a stale delta record).
+
+    bind()/delete_pod() delegate to the client and optimistically apply
+    the result to the cache (upstream's "assume" step) so the next
+    cycle doesn't re-schedule a pod whose Bound event is still in
+    flight; the real event confirms or corrects.
+
+    On watch failure (HTTP error, 410 Gone) the informer re-lists,
+    rebuilds its cache, and the next drain_changed() returns None ONCE
+    ("hints unknown — diff everything"), the informer-resync contract
+    the DeltaSession expects (SURVEY.md §5 'Failure detection')."""
+
+    _POD_PATH = "/api/v1/pods"
+    _NODE_PATH = "/api/v1/nodes"
+
+    def __init__(self, client: KubeApiClient, poll_timeout: float = 30.0):
+        self.client = client
+        self.poll_timeout = poll_timeout
+        self.scheduler_name = client.scheduler_name
+        self._lock = threading.Lock()
+        self._objs: dict[str, dict[str, dict]] = {
+            self._POD_PATH: {}, self._NODE_PATH: {},
+        }
+        self._changed: set[str] = set()
+        self._dirty_all = True
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.bind_count = 0
+        self.delete_count = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        for path in (self._POD_PATH, self._NODE_PATH):
+            self._relist(path)
+            t = threading.Thread(
+                target=self._watch_loop, args=(path,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _relist(self, path: str) -> str:
+        obj = self.client._json("GET", path)
+        fresh = {
+            o["metadata"]["name"]: o for o in obj.get("items", [])
+        }
+        with self._lock:
+            self._objs[path] = fresh
+            self._dirty_all = True
+            self._changed.clear()
+        return obj.get("metadata", {}).get("resourceVersion", "")
+
+    def _watch_loop(self, path: str):
+        rv = ""
+        while not self._stop.is_set():
+            try:
+                if not rv:
+                    rv = self._relist(path)
+                q = urllib.parse.urlencode(
+                    {"watch": "1", "resourceVersion": rv,
+                     "timeoutSeconds": int(self.poll_timeout)}
+                )
+                with self.client._request(
+                    "GET", f"{path}?{q}",
+                    timeout=self.poll_timeout + 10.0,
+                ) as resp:
+                    for line in resp:
+                        if self._stop.is_set():
+                            return
+                        if not line.strip():
+                            continue
+                        evt = json.loads(line)
+                        if evt.get("type") == "ERROR":
+                            rv = ""  # 410 Gone: re-list
+                            break
+                        obj = evt.get("object", {})
+                        meta = obj.get("metadata", {})
+                        name = meta.get("name")
+                        rv = meta.get("resourceVersion", rv)
+                        if not name:
+                            continue
+                        with self._lock:
+                            if evt.get("type") == "DELETED":
+                                self._objs[path].pop(name, None)
+                            else:
+                                self._objs[path][name] = obj
+                            self._changed.add(name)
+            except (urllib.error.URLError, urllib.error.HTTPError,
+                    OSError, json.JSONDecodeError):
+                rv = ""
+                if self._stop.wait(0.5):
+                    return
+
+    # -- FakeApiServer read interface, served from the cache ----------------
+
+    def _pods(self) -> list[dict]:
+        with self._lock:
+            return list(self._objs[self._POD_PATH].values())
+
+    def list_nodes(self) -> list[dict]:
+        with self._lock:
+            nodes = list(self._objs[self._NODE_PATH].values())
+        return [node_record(o) for o in nodes]
+
+    def pending_pods(self) -> list[dict]:
+        out = []
+        for o in self._pods():
+            spec = o.get("spec", {})
+            phase = o.get("status", {}).get("phase", "Pending")
+            if spec.get("nodeName") or phase != "Pending":
+                continue
+            if spec.get("schedulerName", "default-scheduler") != self.scheduler_name:
+                continue
+            rec = pending_record(o)
+            self.client._ns_of[rec["name"]] = rec["namespace"]
+            out.append(rec)
+        return out
+
+    def bound_pods(self) -> list[dict]:
+        pdb_of = self.client._pdb_resolver()
+        out = []
+        for o in self._pods():
+            if not o.get("spec", {}).get("nodeName"):
+                continue
+            if o.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+                continue
+            rec = running_record(o, pdb_of)
+            self.client._ns_of[rec["name"]] = rec["namespace"]
+            out.append(rec)
+        return out
+
+    # -- writes: delegate + assume ------------------------------------------
+
+    def bind(self, pod_name: str, node_name: str) -> None:
+        self.client.bind(pod_name, node_name)
+        self.bind_count += 1
+        with self._lock:
+            obj = self._objs[self._POD_PATH].get(pod_name)
+            if obj is not None:
+                obj.setdefault("spec", {})["nodeName"] = node_name
+                self._changed.add(pod_name)
+
+    def delete_pod(self, pod_name: str) -> bool:
+        ok = self.client.delete_pod(pod_name)
+        if ok:
+            self.delete_count += 1
+        with self._lock:
+            if self._objs[self._POD_PATH].pop(pod_name, None) is not None:
+                self._changed.add(pod_name)
+        return ok
+
+    # -- delta hints --------------------------------------------------------
+
+    def drain_changed(self) -> set[str] | None:
+        with self._lock:
+            if self._dirty_all:
+                self._dirty_all = False
+                self._changed.clear()
+                return None
+            out = self._changed
+            self._changed = set()
+            return out
+
+    def restore_changed(self, names: set[str] | None) -> None:
+        """Un-drain hints a caller consumed but never shipped (see
+        host.FakeApiServer.restore_changed)."""
+        with self._lock:
+            if names is None:
+                self._dirty_all = True
+            else:
+                self._changed |= names
