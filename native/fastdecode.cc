@@ -25,6 +25,8 @@
 #define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
 #include <numpy/arrayobject.h>
 
+#include <locale.h>
+
 #include <algorithm>
 #include <cmath>
 #include <cstdint>
@@ -467,7 +469,13 @@ double try_float(const std::string& s) {
   }
   const char* c = t.c_str();
   char* endp = nullptr;
-  double v = std::strtod(c, &endp);
+  // strtod_l with a cached C locale: plain strtod honors LC_NUMERIC,
+  // so under e.g. de_DE ("," decimal point) "1.5" would parse as 1
+  // and silently break the exact-equality contract with the Python
+  // decoder (round-2 advisor finding). The locale is process-lifetime
+  // and never freed by design.
+  static locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  double v = c_loc ? strtod_l(c, &endp, c_loc) : std::strtod(c, &endp);
   if (endp == c || *endp != '\0')
     return std::numeric_limits<double>::quiet_NaN();
   return v;
@@ -561,13 +569,47 @@ struct Sig {
 // Numpy helpers.
 // ---------------------------------------------------------------------------
 
+// Per-decode allocation tracker (round-2/3 advisor finding: null
+// checks were inconsistent and the error unwind freed nothing). Every
+// Python object created during a decode registers here at creation;
+// dset() un-registers when a dict takes ownership; the tracker's
+// destructor releases whatever is still live, so a fail() anywhere —
+// including a failed numpy allocation — unwinds without leaking.
+// thread_local: the gRPC sidecar decodes on a thread pool.
+struct AllocTracker {
+  std::vector<PyObject*> live;
+  void forget(PyObject* a) {
+    for (auto it = live.rbegin(); it != live.rend(); ++it)
+      if (*it == a) {
+        live.erase(std::next(it).base());
+        return;
+      }
+  }
+  ~AllocTracker() {
+    for (auto* a : live) Py_XDECREF(a);
+  }
+};
+
+thread_local AllocTracker* g_tracker = nullptr;
+
+struct TrackerScope {
+  AllocTracker t;
+  TrackerScope() { g_tracker = &t; }
+  ~TrackerScope() { g_tracker = nullptr; }
+};
+
+PyObject* track(PyObject* a) {
+  if (!a) fail("python object allocation failed");
+  if (g_tracker) g_tracker->live.push_back(a);
+  return a;
+}
+
 PyObject* np_zeros(int nd, npy_intp* dims, int type) {
-  return PyArray_ZEROS(nd, dims, type, 0);
+  return track(PyArray_ZEROS(nd, dims, type, 0));
 }
 
 PyObject* np_full_i32(int nd, npy_intp* dims, int32_t fill) {
-  PyObject* a = PyArray_EMPTY(nd, dims, NPY_INT32, 0);
-  if (!a) fail("alloc failed");
+  PyObject* a = track(PyArray_EMPTY(nd, dims, NPY_INT32, 0));
   int32_t* p = (int32_t*)PyArray_DATA((PyArrayObject*)a);
   npy_intp n = PyArray_SIZE((PyArrayObject*)a);
   for (npy_intp i = 0; i < n; ++i) p[i] = fill;
@@ -575,8 +617,7 @@ PyObject* np_full_i32(int nd, npy_intp* dims, int32_t fill) {
 }
 
 PyObject* np_full_f32(int nd, npy_intp* dims, float fill) {
-  PyObject* a = PyArray_EMPTY(nd, dims, NPY_FLOAT32, 0);
-  if (!a) fail("alloc failed");
+  PyObject* a = track(PyArray_EMPTY(nd, dims, NPY_FLOAT32, 0));
   float* p = (float*)PyArray_DATA((PyArrayObject*)a);
   npy_intp n = PyArray_SIZE((PyArrayObject*)a);
   for (npy_intp i = 0; i < n; ++i) p[i] = fill;
@@ -588,10 +629,13 @@ int32_t* i32p(PyObject* a) { return (int32_t*)PyArray_DATA((PyArrayObject*)a); }
 int8_t* i8p(PyObject* a) { return (int8_t*)PyArray_DATA((PyArrayObject*)a); }
 bool* b8p(PyObject* a) { return (bool*)PyArray_DATA((PyArrayObject*)a); }
 
-// dict-set helper that steals the value reference.
+// dict-set helper that steals the value reference: the dict takes
+// ownership, so the tracker forgets the object (only AFTER a
+// successful insert — a failed insert leaves it tracked for unwind).
 void dset(PyObject* d, const char* k, PyObject* v) {
   if (!v) fail("null value for dict");
-  PyDict_SetItemString(d, k, v);
+  if (PyDict_SetItemString(d, k, v) < 0) fail("dict insert failed");
+  if (g_tracker) g_tracker->forget(v);
   Py_DECREF(v);
 }
 
@@ -1077,8 +1121,11 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
     }
   }
 
-  PyObject* out = PyDict_New();
-  if (!out) fail("dict alloc failed");
+  // From here on, Python objects are being created: the tracker owns
+  // everything until a dset() hands it to a dict, so any fail() (or
+  // allocation failure) unwinds leak-free.
+  TrackerScope trk;
+  PyObject* out = track(PyDict_New());
 
   // ---- Atom table. ----
   {
@@ -1446,10 +1493,12 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
 
   // ---- Meta. ----
   auto set_names = [&](const char* key, auto&& get_name, int64_t count) {
-    PyObject* lst = PyList_New(count);
+    PyObject* lst = track(PyList_New(count));
     for (int64_t i = 0; i < count; ++i) {
       std::string nm = get_name(i);
-      PyList_SET_ITEM(lst, i, PyUnicode_FromStringAndSize(nm.data(), nm.size()));
+      PyObject* u = PyUnicode_FromStringAndSize(nm.data(), nm.size());
+      if (!u) fail("string allocation failed");
+      PyList_SET_ITEM(lst, i, u);  // list steals the reference
     }
     dset(out, key, lst);
   };
@@ -1464,15 +1513,13 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
             n_running);
   set_names("group_names", [&](int64_t i) { return group_list[i]; },
             int64_t(group_list.size()));
-  dset(out, "n_nodes", PyLong_FromLongLong(n_nodes));
-  dset(out, "n_pods", PyLong_FromLongLong(n_pods));
-  dset(out, "n_running", PyLong_FromLongLong(n_running));
+  dset(out, "n_nodes", track(PyLong_FromLongLong(n_nodes)));
+  dset(out, "n_pods", track(PyLong_FromLongLong(n_pods)));
+  dset(out, "n_running", track(PyLong_FromLongLong(n_running)));
 
-  PyObject* bout = PyDict_New();
+  PyObject* bout = track(PyDict_New());
   auto bset = [&](const char* k, int64_t v) {
-    PyObject* o = PyLong_FromLongLong(v);
-    PyDict_SetItemString(bout, k, o);
-    Py_DECREF(o);
+    dset(bout, k, track(PyLong_FromLongLong(v)));
   };
   bset("pods", bk.pods);
   bset("nodes", bk.nodes);
@@ -1495,6 +1542,7 @@ PyObject* decode_impl(const uint8_t* data, Py_ssize_t len,
   bset("pdb_groups", bk.pdb_groups);
   dset(out, "buckets", bout);
 
+  trk.t.forget(out);  // ownership passes to the caller
   return out;
 }
 

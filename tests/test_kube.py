@@ -131,6 +131,7 @@ def test_pending_record_translation_full_constraint_surface():
         },
     }
     rec = pending_record(obj)
+    assert rec["name"] == "team-a/p0", "record identity is ns-qualified"
     assert rec["namespace"] == "team-a"
     assert rec["priority"] == 100.0
     assert rec["slo_target"] == pytest.approx(0.99)
@@ -383,17 +384,18 @@ def test_client_lists_and_binds_over_rest(fake_kube):
     assert [n["name"] for n in nodes] == ["n0"]
     assert nodes[0]["allocatable"]["cpu"] == pytest.approx(4000.0)
     pending = client.pending_pods()
-    assert [p["name"] for p in pending] == ["p0"], (
-        "foreign-scheduler and bound pods are excluded"
+    assert [p["name"] for p in pending] == ["default/p0"], (
+        "foreign-scheduler and bound pods are excluded; pod record "
+        "names are namespace-qualified"
     )
     bound = client.bound_pods()
-    assert [r["name"] for r in bound] == ["r0"]
-    client.bind("p0", "n0")
+    assert [r["name"] for r in bound] == ["default/r0"]
+    client.bind("default/p0", "n0")
     assert state.pods["p0"]["spec"]["nodeName"] == "n0"
     with pytest.raises(Conflict):
-        client.bind("p0", "n0")   # 409 second time
-    assert client.delete_pod("r0") is True
-    assert client.delete_pod("r0") is False   # idempotent
+        client.bind("default/p0", "n0")   # 409 second time
+    assert client.delete_pod("default/r0") is True
+    assert client.delete_pod("default/r0") is False   # idempotent
 
 
 def test_host_e2e_over_rest_with_informer_and_delta(fake_kube):
@@ -466,10 +468,10 @@ def test_informer_assume_prevents_rebind(fake_kube):
     # and the assume write — isolating assume from event delivery.
     for path in (informer._POD_PATH, informer._NODE_PATH):
         informer._relist(path)
-    assert [p["name"] for p in informer.pending_pods()] == ["p0"]
-    informer.bind("p0", "n0")
+    assert [p["name"] for p in informer.pending_pods()] == ["default/p0"]
+    informer.bind("default/p0", "n0")
     assert informer.pending_pods() == []
-    assert [r["name"] for r in informer.bound_pods()] == ["p0"]
+    assert [r["name"] for r in informer.bound_pods()] == ["default/p0"]
 
 
 def test_fake_api_change_log_matches_informer_contract():

@@ -298,3 +298,46 @@ def test_unknown_node_raises():
     msg = snapshot_to_proto(nodes, [], running)
     with pytest.raises(Exception):
         native.decode_snapshot_bytes(msg.SerializeToString(), EngineConfig())
+
+
+def test_locale_independent_float_parse():
+    """strtod honors LC_NUMERIC; the decoder must not (round-2 advisor
+    finding, fixed round 5 with strtod_l over a cached C locale). Force
+    a comma-decimal locale and decode Gt/Lt float literals; auto-skips
+    where no such locale is installed (this image ships only C/POSIX)."""
+    import locale
+
+    comma_locale = None
+    for cand in ("de_DE.UTF-8", "de_DE.utf8", "fr_FR.UTF-8", "fr_FR.utf8"):
+        try:
+            locale.setlocale(locale.LC_NUMERIC, cand)
+            comma_locale = cand
+            break
+        except locale.Error:
+            continue
+    if comma_locale is None:
+        pytest.skip("no comma-decimal locale installed")
+    try:
+        assert locale.localeconv()["decimal_point"] == ","
+        from tpusched.snapshot import MatchExpression, NodeSelectorTerm
+
+        nodes = [dict(name="n0", allocatable={"cpu": 4000.0},
+                      labels={"mem-gb": "1.5"})]
+        pods = [dict(
+            name="p", requests={"cpu": 100.0}, observed_avail=1.0,
+            required_terms=[NodeSelectorTerm(
+                (MatchExpression("mem-gb", "Gt", ("1.25",)),)
+            )],
+        )]
+        msg = snapshot_to_proto(nodes, pods, [])
+        snap_nat, meta_nat = native.decode_snapshot_bytes(
+            msg.SerializeToString(), EngineConfig()
+        )
+        # 1.25 must parse as 1.25 (not 1): the Gt atom's numeric
+        # threshold decides feasibility of the only node.
+        res = Engine(EngineConfig()).solve(snap_nat)
+        assert res.assignment[0] == 0, (
+            "Gt(1.5 > 1.25) must hold under a comma-decimal locale"
+        )
+    finally:
+        locale.setlocale(locale.LC_NUMERIC, "C")

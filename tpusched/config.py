@@ -220,7 +220,12 @@ class EngineConfig:
     tie_break: str = "first"
     tie_seed: int = 0
     # Mesh shape for multi-device runs: (pods-axis, nodes-axis). (1,1)
-    # means single device.
+    # means single device. Consumed by the gRPC sidecar
+    # (rpc.server.SchedulerService): a non-(1,1) shape — or
+    # ring_counts=True — makes the server build a jax Mesh of this
+    # shape (mesh.make_mesh) and run its Engine on it, so a deployed
+    # sidecar reaches the sharded/ring paths from YAML alone.
+    # Library users pass Engine(mesh=...) directly.
     mesh_shape: tuple[int, int] = (1, 1)
     # Route the initial pairwise domain counts through the blockwise
     # ring kernel (tpusched.ring): signature blocks rotate around the
@@ -249,7 +254,8 @@ class EngineConfig:
             kw["weights"] = PluginWeights(**d["weights"])
         if "qos" in d:
             kw["qos"] = QoSConfig(**d["qos"])
-        for k in ("mode", "max_rounds", "tie_break", "tie_seed", "preemption"):
+        for k in ("mode", "max_rounds", "tie_break", "tie_seed",
+                  "preemption", "ring_counts"):
             if k in d:
                 kw[k] = d[k]
         if "mesh_shape" in d:
@@ -257,7 +263,7 @@ class EngineConfig:
         extra = set(d) - {
             "resources", "score_resource_weights", "weights", "qos",
             "mode", "max_rounds", "tie_break", "tie_seed", "mesh_shape",
-            "preemption",
+            "preemption", "ring_counts",
         }
         if extra:
             raise ValueError(f"unknown EngineConfig keys: {sorted(extra)}")

@@ -107,11 +107,6 @@ class Engine:
             raise NotImplementedError(
                 f"tie_break={cfg.tie_break!r}: want 'first' or 'seeded'"
             )
-        if cfg.tie_break == "seeded" and cfg.mode != "parity":
-            raise NotImplementedError(
-                "tie_break='seeded' requires mode='parity' (the fast "
-                "dealing commit always breaks ties by lowest index)"
-            )
 
         def _solve(snap: ClusterSnapshot):
             return solve_core(cfg, snap, mesh=mesh)

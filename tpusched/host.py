@@ -282,8 +282,12 @@ class HostScheduler:
         # draining after the reads could consume a hint whose state the
         # snapshot missed — shipping a stale delta record next cycle.
         changed = None
+        epoch_fn = e0 = None
         if self._delta is not None:
             drain = getattr(self.api, "drain_changed", None)
+            epoch_fn = getattr(self.api, "relist_epoch", None)
+            if epoch_fn is not None:
+                e0 = epoch_fn()
             if drain is not None:
                 changed = drain()
         all_pending = self.api.pending_pods()
@@ -313,6 +317,11 @@ class HostScheduler:
             t0 = time.perf_counter()
             msg = self._wire_snapshot(pending)
             build_s = time.perf_counter() - t0
+            # An informer re-list between the drain and these reads
+            # replaced the cache with state the drained hints cannot
+            # cover (the missed-event window) — diff everything.
+            if epoch_fn is not None and epoch_fn() != e0:
+                changed = None
 
             t0 = time.perf_counter()
             if self.client is not None:

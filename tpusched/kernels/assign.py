@@ -232,6 +232,24 @@ def pick_node(cfg: EngineConfig, masked, p):
     return jnp.argmax(ties & (rank == h))
 
 
+def pick_node_batch(cfg: EngineConfig, masked, pod_idx):
+    """Row-wise pick_node over a [P?, N] score block: each row's seeded
+    uniform pick among its maxima, hash-keyed by the ORIGINAL pod index
+    (so compacted residual views pick identically to full-width rows
+    and to the oracle). Returns None for tie_break='first' — callers
+    use it as 'no override'."""
+    if cfg.tie_break == "first":
+        return None
+    mx = jnp.max(masked, axis=1, keepdims=True)
+    ties = masked == mx
+    cnt = jnp.maximum(jnp.sum(ties, axis=1), 1).astype(jnp.uint32)
+    h = (tie_hash(cfg.tie_seed, pod_idx) % cnt).astype(jnp.int32)
+    rank = jnp.cumsum(ties, axis=1) - 1
+    return jnp.argmax(
+        ties & (rank == h[:, None]), axis=1
+    ).astype(jnp.int32)
+
+
 def pop_order(cfg: EngineConfig, snap: ClusterSnapshot):
     """Queue order (SURVEY.md C10): stable descending sort by dynamic
     QoS priority; invalid pods sink to the end."""
@@ -465,7 +483,7 @@ def _spread_waterfill_deal(snap: ClusterSnapshot, pair_st, used, relaxed,
 def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
                  rank, K: int, dealt_override=None,
                  dealt_override_val=None, dealt_override_ok=None,
-                 score_full=None):
+                 score_full=None, tie_pick=None):
     """One round's dealing + capacity-prefix conflict resolution +
     rescue, shape-generic over the pod axis (used on the full [P, N]
     matrices and on the compacted residual view — same math per pod;
@@ -480,7 +498,15 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
     capacity first covers the cumulative demand of pods 0..q, for
     every resource. Pods whose dealt node is infeasible for them fall
     back to their own top-K; the capacity-prefix commit corrects any
-    estimate error, and misses retry next round."""
+    estimate error, and misses retry next round.
+
+    tie_pick: optional [P] seeded argmax per pod (pick_node_batch) —
+    the upstream rand-among-max analogue for fast mode (C5). When
+    given, it replaces the lowest-index maximum as each pod's OWN top
+    choice (the first top-K candidate and the rescue pick); the
+    lowest-index maximum stays in the list as a later fallback, so
+    under capacity pressure behavior is unchanged and on uncontended
+    rows the committed node is exactly the oracle's seeded pick."""
     P = requests.shape[0]
     N = allocatable.shape[0]
     BIG = jnp.int32(2**31 - 1)
@@ -522,12 +548,26 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
     # Candidate list: dealt node first (when feasible), then the pod's
     # own top-K by score; K capacity sub-iterations.
     topv, topi = jax.lax.top_k(masked, K)                    # [P, K]
+    if tie_pick is not None:
+        # The pod's own top choice becomes the seeded pick (same max
+        # score by construction; equal to topi[:, 0] when untied).
+        tp_val = jnp.take_along_axis(masked, tie_pick[:, None], axis=1)
+        topi = topi.at[:, 0].set(tie_pick)
+        topv = topv.at[:, 0].set(tp_val[:, 0])
     dealt_score = jnp.take_along_axis(masked, dealt[:, None], axis=1)
+    use_dealt = dealt_ok
+    if tie_pick is not None:
+        # Seeded semantics: when the dealt node merely ties the pod's
+        # max score (the dealer's redirect is arbitrary among equals),
+        # the hash pick leads — uniform hashes spread ties like the
+        # dealer would. A strictly lower-scored dealt node keeps its
+        # slot: that redirect is the capacity dealer doing real work.
+        use_dealt = dealt_ok & (dealt_score[:, 0] < topv[:, 0])
     topi = jnp.concatenate(
-        [jnp.where(dealt_ok, dealt, topi[:, 0])[:, None], topi], axis=1
+        [jnp.where(use_dealt, dealt, topi[:, 0])[:, None], topi], axis=1
     )
     topv = jnp.concatenate(
-        [jnp.where(dealt_ok, dealt_score[:, 0], topv[:, 0])[:, None], topv],
+        [jnp.where(use_dealt, dealt_score[:, 0], topv[:, 0])[:, None], topv],
         axis=1,
     )
     if dealt_override is not None:
@@ -616,7 +656,10 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
     can_rescue = ~jnp.any(commit) & jnp.any(allowed & want)
     rk = jnp.where(allowed & want, rank, BIG)
     p_star = jnp.argmin(rk)
-    n_star = jnp.argmax(masked[p_star]).astype(jnp.int32)
+    n_star = (
+        tie_pick[p_star] if tie_pick is not None
+        else jnp.argmax(masked[p_star]).astype(jnp.int32)
+    )
     used2 = used2.at[n_star].add(
         jnp.where(can_rescue, requests[p_star], 0.0)
     )
@@ -739,7 +782,7 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
                 cfg, snap, static, p, used, st
             )
             masked = jnp.where(feasible, score, NEG_INF)
-            n_plain = jnp.argmax(masked).astype(jnp.int32)
+            n_plain = pick_node(cfg, masked, p).astype(jnp.int32)
             return (n_plain, jnp.any(feasible), masked[n_plain], allowed,
                     feasible, masked)
 
@@ -836,6 +879,7 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         _, choice_pl, chosen_pl = _deal_commit(
             nodes.allocatable, req_sel, used, feas_c, masked_c,
             allowed_c, rank[sel], min(8, N),
+            tie_pick=pick_node_batch(cfg, masked_c, sel),
         )
         keep_pl = choice_pl >= 0
         keep_all = keep | keep_pl
@@ -927,10 +971,12 @@ def _cycle_nosig(alloc, used, req, mask, sscore, w_lr, w_ba, w_ts, rw):
     return feasible, score.astype(jnp.float32)
 
 
-def _make_round_nosig(alloc, req, mask, sscore, valid, rank, w_lr, w_ba,
-                      w_ts, rw, max_rounds, K):
+def _make_round_nosig(cfg, alloc, req, mask, sscore, valid, rank, pod_ids,
+                      w_lr, w_ba, w_ts, rw, max_rounds, K):
     """(cond, body) for the no-signature commit rounds over whatever
-    pod-axis width the given arrays carry. State: (used, assigned,
+    pod-axis width the given arrays carry. pod_ids: original pod
+    indices of the rows (seeded tie-break hashes by pod identity, so
+    compacted views pick like full-width ones). State: (used, assigned,
     chosen, round_of, progress, r)."""
 
     def cond(st):
@@ -946,7 +992,8 @@ def _make_round_nosig(alloc, req, mask, sscore, valid, rank, w_lr, w_ba,
         masked = jnp.where(feasible, score, NEG_INF)
         allowed = jnp.any(feasible, axis=1)
         used2, choice, chosen_val = _deal_commit(
-            alloc, req, used, feasible, masked, allowed, rank, K
+            alloc, req, used, feasible, masked, allowed, rank, K,
+            tie_pick=pick_node_batch(cfg, masked, pod_ids),
         )
         commit = choice >= 0
         asg2 = jnp.where(commit, choice, asg)
@@ -971,8 +1018,9 @@ def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
     C = _RESIDUAL_CAP
     BIG = jnp.int32(2**31 - 1)
     cond_f, body_f = _make_round_nosig(
-        nodes.allocatable, pods.requests, static.mask, static.score,
-        pods.valid, rank, static.w_lr, static.w_ba, static.w_ts,
+        cfg, nodes.allocatable, pods.requests, static.mask, static.score,
+        pods.valid, rank, jnp.arange(P, dtype=jnp.int32),
+        static.w_lr, static.w_ba, static.w_ts,
         static.rw, max_rounds, K,
     )
     init = (
@@ -997,9 +1045,10 @@ def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
         pend = (assigned == -1) & pods.valid
         sel = jnp.argsort(jnp.where(pend, rank, BIG))[:C]  # rank order
         cond_c, body_c = _make_round_nosig(
-            nodes.allocatable, pods.requests[sel], static.mask[sel],
-            static.score[sel], pend[sel], rank[sel], static.w_lr[sel],
-            static.w_ba[sel], static.w_ts[sel], static.rw, max_rounds, K,
+            cfg, nodes.allocatable, pods.requests[sel], static.mask[sel],
+            static.score[sel], pend[sel], rank[sel], sel,
+            static.w_lr[sel], static.w_ba[sel], static.w_ts[sel],
+            static.rw, max_rounds, K,
         )
         init_c = (
             used, jnp.full(C, -1, jnp.int32),
@@ -1128,6 +1177,9 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             allowed | sp_ok, rank, K, dealt_override=sp_cand,
             dealt_override_val=sp_val, dealt_override_ok=sp_ok,
             score_full=score,
+            tie_pick=pick_node_batch(
+                cfg, masked, jnp.arange(P, dtype=jnp.int32)
+            ),
         )
         commit = choice >= 0
         if snap.sigs.key.shape[0] == 0:

@@ -57,6 +57,28 @@ ANN_MIN_MEMBER = "scheduling.x-k8s.io/min-member"
 
 DEFAULT_SCHEDULER_NAME = "tpu-scheduler"
 
+# Sentinel distinguishing "no drain has pinned a PDB resolver yet"
+# from a pinned resolver of None (no PDBs / RBAC-denied).
+_UNSET = object()
+
+
+def qualified_name(namespace: str, name: str) -> str:
+    """Record identity for pods: 'namespace/name'. Pod names are only
+    unique per namespace in Kubernetes; bare names would collide in the
+    informer cache, the change-hint set, and the delta transport's
+    name-keyed stores (two 'web-0's in different namespaces would
+    silently collapse to one). Nodes are cluster-scoped and keep bare
+    names."""
+    return f"{namespace or 'default'}/{name}"
+
+
+def split_qualified(qname: str) -> tuple[str, str]:
+    """Inverse of qualified_name; tolerates bare names ('default')."""
+    ns, sep, name = qname.partition("/")
+    if not sep:
+        return "default", qname
+    return ns, name
+
 _SUFFIX = {
     "Ki": 1024.0, "Mi": 1024.0**2, "Gi": 1024.0**3, "Ti": 1024.0**4,
     "Pi": 1024.0**5, "Ei": 1024.0**6,
@@ -185,9 +207,10 @@ def pending_record(obj: dict) -> dict:
             "preferredDuringSchedulingIgnoredDuringExecution"
         ) or []
     )
+    ns = meta.get("namespace", "default")
     rec = dict(
-        name=meta["name"],
-        namespace=meta.get("namespace", "default"),
+        name=qualified_name(ns, meta["name"]),
+        namespace=ns,
         requests=pod_requests(spec),
         priority=float(spec.get("priority", 0)),
         slo_target=float(ann.get(ANN_SLO_TARGET, 0.0)),
@@ -236,7 +259,7 @@ def running_record(obj: dict, pdb_of=None) -> dict:
     slo = float(ann.get(ANN_SLO_TARGET, 0.0))
     observed = float(ann.get(ANN_OBSERVED, 1.0))
     rec = dict(
-        name=meta["name"],
+        name=qualified_name(ns, meta["name"]),
         namespace=ns,
         node=spec.get("nodeName", ""),
         requests=pod_requests(spec),
@@ -369,10 +392,6 @@ class KubeApiClient:
         self.timeout = timeout
         self.bind_count = 0
         self.delete_count = 0
-        # name -> namespace, learned from listings: the host addresses
-        # pods by bare name (FakeApiServer has no namespaces), REST
-        # paths need the namespace back.
-        self._ns_of: dict[str, str] = {}
 
     # -- raw REST -----------------------------------------------------------
 
@@ -412,9 +431,7 @@ class KubeApiClient:
                 continue
             if spec.get("schedulerName", "default-scheduler") != self.scheduler_name:
                 continue
-            rec = pending_record(o)
-            self._ns_of[rec["name"]] = rec["namespace"]
-            out.append(rec)
+            out.append(pending_record(o))
         return out
 
     def bound_pods(self) -> list[dict]:
@@ -425,9 +442,7 @@ class KubeApiClient:
                 continue
             if o.get("status", {}).get("phase") in ("Succeeded", "Failed"):
                 continue
-            rec = running_record(o, pdb_of)
-            self._ns_of[rec["name"]] = rec["namespace"]
-            out.append(rec)
+            out.append(running_record(o, pdb_of))
         return out
 
     def _pdb_resolver(self):
@@ -473,16 +488,16 @@ class KubeApiClient:
 
     # -- writes -------------------------------------------------------------
 
-    def bind(self, pod_name: str, node_name: str,
-             namespace: str | None = None) -> None:
-        """POST the Binding subresource; 409 -> host.Conflict (the
-        idempotent-bind story, SURVEY.md §5 'Failure detection')."""
+    def bind(self, pod_name: str, node_name: str) -> None:
+        """POST the Binding subresource; 404/409 -> host.Conflict (the
+        idempotent-bind story, SURVEY.md §5 'Failure detection').
+        pod_name is the qualified 'namespace/name' record identity."""
         from tpusched.host import Conflict
 
-        namespace = namespace or self._ns_of.get(pod_name, "default")
+        namespace, name = split_qualified(pod_name)
         body = {
             "apiVersion": "v1", "kind": "Binding",
-            "metadata": {"name": pod_name, "namespace": namespace},
+            "metadata": {"name": name, "namespace": namespace},
             "target": {"apiVersion": "v1", "kind": "Node",
                        "name": node_name},
         }
@@ -490,7 +505,7 @@ class KubeApiClient:
             self._json(
                 "POST",
                 f"/api/v1/namespaces/{namespace}/pods/"
-                f"{urllib.parse.quote(pod_name)}/binding",
+                f"{urllib.parse.quote(name)}/binding",
                 body,
             )
         except urllib.error.HTTPError as e:
@@ -501,17 +516,20 @@ class KubeApiClient:
             raise
         self.bind_count += 1
 
-    def delete_pod(self, pod_name: str,
-                   namespace: str | None = None) -> bool:
-        """Eviction subresource (honors PDBs server-side); falls back to
-        plain DELETE where the eviction API is unavailable. Idempotent:
-        missing pod -> False."""
-        namespace = namespace or self._ns_of.get(pod_name, "default")
+    def delete_pod(self, pod_name: str) -> bool:
+        """Eviction subresource; falls back to plain DELETE where the
+        eviction API is unavailable. Idempotent and PDB-aware: a
+        missing pod OR a budget-blocked eviction (HTTP 429, the
+        apiserver's disruptions-exhausted denial) returns False — the
+        host treats an un-evicted victim as 'try again later', never as
+        a cycle-fatal error. pod_name is the qualified
+        'namespace/name' record identity."""
+        namespace, name = split_qualified(pod_name)
         ev = {
             "apiVersion": "policy/v1", "kind": "Eviction",
-            "metadata": {"name": pod_name, "namespace": namespace},
+            "metadata": {"name": name, "namespace": namespace},
         }
-        quoted = urllib.parse.quote(pod_name)
+        quoted = urllib.parse.quote(name)
         try:
             self._json(
                 "POST",
@@ -529,7 +547,7 @@ class KubeApiClient:
                     if e2.code == 404:
                         return False
                     raise
-            elif e.code == 410:
+            elif e.code in (410, 429):
                 return False
             else:
                 raise
@@ -574,18 +592,41 @@ class KubeInformer:
         }
         self._changed: set[str] = set()
         self._dirty_all = True
+        # Bumped on every cache-replacing re-list: a host that drained
+        # hints BEFORE a relist landed must not trust them for the
+        # snapshot it builds AFTER (see relist_epoch()).
+        self._epoch = 0
+        # Previous cycle's per-pod PDB resolution, so budget changes
+        # (which arrive with no pod watch event) still hint the pods
+        # whose running records they alter; _pdb_of_current pins the
+        # resolver drain_changed fetched so bound_pods builds records
+        # from the same data the hints cover.
+        self._pdb_seen: dict[str, tuple] = {}
+        self._pdb_of_current = _UNSET
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self.bind_count = 0
         self.delete_count = 0
 
+    @staticmethod
+    def _key_of(path: str, obj: dict) -> str | None:
+        """Cache/hint key: pods are namespace-qualified (names are only
+        unique per namespace), nodes cluster-scoped."""
+        meta = obj.get("metadata", {})
+        name = meta.get("name")
+        if not name:
+            return None
+        if path == KubeInformer._POD_PATH:
+            return qualified_name(meta.get("namespace", "default"), name)
+        return name
+
     # -- lifecycle ----------------------------------------------------------
 
     def start(self):
         for path in (self._POD_PATH, self._NODE_PATH):
-            self._relist(path)
+            rv = self._relist(path)
             t = threading.Thread(
-                target=self._watch_loop, args=(path,), daemon=True
+                target=self._watch_loop, args=(path, rv), daemon=True
             )
             t.start()
             self._threads.append(t)
@@ -596,17 +637,19 @@ class KubeInformer:
 
     def _relist(self, path: str) -> str:
         obj = self.client._json("GET", path)
-        fresh = {
-            o["metadata"]["name"]: o for o in obj.get("items", [])
-        }
+        fresh = {}
+        for o in obj.get("items", []):
+            k = self._key_of(path, o)
+            if k:
+                fresh[k] = o
         with self._lock:
             self._objs[path] = fresh
             self._dirty_all = True
+            self._epoch += 1
             self._changed.clear()
         return obj.get("metadata", {}).get("resourceVersion", "")
 
-    def _watch_loop(self, path: str):
-        rv = ""
+    def _watch_loop(self, path: str, rv: str = ""):
         while not self._stop.is_set():
             try:
                 if not rv:
@@ -629,17 +672,18 @@ class KubeInformer:
                             rv = ""  # 410 Gone: re-list
                             break
                         obj = evt.get("object", {})
-                        meta = obj.get("metadata", {})
-                        name = meta.get("name")
-                        rv = meta.get("resourceVersion", rv)
-                        if not name:
+                        rv = obj.get("metadata", {}).get(
+                            "resourceVersion", rv
+                        )
+                        key = self._key_of(path, obj)
+                        if not key:
                             continue
                         with self._lock:
                             if evt.get("type") == "DELETED":
-                                self._objs[path].pop(name, None)
+                                self._objs[path].pop(key, None)
                             else:
-                                self._objs[path][name] = obj
-                            self._changed.add(name)
+                                self._objs[path][key] = obj
+                            self._changed.add(key)
             except (urllib.error.URLError, urllib.error.HTTPError,
                     OSError, json.JSONDecodeError):
                 rv = ""
@@ -666,23 +710,47 @@ class KubeInformer:
                 continue
             if spec.get("schedulerName", "default-scheduler") != self.scheduler_name:
                 continue
-            rec = pending_record(o)
-            self.client._ns_of[rec["name"]] = rec["namespace"]
-            out.append(rec)
+            out.append(pending_record(o))
         return out
 
+    def _bound_objs(self) -> list[dict]:
+        return [
+            o for o in self._pods()
+            if o.get("spec", {}).get("nodeName")
+            and o.get("status", {}).get("phase") not in
+            ("Succeeded", "Failed")
+        ]
+
     def bound_pods(self) -> list[dict]:
+        # Use the PDB resolution pinned by the last drain_changed() so
+        # the records match the hints computed there; standalone use
+        # (no delta host) fetches fresh.
+        pdb_of = self._pdb_of_current
+        if pdb_of is _UNSET:
+            pdb_of = self.client._pdb_resolver()
+        return [running_record(o, pdb_of) for o in self._bound_objs()]
+
+    def _refresh_pdb_hints(self) -> None:
+        """PDB status changes arrive with NO pod watch event but alter
+        running records: fetch the budgets ONCE per cycle (here, at
+        drain time — before the host reads the cache, so the hints
+        cover exactly the resolution the snapshot will use), and hint
+        every pod whose resolved budget moved since the last cycle
+        (codec contract: 'name everything you touch')."""
         pdb_of = self.client._pdb_resolver()
-        out = []
-        for o in self._pods():
-            if not o.get("spec", {}).get("nodeName"):
-                continue
-            if o.get("status", {}).get("phase") in ("Succeeded", "Failed"):
-                continue
-            rec = running_record(o, pdb_of)
-            self.client._ns_of[rec["name"]] = rec["namespace"]
-            out.append(rec)
-        return out
+        pdb_now: dict[str, tuple] = {}
+        for o in self._bound_objs():
+            meta = o.get("metadata", {})
+            ns = meta.get("namespace", "default")
+            key = qualified_name(ns, meta.get("name", ""))
+            hit = pdb_of(ns, dict(meta.get("labels") or {})) if pdb_of else None
+            pdb_now[key] = hit
+        with self._lock:
+            self._pdb_of_current = pdb_of
+            for name, cur in pdb_now.items():
+                if name in self._pdb_seen and self._pdb_seen[name] != cur:
+                    self._changed.add(name)
+            self._pdb_seen = pdb_now
 
     # -- writes: delegate + assume ------------------------------------------
 
@@ -707,6 +775,7 @@ class KubeInformer:
     # -- delta hints --------------------------------------------------------
 
     def drain_changed(self) -> set[str] | None:
+        self._refresh_pdb_hints()
         with self._lock:
             if self._dirty_all:
                 self._dirty_all = False
@@ -724,3 +793,11 @@ class KubeInformer:
                 self._dirty_all = True
             else:
                 self._changed |= names
+
+    def relist_epoch(self) -> int:
+        """Monotone count of cache-replacing re-lists. A host compares
+        it before draining hints and after building its snapshot: a
+        bump in between means the snapshot holds relist-discovered
+        state the drained hints cannot cover — diff everything."""
+        with self._lock:
+            return self._epoch

@@ -39,9 +39,12 @@ Semantics notes (each mirrors an upstream plugin, SURVEY.md C2-C7):
     pressure = clip(slo - observed_avail, 0, 1); pop order is stable
     descending.
 
-Tie-break: lowest node index among score maxima (EngineConfig.tie_break
-"first" — deterministic so parity is well-defined; upstream's seeded
-roulette is not reproduced, SURVEY.md §7 hard part 2).
+Tie-break (SURVEY.md §7 hard part 2): EngineConfig.tie_break "first"
+picks the lowest node index among score maxima; "seeded" reproduces
+upstream's rand-among-max as a deterministic per-pod hash pick
+(qos.tie_hash), implemented bit-identically here (Oracle.solve's
+tie-set pick) and on device (kernels.assign.pick_node /
+pick_node_batch), so parity holds for any seed in both engine modes.
 """
 
 from __future__ import annotations

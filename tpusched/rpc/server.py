@@ -129,7 +129,16 @@ class SchedulerService:
         # recompile stall; SnapshotBuilder docstring caveat).
         self.buckets = buckets
         self.metrics = _Metrics()
-        self._engine = Engine(self.config)
+        # A configured mesh shape (or the ring path, which needs a
+        # mesh) puts the sidecar's engine on a device mesh — the YAML
+        # route to the sharded/ring paths (EngineConfig.mesh_shape).
+        mesh = None
+        if self.config.ring_counts or tuple(self.config.mesh_shape) != (1, 1):
+            from tpusched.mesh import make_mesh
+
+            shape = tuple(self.config.mesh_shape)
+            mesh = make_mesh(None if shape == (1, 1) else shape)
+        self._engine = Engine(self.config, mesh=mesh)
         self._log = log_stream if log_stream is not None else sys.stderr
         self._audit = audit_stream
         import threading
