@@ -203,7 +203,11 @@ class EngineConfig:
     # Cap on fast-mode commit rounds; 0 = auto (2*P+8, enough for the
     # worst case of one conservative commit per round). A positive cap
     # trades completeness for bounded latency: pods still pending at the
-    # cap stay unassigned for the batch.
+    # cap stay unassigned for the batch. In the no-signature tranche
+    # path (large P) a positive value caps each tranche's INNER rounds
+    # (every selected pod's view gets up to that many rounds) rather
+    # than the cumulative total, which would starve later-ranked
+    # tranches of any examination at all.
     max_rounds: int = 0
     # PostFilter preemption (SURVEY.md C9): pods with no feasible node
     # evict the cheapest eligible victim set (QoS-slack cost) on the

@@ -375,9 +375,13 @@ def preempt_auction(cfg: EngineConfig, snap: ClusterSnapshot,
     all-False and can_plain False. rank: [C] distinct claim-priority
     keys (defaults to 0..C-1, the descending-rank slot order). Returns
     (target [C] int32 (-1 = no claim), claimed [C] bool,
-    takes_evict [C] bool, evict_m [C, M] bool, could_bid [C] bool —
-    False means the pod has NO placement or victim prefix at all
-    (spent), as opposed to losing this round's node race (retry))."""
+    takes_evict [C] bool, vidx_t [C, V] int32 — running-pod indices of
+    each bidder's victim prefix, M at non-victim slots,
+    freed_req [C, R] f32 — capacity the prefix frees,
+    usage [C, GP] f32 — prefix evictions per PDB budget,
+    could_bid [C] bool — False means the pod has NO placement or
+    victim prefix at all (spent), as opposed to losing this round's
+    node race (retry))."""
     nodes = snap.nodes
     N = nodes.valid.shape[0]
     M = evicted.shape[0]
@@ -431,9 +435,12 @@ def preempt_auction(cfg: EngineConfig, snap: ClusterSnapshot,
         has = ~claimed & (navail > 0)
         r_active = jnp.cumsum(has.astype(jnp.int32)) - 1     # [C]
         tgt_cnt = jnp.mod(r_active, jnp.maximum(navail, 1)) + 1
-        j = jax.vmap(
-            lambda c, t: jnp.searchsorted(c, t, side="left")
-        )(csum, tgt_cnt)
+        # Position of the tgt_cnt-th available candidate: csum is a
+        # monotone int prefix count, so the index is just how many
+        # prefix counts fall short — a [C, K] compare+reduce (a vmapped
+        # searchsorted lowered to 512 tiny serial searches and cost
+        # ~1 ms/iteration here).
+        j = jnp.sum((csum < tgt_cnt[:, None]).astype(jnp.int32), axis=1)
         j = jnp.clip(j, 0, K - 1)
         want = cand_i[jnp.arange(C), j]
         want_c = jnp.clip(want, 0, N - 1)
@@ -455,6 +462,11 @@ def preempt_auction(cfg: EngineConfig, snap: ClusterSnapshot,
     # Victim prefix of each bidder's CLAIMED node (same lexicographic
     # rule as preempt_step: min-viol prefixes, then cheapest; the
     # claimed node's viol equals the bidder's min_viol by construction).
+    # Everything downstream is [C, V]-sized off the node-major table —
+    # freed capacity from the prefix sums, per-budget usage by a tiny
+    # scatter — no [C, M] materialization (an earlier form returned a
+    # dense [C, M] eviction matrix and the caller ran two [C, M]
+    # matmuls off it, ~5 ms/round at M=40960).
     tgt = jnp.clip(target, 0, N - 1)
 
     def rowsel(a):
@@ -475,9 +487,17 @@ def preempt_auction(cfg: EngineConfig, snap: ClusterSnapshot,
         takes_evict[:, None] & elig_t
         & (jnp.arange(V, dtype=jnp.int32)[None, :] <= best_pos[:, None])
     )
-    vidx_t = ctx.vidx[tgt]                                   # [C, V]
-    evict_m = jnp.zeros((C, M), bool).at[
-        jnp.arange(C)[:, None], jnp.clip(vidx_t, 0, M - 1)
-    ].max(sel_v & (vidx_t < M))
+    vidx_t = jnp.where(sel_v, ctx.vidx[tgt], M)              # [C, V]
+    freed_req = jnp.sum(
+        jnp.where(sel_v[..., None], ctx.vreq[tgt], 0.0), axis=1
+    )                                                        # [C, R]
+    GP = snap.pdb_allowed.shape[0]
+    if GP:
+        vpdb_t = ctx.vpdb[tgt]                               # [C, V]
+        usage = jnp.zeros((C, GP), jnp.float32).at[
+            jnp.arange(C)[:, None], jnp.clip(vpdb_t, 0, None)
+        ].add((sel_v & (vpdb_t >= 0)).astype(jnp.float32))
+    else:
+        usage = jnp.zeros((C, 0), jnp.float32)
     could_bid = can_plain | jnp.any(jnp.isfinite(total), axis=1)
-    return target, claimed, takes_evict, evict_m, could_bid
+    return target, claimed, takes_evict, vidx_t, freed_req, usage, could_bid

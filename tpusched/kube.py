@@ -767,9 +767,16 @@ class KubeInformer:
         ok = self.client.delete_pod(pod_name)
         if ok:
             self.delete_count += 1
-        with self._lock:
-            if self._objs[self._POD_PATH].pop(pod_name, None) is not None:
-                self._changed.add(pod_name)
+            # Assume-delete only on success: a False return can mean
+            # PDB-blocked (HTTP 429) with the pod STILL RUNNING — and
+            # since the object never changes, no watch event would ever
+            # restore a wrongly-evicted cache entry, silently
+            # under-counting that node's used capacity. (The
+            # pod-already-gone case needs no pop either: its DELETED
+            # event handles it.)
+            with self._lock:
+                if self._objs[self._POD_PATH].pop(pod_name, None) is not None:
+                    self._changed.add(pod_name)
         return ok
 
     # -- delta hints --------------------------------------------------------
