@@ -158,5 +158,9 @@ def test_fast_divergence_quantified():
         placed_ratio.append(n_fast / max(n_seq, 1))
     mean_ratio = float(np.mean(placed_ratio))
     min_ratio = float(np.min(placed_ratio))
-    assert mean_ratio >= 0.97, f"fast mode lost placements: {mean_ratio:.3f}"
-    assert min_ratio >= 0.85, f"worst-case placement loss: {min_ratio:.3f}"
+    assert mean_ratio >= 0.98, f"fast mode lost placements: {mean_ratio:.3f}"
+    # Round-5 floor raise (VERDICT #9): deeper small-cluster fallback
+    # lists (K=16 at N<=256) recovered most of the stranded-large-pod
+    # gap; mixed placed_delta improved -4.2% -> -1.9% and the worst
+    # seed from 0.86 to 0.95. Floor at 0.90 per the round-5 ask.
+    assert min_ratio >= 0.90, f"worst-case placement loss: {min_ratio:.3f}"

@@ -1,0 +1,142 @@
+"""Round-5 scratch: per-component device cost of the fast preemption
+round at the headline shape, measured as fori_loop slope (amortizes the
+axon-tunnel fetch RTT out)."""
+import os
+import sys
+import time
+
+import numpy as np
+
+if os.environ.get("PROF_CPU"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
+import jax
+import jax.numpy as jnp
+
+from tpusched.config import EngineConfig
+from tpusched.kernels import assign as kassign
+from tpusched.kernels import preempt as kpreempt
+from tpusched.kernels.assign import (
+    _deal_commit, pod_cycle, precompute_static, NEG_INF,
+)
+from tpusched.engine import _sat_tables
+from tpusched.kernels import pairwise as kpair
+from tpusched.qos import effective_priority
+from tpusched.synth import config5_preemption
+
+LO, HI = 2, 18
+
+
+def slope(label, make_body, used0, reps=3):
+    """make_body() -> body(i, used) -> used; time fori(LO) vs fori(HI)."""
+    outs = {}
+    for n in (LO, HI):
+        fn = jax.jit(
+            lambda u, n=n: jax.lax.fori_loop(0, n, make_body(), u)
+        )
+        jax.block_until_ready(fn(used0))
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(used0))
+            ts.append(time.perf_counter() - t0)
+        outs[n] = min(ts)
+    per = (outs[HI] - outs[LO]) / (HI - LO) * 1e3
+    print(f"  {label}: {per:.2f}ms/iter  (LO={outs[LO]*1e3:.1f}ms "
+          f"HI={outs[HI]*1e3:.1f}ms)")
+
+
+def main():
+    pods = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 5_000
+    rng = np.random.default_rng(7)
+    snap, _ = config5_preemption(rng, n_pods=pods, n_nodes=nodes)
+    cfg = EngineConfig(mode="fast", preemption=True)
+    snap = jax.device_put(snap)
+    node_sat_t, member_sat_t = _sat_tables(snap)
+    static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
+    pctx = jax.jit(lambda s: kpreempt.precompute_nv(cfg, s, kassign._PREEMPT_VICTIM_CAP))(snap)
+    P = snap.pods.valid.shape[0]
+    N = snap.nodes.valid.shape[0]
+    M = snap.running.valid.shape[0]
+    C = kassign._PREEMPT_BATCH
+    print(f"P={P} N={N} M={M} C={C} GP={snap.pdb_allowed.shape[0]}")
+    prio = effective_priority(
+        cfg, snap.pods.base_priority, snap.pods.slo_target,
+        snap.pods.observed_avail,
+    )
+    used0 = snap.nodes.used
+    st0 = kpair.pair_state_init(snap, static.sig_match)
+    evicted = jnp.zeros(M, bool)
+    sel = jnp.arange(C, dtype=jnp.int32)
+    reqs = snap.pods.requests[sel]
+
+    def tableau_body():
+        def body(i, used):
+            out = kpreempt._tableau_nv(
+                cfg, snap, pctx, prio[sel], reqs, used, evicted
+            )
+            return used + 1e-12 * out[-1][0, 0]
+        return body
+
+    slope("_tableau_nv [C,N,V]", tableau_body, used0)
+
+    def topk_body():
+        def body(i, used):
+            total = jnp.sum(used, axis=1)[None, :] + prio[sel][:, None]
+            neg_v, cand_i = jax.lax.top_k(-total, 256)
+            return used + 1e-12 * (neg_v[0, 0] + cand_i[0, 0])
+        return body
+
+    slope("top_k k=256 [C,N]", topk_body, used0)
+
+    def podcycle_body():
+        def body(i, used):
+            def one(p):
+                feasible, score, allowed = pod_cycle(
+                    cfg, snap, static, p, used, st0
+                )
+                masked = jnp.where(feasible, score, NEG_INF)
+                return jnp.max(masked)
+            mx = jax.vmap(one)(sel)
+            return used + 1e-12 * mx[0]
+        return body
+
+    slope("vmap pod_cycle [C,N]", podcycle_body, used0)
+
+    def auction_body():
+        allowed = jnp.ones((C, N), bool) & snap.nodes.valid[None, :]
+
+        def body(i, used):
+            can_plain = jnp.zeros(C, bool)
+            n_plain = jnp.zeros(C, jnp.int32)
+            target, claimed, takes_evict, evict_m, could_bid = (
+                kpreempt.preempt_auction(
+                    cfg, snap, pctx, prio[sel], reqs, allowed, used,
+                    evicted, can_plain, n_plain, rank=sel,
+                )
+            )
+            return used + 1e-12 * target[0]
+        return body
+
+    slope("preempt_auction full", auction_body, used0)
+
+    def dc_body():
+        feas = jnp.ones((C, N), bool) & snap.nodes.valid[None, :]
+
+        def body(i, used):
+            masked = jnp.where(feas, 1.0 + 1e-9 * used[0, 0], NEG_INF)
+            u2, choice, val = _deal_commit(
+                snap.nodes.allocatable, reqs, used, feas, masked,
+                jnp.ones(C, bool), sel, 8,
+            )
+            return used + 1e-12 * choice[0]
+        return body
+
+    slope("_deal_commit [C,N]", dc_body, used0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Round-5 scratch profiler for the fast-mode preemption path."""
+import os
+import sys
+import time
+
+import numpy as np
+
+if os.environ.get("PROF_CPU"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+from tpusched import Engine, EngineConfig
+from tpusched.synth import config5_preemption
+
+
+def main():
+    pods = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 5_000
+    rng = np.random.default_rng(7)
+    snap, _ = config5_preemption(rng, n_pods=pods, n_nodes=nodes)
+    eng = Engine(EngineConfig(mode="fast", preemption=True))
+    snap = eng.put(snap)
+    t0 = time.perf_counter()
+    res = eng.solve(snap)
+    print(f"compile+first: {time.perf_counter()-t0:.1f}s rounds={res.rounds} "
+          f"placed={(res.assignment>=0).sum()} evicted={res.evicted.sum()}")
+    ts = []
+    for _ in range(int(os.environ.get("PROF_ITERS", "8"))):
+        t0 = time.perf_counter()
+        res = eng.solve(snap)
+        ts.append(time.perf_counter() - t0)
+    ts = np.array(ts) * 1e3
+    print(f"p50={np.percentile(ts,50):.1f}ms min={ts.min():.1f}ms "
+          f"max={ts.max():.1f}ms rounds={res.rounds}")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,8 +11,9 @@ contention for bounded rounds; its guarantees are:
     (audited by oracle.validate_assignment);
   * near-equal throughput — the same NUMBER of pods places to within a
     few percent, but not the same SET: measured on 6 seeds/preset
-    (round 2), the `mixed` preset nets -3.3% placements for fast mode
-    (35 pods parity places that fast strands vs 19 the other way);
+    (round 5, after the small-cluster fallback-depth fix), the `mixed`
+    preset nets about -2% placements for fast mode; run this module
+    for the current numbers rather than trusting prose;
   * exact node agreement whenever pods' decisions don't interact — note
     that load-balancing scores couple every pod to all earlier commits,
     so on busy clusters node choices differ by design while remaining

@@ -675,6 +675,16 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
     return used2, choice, chosen_val
 
 
+def _fallback_depth(N: int) -> int:
+    """Per-pod fallback-candidate depth for dealing commits: deeper
+    lists on SMALL clusters close most of the fast-mode placement gap
+    (mixed-preset placed_delta -20 -> -9 of 480 at K=16; stranded
+    large pods' top-8 fill up same-round and 8 of 16 nodes left no
+    alternates), while on big clusters a deeper [P, N] top_k costs
+    more than it recovers (pairwise fast +150 ms at 10k x 5k)."""
+    return min(16, N) if N <= 256 else 8
+
+
 # Residual compaction width: after the first full round, the few
 # still-pending pods are gathered into this many slots and later rounds
 # run on the [C, N] view instead of [P, N] (~45 ms -> ~2 ms per round at
@@ -801,7 +811,7 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             masked_d = jnp.where(feas_d, score_d, NEG_INF)
             used, choice_d, chosen_d = _deal_commit(
                 alloc, pods.requests[dsel], used, feas_d, masked_d,
-                jnp.any(feas_d, axis=1), rank[dsel], min(8, N),
+                jnp.any(feas_d, axis=1), rank[dsel], _fallback_depth(N),
                 tie_pick=pick_node_batch(cfg, masked_d, dsel),
             )
             hit_d = choice_d >= 0
@@ -918,7 +928,7 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         allowed_c = plain_cap & jnp.any(feas_c, axis=1)
         _, choice_pl, chosen_pl = _deal_commit(
             nodes.allocatable, req_sel, used, feas_c, masked_c,
-            allowed_c, rank[sel], min(8, N),
+            allowed_c, rank[sel], _fallback_depth(N),
             tie_pick=pick_node_batch(cfg, masked_c, sel),
         )
         keep_pl = choice_pl >= 0
@@ -1229,7 +1239,7 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         progress, r = state[-2], state[-1]
         return progress & (r < max_rounds)
 
-    K = min(8, N)
+    K = _fallback_depth(N)
 
     def body(state):
         used, assigned, pair_st, conservative, chosen, round_of, _, r = state

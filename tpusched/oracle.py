@@ -9,9 +9,10 @@ produce identical placements in parity mode (bit-identical, fuzz-tested).
 Fast mode does NOT promise node-identical placements: every commit
 couples later pods through load-balancing scores, so node agreement
 collapses once commit order diverges (measured ~11% node-identical even
-with no constraints; net placed-pod delta -3.3% on the mixed preset —
-tpusched/divergence.py has the numbers). Fast mode's contract is
-validity (audited) and near-equal placement COUNT, not the same nodes.
+with no constraints; net placed-pod delta about -2% on the mixed preset
+as of round 5 — run tpusched/divergence.py for current numbers). Fast
+mode's contract is validity (audited) and near-equal placement COUNT,
+not the same nodes.
 
 Semantics notes (each mirrors an upstream plugin, SURVEY.md C2-C7):
   * NodeResourcesFit filter: forall r: used_r + req_r <= allocatable_r.
