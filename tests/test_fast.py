@@ -119,3 +119,53 @@ def test_fast_required_self_affinity_first_pod():
         placed = res.assignment[:3]
         assert (placed >= 0).all(), f"{mode}: self-affine pods unplaced"
         assert len(set(zones[placed].tolist())) == 1, f"{mode}: not co-located"
+
+
+def test_ia_ok_at_choice_matches_full_matrix():
+    """The chosen-node-only IA validator (round 5; used by the fast
+    loop's commit-validation fixpoint) must agree BITWISE with the full
+    [P, N] pairwise_from_counts gathered at the chosen column, for any
+    committed subset, across constraint-heavy fuzz snapshots."""
+    import jax.numpy as jnp
+
+    from tpusched.engine import _sat_tables
+    from tpusched.kernels import pairwise as kpair
+    from tpusched.kernels.assign import precompute_static
+    from tpusched.synth import make_cluster
+
+    for seed in range(4):
+        rng = np.random.default_rng(71000 + seed)
+        snap, _ = make_cluster(
+            rng, 40, 10, spread_frac=0.4, interpod_frac=0.5,
+            run_anti_frac=0.3, namespace_count=2,
+            initial_utilization=0.4, n_running_per_node=2,
+        )
+        if int(np.asarray(snap.sigs.key).shape[0]) == 0:
+            continue
+        cfg = EngineConfig()
+        node_sat_t, member_sat_t = _sat_tables(snap)
+        static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
+        st = kpair.pair_state_init(snap, static.sig_match)
+        P = int(np.asarray(snap.pods.valid).shape[0])
+        N = int(np.asarray(snap.nodes.valid).shape[0])
+        choice = jnp.asarray(
+            rng.integers(-1, N, size=P).astype(np.int32)
+        )
+        kept = jnp.asarray(rng.random(P) < 0.7) & (choice >= 0)
+        st2 = kpair.pair_state_commit(
+            snap, st, static.sig_match, choice, kept
+        )
+        esn = jnp.where(kept, choice, -1)
+        _, _, ia_full, _ = kpair.pairwise_from_counts(
+            snap, st2, static.aff_ok, static.sig_match,
+            exclude_self_node=esn,
+        )
+        want = np.asarray(
+            jnp.take_along_axis(
+                ia_full, jnp.clip(choice, 0, N - 1)[:, None], axis=1
+            )[:, 0]
+        )
+        got = np.asarray(
+            kpair.ia_ok_at_choice(snap, st2, static.sig_match, choice, esn)
+        )
+        np.testing.assert_array_equal(got, want, err_msg=f"seed {seed}")

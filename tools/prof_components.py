@@ -3,6 +3,8 @@ round at the headline shape, measured as fori_loop slope (amortizes the
 axon-tunnel fetch RTT out)."""
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import time
 
 import numpy as np

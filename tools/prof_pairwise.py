@@ -1,6 +1,8 @@
 """Round-5 scratch: per-component device cost of the S>0 fast round."""
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import time
 
 import numpy as np

@@ -431,10 +431,11 @@ def _spread_waterfill_deal(snap: ClusterSnapshot, pair_st, used, relaxed,
     js = jnp.arange(N, dtype=jnp.float32)[None, :]
     fill = js * csort - presum                               # [S, N] nondecr.
     fill_p = fill[s_p]                                       # [P, N]
+    # searchsorted(fill_p[p], q[p], right) == count of entries <= q[p]:
+    # one [P, N] compare+reduce (a vmapped searchsorted lowers to P
+    # serial row searches — ~20 ms/round at 10k x 5k).
     j_p = jnp.clip(
-        jax.vmap(lambda f, v: jnp.searchsorted(f, v, side="right"))(
-            fill_p, q
-        ).astype(jnp.int32) - 1,
+        jnp.sum((fill_p <= q[:, None]).astype(jnp.int32), axis=1) - 1,
         0, N - 1,
     )
     r_p = (q - jnp.take_along_axis(fill_p, j_p[:, None], axis=1)[:, 0])
@@ -468,9 +469,17 @@ def _spread_waterfill_deal(snap: ClusterSnapshot, pair_st, used, relaxed,
         + jnp.arange(K + 1, dtype=jnp.float32)[None, :],
         jnp.maximum(n_feas, 1.0)[:, None],
     ) + 1.0                                                  # [P, K+1]
-    j_node = jax.vmap(
-        lambda c, t: jnp.searchsorted(c, t, side="left")
-    )(csum, targets).astype(jnp.int32)
+    # searchsorted(csum[p], t, left) == count of entries < t; K+1 small
+    # compare+reduce passes instead of P serial row searches.
+    j_node = jnp.stack(
+        [
+            jnp.sum(
+                (csum < targets[:, k][:, None]).astype(jnp.int32), axis=1
+            )
+            for k in range(K + 1)
+        ],
+        axis=1,
+    )
     cand = cap_order[jnp.clip(j_node, 0, cap_order.shape[0] - 1)]
     ok = member & (n_feas > 0)
     sel_at = jnp.take_along_axis(sel, cand, axis=1)
@@ -483,7 +492,8 @@ def _spread_waterfill_deal(snap: ClusterSnapshot, pair_st, used, relaxed,
 def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
                  rank, K: int, dealt_override=None,
                  dealt_override_val=None, dealt_override_ok=None,
-                 score_full=None, tie_pick=None):
+                 score_full=None, tie_pick=None,
+                 rank_is_sorted: bool = False):
     """One round's dealing + capacity-prefix conflict resolution +
     rescue, shape-generic over the pod axis (used on the full [P, N]
     matrices and on the compacted residual view — same math per pod;
@@ -523,15 +533,18 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
     remaining = jnp.where(
         jnp.isfinite(desir)[:, None], remaining, 0.0
     )
-    q_perm = jnp.argsort(jnp.where(allowed, rank, BIG))
-    q_of = jnp.zeros(P, jnp.int32).at[q_perm].set(
-        jnp.arange(P, dtype=jnp.int32)
-    )
-    dem_sorted = jnp.where(
-        allowed[q_perm][:, None], requests[q_perm], 0.0
-    )
-    cum_dem = jnp.cumsum(dem_sorted, axis=0)                 # [P, R]
-    my_dem = cum_dem[q_of]                                   # [P, R] own-incl.
+    # Inclusive cumulative demand of allowed pods in rank order,
+    # WITHOUT sorting (a [P] argsort costs ~4.5 ms on this TPU's sort
+    # path, per round, and the two rank layouts both admit a sortless
+    # form): rank_is_sorted views (tranches, drains — sel was chosen
+    # by rank) cumsum directly; full-width callers have rank as a
+    # permutation of 0..P-1 and scatter into rank-major layout.
+    dem = jnp.where(allowed[:, None], requests, 0.0)
+    if rank_is_sorted:
+        my_dem = jnp.cumsum(dem, axis=0)                     # [P, R]
+    else:
+        rm = jnp.zeros_like(dem).at[rank].set(dem)
+        my_dem = jnp.cumsum(rm, axis=0)[rank]                # [P, R]
     cum_rem = jnp.cumsum(remaining[node_order], axis=0)      # [N, R]
     pos = jnp.zeros(P, jnp.int32)
     for ri in range(cum_rem.shape[1]):
@@ -675,6 +688,32 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
     return used2, choice, chosen_val
 
 
+def _top_by_rank(pend, order, C: int):
+    """Indices of the C lowest-rank True pods of `pend`, in ascending
+    rank order, plus the number of True pods — SORTLESS (order is the
+    precomputed pop order, i.e. pods by ascending rank). Replaces the
+    per-round argsort(where(pend, rank, BIG))[:C] selections, each of
+    which paid ~4.5 ms on this TPU's sort path. Requires C <= P: every
+    slot gets a DISTINCT pod (callers scatter through the result, and
+    duplicate tail indices would race); with C > P distinct fillers
+    cannot exist."""
+    assert C <= order.shape[0], (C, order.shape)
+    pend_rm = pend[order]                                    # rank-major
+    cpend = jnp.cumsum(pend_rm.astype(jnp.int32))
+    cnon = jnp.cumsum((~pend_rm).astype(jnp.int32))
+    n_pend = cpend[-1]
+    # Every pod gets a DISTINCT slot (pending pods 0..n_pend-1 by rank,
+    # then non-pending by rank): tail slots must not repeat a pod —
+    # callers scatter through sel, and duplicate indices make the
+    # unkept-slot writes race the kept one.
+    slot = jnp.where(pend_rm, cpend - 1, n_pend + cnon - 1)
+    take = slot < C
+    buf = jnp.zeros(C + 1, order.dtype).at[
+        jnp.where(take, slot, C)
+    ].set(order)
+    return buf[:C], n_pend
+
+
 def _fallback_depth(N: int) -> int:
     """Per-pod fallback-candidate depth for dealing commits: deeper
     lists on SMALL clusters close most of the fast-mode placement gap
@@ -724,7 +763,7 @@ _PREEMPT_VICTIM_CAP = 16
 
 
 def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
-                    static: StaticCtx, rank, base_rounds,
+                    static: StaticCtx, rank, order, base_rounds,
                     used, assigned, st, evicted, round_of, chosen,
                     has_pair=None):
     """Fast-mode PostFilter as BATCHED AUCTION ROUNDS (round-4; replaces
@@ -800,7 +839,9 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             # handles plain bidders under node exclusivity.
             alloc = nodes.allocatable
             pend0 = (assigned < 0) & pods.valid
-            dsel = jnp.argsort(jnp.where(pend0, rank, BIG))[:_PREEMPT_DRAIN]
+            dsel, _ = _top_by_rank(
+                pend0, order, min(_PREEMPT_DRAIN, P)
+            )
             dreal = pend0[dsel]
             feas_d, score_d = _cycle_nosig(
                 alloc, used, pods.requests[dsel], static.mask[dsel],
@@ -813,6 +854,7 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
                 alloc, pods.requests[dsel], used, feas_d, masked_d,
                 jnp.any(feas_d, axis=1), rank[dsel], _fallback_depth(N),
                 tie_pick=pick_node_batch(cfg, masked_d, dsel),
+                rank_is_sorted=True,
             )
             hit_d = choice_d >= 0
             assigned = assigned.at[dsel].set(
@@ -830,7 +872,7 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         # deferred by the conflict scan is NOT tried — it re-bids
         # against the updated state next round.
         pend = (assigned < 0) & pods.valid & ~tried
-        sel = jnp.argsort(jnp.where(pend, rank, BIG))[:C]
+        sel, _ = _top_by_rank(pend, order, C)
         real = pend[sel]
 
         def eval_plain(p):
@@ -930,6 +972,7 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             nodes.allocatable, req_sel, used, feas_c, masked_c,
             allowed_c, rank[sel], _fallback_depth(N),
             tie_pick=pick_node_batch(cfg, masked_c, sel),
+            rank_is_sorted=True,
         )
         keep_pl = choice_pl >= 0
         keep_all = keep | keep_pl
@@ -1023,7 +1066,7 @@ def _cycle_nosig(alloc, used, req, mask, sscore, w_lr, w_ba, w_ts, rw):
 
 def _make_round_nosig(cfg, alloc, req, mask, sscore, valid, rank, pod_ids,
                       w_lr, w_ba, w_ts, rw, max_rounds, K,
-                      round_cap=None):
+                      round_cap=None, rank_is_sorted=False):
     """(cond, body) for the no-signature commit rounds over whatever
     pod-axis width the given arrays carry. pod_ids: original pod
     indices of the rows (seeded tie-break hashes by pod identity, so
@@ -1051,6 +1094,7 @@ def _make_round_nosig(cfg, alloc, req, mask, sscore, valid, rank, pod_ids,
         used2, choice, chosen_val = _deal_commit(
             alloc, req, used, feasible, masked, allowed, rank, K,
             tie_pick=pick_node_batch(cfg, masked, pod_ids),
+            rank_is_sorted=rank_is_sorted,
         )
         commit = choice >= 0
         asg2 = jnp.where(commit, choice, asg)
@@ -1064,7 +1108,8 @@ def _make_round_nosig(cfg, alloc, req, mask, sscore, valid, rank, pod_ids,
 
 
 def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
-                        static: StaticCtx, rank, max_rounds: int, K: int):
+                        static: StaticCtx, rank, order, max_rounds: int,
+                        K: int):
     """Fast-mode rounds when the snapshot has NO pairwise signatures
     (trace-time fact; the common resource/affinity-only serving case):
     tranches of the top-_RESIDUAL_CAP pending pods by rank run [C, N]
@@ -1091,6 +1136,14 @@ def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
         used, assigned, chosen, round_of, _, rounds = st
         return used, assigned, chosen, round_of, rounds
 
+    # Full-width round 1: one deal over all P places the uncontended
+    # bulk more cheaply than ~P/C tranches' fixed costs (headline fast
+    # regressed ~45 ms device without it). SKIPPED when preemption is
+    # enabled — that config exists because the cluster is near
+    # capacity, round 1 then places little and costs ~50 ms, and the
+    # tranche loop handles a large pending set strictly cheaper.
+    state1 = init if cfg.preemption else body_f(init)
+
     # TRANCHE processing (round 5; replaces the full-width rounds whose
     # 13 x ~45 ms sweeps dominated the preemption-config solve):
     # capacity only SHRINKS in the no-signature loop, so a pod
@@ -1101,11 +1154,10 @@ def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
     # loop: take the top-C still-unspent pending pods by rank, run the
     # [C, N] view to fixpoint, mark, repeat — pending strictly shrinks
     # by C per tranche, so ~P/C cheap tranches replace O(rounds) full
-    # [P, N] sweeps (a ~P/C-tranche pass also beats ONE full-width
-    # round: 10 x ~3 ms vs ~45 ms, so tranches start immediately).
-    # Placement parity with the old full path holds because spent pods
-    # could never have committed later anyway; rank-ordered tranches
-    # track the sequential semantics at least as closely.
+    # [P, N] sweeps. Placement parity with the old full path holds
+    # because spent pods could never have committed later anyway;
+    # rank-ordered tranches track the sequential semantics at least as
+    # closely.
     # A positive cfg.max_rounds caps the PER-TRANCHE inner rounds here
     # (each selected pod's view gets up to that many rounds — the
     # closest analogue of the old full-width "every pod considered up
@@ -1130,13 +1182,14 @@ def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
         def outer_body(os):
             used, assigned, chosen, round_of, spent, r, t, _ = os
             pend = (assigned == -1) & pods.valid & ~spent
-            sel = jnp.argsort(jnp.where(pend, rank, BIG))[:C]
+            sel, _ = _top_by_rank(pend, order, C)
             real = pend[sel]
             cond_c, body_c = _make_round_nosig(
                 cfg, alloc, req[sel], static.mask[sel],
                 static.score[sel], real, rank[sel], sel,
                 static.w_lr[sel], static.w_ba[sel], static.w_ts[sel],
                 static.rw, 2**30, K, round_cap=(r, tranche_cap),
+                rank_is_sorted=True,
             )
             init_c = (
                 used, jnp.full(C, -1, jnp.int32),
@@ -1181,7 +1234,7 @@ def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
         )
         return used, assigned, chosen, round_of, rounds
 
-    return tranche_path(init)
+    return tranche_path(state1)
 
 
 def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
@@ -1403,13 +1456,14 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
 
         def vbody(vs):
             st_v, used_v, kept_v, _ = vs
-            _, _, ia_ok2, _ = kpair.pairwise_from_counts(
-                snap, st_v, static.aff_ok, static.sig_match,
-                exclude_self_node=jnp.where(kept_v, choice, -1),
+            # Chosen-node-only IA verdict: the full [P, N]
+            # pairwise_from_counts made each validation pass as
+            # expensive as a scoring round; the fixpoint only reads
+            # the chosen-node column.
+            ia_ok_at = kpair.ia_ok_at_choice(
+                snap, st_v, static.sig_match, choice,
+                jnp.where(kept_v, choice, -1),
             )
-            ia_ok_at = jnp.take_along_axis(
-                ia_ok2, jnp.clip(choice, 0, N - 1)[:, None], axis=1
-            )[:, 0]
             ia_bad_all = kept_v & has_pair & ~ia_ok_at
             # Rank-ordered partial reverts (round-4: replaces marking
             # every IA violator conservative, which serialized them
@@ -1480,7 +1534,7 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         # residual compaction after round 1 (the conservative/
         # validation machinery is inert at S == 0).
         used, assigned, chosen, round_of, rounds = _solve_rounds_nosig(
-            cfg, snap, static, rank, max_rounds, K
+            cfg, snap, static, rank, order, max_rounds, K
         )
         st_f = st0
     else:
@@ -1497,7 +1551,7 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
     if cfg.preemption and M > 0:
         (used, assigned, st_f, evicted, round_of, chosen,
          preempt_r) = _preempt_rounds(
-            cfg, snap, static, rank, rounds,
+            cfg, snap, static, rank, order, rounds,
             used, assigned, st_f, evicted, round_of, chosen,
             has_pair=has_pair,
         )

@@ -396,6 +396,73 @@ def pairwise_from_counts(snap: ClusterSnapshot, st: PairState, aff_ok,
     return spread_ok, spread_pen, ia_ok, ia_raw
 
 
+def ia_ok_at_choice(snap: ClusterSnapshot, st: PairState, sig_match,
+                    choice, esn):
+    """[P] bool: the required inter-pod-affinity + symmetric-anti
+    verdict of `pairwise_from_counts(..., exclude_self_node=esn)`
+    gathered at each pod's chosen node — O(S*P) gathers instead of the
+    full [P, N] matrices (the commit-validation fixpoint only ever
+    reads the chosen-node column, which at 10k x 5k made each
+    validation pass as expensive as a whole scoring round).
+
+    choice: [P] node of each committed pod (rows with choice < 0 are
+    evaluated at node 0 and must be masked by the caller).
+    esn: [P] exclude-self-node (-1 = no exclusion), exactly the
+    exclude_self_node contract. Kept bit-equivalent to the full path;
+    tests/test_fast.py pins the equality on fuzz snapshots."""
+    pods = snap.pods
+    dom_s = sig_domains(snap)                                # [S, N]
+    S = dom_s.shape[0]
+    M = snap.running.valid.shape[0]
+    P = pods.valid.shape[0]
+    pod_idx = jnp.arange(P)
+    ch = jnp.clip(choice, 0, None)
+    ok = jnp.ones(P, bool)
+    for t in range(pods.ia_key.shape[1]):
+        s = jnp.clip(pods.ia_sig[:, t], 0, None)             # [P]
+        valid_t = pods.ia_valid[:, t]
+        d = dom_s[s, ch]                                     # [P]
+        self_match = sig_match[s, M + pod_idx]
+        committed = self_match & (esn >= 0)
+        own_dom = dom_s[s, jnp.clip(esn, 0, None)]
+        # _self_adj at n = choice: the pod's own contribution counts
+        # only where the evaluated node's domain equals its own-node
+        # domain.
+        active = committed & (own_dom >= 0) & (d == own_dom)
+        nc = st.counts[s, jnp.clip(d, 0, None)] - active.astype(
+            jnp.float32
+        )
+        hk = d >= 0
+        node_has = hk & (nc > 0)
+        anti = pods.ia_anti[:, t]
+        req = pods.ia_required[:, t]
+        all_zero = (
+            st.match_tot[s] - committed.astype(jnp.float32)
+        ) <= 0
+        pos_ok = node_has | (all_zero & self_match & hk)
+        ok_t = jnp.where(anti, ~node_has, pos_ok)
+        ok &= jnp.where(valid_t & req, ok_t, True)
+    # Symmetric anti at the chosen node (symmetric_anti_block column).
+    d_all = dom_s[:, ch]                                     # [S, P]
+    anti_at = st.anti[
+        jnp.arange(S)[:, None], jnp.clip(d_all, 0, None)
+    ]
+    anti_at = jnp.where(d_all >= 0, anti_at, 0.0)
+    match = sig_match[:, M:].astype(jnp.float32)             # [S, P]
+    blocked = jnp.sum(match * anti_at, axis=0)               # [P]
+    for t in range(pods.ia_key.shape[1]):
+        s = jnp.clip(pods.ia_sig[:, t], 0, None)
+        d = dom_s[s, ch]
+        own_dom = dom_s[s, jnp.clip(esn, 0, None)]
+        self_match = sig_match[s, M + pod_idx]
+        active = (
+            _pod_anti_holds(snap, t) & self_match
+            & (esn >= 0) & (own_dom >= 0) & (d == own_dom)
+        )
+        blocked = blocked - active.astype(jnp.float32)
+    return ok & ~(blocked > 0.5)
+
+
 def pairwise_row(snap: ClusterSnapshot, st: PairState, sig_match, p, aff_ok_p):
     """Single-pod [N] variant for the sequential scan: same math as
     pairwise_from_counts restricted to traced pod index p (no

@@ -746,6 +746,13 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
     (opt-in diagnostics; see
     tests/test_gangs.py::test_gang_rollback_audit_caveat).
 
+    EVICTION-TIMING CAVEAT: with `evicted` given, the audit cannot
+    know WHEN each eviction happened relative to each commit, so
+    pairwise violations are reported only when they hold with the
+    evictions applied AND ignored (see the inline note) — faithful
+    engine output never yields a false report; a placement valid only
+    under a strict subset of the evictions may go unreported.
+
     Returns human-readable violation strings (empty = valid)."""
     ora = Oracle(snap, cfg)
     pods, nodes = snap.pods, snap.nodes
@@ -817,6 +824,29 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
                 return " [gang-optimism]"
         return ""
 
+    # Retroactive-eviction ambiguity (round 5): the audit applies ALL
+    # evictions up front, but a pod committed BEFORE a later preemptor's
+    # eviction legitimately counted the evicted member in its own
+    # check (upstream checks the incoming pod against the cache of its
+    # cycle). The audit has no per-eviction timing, so a pairwise
+    # violation is reported only if it holds under BOTH timing extremes
+    # — evictions applied AND evictions ignored. One-sided: no false
+    # reports on faithful engine output; an exotic placement valid only
+    # under a strict SUBSET of the evictions could go unreported.
+    ora_noev = None
+    if evicted is not None and evicted.any() and snap.sigs.key.shape[0]:
+        ora_noev = Oracle(snap, cfg)
+
+    def _both(check_fn, p, on, op, n):
+        """True iff the check FAILS under both eviction timings."""
+        if check_fn(ora, p, on, op)[n]:
+            return False
+        return ora_noev is None or not check_fn(ora_noev, p, on, op)[n]
+
+    sp_fn = lambda o, p, on, op: o.spread_ok_and_penalty(p, on, op)[0]
+    ia_fn = lambda o, p, on, op: o.interpod_ok_and_raw(p, on, op)[0]
+    sym_fn = lambda o, p, on, op: o.symmetric_anti_ok(p, on, op)
+
     for p, n in placed:
         if not _np(nodes.valid)[n]:
             out.append(f"pod {p}: placed on invalid node {n}")
@@ -838,8 +868,7 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
             ]
         others_n = [m for _, m in others]
         others_p = [q for q, _ in others]
-        sp_ok, _ = ora.spread_ok_and_penalty(p, others_n, others_p)
-        if not sp_ok[n]:
+        if _both(sp_fn, p, others_n, others_p, n):
             tag = _gang_tag(
                 p, n, others,
                 lambda on, op: ora.spread_ok_and_penalty(p, on, op)[0][n],
@@ -847,8 +876,7 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
             out.append(
                 f"pod {p}: node {n} violates DoNotSchedule spread{tag}"
             )
-        ia_ok, _ = ora.interpod_ok_and_raw(p, others_n, others_p)
-        if not ia_ok[n]:
+        if _both(ia_fn, p, others_n, others_p, n):
             tag = _gang_tag(
                 p, n, others,
                 lambda on, op: ora.interpod_ok_and_raw(p, on, op)[0][n],
@@ -856,7 +884,7 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
             out.append(
                 f"pod {p}: node {n} violates required pod affinity{tag}"
             )
-        if not ora.symmetric_anti_ok(p, others_n, others_p)[n]:
+        if _both(sym_fn, p, others_n, others_p, n):
             # Restoring members can only ADD anti holders, never remove
             # them, so a symmetric-anti violation cannot be
             # gang-optimism: always untagged.
