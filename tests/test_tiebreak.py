@@ -160,7 +160,9 @@ def test_fast_divergence_quantified():
     min_ratio = float(np.min(placed_ratio))
     assert mean_ratio >= 0.98, f"fast mode lost placements: {mean_ratio:.3f}"
     # Round-5 floor raise (VERDICT #9): deeper small-cluster fallback
-    # lists (K=16 at N<=256) recovered most of the stranded-large-pod
-    # gap; mixed placed_delta improved -4.2% -> -1.9% and the worst
-    # seed from 0.86 to 0.95. Floor at 0.90 per the round-5 ask.
+    # lists (K=16 at N<=256) recovered the stranded-large-pod gap on
+    # THESE seeds (worst 0.86 -> 0.95); the canonical divergence seeds
+    # (divergence.measure base_seed 3000) are fragmentation-bound and
+    # unchanged — see COVERAGE.md "Known, documented divergences" for
+    # the open rank-horizon item. Floor at 0.90 per the round-5 ask.
     assert min_ratio >= 0.90, f"worst-case placement loss: {min_ratio:.3f}"
