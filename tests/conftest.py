@@ -38,3 +38,13 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def pytest_configure(config):
+    # Tier-1 runs with -m 'not slow' (ROADMAP.md): the marker gates
+    # compile-heavy multi-device tests that a 2-core CPU host cannot
+    # afford inside the tier-1 wall budget; the full (unfiltered) suite
+    # still runs everything.
+    config.addinivalue_line(
+        "markers", "slow: compile-heavy test excluded from tier-1"
+    )

@@ -144,3 +144,37 @@ def test_gang_pods_all_or_nothing_e2e():
     host.run_until_idle(max_cycles=3)
     assert api.bound_pods() == []  # quorum impossible: nothing binds
     assert len(api.pending_pods()) == 4
+
+
+def test_failure_after_drain_restores_hints():
+    """ADVICE round 5 / round-6 fix: pending_pods() (or anything else
+    between the hint drain and a successful send) raising must RESTORE
+    the drained hints — otherwise DeltaSession's next diff trusts a
+    stale base for those records and ships stale deltas forever."""
+
+    class _Flaky(FakeApiServer):
+        fail_next = False
+
+        def pending_pods(self):
+            if self.fail_next:
+                self.fail_next = False
+                raise RuntimeError("malformed pod record")
+            return super().pending_pods()
+
+    api = _Flaky()
+    build_synthetic_cluster(api, np.random.default_rng(2), 6, 3)
+
+    class _NeverCalled:
+        def assign(self, *a, **kw):  # pragma: no cover
+            raise AssertionError("send must not happen on this path")
+
+    host = HostScheduler(api, EngineConfig(mode="fast"),
+                         client=_NeverCalled())
+    assert api.drain_changed() is None  # consume the no-baseline drain
+    api.add_pod("late-pod", requests={"cpu": 10.0, "memory": 1e6})
+    api.fail_next = True
+    with pytest.raises(RuntimeError):
+        host.cycle()
+    assert api.drain_changed() == {"late-pod"}, (
+        "hints drained by the failed cycle were not restored"
+    )

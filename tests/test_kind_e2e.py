@@ -88,8 +88,11 @@ def test_kind_host_schedules_all_pods(kind_cluster):
         deadline = time.monotonic() + 120.0
         while time.monotonic() < deadline:
             host.cycle()
+            # Informer records are namespace-qualified
+            # ("default/tpusched-e2e-..."); a bare-name prefix matches
+            # nothing and the loop would always time out.
             bound = [r for r in informer.bound_pods()
-                     if r["name"].startswith("tpusched-e2e-")]
+                     if r["name"].startswith("default/tpusched-e2e-")]
             if len(bound) == N_PODS:
                 break
             time.sleep(1.0)

@@ -488,3 +488,42 @@ def test_fake_api_change_log_matches_informer_contract():
     assert api.drain_changed() == {"p0"}
     api.restore_changed(None)
     assert api.drain_changed() is None
+
+
+def test_malformed_annotations_fall_back_to_defaults():
+    """ADVICE round 5: annotations are user-controlled free text; one
+    pod annotated slo-target: "high" must degrade to defaults for that
+    pod, not raise inside pending_pods() and crash-loop the scheduler."""
+    obj = {
+        "metadata": {
+            "name": "p-bad", "namespace": "default",
+            "labels": {LABEL_POD_GROUP: "gang-x"},
+            "annotations": {ANN_SLO_TARGET: "high",
+                            ANN_OBSERVED: "",
+                            ANN_MIN_MEMBER: "three"},
+        },
+        "spec": {
+            "containers": [
+                {"resources": {"requests": {"cpu": "100m",
+                                            "memory": "64Mi"}}}
+            ],
+        },
+    }
+    rec = pending_record(obj)
+    assert rec["slo_target"] == 0.0
+    assert rec["observed_avail"] == 1.0
+    assert rec["pod_group_min_member"] == 0
+    # float-shaped int strings still parse ("4.0" -> 4)
+    obj["metadata"]["annotations"][ANN_MIN_MEMBER] = "4.0"
+    assert pending_record(obj)["pod_group_min_member"] == 4
+
+    from tpusched.kube import running_record
+
+    robj = {
+        "metadata": {"name": "r-bad", "namespace": "default",
+                     "annotations": {ANN_SLO_TARGET: "yes",
+                                     ANN_OBSERVED: None}},
+        "spec": {"nodeName": "n0", "containers": []},
+    }
+    rrec = running_record(robj)
+    assert rrec["slack"] == pytest.approx(1.0)  # default observed - slo

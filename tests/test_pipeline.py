@@ -56,3 +56,26 @@ def test_bench_overlap_runs():
         np.random.default_rng(800 + s), 16, 8
     ))
     assert stats["sequential_s"] > 0 and stats["pipelined_s"] > 0
+
+
+def test_solve_async_matches_sync():
+    """Engine.solve_async (round 6: the dispatch+background-fetch
+    primitive behind solve_stream AND the sidecar's staged handlers)
+    returns exactly Engine.solve's result."""
+    import numpy as np
+
+    from tpusched import Engine, EngineConfig
+    from tpusched.synth import make_cluster
+
+    rng = np.random.default_rng(9)
+    snap, _ = make_cluster(rng, 40, 8)
+    eng = Engine(EngineConfig(mode="fast"))
+    snap = eng.put(snap)
+    sync = eng.solve(snap)
+    pending = eng.solve_async(snap)
+    # The caller's thread is free here — that window is the feature.
+    async_res = pending.result()
+    np.testing.assert_array_equal(sync.assignment, async_res.assignment)
+    np.testing.assert_array_equal(sync.commit_key, async_res.commit_key)
+    np.testing.assert_allclose(sync.final_used, async_res.final_used)
+    assert async_res.solve_seconds > 0

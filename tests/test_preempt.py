@@ -218,3 +218,60 @@ def test_preemption_off_by_default():
     res = Engine(cfg).solve(snap)
     assert res.assignment[0] == -1
     assert not res.evicted.any()
+
+
+@pytest.mark.parametrize(
+    "seed", [0, pytest.param(1, marks=pytest.mark.slow),
+             pytest.param(2, marks=pytest.mark.slow)],
+)
+def test_preemption_fast_valid_many_bidders(seed):
+    """Round-6 auction restructure ([N, V] candidate tables bucketed by
+    bidder priority + exact [C, V] claimed-node validation): validity
+    must hold with MANY concurrent bidders of widely mixed priorities —
+    the regime where the bucket approximation actually approximates."""
+    rng = np.random.default_rng(13000 + seed)
+    snap, _ = make_cluster(
+        rng,
+        n_pods=120,
+        n_nodes=10,
+        initial_utilization=0.9,
+        n_running_per_node=6,
+        tight_utilization=True,
+        pdb_frac=0.3,
+    )
+    cfg = _cfg("fast")
+    res = Engine(cfg).solve(snap)
+    violations = validate_assignment(
+        snap, cfg, res.assignment, commit_key=res.commit_key,
+        evicted=res.evicted,
+    )
+    assert violations == [], violations
+    assert res.evicted.sum() > 0, "90% tight utilization must preempt"
+
+
+@pytest.mark.parametrize(
+    "seed", [0, pytest.param(1, marks=pytest.mark.slow)],
+)
+def test_preemption_fast_valid_with_pairwise(seed):
+    """Fast preemption with SIGNATURES present (S > 0): the auction's
+    pairwise-involved plain lane and the pair-state commit/evict
+    scatters must stay consistent through the round-6 [C, V]
+    restructure — validity audited end to end."""
+    rng = np.random.default_rng(14000 + seed)
+    snap, _ = make_cluster(
+        rng,
+        n_pods=60,
+        n_nodes=8,
+        initial_utilization=0.9,
+        n_running_per_node=5,
+        tight_utilization=True,
+        interpod_frac=0.3,
+        spread_frac=0.3,
+    )
+    cfg = _cfg("fast")
+    res = Engine(cfg).solve(snap)
+    violations = validate_assignment(
+        snap, cfg, res.assignment, commit_key=res.commit_key,
+        evicted=res.evicted,
+    )
+    assert violations == [], violations

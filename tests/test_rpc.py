@@ -325,3 +325,52 @@ def test_floor_buckets_pin_shapes():
             client.assign(_wire_snapshot())
     finally:
         server.stop(0)
+
+
+def test_assign_pipeline_single_connection_matches_sequential():
+    """Round 6: AssignPipeline (depth-2 pinned-base cumulative deltas
+    on ONE connection) must produce, cycle for cycle, exactly the
+    responses a sequential DeltaSession-style client gets for the same
+    snapshot versions — overlap is a latency feature, never a
+    semantics change."""
+    from tpusched.rpc.client import AssignPipeline, assign_response_arrays
+
+    server, port, _ = make_server("127.0.0.1:0")
+    server.start()
+    seq_client = SchedulerClient(f"127.0.0.1:{port}")
+    pipe_client = SchedulerClient(f"127.0.0.1:{port}")
+    try:
+        msg = _wire_snapshot()
+        # Sequential reference: full send per version (simplest exact
+        # baseline; the engine is deterministic).
+        versions = []
+        for it in range(6):
+            msg.pods[it % 2].priority = float(100 + it)
+            versions.append(pb.ClusterSnapshot.FromString(
+                msg.SerializeToString()
+            ))
+        seq = [
+            assign_response_arrays(seq_client.assign(v, packed_ok=True))
+            for v in versions
+        ]
+        pipe = AssignPipeline(pipe_client, depth=2)
+        msg2 = _wire_snapshot()
+        pipe.submit(msg2, changed=None)  # pin on the UNMUTATED base
+        got_resps = []
+        for it in range(6):
+            p = msg2.pods[it % 2]
+            p.priority = float(100 + it)
+            got_resps += pipe.submit(msg2, changed={p.name})
+        got_resps += pipe.flush()
+        got = [assign_response_arrays(r) for r in got_resps]
+        assert pipe.delta_sends > 0, "pipeline never took the delta path"
+        assert len(got) == len(seq)
+        for (sp, sn, si, ss, sk), (gp, gn, gi, gs, gk) in zip(seq, got):
+            assert sp == gp and sn == gn
+            np.testing.assert_array_equal(si, gi)
+            np.testing.assert_array_equal(ss, gs)
+            np.testing.assert_array_equal(sk, gk)
+    finally:
+        seq_client.close()
+        pipe_client.close()
+        server.stop(0)

@@ -1,4 +1,22 @@
-"""Round-5 scratch profiler for the fast-mode preemption path."""
+"""Scratch profiler for the fast-mode preemption path (rounds 5/6).
+
+Knobs (env):
+  PROF_CPU=1              force the CPU backend (jax_platforms=cpu)
+  PROF_ITERS=N            timed iterations after compile (0 = compile
+                          + first-solve only; percentiles are skipped)
+  TPUSCHED_DEBUG_ROUNDS=1 per-round auction trace on stderr: real
+                          (occupied bid slots), plain (plain-feasible
+                          bidders), pre (eviction bids kept as claims),
+                          claimed, keep (eviction keeps), keep_pl
+                          (plain keeps via the dealing commit), evicts.
+
+The round-6 [C, V] restructure was diagnosed with exactly this trace:
+the round-5 "keeps-per-round collapse at 10k" was plain-feasible
+bidders crowding the C=512 slots (rounds with plain~250 halve eviction
+keeps to ~230-260), and the late-drain one-keep tail was the PDB
+budget gate serializing declared-violation bids one per budget per
+round. See kernels/preempt.py:preempt_auction and tools/README.md.
+"""
 import os
 import sys
 
@@ -32,6 +50,8 @@ def main():
         t0 = time.perf_counter()
         res = eng.solve(snap)
         ts.append(time.perf_counter() - t0)
+    if not ts:
+        return  # PROF_ITERS=0: compile + round-trace run only
     ts = np.array(ts) * 1e3
     print(f"p50={np.percentile(ts,50):.1f}ms min={ts.min():.1f}ms "
           f"max={ts.max():.1f}ms rounds={res.rounds}")

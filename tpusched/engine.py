@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +45,27 @@ class ScoreBatchResult:
     feasible: np.ndarray       # [P, N] bool
     scores: np.ndarray         # [P, N] f32
     solve_seconds: float = 0.0
+
+
+@dataclasses.dataclass
+class PendingFetch:
+    """An in-flight device result: the program is dispatched and its
+    packed buffer is being fetched on the engine's background fetch
+    thread. `result()` joins and decodes. The point of the split —
+    SURVEY.md §2.3 PP, lifted out of pipeline.solve_stream so SERVING
+    paths get the same overlap — is that between dispatch and join the
+    caller's thread is free for CPU work (the next request's decode,
+    response scaffolding), while on fetch-driven transports (the axon
+    tunnel: execution only runs while a D2H read is in flight) the
+    background np.asarray is what actually drives the device."""
+
+    _unpack: Callable[[np.ndarray, float], Any]
+    _fut: Any          # Future[(np buffer, completion perf_counter)]
+    _t0: float
+
+    def result(self):
+        raw, done_t = self._fut.result()
+        return self._unpack(raw, done_t - self._t0)
 
 
 def _sat_tables(snap: ClusterSnapshot):
@@ -156,6 +178,17 @@ class Engine:
         self._score_top1_jit = jax.jit(_score_top1)
         self._score_fn = _score
         self._topk_jits: dict[int, Any] = {}  # k -> jitted top-k path
+        # ONE background fetch worker: fetch order == dispatch order,
+        # which fetch-driven transports (axon tunnel) rely on — two
+        # concurrent D2H reads would race for the single execution
+        # stream. Callers overlap by dispatching the next program while
+        # the worker's np.asarray drives the current one. (Eager: the
+        # executor spawns its thread only on first submit, so idle
+        # engines pay nothing, and handler threads never race a lazy
+        # init.)
+        self._fetch_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpusched-fetch"
+        )
 
     # -- public API ---------------------------------------------------------
 
@@ -179,6 +212,20 @@ class Engine:
             rounds=int(buf[-1]),
         )
 
+    def _pool(self) -> ThreadPoolExecutor:
+        return self._fetch_pool
+
+    @staticmethod
+    def _fetch(buf):
+        # Completion time measured INSIDE the worker so solve_seconds
+        # covers dispatch->fetch-done, not whatever CPU work the caller
+        # overlapped with the wait. np.asarray releases the GIL inside
+        # the transport wait and, on fetch-driven transports, is what
+        # actually runs the program. tree.map: score_async fetches a
+        # (feasible, scores) pair through the same worker.
+        out = jax.tree.map(np.asarray, buf)
+        return out, time.perf_counter()
+
     def solve(self, snap: ClusterSnapshot) -> SolveResult:
         """Full batched scheduling: assign every pending pod (or -1).
 
@@ -191,16 +238,44 @@ class Engine:
         out.solve_seconds = time.perf_counter() - t0
         return out
 
+    def solve_async(self, snap: ClusterSnapshot) -> PendingFetch:
+        """Dispatch the packed solve and fetch its one flat buffer on
+        the engine's background worker; `.result()` joins and unpacks.
+        The caller's thread is free between dispatch and join — the
+        decode<->solve overlap primitive behind pipeline.solve_stream
+        and the sidecar's staged request handling (in-request overlap:
+        response scaffolding builds while the device runs; cross-
+        request: the next request's decode overlaps this solve)."""
+        t0 = time.perf_counter()
+        buf = self._solve_packed_jit(snap)  # async dispatch
+
+        def unpack(raw, seconds):
+            res = self.unpack(snap, raw)
+            res.solve_seconds = seconds
+            return res
+
+        return PendingFetch(unpack, self._pool().submit(self._fetch, buf), t0)
+
     def score(self, snap: ClusterSnapshot) -> ScoreBatchResult:
         """ScoreBatch: [P, N] feasibility + normalized weighted scores,
         no commits (the Score-plugin backend of the north star)."""
+        return self.score_async(snap).result()
+
+    def score_async(self, snap: ClusterSnapshot) -> PendingFetch:
+        """Async form of score(): both matrices fetched on the engine's
+        ordered fetch worker. Serving handlers must use this (or any
+        *_async form) rather than fetching on their own thread — a
+        handler-thread np.asarray would race the worker's in-flight
+        fetch on fetch-driven transports."""
+        def unpack(pair, seconds):
+            feasible, scores = pair
+            return ScoreBatchResult(
+                feasible=feasible, scores=scores, solve_seconds=seconds
+            )
+
         t0 = time.perf_counter()
-        feasible, scores = self._score_jit(snap)
-        out = ScoreBatchResult(
-            feasible=np.asarray(feasible), scores=np.asarray(scores)
-        )
-        out.solve_seconds = time.perf_counter() - t0
-        return out
+        out = self._score_jit(snap)  # async dispatch
+        return PendingFetch(unpack, self._pool().submit(self._fetch, out), t0)
 
     def score_topk(self, snap: ClusterSnapshot, k: int):
         """Top-k of the ScoreBatch matrix computed ON DEVICE: each
@@ -212,6 +287,15 @@ class Engine:
         the scored-node set at scale. Returns (idx[P,k] int32 with -1
         where fewer than k feasible, scores[P,k] f32 with 0 at -1
         slots, seconds)."""
+        res = self.score_topk_async(snap, k)
+        idx, val, seconds = res.result()
+        return idx, val, seconds
+
+    def score_topk_async(self, snap: ClusterSnapshot, k: int) -> PendingFetch:
+        """Async form of score_topk (same packed buffer, background
+        fetch): `.result()` -> (idx, val, seconds). Lets the sidecar's
+        ScoreBatch handler build its response name tables while the
+        device ranks."""
         k = int(k)
         if not 1 <= k <= snap.nodes.valid.shape[0]:
             raise ValueError(
@@ -233,13 +317,17 @@ class Engine:
                 ])
 
             fn = self._topk_jits[k] = jax.jit(_topk)
-        t0 = time.perf_counter()
-        buf = np.asarray(fn(snap))
         P = snap.pods.valid.shape[0]
-        half = P * k
-        idx = buf[:half].astype(np.int32).reshape(P, k)
-        val = buf[half:].reshape(P, k).astype(np.float32)
-        return idx, val, time.perf_counter() - t0
+
+        def unpack(buf, seconds):
+            half = P * k
+            idx = buf[:half].astype(np.int32).reshape(P, k)
+            val = buf[half:].reshape(P, k).astype(np.float32)
+            return idx, val, seconds
+
+        t0 = time.perf_counter()
+        buf = fn(snap)  # async dispatch
+        return PendingFetch(unpack, self._pool().submit(self._fetch, buf), t0)
 
     def score_top1(self, snap: ClusterSnapshot):
         """Full [P, N] scoring on device, returning only each pod's best
@@ -261,3 +349,10 @@ class Engine:
     def put(self, snap: ClusterSnapshot) -> ClusterSnapshot:
         """Explicit host->device transfer (otherwise implicit on call)."""
         return jax.device_put(snap)
+
+    def close(self) -> None:
+        """Shut down the background fetch worker. Idle workers also
+        exit when the engine is garbage-collected (executor weakref),
+        so short-lived engines need no explicit close; long-lived
+        processes cycling many engines should call this."""
+        self._fetch_pool.shutdown(wait=False)

@@ -740,12 +740,18 @@ _RESIDUAL_CAP = 1024
 # Bid width and round cap of the fast-mode batched preemption auction
 # (_preempt_rounds): per round, the top _PREEMPT_BATCH unplaced pods
 # bid in parallel; upstream preempts ONE pod per scheduling cycle, so
-# even one round x 512 bids is far past parity behavior. 512 (round
-# 5, was 256): plain-feasible bidders share the same slots, and at
-# 90% utilization they crowd out preemptors mid-drain — the wider
-# batch keeps eviction throughput up; per-round cost grows sublinearly
-# now that claim resolution is parallel (preempt_auction claim_it).
-_PREEMPT_BATCH = 512
+# even one round x 512 bids is far past parity behavior. 1024 (round
+# 6, was 512): plain-feasible bidders share the same slots, and at
+# 90% utilization they crowd out preemptors mid-drain — round-5 traces
+# show rounds where ~250 of the 512 slots went to plain bidders,
+# halving eviction keeps to ~230-260 (the keeps-per-round collapse in
+# VERDICT round 5). The wider batch keeps eviction throughput at
+# ~full-width even with plain crowding, roughly halving drain rounds;
+# it became affordable when preempt_auction dropped its exact
+# [C, N, V] tableau for [N, V] candidate tables + [C, V] claimed-node
+# validation (per-round cost now scales with C only through [C, N]
+# ranking and [C, V] validation).
+_PREEMPT_BATCH = 1024
 # Width of the per-round plain drain in _preempt_rounds.
 _PREEMPT_DRAIN = 1024
 # Round cap; the env override exists for per-round cost profiling
@@ -762,6 +768,85 @@ _PREEMPT_MAX_ROUNDS = int(
 _PREEMPT_VICTIM_CAP = 16
 
 
+def _spread_excess_mask(snap: ClusterSnapshot, static: StaticCtx, rank,
+                        choice, kept_v, st_v):
+    """[P] bool: kept members to revert so every kept DNS-spread
+    constraint holds against st_v's (end-of-round) counts. Per (sig,
+    domain) group of revert-eligible members, the highest-priority
+    prefix whose size respects every kept member's skew bound survives;
+    the excess reverts. Shared by solve_rounds' commit-validation
+    fixpoint and _preempt_rounds' round validation (round 6)."""
+    pods, nodes = snap.pods, snap.nodes
+    P = pods.valid.shape[0]
+    N = nodes.valid.shape[0]
+    dom_s_v = kpair.sig_domains(snap)                        # [S, N]
+    S_sigs = dom_s_v.shape[0]
+    dns_any = pods.ts_valid & (pods.ts_when == DO_NOT_SCHEDULE)  # [P, C]
+    counts_v = st_v.counts                                   # [S, N]
+    node_cnt = jnp.take_along_axis(
+        counts_v, jnp.clip(dom_s_v, 0, None), axis=1
+    )                                                        # [S, N]
+    node_cnt = jnp.where(dom_s_v >= 0, node_cnt, jnp.inf)
+    bad = jnp.zeros(P, bool)
+    idx = jnp.arange(P, dtype=jnp.int32)
+    for c in range(pods.ts_key.shape[1]):
+        s_c = jnp.clip(pods.ts_sig[:, c], 0, None)           # [P]
+        d_c = dom_s_v[s_c, jnp.clip(choice, 0, N - 1)]
+        member = (
+            kept_v & dns_any[:, c] & (choice >= 0) & (d_c >= 0)
+        )
+        # Per-pod allowance T = min over eligible domains of the
+        # END-state count, plus the pod's own maxSkew.
+        nc_p = node_cnt[s_c]                                 # [P, N]
+        eligible = nodes.valid[None, :] & static.aff_ok & (
+            dom_s_v[s_c] >= 0
+        )
+        min_end = jnp.min(
+            jnp.where(eligible, nc_p, jnp.inf), axis=1
+        )
+        min_end = jnp.where(jnp.isfinite(min_end), min_end, 0.0)
+        T = min_end + pods.ts_max_skew[:, c]                 # [P]
+        cnt_total = counts_v[s_c, jnp.clip(d_c, 0, None)]
+        # Rank-ordered position within each (sig, domain) group
+        # of revert-eligible members, and the group's size.
+        gid = jnp.where(
+            member, s_c * N + jnp.clip(d_c, 0, None), S_sigs * N
+        )
+        g_tab = jnp.zeros(S_sigs * N + 1, jnp.float32).at[gid].add(
+            member.astype(jnp.float32)
+        )
+        g_elig = g_tab[gid]                                  # [P]
+        b_fixed = cnt_total - g_elig  # non-revertable contribution
+        perm2 = jnp.lexsort((rank, gid))
+        gid_s = gid[perm2]
+        mem_s = member[perm2]
+        boundary = jnp.concatenate(
+            [jnp.ones(1, bool), gid_s[1:] != gid_s[:-1]]
+        )
+        q_cum = jnp.cumsum(mem_s.astype(jnp.float32))
+        seg_start2 = jax.lax.cummax(jnp.where(boundary, idx, 0))
+        q_off = jnp.where(
+            seg_start2 > 0,
+            q_cum[jnp.clip(seg_start2 - 1, 0, None)], 0.0,
+        )
+        q_incl = q_cum - q_off                               # 1-based position
+        # Segmented prefix-min of T in rank order: the k-member
+        # prefix is admissible iff b + k <= min over its
+        # members' allowances.
+        T_s = jnp.where(mem_s, T[perm2], jnp.inf)
+
+        def comb(a, bpair):
+            av, ab = a
+            bv, bb = bpair
+            return (jnp.where(bb, bv, jnp.minimum(av, bv)), ab | bb)
+
+        pm_s, _ = jax.lax.associative_scan(comb, (T_s, boundary))
+        survive_s = mem_s & (b_fixed[perm2] + q_incl <= pm_s)
+        bad_c = jnp.zeros(P, bool).at[perm2].set(mem_s & ~survive_s)
+        bad |= bad_c
+    return bad
+
+
 def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
                     static: StaticCtx, rank, order, base_rounds,
                     used, assigned, st, evicted, round_of, chosen,
@@ -774,13 +859,16 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
          order) are evaluated IN PARALLEL against round-start state:
          plain feasibility first (an earlier round's evictions may have
          left room), else the batched victim-prefix auction
-         (kpreempt.preempt_auction): every bidder's per-node tableau
-         comes from the node-major table (_tableau_nv) and parallel
-         claim iterations deal bidders distinct cheap STILL-UNCLAIMED
+         (kpreempt.preempt_auction): bidders rank nodes off
+         bidder-INDEPENDENT [N, V] prefix tables (priority-quantile
+         buckets of the active bidders; round 6 — the exact [C, N, V]
+         tableau was the per-round cost floor), parallel claim
+         iterations deal bidders distinct cheap STILL-UNCLAIMED
          nodes — one claimant per node, so same-round victim sets never
          overlap (a bidder unclaimed after the fixed iteration count
          defers to the next round, a retry the old rank-ordered scan
-         never needed). Plain bidders WITHOUT pairwise involvement (has_pair
+         never needed) — and each claimed node gets an EXACT [C, V]
+         victim-prefix validation. Plain bidders WITHOUT pairwise involvement (has_pair
          False) bypass the one-claim-per-node scan entirely: the load-
          balancing scores herd their argmaxes onto the same few nodes,
          which capped keeps at ~one per node per round (a 25-round
@@ -863,9 +951,11 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             chosen = chosen.at[dsel].set(
                 jnp.where(hit_d, chosen_d, chosen[dsel])
             )
+            # Shared per-round key, like the auction keeps below (the
+            # drain is S == 0-only, so only capacity semantics ride on
+            # it — validated jointly by _deal_commit's prefix rule).
             round_of = round_of.at[dsel].set(
-                jnp.where(hit_d, base_rounds + r * P + rank[dsel],
-                          round_of[dsel])
+                jnp.where(hit_d, base_rounds + r, round_of[dsel])
             )
             drained = jnp.any(hit_d)
         # Like the sequential pass, each pod gets ONE bid (tried); a bid
@@ -922,7 +1012,13 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             # so real consumption never exceeds the bound checked). A
             # bid that DECLARED a violation (its own usage alone
             # overdraws — upstream's evict-PDB-pods-as-last-resort)
-            # keeps only if no earlier claimed bid touched its budgets.
+            # keeps unconditionally: `remaining` only decreases, so a
+            # bid violating against round-start budgets would violate
+            # against ANY later sequential state too — the sequential
+            # pass would evict it as last resort just the same, and
+            # serializing these (the old rule admitted one per budget
+            # per round via a no-earlier-toucher check) stretched the
+            # drain by ~10 one-keep rounds at 6k x 3k (round-6 trace).
             # This replaces a C-step lax.scan with O(1)-depth cumsums
             # (the scan's sequential steps dominated the round wall).
             usage_cl = jnp.where(claimed[:, None], usage, 0.0)
@@ -937,14 +1033,8 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
                 ),
                 axis=1,
             )
-            touch = usage_cl > 0.0
-            touched_before = (
-                jnp.cumsum(touch.astype(jnp.int32), axis=0)
-                - touch.astype(jnp.int32)
-            )
             alone_viol = jnp.any(usage > remaining0[None, :] + 1e-6, axis=1)
-            clean = ~jnp.any(touch & (touched_before > 0), axis=1)
-            keep = claimed & (fits_budget | (alone_viol & clean))
+            keep = claimed & (fits_budget | alone_viol)
         else:
             keep = claimed
         keep_evict = keep & takes_evict
@@ -977,6 +1067,72 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         keep_pl = choice_pl >= 0
         keep_all = keep | keep_pl
         target_all = jnp.where(keep_pl, choice_pl, target)
+        st2 = st
+        if S:
+            # Pairwise state stays EVICTION-FREE through the preemption
+            # rounds (round 6; pair_state_evict is deliberately NOT
+            # applied): a pod validated against an INTERMEDIATE
+            # eviction state — some victims gone, later rounds' not
+            # yet — can be legal there yet illegal under BOTH timings
+            # the external audit accepts (validate_assignment checks
+            # with ALL evictions applied and with none; pod counts are
+            # key-filtered but the evicted mask is not). Counting
+            # still-evicted members keeps every check equal to the
+            # audit's no-eviction arm: spread and required-anti only
+            # get stricter with more members, and a positive-affinity
+            # match on an evicted member is precisely what that arm
+            # accepts. The cost is bounded conservatism: a pairwise
+            # slot freed only by this batch's evictions opens next
+            # batch (the snapshot then has the victims gone), exactly
+            # like upstream's nominate-then-requeue.
+            choice_full = jnp.full(P, -1, jnp.int32).at[sel].set(
+                jnp.where(keep_all, target_all, -1)
+            )
+            keep_full = jnp.zeros(P, bool).at[sel].set(keep_all)
+            st2 = kpair.pair_state_commit(
+                snap, st2, static.sig_match, choice_full, keep_full
+            )
+            # Same-round cross-commit validation (round 6): the claim
+            # scan's NODE exclusivity does not bound pairwise
+            # interactions — spread constraints are per-DOMAIN (many
+            # nodes share a zone, so two same-sig keeps on different
+            # nodes can jointly breach a skew bound), and this round's
+            # evictions can remove the match another keep's required
+            # affinity relied on. Re-check every keep against
+            # end-of-round state exactly as solve_rounds' commit
+            # validation does (same helpers), reverting violators to
+            # PENDING — they re-bid next round against true counts.
+            # Their victims stay evicted (the eviction was decided
+            # against valid round-start state; upstream's
+            # nominate-then-requeue can strand evictions the same way).
+
+            def pv_cond(vs):
+                return vs[-1]
+
+            def pv_body(vs):
+                st_v, kept_v, _ = vs
+                ia_ok = kpair.ia_ok_at_choice(
+                    snap, st_v, static.sig_match, choice_full,
+                    jnp.where(kept_v, choice_full, -1),
+                )
+                bad = kept_v & has_pair & ~ia_ok
+                bad = bad | (kept_v & _spread_excess_mask(
+                    snap, static, rank, choice_full, kept_v, st_v
+                ))
+                st_v = kpair.pair_state_commit(
+                    snap, st_v, static.sig_match, choice_full, bad,
+                    sign=-1.0,
+                )
+                return st_v, kept_v & ~bad, jnp.any(bad)
+
+            st2, kept_final, _ = jax.lax.while_loop(
+                pv_cond, pv_body,
+                (st2, keep_full, jnp.any(keep_full & has_pair)),
+            )
+            keep_valid = kept_final[sel]
+            keep = keep & keep_valid
+            keep_pl = keep_pl & keep_valid
+            keep_all = keep | keep_pl
         used2 = used.at[tgt_c].add(
             jnp.where(keep_evict[:, None], -freed_req, 0.0)
         )
@@ -986,18 +1142,6 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         used2 = used2.at[jnp.clip(choice_pl, 0, N - 1)].add(
             jnp.where(keep_pl[:, None], req_sel, 0.0)
         )
-        st2 = st
-        if S:
-            st2 = kpair.pair_state_evict(
-                snap, st2, static.sig_match, ev_round
-            )
-            choice_full = jnp.full(P, -1, jnp.int32).at[sel].set(
-                jnp.where(keep_all, target_all, -1)
-            )
-            keep_full = jnp.zeros(P, bool).at[sel].set(keep_all)
-            st2 = kpair.pair_state_commit(
-                snap, st2, static.sig_match, choice_full, keep_full
-            )
         assigned2 = assigned.at[sel].set(
             jnp.where(keep_all, target_all, assigned[sel])
         )
@@ -1008,12 +1152,17 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
                       jnp.where(keep & can_plain, sc_plain,
                                 jnp.where(keep, NEG_INF, chosen[sel])))
         )
-        # Commit keys: strictly after the main rounds, ordered by
-        # (preemption round, rank) — later-round keeps saw earlier
-        # keeps' state.
+        # Commit keys: strictly after the main rounds, one SHARED key
+        # per preemption round — same-round keeps did NOT see each
+        # other's state (they were all checked against round-start
+        # state and then jointly validated against end-of-round state
+        # above), so rank-ordered intra-round keys would promise the
+        # external audit a sequential consistency the engine never
+        # enforced; a shared key makes validate_assignment judge each
+        # keep against exactly the end-of-round set the engine
+        # validated — the same contract solve_rounds' main rounds use.
         round_of2 = round_of.at[sel].set(
-            jnp.where(keep_all, base_rounds + r * P + rank[sel],
-                      round_of[sel])
+            jnp.where(keep_all, base_rounds + r, round_of[sel])
         )
         # A no-bid pod (nothing feasible, no victim prefix anywhere) is
         # spent; a kept pod is placed; a DEFERRED pod (could bid but
@@ -1166,7 +1315,15 @@ def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
     # tranches and silently never examine later-ranked pods at all.
     # The outer loop is bounded by its own progress guarantee (every
     # tranche places or spends >= 1 pod) plus a P-sized safety cap.
-    tranche_cap = min(4, max_rounds) if cfg.max_rounds > 0 else 4
+    # With preemption on the cap drops to 2: the cluster is near
+    # capacity (that is why preemption is configured), deep per-tranche
+    # fixpoints dribble the last few commits through extra [C, N]
+    # rounds (~P/C x cap rounds total — 39 of the 55 rounds at
+    # 10k x 5k were main-loop rounds, round-6 trace), and any feasible
+    # straggler a capped tranche leaves behind is re-examined every
+    # preemption round by _preempt_rounds' plain drain anyway.
+    base_cap = 2 if cfg.preemption else 4
+    tranche_cap = min(base_cap, max_rounds) if cfg.max_rounds > 0 else base_cap
 
     def tranche_path(st):
         used, assigned, chosen, round_of, progress, r = st
@@ -1380,76 +1537,9 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         #     them (the old policy) cost O(pods-with-spread) rounds on
         #     spread-heavy workloads (~141 rounds on BASELINE config 3);
         #     excess-only reverts converge in a handful.
-        dom_s_v = kpair.sig_domains(snap)                    # [S, N]
-        S_sigs = dom_s_v.shape[0]
-        dns_any = pods.ts_valid & (pods.ts_when == DO_NOT_SCHEDULE)  # [P, C]
-
         def spread_excess(st_v, kept_v):
-            """[P] bool: members to revert so every kept DNS-spread
-            constraint holds against the resulting counts."""
-            counts_v = st_v.counts                           # [S, N]
-            node_cnt = jnp.take_along_axis(
-                counts_v, jnp.clip(dom_s_v, 0, None), axis=1
-            )                                                # [S, N]
-            node_cnt = jnp.where(dom_s_v >= 0, node_cnt, jnp.inf)
-            bad = jnp.zeros(P, bool)
-            idx = jnp.arange(P, dtype=jnp.int32)
-            for c in range(pods.ts_key.shape[1]):
-                s_c = jnp.clip(pods.ts_sig[:, c], 0, None)   # [P]
-                d_c = dom_s_v[s_c, jnp.clip(choice, 0, N - 1)]
-                member = (
-                    kept_v & dns_any[:, c] & (choice >= 0) & (d_c >= 0)
-                )
-                # Per-pod allowance T = min over eligible domains of the
-                # END-state count, plus the pod's own maxSkew.
-                nc_p = node_cnt[s_c]                         # [P, N]
-                eligible = nodes.valid[None, :] & static.aff_ok & (
-                    dom_s_v[s_c] >= 0
-                )
-                min_end = jnp.min(
-                    jnp.where(eligible, nc_p, jnp.inf), axis=1
-                )
-                min_end = jnp.where(jnp.isfinite(min_end), min_end, 0.0)
-                T = min_end + pods.ts_max_skew[:, c]         # [P]
-                cnt_total = counts_v[s_c, jnp.clip(d_c, 0, None)]
-                # Rank-ordered position within each (sig, domain) group
-                # of revert-eligible members, and the group's size.
-                gid = jnp.where(
-                    member, s_c * N + jnp.clip(d_c, 0, None), S_sigs * N
-                )
-                g_tab = jnp.zeros(S_sigs * N + 1, jnp.float32).at[gid].add(
-                    member.astype(jnp.float32)
-                )
-                g_elig = g_tab[gid]                          # [P]
-                b_fixed = cnt_total - g_elig  # non-revertable contribution
-                perm2 = jnp.lexsort((rank, gid))
-                gid_s = gid[perm2]
-                mem_s = member[perm2]
-                boundary = jnp.concatenate(
-                    [jnp.ones(1, bool), gid_s[1:] != gid_s[:-1]]
-                )
-                q_cum = jnp.cumsum(mem_s.astype(jnp.float32))
-                seg_start2 = jax.lax.cummax(jnp.where(boundary, idx, 0))
-                q_off = jnp.where(
-                    seg_start2 > 0,
-                    q_cum[jnp.clip(seg_start2 - 1, 0, None)], 0.0,
-                )
-                q_incl = q_cum - q_off                       # 1-based position
-                # Segmented prefix-min of T in rank order: the k-member
-                # prefix is admissible iff b + k <= min over its
-                # members' allowances.
-                T_s = jnp.where(mem_s, T[perm2], jnp.inf)
-
-                def comb(a, bpair):
-                    av, ab = a
-                    bv, bb = bpair
-                    return (jnp.where(bb, bv, jnp.minimum(av, bv)), ab | bb)
-
-                pm_s, _ = jax.lax.associative_scan(comb, (T_s, boundary))
-                survive_s = mem_s & (b_fixed[perm2] + q_incl <= pm_s)
-                bad_c = jnp.zeros(P, bool).at[perm2].set(mem_s & ~survive_s)
-                bad |= bad_c
-            return bad
+            return _spread_excess_mask(snap, static, rank, choice,
+                                       kept_v, st_v)
 
         def vcond(vs):
             return vs[-1]

@@ -173,7 +173,13 @@ def _tableau_nv(cfg: EngineConfig, snap: ClusterSnapshot,
     triangular [V, V] contraction. Ranking semantics identical to
     _tableau (lexicographic (violations, cost) min over feasible
     prefixes per node). Returns (elig, wcost, wviol, fits,
-    node_viol [C, N], node_cost [C, N]) with [C, N, V] leading four."""
+    node_viol [C, N], node_cost [C, N]) with [C, N, V] leading four.
+
+    Round 6: RETAINED FOR PROFILING/REFERENCE ONLY
+    (tools/prof_components.py slopes it) — preempt_auction no longer
+    materializes it; see its docstring for the [N, V]-table + [C, V]
+    validation restructure that replaced the ~0.5 GB/round of f32
+    cumsums this form costs at 10k x 5k."""
     nodes = snap.nodes
     N, V = ctx.vvalid.shape
     M = evicted.shape[0]
@@ -347,28 +353,71 @@ def preempt_step(cfg: EngineConfig, snap: ClusterSnapshot, ctx: PreemptCtx,
     return best_n, can, evict_m, freed
 
 
+# Quantile buckets of active-bidder priority for the candidate tables
+# (see preempt_auction). 2: each bucket's table is traced/compiled as
+# its own [N, V] subgraph, and 2 buckets + the optimistic lane already
+# give the common case (victims below every bidder) exact tables while
+# keeping the auction's compile time inside the tier-1 wall budget on
+# CPU hosts; boundary bidders fall through to the optimistic lane +
+# exact [C, V] validation either way.
+_PRIO_BUCKETS = 2
+
+
 def preempt_auction(cfg: EngineConfig, snap: ClusterSnapshot,
                     ctx: PreemptCtxNV, p_prio, p_req, allowed,
                     used, evicted, can_plain, n_plain,
-                    k_cand: int = 256, rank=None, claim_iters: int = 4):
+                    k_cand: int = 256, rank=None, claim_iters: int = 6):
     """Batched bidding for C preemptors at once (the fast mode's
     auction round; SURVEY.md §7 hard part 4 — parallel bids, global
-    resolution). Every bidder computes its full per-node tableau
-    (_tableau_nv on the node-major victim table — V-length prefix
-    sums and one [V, V] triangular PDB contraction instead of [C, M]
-    global cumsums), then PARALLEL claim iterations assign each bidder a cheap
-    still-unclaimed candidate node: each iteration every unclaimed
-    bidder bids its best untaken candidate and the lowest-rank bidder
-    per node wins (scatter-min) — one claimant per node, no two
-    same-round victim sets can overlap (victims are node-local). A few
-    O(1)-depth iterations resolve what a C-step rank-ordered scan did
-    before (measured ~2x the per-round wall at C=256: the scan's 256
-    sequential steps dominated the round); bidders still unclaimed
-    after claim_iters defer to the next auction round, the same
-    retry path as losing the node race under the scan. Plain
-    placements (can_plain, from the caller's feasibility re-check)
-    claim their scored node through the same iterations as
-    single-candidate bidders.
+    resolution), restructured (round 6) so the EXACT per-bidder work is
+    a [C, V] tableau on the claimed node only, never [C, N, V]:
+
+      1. CANDIDATE RANKING from bidder-independent [N, V] prefix
+         tables. Within a node, victims sit in ascending-cost slots,
+         prefix-freed capacity / cost / violation count are all
+         nondecreasing in prefix length, and feasibility is monotone
+         (a longer prefix frees more) — so a bidder's best prefix on a
+         node is always the FIRST feasible slot, and ranking nodes
+         needs only "where does my demand cross this node's cumulative
+         freed capacity" (a searchsorted-style compare+reduce per
+         resource, [C, N] out) plus two [C, N] gathers into the
+         node-major cumulative cost/violation tables. Eligibility is
+         approximated by _PRIO_BUCKETS quantile buckets of the ACTIVE
+         bidders' priorities: each bucket's table masks victims
+         eligible at the bucket's LOWER bound, a conservative subset
+         of every member bidder's true eligible set — so a node the
+         bucket table calls feasible is feasible for the bidder (more
+         eligibility only frees more), while cost is an upper
+         estimate. A single active bidder (the small-cluster unit-test
+         shape) gets thresholds equal to its own priority: exact.
+         The old path materialized the exact [C, N, V(, R)] tableau —
+         ~0.5 GB of f32 cumsums per round at 10k x 5k, the measured
+         ~16 ms/round floor of the preemption drain.
+      2. An OPTIMISTIC (priority-unaware) table answers "could this
+         bidder EVER preempt anywhere": bidders with no bucket-feasible
+         node but an optimistic-feasible one bid that node as a single
+         candidate (exact validation decides), and could_bid/spent
+         marking uses the optimistic answer so no pod is falsely
+         retired by the bucket approximation.
+      3. PARALLEL claim iterations (unchanged) deal bidders distinct
+         still-unclaimed candidate nodes: each iteration every
+         unclaimed bidder bids its (active-rank mod available)-th
+         cheapest untaken candidate and the lowest-rank bidder per
+         node wins (scatter-min) — one claimant per node, so
+         same-round victim sets never overlap (victims are
+         node-local). Losers re-deal next iteration; bidders still
+         unclaimed after claim_iters defer to the next auction round.
+         Plain placements (can_plain, from the caller's feasibility
+         re-check) claim their scored node through the same iterations
+         as single-candidate bidders.
+      4. EXACT [C, V] VALIDATION on each bidder's claimed node: true
+         priority eligibility, V-length prefix sums, first-feasible
+         prefix selection — the same selection rule as preempt_step
+         restricted to one node (per-prefix violation counts need no
+         separate pass: the first feasible prefix is the lexicographic
+         minimum). A claim whose exact check fails (possible only via
+         the optimistic fallback lane) is released; the bidder is
+         marked tried until the next keep changes the state.
 
     p_prio/p_req/allowed/can_plain/n_plain: [C]/[C,R]/[C,N]/[C]/[C] in
     descending rank order; inactive bidders must arrive with allowed
@@ -386,18 +435,129 @@ def preempt_auction(cfg: EngineConfig, snap: ClusterSnapshot,
     N = nodes.valid.shape[0]
     M = evicted.shape[0]
     C = p_prio.shape[0]
+    V = ctx.vvalid.shape[1]
+    R = p_req.shape[1]
     BIG = jnp.int32(2**31 - 1)
     if rank is None:
         rank = jnp.arange(C, dtype=jnp.int32)
-    elig, wcost, wviol, fits, node_viol, node_cost = _tableau_nv(
-        cfg, snap, ctx, p_prio, p_req, used, evicted
-    )                                                        # [C, N, V] x4
-    V = ctx.vvalid.shape[1]
     ok_node = allowed & nodes.valid[None, :]
-    viol_total = jnp.where(ok_node, node_viol, jnp.inf)
+
+    # -- stage 1/2: bidder-independent tables + [C, N] node ranking ---------
+    ev_nv = evicted[jnp.clip(ctx.vidx, 0, M - 1)] & ctx.vvalid
+    base_elig = ctx.vvalid & ~ev_nv                          # [N, V]
+    active = jnp.any(ok_node, axis=1) & ~can_plain
+    # Bucket thresholds: quantiles of the ACTIVE bidders' priorities
+    # (lower bounds, so each bidder's bucket is conservative for it).
+    # No active bidder -> NaN thresholds -> empty tables -> the
+    # optimistic lane (whose threshold is +inf) carries nothing either
+    # since ok_node is all-False then.
+    qs = jnp.linspace(0.0, 1.0, _PRIO_BUCKETS, endpoint=False)
+    thr = jnp.nanquantile(jnp.where(active, p_prio, jnp.nan), qs)
+    # Bidder -> bucket: largest b with thr[b] <= p_prio (NaN compares
+    # False -> bucket 0, harmless: its table is empty too).
+    bk = jnp.clip(
+        jnp.sum((thr[None, :] <= p_prio[:, None]).astype(jnp.int32), axis=1)
+        - 1, 0, _PRIO_BUCKETS - 1,
+    )                                                        # [C]
+    GP = snap.pdb_allowed.shape[0]
+    if GP:
+        run_pdb = snap.running.pdb_group
+        consumed = jnp.zeros(GP, jnp.float32).at[
+            jnp.clip(run_pdb, 0, None)
+        ].add(
+            (evicted & (run_pdb >= 0) & snap.running.valid).astype(
+                jnp.float32
+            )
+        )
+        remaining = snap.pdb_allowed - consumed              # [GP]
+        has_pdb = ctx.vpdb >= 0                              # [N, V]
+        tri = (
+            jnp.arange(V)[:, None] >= jnp.arange(V)[None, :]
+        )
+        same_g = (
+            (ctx.vpdb[:, :, None] == ctx.vpdb[:, None, :])
+            & has_pdb[:, :, None] & tri[None]
+        ).astype(jnp.float32)                                # [N, V, V]
+        rem_nv = remaining[jnp.clip(ctx.vpdb, 0, None)]      # [N, V]
+    else:
+        remaining = jnp.zeros(0, jnp.float32)
+    # Demand each node must free for each bidder (<= 0 in every
+    # resource cannot happen on an allowed node of a non-plain bidder).
+    need = used[None] + p_req[:, None, :] - nodes.allocatable[None]
+
+    def node_rank(thr_b):
+        """[C, N] (feasible, first-feasible cost, viols) against the
+        victim subset eligible at priority threshold thr_b."""
+        elig_b = base_elig & (
+            ctx.vprio + cfg.qos.preemption_margin < thr_b
+        )                                                    # [N, V]
+        cum_req = jnp.cumsum(
+            jnp.where(elig_b[..., None], ctx.vreq, 0.0), axis=1
+        )                                                    # [N, V, R]
+        cum_cost = jnp.cumsum(
+            jnp.where(elig_b, ctx.vcost, 0.0), axis=1
+        )                                                    # [N, V]
+        if GP:
+            eligp = (elig_b & has_pdb).astype(jnp.float32)
+            wcnt = jnp.einsum("nvw,nw->nv", same_g, eligp)
+            viol_b = elig_b & has_pdb & (wcnt > rem_nv)
+        else:
+            viol_b = jnp.zeros_like(elig_b)
+        cum_viol = jnp.cumsum(viol_b.astype(jnp.float32), axis=1)
+        # First-feasible slot: the compare+reduce form of a per-(c, n)
+        # searchsorted; [C, N, V] compares fuse into the [C, N] sum
+        # without materializing the old [C, N, V, R] f32 tableau.
+        pos = jnp.zeros((C, N), jnp.int32)
+        for r in range(R):
+            pos = jnp.maximum(
+                pos,
+                jnp.sum(
+                    (cum_req[None, :, :, r] < need[:, :, None, r]
+                     ).astype(jnp.int32),
+                    axis=2,
+                ),
+            )
+        feas = jnp.all(
+            need <= cum_req[None, :, V - 1, :], axis=-1
+        )                                                    # [C, N]
+        posc = jnp.clip(pos, 0, V - 1)
+        cost = cum_cost[jnp.arange(N)[None, :], posc]        # [C, N]
+        viol = cum_viol[jnp.arange(N)[None, :], posc]        # [C, N]
+        return feas, cost, viol
+
+    feas_t, cost_t, viol_t = [], [], []
+    for b in range(_PRIO_BUCKETS):
+        f, c_, v_ = node_rank(thr[b])
+        feas_t.append(f)
+        cost_t.append(c_)
+        viol_t.append(v_)
+    # Optimistic (priority-unaware) lane: thr = +inf admits every
+    # victim; used for spent-marking and the fallback candidate.
+    feas_opt, cost_opt, viol_opt = node_rank(jnp.float32(jnp.inf))
+
+    def pick_bucket(stacked):
+        return jnp.take_along_axis(
+            jnp.stack(stacked), bk[None, :, None], axis=0
+        )[0]
+
+    feas = pick_bucket(feas_t)
+    cost = pick_bucket(cost_t)
+    viol = pick_bucket(viol_t)
+    # Fallback: bucket tables see no feasible node but the optimistic
+    # one does (a bidder whose margin sits between its bucket's lower
+    # bound and its own priority) — rank by the optimistic tables and
+    # let exact validation arbitrate.
+    use_fb = (
+        ~jnp.any(ok_node & feas, axis=1)
+        & jnp.any(ok_node & feas_opt, axis=1)
+    )[:, None]
+    feas = jnp.where(use_fb, feas_opt, feas)
+    cost = jnp.where(use_fb, cost_opt, cost)
+    viol = jnp.where(use_fb, viol_opt, viol)
+    viol_total = jnp.where(ok_node & feas, viol, jnp.inf)
     min_viol = jnp.min(viol_total, axis=1, keepdims=True)    # [C, 1]
     total = jnp.where(
-        ok_node & (viol_total == min_viol), node_cost, jnp.inf
+        ok_node & feas & (viol_total == min_viol), cost, jnp.inf
     )
     K = min(k_cand, N)
     neg_v, cand_i = jax.lax.top_k(-total, K)                 # [C, K]
@@ -458,40 +618,45 @@ def preempt_auction(cfg: EngineConfig, snap: ClusterSnapshot,
          jnp.zeros(C, bool)),
         None, length=claim_iters,
     )
-    takes_evict = claimed & ~can_plain
-    # Victim prefix of each bidder's CLAIMED node (same lexicographic
-    # rule as preempt_step: min-viol prefixes, then cheapest; the
-    # claimed node's viol equals the bidder's min_viol by construction).
-    # Everything downstream is [C, V]-sized off the node-major table —
-    # freed capacity from the prefix sums, per-budget usage by a tiny
-    # scatter — no [C, M] materialization (an earlier form returned a
-    # dense [C, M] eviction matrix and the caller ran two [C, M]
-    # matmuls off it, ~5 ms/round at M=40960).
+    # -- stage 4: EXACT [C, V] validation on the claimed node ---------------
+    # True-priority eligibility, V-length prefix sums, first-feasible
+    # prefix selection — preempt_step's selection rule restricted to
+    # one node per bidder (prefix cost/viol/freed are nondecreasing and
+    # fits is monotone in prefix length, so first-feasible IS the
+    # lexicographic (viol, cost) minimum and no per-prefix violation
+    # pass is needed). Everything downstream is [C, V]-sized off the
+    # node-major table — no [C, N, V] or [C, M] materialization.
     tgt = jnp.clip(target, 0, N - 1)
-
-    def rowsel(a):
-        return jnp.take_along_axis(
-            a, tgt[:, None, None], axis=1
-        )[:, 0]                                              # [C, V]
-
-    fits_t, wviol_t, wcost_t, elig_t = map(
-        rowsel, (fits, wviol, wcost, elig)
-    )
-    best_pos = jnp.argmin(
-        jnp.where(
-            fits_t & (wviol_t == min_viol), wcost_t, jnp.inf
-        ),
-        axis=1,
-    ).astype(jnp.int32)                                      # [C]
+    vvalid_x = ctx.vvalid[tgt]                               # [C, V]
+    ev_x = evicted[jnp.clip(ctx.vidx[tgt], 0, M - 1)] & vvalid_x
+    elig_x = vvalid_x & ~ev_x & (
+        ctx.vprio[tgt] + cfg.qos.preemption_margin < p_prio[:, None]
+    )                                                        # [C, V]
+    wreq_x = jnp.cumsum(
+        jnp.where(elig_x[..., None], ctx.vreq[tgt], 0.0), axis=1
+    )                                                        # [C, V, R]
+    fits_x = elig_x & jnp.all(
+        used[tgt][:, None, :] - wreq_x + p_req[:, None, :]
+        <= nodes.allocatable[tgt][:, None, :],
+        axis=-1,
+    )                                                        # [C, V]
+    feas_x = jnp.any(fits_x, axis=1)
+    # A claim whose exact check fails (reachable only through the
+    # optimistic fallback lane — bucket-table feasibility is a sound
+    # subset) is RELEASED.
+    released = claimed & ~can_plain & ~feas_x
+    claimed = claimed & (can_plain | feas_x)
+    target = jnp.where(claimed, target, -1)
+    takes_evict = claimed & ~can_plain
+    best_pos = jnp.argmax(fits_x, axis=1).astype(jnp.int32)  # first feasible
     sel_v = (
-        takes_evict[:, None] & elig_t
+        takes_evict[:, None] & elig_x
         & (jnp.arange(V, dtype=jnp.int32)[None, :] <= best_pos[:, None])
     )
     vidx_t = jnp.where(sel_v, ctx.vidx[tgt], M)              # [C, V]
     freed_req = jnp.sum(
         jnp.where(sel_v[..., None], ctx.vreq[tgt], 0.0), axis=1
     )                                                        # [C, R]
-    GP = snap.pdb_allowed.shape[0]
     if GP:
         vpdb_t = ctx.vpdb[tgt]                               # [C, V]
         usage = jnp.zeros((C, GP), jnp.float32).at[
@@ -499,5 +664,16 @@ def preempt_auction(cfg: EngineConfig, snap: ClusterSnapshot,
         ].add((sel_v & (vpdb_t >= 0)).astype(jnp.float32))
     else:
         usage = jnp.zeros((C, 0), jnp.float32)
-    could_bid = can_plain | jnp.any(jnp.isfinite(total), axis=1)
+    # Spent-marking uses the OPTIMISTIC answer — a pod the bucket
+    # approximation under-serves is a deferral, not a retirement —
+    # EXCEPT for a released fallback claim: that bidder's best
+    # optimistic node just failed the exact check, and keeping it
+    # could_bid would let phantom bidders occupy the C slots round
+    # after round (claiming and releasing a node each time) while pods
+    # ranked beyond C are never examined. Marking it tried retires it
+    # for now; any later keep resets `tried` in _preempt_rounds, so it
+    # re-bids as soon as evictions actually change the state.
+    could_bid = can_plain | (
+        jnp.any(ok_node & feas_opt, axis=1) & ~released
+    )
     return target, claimed, takes_evict, vidx_t, freed_req, usage, could_bid

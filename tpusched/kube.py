@@ -31,8 +31,10 @@ import base64
 import json
 import os
 import ssl
+import sys
 import tempfile
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -56,6 +58,27 @@ LABEL_POD_GROUP = "scheduling.x-k8s.io/pod-group"
 ANN_MIN_MEMBER = "scheduling.x-k8s.io/min-member"
 
 DEFAULT_SCHEDULER_NAME = "tpu-scheduler"
+
+
+def _ann_float(ann: dict, key: str, default: float) -> float:
+    """Tolerant annotation parse: annotations are user-controlled free
+    text, and one pod annotated e.g. `slo-target: "high"` must degrade
+    to the default for THAT pod — a bare float() here would raise inside
+    pending_pods() every cycle and crash-loop the scheduler for the
+    whole cluster."""
+    try:
+        return float(ann.get(key, default))
+    except (TypeError, ValueError):
+        return float(default)
+
+
+def _ann_int(ann: dict, key: str, default: int) -> int:
+    """Integer twin of _ann_float (same crash-loop rationale). Accepts
+    float-shaped strings ("4.0") the way k8s users write them."""
+    try:
+        return int(float(ann.get(key, default)))
+    except (TypeError, ValueError):
+        return int(default)
 
 # Sentinel distinguishing "no drain has pinned a PDB resolver yet"
 # from a pinned resolver of None (no PDBs / RBAC-denied).
@@ -213,8 +236,8 @@ def pending_record(obj: dict) -> dict:
         namespace=ns,
         requests=pod_requests(spec),
         priority=float(spec.get("priority", 0)),
-        slo_target=float(ann.get(ANN_SLO_TARGET, 0.0)),
-        observed_avail=float(ann.get(ANN_OBSERVED, 1.0)),
+        slo_target=_ann_float(ann, ANN_SLO_TARGET, 0.0),
+        observed_avail=_ann_float(ann, ANN_OBSERVED, 1.0),
         labels=labels,
         node_selector=dict(spec.get("nodeSelector") or {}),
         required_terms=required_terms,
@@ -243,7 +266,7 @@ def pending_record(obj: dict) -> dict:
     group = labels.get(LABEL_POD_GROUP)
     if group:
         rec["pod_group"] = group
-        rec["pod_group_min_member"] = int(ann.get(ANN_MIN_MEMBER, 0))
+        rec["pod_group_min_member"] = _ann_int(ann, ANN_MIN_MEMBER, 0)
     return rec
 
 
@@ -256,8 +279,8 @@ def running_record(obj: dict, pdb_of=None) -> dict:
     ann = meta.get("annotations") or {}
     labels = dict(meta.get("labels") or {})
     ns = meta.get("namespace", "default")
-    slo = float(ann.get(ANN_SLO_TARGET, 0.0))
-    observed = float(ann.get(ANN_OBSERVED, 1.0))
+    slo = _ann_float(ann, ANN_SLO_TARGET, 0.0)
+    observed = _ann_float(ann, ANN_OBSERVED, 1.0)
     rec = dict(
         name=qualified_name(ns, meta["name"]),
         namespace=ns,
@@ -392,6 +415,9 @@ class KubeApiClient:
         self.timeout = timeout
         self.bind_count = 0
         self.delete_count = 0
+        # The host issues binds/deletes from a thread pool (round 6):
+        # bare += on the counters would lose increments.
+        self._count_lock = threading.Lock()
 
     # -- raw REST -----------------------------------------------------------
 
@@ -514,7 +540,8 @@ class KubeApiClient:
                     f"bind {pod_name} -> {node_name}: HTTP {e.code}"
                 ) from e
             raise
-        self.bind_count += 1
+        with self._count_lock:
+            self.bind_count += 1
 
     def delete_pod(self, pod_name: str) -> bool:
         """Eviction subresource; falls back to plain DELETE where the
@@ -551,7 +578,8 @@ class KubeApiClient:
                 return False
             else:
                 raise
-        self.delete_count += 1
+        with self._count_lock:
+            self.delete_count += 1
         return True
 
 
@@ -607,6 +635,39 @@ class KubeInformer:
         self._threads: list[threading.Thread] = []
         self.bind_count = 0
         self.delete_count = 0
+        # Rate-limited watch-failure reporting: (path, failure class) ->
+        # (last emit monotonic time, suppressed-since-then count). A
+        # watch loop stuck on 401s must be VISIBLE — the host otherwise
+        # just sees an ever-staler cache — without a 2-lines-per-second
+        # stderr flood from the 0.5 s retry loop.
+        self._err_log_lock = threading.Lock()
+        self._err_last: dict[tuple[str, str], tuple[float, int]] = {}
+        self.watch_err_interval = 30.0
+
+    def _log_watch_failure(self, path: str, exc: BaseException) -> None:
+        """One stderr line per (path, failure class) per
+        watch_err_interval, with a count of suppressed repeats."""
+        if isinstance(exc, urllib.error.HTTPError):
+            klass = f"http-{exc.code}"
+        elif isinstance(exc, urllib.error.URLError):
+            klass = f"url-{type(getattr(exc, 'reason', exc)).__name__}"
+        elif isinstance(exc, json.JSONDecodeError):
+            klass = "json-decode"
+        else:
+            klass = type(exc).__name__
+        now = time.monotonic()
+        with self._err_log_lock:
+            last, suppressed = self._err_last.get((path, klass), (0.0, 0))
+            if now - last < self.watch_err_interval:
+                self._err_last[(path, klass)] = (last, suppressed + 1)
+                return
+            self._err_last[(path, klass)] = (now, 0)
+        extra = f" ({suppressed} repeats suppressed)" if suppressed else ""
+        print(
+            f"tpusched informer: watch {path} failed [{klass}]: "
+            f"{exc}{extra}; re-listing and retrying",
+            file=sys.stderr, flush=True,
+        )
 
     @staticmethod
     def _key_of(path: str, obj: dict) -> str | None:
@@ -685,7 +746,8 @@ class KubeInformer:
                                 self._objs[path][key] = obj
                             self._changed.add(key)
             except (urllib.error.URLError, urllib.error.HTTPError,
-                    OSError, json.JSONDecodeError):
+                    OSError, json.JSONDecodeError) as e:
+                self._log_watch_failure(path, e)
                 rv = ""
                 if self._stop.wait(0.5):
                     return
@@ -756,8 +818,10 @@ class KubeInformer:
 
     def bind(self, pod_name: str, node_name: str) -> None:
         self.client.bind(pod_name, node_name)
-        self.bind_count += 1
         with self._lock:
+            # Counter under the lock: the host issues binds from a
+            # thread pool (round 6) and bare += loses increments.
+            self.bind_count += 1
             obj = self._objs[self._POD_PATH].get(pod_name)
             if obj is not None:
                 obj.setdefault("spec", {})["nodeName"] = node_name
@@ -766,7 +830,6 @@ class KubeInformer:
     def delete_pod(self, pod_name: str) -> bool:
         ok = self.client.delete_pod(pod_name)
         if ok:
-            self.delete_count += 1
             # Assume-delete only on success: a False return can mean
             # PDB-blocked (HTTP 429) with the pod STILL RUNNING — and
             # since the object never changes, no watch event would ever
@@ -775,6 +838,7 @@ class KubeInformer:
             # pod-already-gone case needs no pop either: its DELETED
             # event handles it.)
             with self._lock:
+                self.delete_count += 1
                 if self._objs[self._POD_PATH].pop(pod_name, None) is not None:
                     self._changed.add(pod_name)
         return ok

@@ -33,18 +33,12 @@ scheduleOne loop.
 from __future__ import annotations
 
 import time
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Iterator
 
 import numpy as np
 
 from tpusched.engine import Engine, SolveResult
 from tpusched.snapshot import ClusterSnapshot
-
-
-def _unpack(engine: Engine, snap: ClusterSnapshot, buf) -> SolveResult:
-    """Packed-buffer decode — single layout authority is Engine.unpack."""
-    return engine.unpack(snap, buf)
 
 
 def solve_stream(
@@ -61,38 +55,24 @@ def solve_stream(
     The generator keeps exactly one batch in flight on the device while
     the host decodes the next (double buffering): dispatch(k) ->
     decode(k+1) -> fetch(k) -> dispatch(k+1) -> ...
+
+    Round 6: the dispatch + background-fetch mechanics moved INTO the
+    engine (Engine.solve_async, one shared ordered fetch worker), so
+    this generator is now just the stream-shaped driver and the SAME
+    overlap serves single requests in rpc/server.py's staged handlers.
     """
     decode = decode or (lambda item: item)
 
-    def fetch(buf):
-        # Completion time measured INSIDE the worker so solve_seconds
-        # covers dispatch->fetch-done (same meaning as Engine.solve's
-        # field), not the main thread's decode of the next batch.
-        out = np.asarray(buf)
-        return out, time.perf_counter()
-
-    with ThreadPoolExecutor(max_workers=1) as pool:
-        in_flight = None  # (Future[(np buffer, done_t)], snap, meta, t0)
-        for item in batches:
-            snap, meta = decode(item)  # overlaps the in-flight fetch
-            if in_flight is not None:
-                fut, psnap, pmeta, t0 = in_flight
-                raw, done_t = fut.result()
-                res = _unpack(engine, psnap, raw)
-                res.solve_seconds = done_t - t0
-                yield pmeta, res
-            t0 = time.perf_counter()
-            snap = engine.put(snap)
-            buf = engine._solve_packed_jit(snap)  # async dispatch
-            # The background np.asarray drives execution on fetch-driven
-            # transports and releases the GIL during the wait either way.
-            in_flight = (pool.submit(fetch, buf), snap, meta, t0)
+    in_flight = None  # (meta, PendingFetch)
+    for item in batches:
+        snap, meta = decode(item)  # overlaps the in-flight fetch
         if in_flight is not None:
-            fut, psnap, pmeta, t0 = in_flight
-            raw, done_t = fut.result()
-            res = _unpack(engine, psnap, raw)
-            res.solve_seconds = done_t - t0
-            yield pmeta, res
+            pmeta, pending = in_flight
+            yield pmeta, pending.result()
+        in_flight = (meta, engine.solve_async(engine.put(snap)))
+    if in_flight is not None:
+        pmeta, pending = in_flight
+        yield pmeta, pending.result()
 
 
 def bench_overlap(

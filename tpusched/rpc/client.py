@@ -122,6 +122,25 @@ class SchedulerClient:
             timeout=self.timeout,
         )
 
+    def assign_future(self, snapshot: pb.ClusterSnapshot, *,
+                      packed_ok: bool = False):
+        """Non-blocking Assign: returns a grpc Future. With the
+        sidecar's staged handlers (decode outside the dispatch lane), a
+        second in-flight request is what lets ONE client overlap its
+        next request's decode with the previous solve — see
+        AssignPipeline."""
+        return self._assign.future(
+            pb.AssignRequest(snapshot=snapshot, packed_ok=packed_ok),
+            timeout=self.timeout,
+        )
+
+    def assign_delta_future(self, delta: pb.SnapshotDelta, *,
+                            packed_ok: bool = False):
+        return self._assign.future(
+            pb.AssignRequest(delta=delta, packed_ok=packed_ok),
+            timeout=self.timeout,
+        )
+
     def score_batch_delta(self, delta: pb.SnapshotDelta, *,
                           packed_ok: bool = False,
                           top_k: int = 0) -> pb.ScoreResponse:
@@ -247,11 +266,7 @@ class DeltaSession:
             st = prebuilt
         else:
             st = codec.SnapshotStore()
-            st.nodes = {n.name: n.SerializeToString() for n in snapshot.nodes}
-            st.pods = {p.name: p.SerializeToString() for p in snapshot.pods}
-            st.running = {
-                r.name: r.SerializeToString() for r in snapshot.running
-            }
+            st.set_full_bytes(snapshot)
         self._base = st
         self._base_id = sid
 
@@ -277,3 +292,131 @@ class DeltaSession:
             lambda d: self.client.score_batch_delta(d, **kw),
             changed=changed,
         )
+
+
+class StaleBase(Exception):
+    """An in-flight pipelined delta named a base the sidecar no longer
+    holds (restart / LRU eviction). The caller still has its current
+    snapshot: re-pin by submitting it with changed=None (a full send).
+    `completed` carries the responses that HAD already been received
+    before the stale request — earlier cycles' assignments are handed
+    to the caller, not dropped in the unwind."""
+
+    def __init__(self, msg: str, completed=()):
+        super().__init__(msg)
+        self.completed: list = list(completed)
+
+
+class AssignPipeline:
+    """Single-connection pipelined Assign (SURVEY.md §2.3 PP at the
+    serving boundary): keep up to `depth` requests in flight on ONE
+    channel so the sidecar's staged handlers overlap request k+1's
+    decode with request k's solve — the single-scheduler deployment
+    gets the overlap the two-session wire bench measured, without a
+    second scheduler.
+
+    Delta discipline: DeltaSession advances its base every response,
+    but a pipelined delta k+1 cannot diff against snapshot k — k's
+    snapshot_id is unknown until its response arrives. Instead the base
+    is PINNED: every in-flight delta names the same pinned base and
+    carries the CUMULATIVE churn since the pin (the server's LRU
+    refreshes the pinned store on every hit, keeping it alive). The pin
+    refreshes with a full send (draining the pipe first — the response
+    carries the new id) when cumulative churn passes refresh_frac of
+    the record count, bounding delta growth at O(cumulative churn).
+
+    For streams of independent or slowly-churning snapshots (replay,
+    bench, many-cluster fan-in, a scheduler pipelining speculative
+    cycles). One cluster's strictly serial feedback cycles cannot be
+    pipelined — same limit as pipeline.solve_stream documents."""
+
+    def __init__(self, client: SchedulerClient, depth: int = 2,
+                 refresh_frac: float = 0.25):
+        self.client = client
+        self.depth = max(1, int(depth))
+        self.refresh_frac = refresh_frac
+        self._pinned: codec.SnapshotStore | None = None
+        self._pinned_id: str | None = None
+        self._churn: set = set()
+        self._inflight: list = []
+        self.full_sends = 0
+        self.delta_sends = 0
+        self.bytes_sent = 0
+
+    def _join(self, fut) -> pb.AssignResponse:
+        try:
+            return fut.result()
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
+                self._pinned = self._pinned_id = None
+                self._drop_inflight()
+                raise StaleBase(str(e)) from e
+            raise
+
+    def _drop_inflight(self):
+        for f in self._inflight:
+            f.cancel()
+        self._inflight = []
+
+    def submit(self, snapshot: pb.ClusterSnapshot,
+               changed: "set[str] | None" = None,
+               packed_ok: bool = True) -> list[pb.AssignResponse]:
+        """Enqueue one cycle; returns the responses this call completed
+        (drained oldest-first; possibly empty while the pipe fills).
+        changed: names mutated since the LAST submit, or None to force
+        a full send (also the re-pin path). The delta is serialized
+        BEFORE returning, so the caller may mutate `snapshot` in place
+        between submits."""
+        n_rec = (len(snapshot.nodes) + len(snapshot.pods)
+                 + len(snapshot.running))
+        churn_next = (
+            self._churn | set(changed) if changed is not None else None
+        )
+        if (
+            self._pinned is None or churn_next is None
+            or len(churn_next) > self.refresh_frac * max(n_rec, 1)
+            or not codec.delta_safe(snapshot)
+        ):
+            done = self.flush()
+            resp = self.client.assign(snapshot, packed_ok=packed_ok)
+            self.full_sends += 1
+            self.bytes_sent += snapshot.ByteSize()
+            if resp.snapshot_id and codec.delta_safe(snapshot):
+                st = codec.SnapshotStore()
+                st.set_full_bytes(snapshot)
+                self._pinned, self._pinned_id = st, resp.snapshot_id
+                self._churn = set()
+            else:
+                self._pinned = self._pinned_id = None
+            done.append(resp)
+            return done
+        self._churn = churn_next
+        delta = codec.delta_between(
+            self._pinned, snapshot, self._pinned_id, changed=self._churn
+        )
+        self.bytes_sent += delta.ByteSize()
+        self._inflight.append(
+            self.client.assign_delta_future(delta, packed_ok=packed_ok)
+        )
+        self.delta_sends += 1
+        done = []
+        while len(self._inflight) >= self.depth:
+            self._join_into(done)
+        return done
+
+    def flush(self) -> list[pb.AssignResponse]:
+        """Drain every in-flight request, oldest first."""
+        out: list = []
+        while self._inflight:
+            self._join_into(out)
+        return out
+
+    def _join_into(self, done: list) -> None:
+        """Join the oldest in-flight request into `done`; on StaleBase
+        the already-joined responses ride the exception (`completed`)
+        instead of being lost in the unwind."""
+        try:
+            done.append(self._join(self._inflight.pop(0)))
+        except StaleBase as e:
+            e.completed = list(done) + e.completed
+            raise

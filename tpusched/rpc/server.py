@@ -6,6 +6,17 @@ Service stubs are hand-wired with grpc generic handlers (the image has
 protoc + grpcio but no grpc_tools codegen); the method table mirrors
 protos/tpusched.proto's service block.
 
+Request handling is STAGED (round 6, SURVEY.md §2.3 PP in-request):
+decode runs outside the device dispatch lane (concurrent across
+handler threads), dispatch holds the lane just long enough to enqueue
+the program (Engine.solve_async / score_topk_async — one ordered
+background fetch worker), and the response's name tables build while
+the device runs. A single pipelined connection (client
+AssignPipeline, depth 2) therefore overlaps request k+1's decode with
+request k's solve — the overlap that previously required two
+concurrent schedulers — and even a strictly sequential client gets
+its response scaffolding for free inside the device window.
+
 Observability (SURVEY.md §5): every batch emits one structured JSON log
 line (sizes, rounds, per-phase seconds, placements/sec) on stderr, and
 the Metrics rpc serves Prometheus text with upstream-compatible metric
@@ -147,6 +158,16 @@ class SchedulerService:
         self._store_lock = threading.Lock()
         self._stores: dict[str, SnapshotStore] = {}  # LRU by insertion
         self._next_store = 0
+        # Device dispatch lane (round 6, in-request decode<->solve
+        # overlap): handlers decode OUTSIDE the lane (pure CPU, runs
+        # concurrently on the gRPC thread pool), hold the lane only to
+        # DISPATCH, then build their response scaffolding while the
+        # engine's background worker fetches. Request k+1's decode and
+        # dispatch therefore overlap request k's in-flight solve even
+        # on a single pipelined connection; the lane plus the engine's
+        # single ordered fetch worker keep dispatch order == fetch
+        # order, which fetch-driven transports require.
+        self._dispatch_lane = threading.Lock()
 
     def _register_store(self, store: SnapshotStore) -> str:
         with self._store_lock:
@@ -243,28 +264,42 @@ class SchedulerService:
         msg, sid = self._resolve(request, context)
         snap, meta, decode_s = self._decode(msg)
         resp = pb.ScoreResponse(snapshot_id=sid)
-        resp.pod_names.extend(meta.pod_names)
-        resp.node_names.extend(meta.node_names)
         P, N = meta.n_pods, meta.n_nodes
+        # Staged (see the lane comment in __init__): dispatch the device
+        # work for whichever form was requested, then build the response
+        # name tables — ONE authority, below — while the fetch is in
+        # flight. Both forms fetch through the engine's ordered worker:
+        # a handler-thread fetch would race a pipelined Assign's
+        # in-flight fetch on fetch-driven transports.
+        pending_topk = pending_full = None
+        k = 0
         if request.top_k > 0:
             # O(P) response: top-k computed on device, [P,N] never
             # fetched. The only form that serves the headline shape
             # under budget on bandwidth-limited links. A drained
             # cluster (N == 0) has nothing to rank: k stays 0 with no
             # rows, which the client decodes as [P, 0] arrays.
-            solve_s = 0.0
             if N > 0:
                 k = min(int(request.top_k), N)
-                idx, val, solve_s = self._engine.score_topk(snap, k)
-                resp.k = k
-                resp.topk_idx_packed = np.ascontiguousarray(
-                    idx[:P], dtype="<i4"
-                ).tobytes()
-                resp.topk_score_packed = np.ascontiguousarray(
-                    val[:P], dtype="<f4"
-                ).tobytes()
+                with self._dispatch_lane:
+                    pending_topk = self._engine.score_topk_async(snap, k)
         else:
-            res = self._engine.score(snap)
+            with self._dispatch_lane:
+                pending_full = self._engine.score_async(snap)
+        resp.pod_names.extend(meta.pod_names)
+        resp.node_names.extend(meta.node_names)
+        solve_s = 0.0
+        if pending_topk is not None:
+            idx, val, solve_s = pending_topk.result()
+            resp.k = k
+            resp.topk_idx_packed = np.ascontiguousarray(
+                idx[:P], dtype="<i4"
+            ).tobytes()
+            resp.topk_score_packed = np.ascontiguousarray(
+                val[:P], dtype="<f4"
+            ).tobytes()
+        elif pending_full is not None:
+            res = pending_full.result()
             solve_s = res.solve_seconds
             if request.packed_ok and P * N >= PACK_CELLS:
                 resp.feasible_packed = np.ascontiguousarray(
@@ -285,9 +320,24 @@ class SchedulerService:
     def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
         msg, sid = self._resolve(request, context)
         snap, meta, decode_s = self._decode(msg)
-        res = self._engine.solve(snap)
+        # Staged handling (round 6): decode ran OUTSIDE the lane (so a
+        # concurrent request's decode overlaps this solve), dispatch
+        # holds the lane only long enough to enqueue the program, and
+        # the response's name tables build while the engine's worker
+        # drives the device and fetches the packed buffer.
+        with self._dispatch_lane:
+            pending = self._engine.solve_async(snap)
         resp = pb.AssignResponse(snapshot_id=sid)
         P = meta.n_pods
+        if request.packed_ok:
+            # Name tables now, result arrays after the join: the two
+            # string extends are the response's CPU-heavy part at 10k
+            # pods and ride inside the device window for free.
+            resp.pod_names.extend(meta.pod_names)
+            # Indices resolve against the DECODER's canonical (sorted)
+            # node order, not the request's wire order — ship the table.
+            resp.node_names.extend(meta.node_names)
+        res = pending.result()
         ni = np.asarray(res.assignment[:P], dtype=np.int32)
         sc = np.asarray(res.chosen_score[:P], dtype=np.float32).copy()
         sc[~np.isfinite(sc)] = 0.0  # -inf (unplaced/preempted) -> 0
@@ -296,10 +346,6 @@ class SchedulerService:
         if request.packed_ok:
             # Parallel-array form: three tobytes() instead of P Python
             # message constructions (~30 ms saved at 10k pods).
-            resp.pod_names.extend(meta.pod_names)
-            # Indices resolve against the DECODER's canonical (sorted)
-            # node order, not the request's wire order — ship the table.
-            resp.node_names.extend(meta.node_names)
             resp.node_idx_packed = ni.astype("<i4").tobytes()
             resp.score_packed = sc.astype("<f4").tobytes()
             resp.commit_key_packed = ck.astype("<i4").tobytes()
