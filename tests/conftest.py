@@ -31,6 +31,8 @@ if _env_on("TPUSCHED_DEBUG_NANS"):
 if _env_on("TPUSCHED_DEBUG_CHECKS"):
     jax.config.update("jax_disable_most_optimizations", True)
 
+import time
+
 import numpy as np
 import pytest
 
@@ -38,6 +40,33 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture
+def thread_leak_check():
+    """Multi-client/concurrency tests opt in: asserts every NEW
+    tpusched-* worker thread spawned during the test has exited by the
+    end (i.e. Engine.close / SchedulerService.close actually drained).
+    Threads predating the test (module-scoped servers) are exempt."""
+    import threading
+
+    # Keyed by Thread OBJECT, not ident: the OS recycles idents, and a
+    # leaked worker created with a recycled ident would otherwise be
+    # silently exempted.
+    before = set(threading.enumerate())
+
+    def leaked():
+        return [
+            t for t in threading.enumerate()
+            if t not in before and t.is_alive()
+            and t.name.startswith("tpusched-")
+        ]
+
+    yield
+    deadline = time.monotonic() + 5.0
+    while leaked() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert leaked() == [], f"leaked worker threads: {leaked()}"
 
 
 def pytest_configure(config):

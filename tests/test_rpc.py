@@ -2,6 +2,8 @@
 process-style client gets ScoreBatch/Assign answers over the wire that
 match the in-process engine and oracle; golden proto round-trips."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -46,6 +48,7 @@ def server_and_client():
     yield client, svc
     client.close()
     server.stop(0)
+    svc.close()  # drain the engine's fetch worker (no thread leaks)
 
 
 def test_proto_golden_roundtrip():
@@ -374,3 +377,338 @@ def test_assign_pipeline_single_connection_matches_sequential():
         seq_client.close()
         pipe_client.close()
         server.stop(0)
+
+
+def test_score_pipeline_single_connection_matches_sequential():
+    """ScorePipeline (round 7, satellite of the coalesced-serving PR):
+    depth-2 pinned-base top-k ScoreBatch pipelining must produce, cycle
+    for cycle, exactly the responses a sequential client gets for the
+    same snapshot versions — same contract AssignPipeline pinned in
+    round 6, now for the Score-plugin surface."""
+    from tpusched.rpc.client import ScorePipeline, score_topk_arrays
+
+    server, port, svc = make_server("127.0.0.1:0")
+    server.start()
+    seq_client = SchedulerClient(f"127.0.0.1:{port}")
+    pipe_client = SchedulerClient(f"127.0.0.1:{port}")
+    try:
+        msg = _wire_snapshot()
+        versions = []
+        for it in range(6):
+            msg.pods[it % 2].priority = float(100 + it)
+            versions.append(pb.ClusterSnapshot.FromString(
+                msg.SerializeToString()
+            ))
+        seq = [
+            score_topk_arrays(seq_client.score_batch(v, top_k=2))
+            for v in versions
+        ]
+        pipe = ScorePipeline(pipe_client, depth=2, top_k=2)
+        msg2 = _wire_snapshot()
+        pipe.submit(msg2, changed=None)  # pin on the UNMUTATED base
+        got_resps = []
+        for it in range(6):
+            p = msg2.pods[it % 2]
+            p.priority = float(100 + it)
+            got_resps += pipe.submit(msg2, changed={p.name})
+        got_resps += pipe.flush()
+        got = [score_topk_arrays(r) for r in got_resps]
+        assert pipe.delta_sends > 0, "pipeline never took the delta path"
+        assert len(got) == len(seq)
+        for (si, sv), (gi, gv) in zip(seq, got):
+            np.testing.assert_array_equal(si, gi)
+            np.testing.assert_array_equal(sv, gv)
+    finally:
+        seq_client.close()
+        pipe_client.close()
+        server.stop(0)
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Round 7: multi-client coalesced serving.
+# ---------------------------------------------------------------------------
+
+
+def _strip_sid(resp):
+    """Comparable form of a response minus snapshot_id (coalesced
+    followers answer with the LEADER's sid, sequential replays mint
+    fresh ids) and minus solve_seconds (wall-clock) — every DECISION
+    byte must be identical."""
+    c = type(resp).FromString(resp.SerializeToString())
+    c.snapshot_id = ""
+    if hasattr(c, "solve_seconds"):
+        c.solve_seconds = 0.0
+    return c.SerializeToString()
+
+
+def _client_workload(client, base_msg, cycles, assign_every=2):
+    """One client's deterministic mixed Assign/ScoreBatch delta stream;
+    returns the stripped response bytes, in order."""
+    from tpusched.rpc.client import DeltaSession
+
+    sess = DeltaSession(client)
+    msg = pb.ClusterSnapshot.FromString(base_msg.SerializeToString())
+    out = [_strip_sid(sess.assign(msg, packed_ok=True))]
+    for it in range(cycles):
+        p = msg.pods[it % len(msg.pods)]
+        p.priority = float(10 + it)
+        changed = {p.name}
+        if it % assign_every == 0:
+            r = sess.assign(msg, packed_ok=True, changed=changed)
+        else:
+            r = sess.score_batch(msg, top_k=1 + it % 3, changed=changed)
+        out.append(_strip_sid(r))
+    return out
+
+
+def test_concurrent_mixed_clients_match_sequential(thread_leak_check):
+    """THE coalescer/gate equivalence gate (acceptance criterion):
+    N threads issuing mixed Assign/ScoreBatch against one server get
+    responses byte-identical (minus snapshot_id) to the same workload
+    run sequentially — concurrency is a latency feature, never a
+    semantics change. All clients run the SAME deterministic workload,
+    so their response streams must also be identical to each other."""
+    import threading
+
+    server, port, svc = make_server("127.0.0.1:0")
+    server.start()
+    msg = _wire_snapshot()
+    try:
+        with SchedulerClient(f"127.0.0.1:{port}") as c:
+            sequential = _client_workload(c, msg, cycles=8)
+        results = {}
+        errors = []
+
+        def worker(i):
+            try:
+                with SchedulerClient(f"127.0.0.1:{port}") as c:
+                    results[i] = _client_workload(c, msg, cycles=8)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == [], errors
+        for i, got in results.items():
+            assert got == sequential, f"client {i} diverged"
+    finally:
+        server.stop(0)
+        svc.close()
+
+
+def test_coalescer_fuses_identical_score_deltas(thread_leak_check):
+    """Deterministic fusion: while the dispatch gate is held busy, K
+    concurrent ScoreBatch requests carrying the SAME delta bytes (but
+    different top_k) must fuse into ONE dispatch — K-1 followers — and
+    each caller's sliced top-k must equal a direct unfused request."""
+    import threading
+    import time as _time
+
+    from tpusched.rpc.client import score_topk_arrays
+
+    server, port, svc = make_server("127.0.0.1:0")
+    server.start()
+    client = SchedulerClient(f"127.0.0.1:{port}")
+    try:
+        msg = _wire_snapshot()
+        sid = client.score_batch(msg, top_k=1).snapshot_id
+        assert sid
+        delta = pb.SnapshotDelta(base_id=sid)
+        up = delta.upsert_pods.add()
+        up.CopyFrom(msg.pods[0])
+        up.priority = 123.0
+        K = 4
+        results = {}
+        errors = []
+
+        def worker(i):
+            try:
+                results[i] = client.score_batch_delta(delta, top_k=1 + i)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        lead0 = svc._coalescer.lead_requests
+        with svc._gate.slot("test-hog"):
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(K)]
+            for t in threads:
+                t.start()
+            # Wait until all K joined the fusion (one leader blocked at
+            # the gate, K-1 followers waiting on its publish).
+            deadline = _time.monotonic() + 10
+            while _time.monotonic() < deadline:
+                with svc._coalescer._lock:
+                    pend = list(svc._coalescer._pending.values())
+                if pend and len(pend[0]._ks) == K:
+                    break
+                _time.sleep(0.01)
+            else:
+                raise AssertionError("fusion never gathered all callers")
+        for t in threads:
+            t.join()
+        assert errors == [], errors
+        assert svc._coalescer.lead_requests == lead0 + 1
+        assert svc._coalescer.fused_requests >= K - 1
+        # Every caller's k-slice equals a direct (unfused) request.
+        for i, resp in results.items():
+            direct = client.score_batch_delta(delta, top_k=1 + i)
+            np.testing.assert_array_equal(
+                np.stack(score_topk_arrays(resp)),
+                np.stack(score_topk_arrays(direct)),
+            )
+            assert resp.k == direct.k
+    finally:
+        client.close()
+        server.stop(0)
+        svc.close()
+
+
+def test_dispatch_gate_round_robin_and_bounds():
+    """Unit: the gate serves client queue heads round-robin (a flood
+    from one client cannot starve another) and refuses admission past
+    the per-client cap."""
+    import threading
+
+    from tpusched.rpc.server import _DispatchGate, _Overloaded
+
+    gate = _DispatchGate(max_waiting_per_client=4, max_waiting=16)
+    served = []
+    hold = threading.Event()
+
+    def use(client, tag):
+        with gate.slot(client):
+            served.append(tag)
+
+    # Occupy the slot, queue a flood from A and one from B, release.
+    entered = threading.Event()
+
+    def holder():
+        with gate.slot("hold"):
+            entered.set()
+            hold.wait()
+
+    ht = threading.Thread(target=holder)
+    ht.start()
+    entered.wait()
+    threads = []
+    for i in range(3):
+        t = threading.Thread(target=use, args=("A", f"A{i}"))
+        t.start()
+        threads.append(t)
+        while True:  # FIFO within A needs deterministic enqueue order
+            with gate._cv:
+                if gate._waiting >= i + 1:
+                    break
+    tb = threading.Thread(target=use, args=("B", "B0"))
+    tb.start()
+    threads.append(tb)
+    while True:
+        with gate._cv:
+            if gate._waiting == 4:
+                break
+    hold.set()
+    ht.join()
+    for t in threads:
+        t.join()
+    # B's single request must NOT be served last despite A's flood.
+    assert served.index("B0") < len(served) - 1
+    assert served.index("A0") < served.index("A1") < served.index("A2")
+
+    # Bounded admission: per-client cap refuses the 5th queued entry.
+    gate2 = _DispatchGate(max_waiting_per_client=1, max_waiting=16)
+    entered2 = threading.Event()
+    release2 = threading.Event()
+
+    def holder2():
+        with gate2.slot("X"):
+            entered2.set()
+            release2.wait()
+
+    h2 = threading.Thread(target=holder2)
+    h2.start()
+    entered2.wait()
+    overflow = []
+
+    def try_overflow():
+        try:
+            with gate2.slot("X"):
+                pass
+        except _Overloaded as e:
+            overflow.append(e)
+
+    t1 = threading.Thread(target=try_overflow)
+    t1.start()
+    while True:
+        with gate2._cv:
+            if gate2._waiting == 1:
+                break
+    t2 = threading.Thread(target=try_overflow)
+    t2.start()
+    t2.join()
+    assert overflow, "second queued entry should have been refused"
+    release2.set()
+    h2.join()
+    t1.join()
+
+
+def test_engine_close_drains_inflight_fetch(thread_leak_check):
+    """Engine.close(wait=True) completes in-flight PendingFetch work
+    before returning, and submits after close fail loudly."""
+    from tpusched.rpc.codec import snapshot_from_proto
+
+    eng = Engine(EngineConfig())
+    snap, _ = snapshot_from_proto(_wire_snapshot(), EngineConfig())
+    pending = eng.solve_async(snap)
+    eng.close(wait=True)
+    res = pending.result()   # already fetched by the drain
+    assert res.assignment.shape[0] >= 2
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.solve_async(snap)
+
+
+def test_multiclient_smoke(thread_leak_check):
+    """Tier-1 concurrency smoke (bounded ~2s on CPU): 4 clients x 25
+    mixed delta cycles against one sidecar — races introduced by the
+    lane removal (gate, coalescer, device sessions) surface here on
+    every run. All clients run the same deterministic workload, so all
+    four response streams must be identical."""
+    import threading
+
+    server, port, svc = make_server("127.0.0.1:0")
+    server.start()
+    msg = _wire_snapshot()
+    try:
+        results = {}
+        errors = []
+
+        def worker(i):
+            try:
+                with SchedulerClient(f"127.0.0.1:{port}") as c:
+                    results[i] = _client_workload(c, msg, cycles=24)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert errors == [], errors
+        first = results[0]
+        assert len(first) == 25
+        for i in range(1, 4):
+            assert results[i] == first, f"client {i} diverged"
+        # Soft budget: tiny solves; far under the tier-1 wall even on a
+        # loaded 2-core box.
+        assert wall < 60, f"smoke took {wall:.1f}s"
+    finally:
+        server.stop(0)
+        svc.close()

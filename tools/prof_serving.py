@@ -1,0 +1,144 @@
+"""Scratch profiler for the multi-client fan-in serving path (round 7).
+
+Spins up the sidecar in-process, drives K concurrent DeltaSession
+clients (own connections, own lineages) through churn->Assign cycles,
+and prints per-phase numbers plus the device-residency and dispatch-
+queue counters that explain them:
+
+  python tools/prof_serving.py [pods] [nodes]
+
+Knobs (env):
+  PROF_CPU=1        force the CPU backend (jax_platforms=cpu)
+  PROF_CLIENTS=K    concurrent connections          (default 4)
+  PROF_CYCLES=N     cycles per client               (default 20)
+  PROF_CHURN=C      pods mutated per cycle          (default pods//100)
+  PROF_SESSIONS=S   device-session cap, 0 disables  (default 8)
+
+With PROF_SESSIONS=0 the sidecar serves every delta through
+recompose-bytes -> full decode -> full H2D — the before/after of
+device-resident state is the difference between the two runs.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import threading
+import time
+
+import numpy as np
+
+if os.environ.get("PROF_CPU"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+from tpusched.config import EngineConfig
+from tpusched.rpc.client import (
+    DeltaSession,
+    SchedulerClient,
+    assign_response_arrays,
+)
+from tpusched.rpc.codec import snapshot_to_proto
+from tpusched.rpc.server import make_server
+from tpusched.synth import config2_scale
+
+
+def main():
+    pods = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 5_000
+    K = int(os.environ.get("PROF_CLIENTS", "4"))
+    cycles = int(os.environ.get("PROF_CYCLES", "20"))
+    churn = int(os.environ.get("PROF_CHURN", str(max(1, pods // 100))))
+    cap = int(os.environ.get("PROF_SESSIONS", "8"))
+
+    rng = np.random.default_rng(7)
+    nrec, prec, rrec = config2_scale(rng, pods, nodes, with_qos=True,
+                                     as_records=True)
+    base = snapshot_to_proto(nrec, prec, rrec)
+    print(f"{pods}x{nodes}, {K} clients x {cycles} cycles, "
+          f"churn {churn}/cycle, device sessions {cap}")
+
+    server, port, svc = make_server(config=EngineConfig(mode="fast"),
+                                    device_sessions=cap)
+    server.start()
+    clients = [SchedulerClient(f"127.0.0.1:{port}") for _ in range(K)]
+    try:
+        msgs = [type(base).FromString(base.SerializeToString())
+                for _ in range(K)]
+        sessions = [DeltaSession(c) for c in clients]
+        rngs = [np.random.default_rng(100 + i) for i in range(K)]
+
+        def one_cycle(i):
+            names = set()
+            for j in rngs[i].choice(pods, size=churn, replace=False):
+                p = msgs[i].pods[int(j)]
+                p.observed_availability = float(rngs[i].uniform(0.5, 1.0))
+                names.add(p.name)
+            resp = sessions[i].assign(msgs[i], packed_ok=True,
+                                      changed=names)
+            assign_response_arrays(resp)
+
+        t0 = time.perf_counter()
+        for i in range(K):
+            sessions[i].assign(msgs[i], packed_ok=True)
+            one_cycle(i)
+        print(f"warmup (compile + {K} lineage seeds): "
+              f"{time.perf_counter() - t0:.1f}s")
+
+        seq = []
+        for _ in range(cycles):
+            t0 = time.perf_counter()
+            one_cycle(0)
+            seq.append(time.perf_counter() - t0)
+        seq = np.asarray(seq) * 1e3
+        print(f"sequential 1-client: p50={np.percentile(seq, 50):.1f}ms "
+              f"p99={np.percentile(seq, 99):.1f}ms "
+              f"({1e3 / np.percentile(seq, 50):.2f} qps)")
+
+        lat = [[] for _ in range(K)]
+
+        def drive(i):
+            for _ in range(cycles):
+                t0 = time.perf_counter()
+                one_cycle(i)
+                lat[i].append(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=drive, args=(i,))
+                   for i in range(K)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        alllat = np.asarray([x for l in lat for x in l]) * 1e3
+        print(f"{K}-client fan-in: {K * cycles / wall:.2f} qps aggregate "
+              f"({K * cycles / wall * np.percentile(seq, 50) / 1e3:.2f}x "
+              f"sequential), per-request p50={np.percentile(alllat, 50):.1f}"
+              f"ms p99={np.percentile(alllat, 99):.1f}ms")
+        print(f"gate: served={svc._gate.served} "
+              f"peak_waiting={svc._gate.peak_waiting}")
+        print(f"sessions: hits={svc.session_hits} seeds={svc.session_seeds}"
+              f" misses={svc.session_misses}")
+        with svc._store_lock:
+            devs = []
+            for s in svc._sessions.values():
+                if s not in devs:
+                    devs.append(s)
+        for i, s in enumerate(devs):
+            d = s.device
+            print(f"  lineage {i}: full_uploads={d.full_uploads} "
+                  f"delta_updates={d.delta_updates} "
+                  f"rebuilds={d.rebuilds}{d.rebuild_reasons} "
+                  f"h2d_last={d.h2d_bytes_last}B "
+                  f"full={d.full_bytes}B "
+                  f"({d.full_bytes / max(d.h2d_bytes_last, 1):.0f}x)")
+    finally:
+        for c in clients:
+            c.close()
+        server.stop(None)
+        svc.close()
+
+
+if __name__ == "__main__":
+    main()

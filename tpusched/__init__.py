@@ -29,6 +29,7 @@ from tpusched.snapshot import (
     AtomTable,
 )
 from tpusched.engine import Engine, SolveResult
+from tpusched.device_state import DeviceSnapshot
 
 __version__ = "0.1.0"
 
@@ -47,4 +48,5 @@ __all__ = [
     "AtomTable",
     "Engine",
     "SolveResult",
+    "DeviceSnapshot",
 ]

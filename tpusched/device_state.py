@@ -1,0 +1,702 @@
+"""Device-resident cluster state (SURVEY.md §7 hard part 6, serving
+form): after the first upload, delta cycles mutate the ON-DEVICE
+snapshot in place instead of rebuilding + re-uploading the cluster.
+
+This is the scheduling analogue of what continuous-batching LLM servers
+(Orca-style iteration scheduling, vLLM's paged KV state) do with model
+state: keep the big arrays resident on the accelerator, apply each
+cycle's churn as scatter updates, and let the host do O(churn) work per
+cycle instead of O(cluster).
+
+Per delta cycle the host:
+  * normalizes + interns only the CHURNED records against a persistent
+    `_Interner` (vocabulary appends; ids already burned into device
+    arrays stay valid),
+  * re-encodes only the churned rows into the numpy mirror
+    (snapshot.py's shared row fills),
+  * ships those rows (plus, when insertion/removal shifted the
+    name-sorted row order, one int32 permutation per collection) and
+    applies them with `kernels.assign.scatter_rows` /
+    `permute_rows` — `.at[idx].set` XLA scatters over whole
+    struct-of-arrays groups.
+
+Anything the row model cannot express incrementally falls back to a
+full SnapshotBuilder rebuild + re-upload, counted and reasoned:
+bucket overflow (rows or any feature axis), a NEW taint (the [P, VT]
+tolerated matrix gains a column for every pod), or a NEW topology key
+(the [N, TK] domain matrix gains a column for every node).
+
+Invariants (the delta-vs-rebuild parity tests pin these):
+  * Row order is ALWAYS name-sorted per collection — exactly the
+    canonical order the wire decoder uses — so index-based tie-breaks
+    are a function of cluster STATE, not of the delta history, and a
+    fallback/rebuild produces identical results.
+  * Value-only churn produces arrays BYTE-IDENTICAL to a fresh
+    `SnapshotBuilder.build()` of the same records (same buckets).
+    Vocabulary-growing churn may assign different (opaque) intern ids
+    than a fresh build; solve/score results are unaffected.
+  * Node `used` rows are re-summed over the node's counted running
+    pods in name order on every touch — float-identical to a rebuild,
+    never drifting through += / -= pairs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Mapping
+
+import jax
+import numpy as np
+
+from tpusched.config import Buckets, EngineConfig
+from tpusched.kernels.assign import permute_rows, scatter_rows
+from tpusched.snapshot import (
+    ClusterSnapshot,
+    SnapshotBuilder,
+    SnapshotMeta,
+    _fill_node_row,
+    _fill_pod_row,
+    _fill_running_row,
+    _fill_atom_row,
+    _fill_sig_row,
+    _pad_node_row,
+    _pad_pod_row,
+    _pad_running_row,
+    _snapshot_from_arrays,
+)
+
+
+@dataclasses.dataclass
+class ApplyStats:
+    """What one apply() did and what it cost on the wire to the device."""
+
+    path: str                 # "delta" | "rebuild"
+    reason: str = ""          # rebuild trigger ("" on the delta path)
+    h2d_bytes: int = 0        # bytes actually shipped host->device
+    rows_scattered: int = 0   # churned+pad rows written across groups
+    reordered: bool = False   # a permutation gather ran
+
+
+class _NeedsRebuild(Exception):
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+def _tree_nbytes(tree) -> int:
+    return sum(int(l.nbytes) for l in jax.tree.leaves(tree))
+
+
+def _pad_pow2(idx: list[int]) -> np.ndarray:
+    """Pad a scatter index list to the next power of two by REPEATING
+    the first index: bounded jit-shape set, and the duplicate writes
+    carry identical row content so scatter order cannot matter."""
+    n = len(idx)
+    cap = 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+    out = np.full(cap, idx[0], np.int32)
+    out[:n] = idx
+    return out
+
+
+class DeviceSnapshot:
+    """One snapshot lineage resident on the device.
+
+    `full_load()` takes builder-style record dicts (the kwargs
+    SnapshotBuilder.add_* accept, plus 'name'; running records carry
+    both 'name' and 'node'), sorts them by name, builds, and uploads.
+    `apply()` upserts/removes records and updates the device arrays in
+    O(churn); `snap`/`meta` always reflect the latest applied state.
+
+    Not thread-safe: callers (the sidecar's DeviceSession) serialize
+    applies per lineage.
+    """
+
+    def __init__(self, config: EngineConfig | None = None,
+                 buckets: Buckets | None = None):
+        self.config = config or EngineConfig()
+        self._floor_buckets = buckets
+        # Raw record kwargs by name (rebuild source of truth) and the
+        # normalized forms row fills consume.
+        self._nodes: dict[str, dict] = {}
+        self._pods: dict[str, dict] = {}
+        self._running: dict[str, dict] = {}
+        self._norm_nodes: dict[str, dict] = {}
+        self._norm_pods: dict[str, dict] = {}
+        self._norm_running: dict[str, dict] = {}
+        self._run_anti: dict[str, list[int]] = {}   # name -> anti sig ids
+        self._pod_pc: dict[str, dict] = {}          # name -> compiled pod
+        # Name-sorted row orders (the decoder's canonical order).
+        self._node_order: list[str] = []
+        self._pod_order: list[str] = []
+        self._run_order: list[str] = []
+        # group name -> {pod name: min_member}; pdb key -> {run name: allowed}
+        self._group_members: dict[str, dict[str, int]] = {}
+        self._pdb_members: dict[tuple, dict[str, int]] = {}
+        # Reverse maps of the PREVIOUS state (see _refresh_prev_maps).
+        self._run_node_name: dict[str, str] = {}
+        self._pod_group_name: dict[str, str] = {}
+        self._run_pdb_key: dict[str, tuple] = {}
+        self._state = None          # snapshot.BuiltState
+        self._meta: SnapshotMeta | None = None
+        self._device: ClusterSnapshot | None = None
+        # Transfer accounting (the test/bench hook for the "no full H2D
+        # in steady state" acceptance).
+        self.full_uploads = 0
+        self.delta_updates = 0
+        self.rebuilds = 0
+        self.rebuild_reasons: list[str] = []
+        self.h2d_bytes_total = 0
+        self.h2d_bytes_last = 0
+
+    # -- views --------------------------------------------------------------
+
+    @property
+    def snap(self) -> ClusterSnapshot:
+        if self._device is None:
+            raise ValueError("DeviceSnapshot: full_load() first")
+        return self._device
+
+    @property
+    def meta(self) -> SnapshotMeta:
+        if self._meta is None:
+            raise ValueError("DeviceSnapshot: full_load() first")
+        return self._meta
+
+    @property
+    def full_bytes(self) -> int:
+        """Size of one full snapshot upload at current buckets."""
+        return _tree_nbytes(self.snap)
+
+    # -- load / rebuild -----------------------------------------------------
+
+    def full_load(self, nodes: Iterable[Mapping], pods: Iterable[Mapping],
+                  running: Iterable[Mapping]) -> ApplyStats:
+        """Replace all state with these records and upload."""
+        self._nodes = self._keyed(nodes, "node")
+        self._pods = self._keyed(pods, "pod")
+        self._running = self._keyed(running, "running pod")
+        self._rebuild_members()
+        return self._rebuild("full_load")
+
+    @staticmethod
+    def _keyed(records: Iterable[Mapping], kind: str) -> dict[str, dict]:
+        out: dict[str, dict] = {}
+        for rec in records:
+            name = rec.get("name")
+            if not name or name in out:
+                raise ValueError(
+                    f"device-resident state needs unique non-empty {kind} "
+                    f"names (offending: {name!r})"
+                )
+            out[name] = dict(rec)
+        return out
+
+    def _rebuild_members(self) -> None:
+        self._group_members = {}
+        for name, rec in self._pods.items():
+            g = rec.get("pod_group")
+            if g:
+                self._group_members.setdefault(g, {})[name] = int(
+                    rec.get("pod_group_min_member", 0)
+                )
+        self._pdb_members = {}
+        for name, rec in self._running.items():
+            g = rec.get("pdb_group")
+            if g:
+                key = (str(rec.get("namespace", "default")) or "default", g)
+                self._pdb_members.setdefault(key, {})[name] = int(
+                    rec.get("pdb_disruptions_allowed", 0)
+                )
+
+    def _refresh_prev_maps(self) -> None:
+        """Reverse maps the NEXT apply needs to find what a churned
+        record used to reference (old node, old group, old PDB)."""
+        self._run_node_name = {
+            name: rec["node"] for name, rec in self._running.items()
+        }
+        self._pod_group_name = {
+            name: rec.get("pod_group") for name, rec in self._pods.items()
+            if rec.get("pod_group")
+        }
+        self._run_pdb_key = {}
+        for key, members in self._pdb_members.items():
+            for name in members:
+                self._run_pdb_key[name] = key
+
+    def _rebuild(self, reason: str) -> ApplyStats:
+        """Full host rebuild + device re-upload (the fallback path).
+        Buckets floor at the PREVIOUS state's buckets so a lineage's
+        compile shapes never shrink mid-session (no recompile churn)."""
+        floor = self._state.buckets if self._state is not None \
+            else self._floor_buckets
+        b = SnapshotBuilder(self.config, floor)
+        self._node_order = sorted(self._nodes)
+        self._pod_order = sorted(self._pods)
+        self._run_order = sorted(self._running)
+        for name in self._node_order:
+            b.add_node(**self._nodes[name])
+        for name in self._pod_order:
+            b.add_pod(**self._pods[name])
+        for name in self._run_order:
+            rec = {k: v for k, v in self._running[name].items()
+                   if k != "name"}
+            b.add_running_pod(**rec)
+        snap_np, meta, state = b.build_state()
+        meta.running_names = list(self._run_order)
+        self._state = state
+        self._meta = meta
+        # Harvest the builder's normalized records + compiled forms so
+        # later incremental row re-encodes match build exactly.
+        self._norm_nodes = dict(zip(self._node_order, b._nodes))
+        self._norm_pods = dict(zip(self._pod_order, b._pods))
+        self._norm_running = dict(zip(self._run_order, b._running))
+        # Compiled forms cache only what churn touches; the build just
+        # burned every row, so start empty.
+        self._pod_pc = {}
+        self._run_anti = {}
+        self._refresh_prev_maps()
+        self._device = jax.device_put(snap_np)
+        nbytes = _tree_nbytes(snap_np)
+        self.full_uploads += 1
+        if reason != "full_load":
+            self.rebuilds += 1
+            self.rebuild_reasons.append(reason)
+        self.h2d_bytes_last = nbytes
+        self.h2d_bytes_total += nbytes
+        return ApplyStats(path="rebuild", reason=reason, h2d_bytes=nbytes)
+
+    # -- incremental apply --------------------------------------------------
+
+    def apply(
+        self,
+        upsert_nodes: Iterable[Mapping] = (),
+        remove_nodes: Iterable[str] = (),
+        upsert_pods: Iterable[Mapping] = (),
+        remove_pods: Iterable[str] = (),
+        upsert_running: Iterable[Mapping] = (),
+        remove_running: Iterable[str] = (),
+    ) -> ApplyStats:
+        if self._device is None:
+            raise ValueError("DeviceSnapshot: full_load() first")
+        upsert_nodes = [dict(r) for r in upsert_nodes]
+        upsert_pods = [dict(r) for r in upsert_pods]
+        upsert_running = [dict(r) for r in upsert_running]
+        remove_nodes = list(remove_nodes)
+        remove_pods = list(remove_pods)
+        remove_running = list(remove_running)
+        for coll, kind in ((upsert_nodes, "node"), (upsert_pods, "pod"),
+                           (upsert_running, "running pod")):
+            seen = set()
+            for rec in coll:
+                name = rec.get("name")
+                if not name or name in seen:
+                    raise ValueError(
+                        f"delta upserts need unique non-empty {kind} names "
+                        f"(offending: {name!r})"
+                    )
+                seen.add(name)
+        # Validate BEFORE committing anything: a running pod whose node
+        # is gone cannot be encoded (the fresh decoder raises the same
+        # way), and raising mid-apply must not leave records and device
+        # arrays disagreeing.
+        nodes_after = (set(self._nodes) | {r["name"] for r in upsert_nodes}
+                       ) - set(remove_nodes)
+        removed_r = set(remove_running)
+        upserted_r = {u["name"] for u in upsert_running}
+        check = list(upsert_running)
+        if remove_nodes:
+            check += [rec for name, rec in self._running.items()
+                      if name not in removed_r and name not in upserted_r]
+        for rec in check:
+            if rec["node"] not in nodes_after:
+                raise ValueError(
+                    f"running pod {rec.get('name')!r} references missing "
+                    f"node {rec['node']!r}"
+                )
+        # Commit the record store FIRST: if the incremental path cannot
+        # express the change, _rebuild() regenerates everything from
+        # records, so any surprise below degrades to a correct (slower)
+        # cycle instead of corrupt state.
+        for rec in upsert_nodes:
+            self._nodes[rec["name"]] = rec
+        for name in remove_nodes:
+            self._nodes.pop(name, None)
+        for rec in upsert_pods:
+            self._pods[rec["name"]] = rec
+        for name in remove_pods:
+            self._pods.pop(name, None)
+        for rec in upsert_running:
+            self._running[rec["name"]] = rec
+        for name in remove_running:
+            self._running.pop(name, None)
+        self._rebuild_members()
+        try:
+            return self._apply_incremental(
+                upsert_nodes, remove_nodes, upsert_pods, remove_pods,
+                upsert_running, remove_running,
+            )
+        except _NeedsRebuild as e:
+            return self._rebuild(e.reason)
+        except Exception:  # noqa: BLE001 — heal, then let tests catch it
+            import logging
+            import traceback
+
+            logging.getLogger("tpusched.device_state").warning(
+                "incremental delta apply failed; rebuilding this "
+                "lineage:\n%s", traceback.format_exc(limit=4),
+            )
+            return self._rebuild("incremental_error")
+
+    def _apply_incremental(self, upsert_nodes, remove_nodes, upsert_pods,
+                           remove_pods, upsert_running, remove_running
+                           ) -> ApplyStats:
+        st = self._state
+        intr = st.interner
+        bk = st.buckets
+        cfg = self.config
+
+        # Row-count capacity.
+        if (len(self._pods) > bk.pods or len(self._nodes) > bk.nodes
+                or len(self._running) > bk.running_pods):
+            raise _NeedsRebuild("row_bucket")
+
+        # Normalize churned records through a scratch builder: identical
+        # defaulting (pods resource, namespace fallback, PDB keying) to
+        # a full build.
+        nb = SnapshotBuilder(cfg)
+        for rec in upsert_nodes:
+            nb.add_node(**rec)
+        for rec in upsert_pods:
+            nb.add_pod(**rec)
+        for rec in upsert_running:
+            nb.add_running_pod(**{k: v for k, v in rec.items()
+                                  if k != "name"})
+        norm_nodes = dict(zip([r["name"] for r in upsert_nodes], nb._nodes))
+        norm_pods = dict(zip([r["name"] for r in upsert_pods], nb._pods))
+        norm_running = dict(
+            zip([r["name"] for r in upsert_running], nb._running)
+        )
+
+        # Vocabulary growth with column-wise blast radius forces a
+        # rebuild: a new taint grows pods.tolerated for EVERY pod, a new
+        # topology key grows nodes.domain for EVERY node.
+        n_topo0 = len(intr.topo_keys)
+        for rec in norm_nodes.values():
+            for (k, v, e) in rec["taints"]:
+                if (k, v, e) not in intr.taint_ids:
+                    raise _NeedsRebuild("new_taint")
+
+        n_atoms0, n_sigs0 = len(intr.atoms), len(intr.sigs)
+        new_pcs: dict[str, dict] = {}
+        for name, rec in norm_pods.items():
+            pc = intr.compile_pod(rec)
+            intr.intern_labels(rec["labels"])
+            intr.nsid(rec["namespace"])
+            new_pcs[name] = pc
+            if (len(pc["req_terms"]) > bk.terms
+                    or len(pc["pref_terms"]) > bk.pref_terms
+                    or len(pc["ts"]) > bk.spread_constraints
+                    or len(pc["ia"]) > bk.affinity_terms
+                    or len(rec["labels"]) > bk.pod_labels
+                    or any(len(t) > bk.term_atoms
+                           for t in pc["req_terms"])
+                    or any(len(t[0]) > bk.term_atoms
+                           for t in pc["pref_terms"])):
+                raise _NeedsRebuild("pod_feature_bucket")
+        new_anti: dict[str, list[int]] = {}
+        for name, rec in norm_running.items():
+            sigs_of_pod, am = intr.compile_running_anti(rec)
+            intr.intern_labels(rec["labels"])
+            intr.nsid(rec["namespace"])
+            new_anti[name] = sigs_of_pod
+            if (len(sigs_of_pod) > bk.affinity_terms or am > bk.term_atoms
+                    or len(rec["labels"]) > bk.pod_labels):
+                raise _NeedsRebuild("running_feature_bucket")
+        for rec in norm_nodes.values():
+            intr.intern_labels(rec["labels"])
+            if (len(rec["labels"]) > bk.node_labels
+                    or len(rec["taints"]) > bk.node_taints):
+                raise _NeedsRebuild("node_feature_bucket")
+        # Topology-domain ids append FOREVER on a long-lived interner
+        # (node relabels keep minting values), but the pairwise kernels
+        # scatter domain counts into [S, N] — an id >= the node bucket
+        # would be silently dropped by XLA. A fresh build compacts ids
+        # to <= #nodes, so rebuild before the bucket is breached.
+        new_domains: dict[int, set] = {}
+        for rec in norm_nodes.values():
+            for ti, tk in enumerate(intr.topo_keys):
+                v = rec["labels"].get(tk)
+                if v is not None and v not in intr.domain_ids[ti]:
+                    new_domains.setdefault(ti, set()).add(v)
+        for ti, vals in new_domains.items():
+            if len(intr.domain_ids[ti]) + len(vals) > bk.nodes:
+                raise _NeedsRebuild("domain_vocab")
+        if len(intr.topo_keys) > n_topo0:
+            raise _NeedsRebuild("new_topo_key")
+        if len(intr.atoms) > bk.atoms or len(intr.sigs) > bk.signatures:
+            raise _NeedsRebuild("table_bucket")
+        for a in range(n_atoms0, len(intr.atoms)):
+            if len(intr.atoms[a][2]) > bk.atom_values:
+                raise _NeedsRebuild("atom_values_bucket")
+        for s in range(n_sigs0, len(intr.sigs)):
+            _, ns_scope, alist = intr.sigs[s]
+            if len(alist) > bk.term_atoms or (
+                    ns_scope != "*" and len(ns_scope) > bk.sig_namespaces):
+                raise _NeedsRebuild("sig_bucket")
+
+        # Groups / PDBs: new ids APPEND (a fresh build sorts names; ids
+        # are opaque equality tokens so appending keeps settled pod rows
+        # valid). Touched = any slot whose membership a churned record
+        # enters or leaves; its value is max over current members.
+        touched_groups = set()
+        for rec in upsert_pods:
+            g = rec.get("pod_group")
+            if g:
+                touched_groups.add(g)
+            old_g = self._pod_group_name.get(rec["name"])
+            if old_g:
+                touched_groups.add(old_g)
+        for name in remove_pods:
+            old_g = self._pod_group_name.get(name)
+            if old_g:
+                touched_groups.add(old_g)
+        for g in touched_groups:
+            if g in self._group_members and g not in st.group_idx:
+                if len(st.group_idx) >= bk.pod_groups:
+                    raise _NeedsRebuild("group_bucket")
+                st.group_idx[g] = len(st.group_idx)
+        touched_groups &= set(st.group_idx)
+        touched_pdbs = set()
+        for rec in norm_running.values():
+            if rec["pdb_group"] is not None:
+                touched_pdbs.add(rec["pdb_group"])
+        for rec in upsert_running:
+            old_key = self._run_pdb_key.get(rec["name"])
+            if old_key:
+                touched_pdbs.add(old_key)
+        for name in remove_running:
+            old_key = self._run_pdb_key.get(name)
+            if old_key:
+                touched_pdbs.add(old_key)
+        for key in touched_pdbs:
+            if key in self._pdb_members and key not in st.pdb_idx:
+                if len(st.pdb_idx) >= bk.pdb_groups:
+                    raise _NeedsRebuild("pdb_bucket")
+                st.pdb_idx[key] = len(st.pdb_idx)
+        touched_pdbs &= set(st.pdb_idx)
+
+        # Commit normalized forms + compiled caches.
+        for name in remove_nodes:
+            self._norm_nodes.pop(name, None)
+        for name in remove_pods:
+            self._norm_pods.pop(name, None)
+            self._pod_pc.pop(name, None)
+        for name in remove_running:
+            self._norm_running.pop(name, None)
+            self._run_anti.pop(name, None)
+        self._norm_nodes.update(norm_nodes)
+        self._norm_pods.update(norm_pods)
+        self._norm_running.update(norm_running)
+        self._pod_pc.update(new_pcs)
+        self._run_anti.update(new_anti)
+
+        # Churn sets. A running upsert/remove dirties its node's `used`
+        # row (old node AND new node when the pod moved).
+        node_churn = set(norm_nodes)
+        run_churn = set(norm_running)
+        pod_churn = set(norm_pods)
+        for rec in upsert_running:
+            node_churn.add(rec["node"])
+            old_node = self._run_node_name.get(rec["name"])
+            if old_node is not None:
+                node_churn.add(old_node)
+        for name in remove_running:
+            old_node = self._run_node_name.get(name)
+            if old_node is not None:
+                node_churn.add(old_node)
+        node_churn &= set(self._nodes)
+        self._refresh_prev_maps()
+
+        new_node_order = sorted(self._nodes)
+        new_pod_order = sorted(self._pods)
+        new_run_order = sorted(self._running)
+
+        # Permutations for insertion/removal (None = steady-state
+        # value churn, pure scatter).
+        node_perm, node_pads = self._perm(self._node_order, new_node_order,
+                                          bk.nodes)
+        pod_perm, pod_pads = self._perm(self._pod_order, new_pod_order,
+                                        bk.pods)
+        run_perm, run_pads = self._perm(self._run_order, new_run_order,
+                                        bk.running_pods)
+        node_reorder = node_perm is not None
+        if node_reorder:
+            # Node rows moved: every running row's node_idx needs a
+            # remap (one [M] int32 column, not a per-row re-encode).
+            old_pos = {nm: i for i, nm in enumerate(self._node_order)}
+            remap = np.full(bk.nodes, -1, np.int32)
+            for new_i, nm in enumerate(new_node_order):
+                if nm in old_pos:
+                    remap[old_pos[nm]] = new_i
+
+        # Reorder the numpy mirror first (fancy-index gather allocates
+        # fresh arrays), then re-encode churned rows at NEW positions,
+        # then pad vacated tail rows.
+        for holder, perm in ((st.nodes_np, node_perm),
+                             (st.pods_np, pod_perm),
+                             (st.run_np, run_perm)):
+            if perm is None:
+                continue
+            for f, arr in list(vars(holder).items()):
+                setattr(holder, f, np.ascontiguousarray(arr[perm]))
+        if node_reorder:
+            ni = st.run_np.node_idx
+            st.run_np.node_idx = np.where(
+                ni >= 0, remap[ni], ni
+            ).astype(np.int32)
+        mirror = _snapshot_from_arrays(st.nodes_np, st.pods_np, st.run_np,
+                                       st.tables)
+        st.node_index = {nm: i for i, nm in enumerate(new_node_order)}
+        pod_index = {nm: i for i, nm in enumerate(new_pod_order)}
+        run_index = {nm: i for i, nm in enumerate(new_run_order)}
+
+        run_by_node: dict[str, list[str]] = {}
+        for name in new_run_order:
+            run_by_node.setdefault(self._norm_running[name]["node"],
+                                   []).append(name)
+        for nm in node_churn:
+            i = st.node_index[nm]
+            _fill_node_row(st.nodes_np, i, self._norm_nodes[nm], intr, cfg)
+            # Re-sum counted members in name order: float-identical to a
+            # rebuild, never drifting through +=/-= pairs.
+            for member in run_by_node.get(nm, ()):
+                rrec = self._norm_running[member]
+                if rrec["count_into_used"]:
+                    for r, rn in enumerate(cfg.resources):
+                        st.nodes_np.used[i, r] += float(
+                            rrec["requests"].get(rn, 0.0)
+                        )
+        for nm in pod_churn:
+            _fill_pod_row(st.pods_np, pod_index[nm], self._norm_pods[nm],
+                          self._pod_pc[nm], intr, cfg, st.group_idx)
+        for nm in run_churn:
+            _fill_running_row(st.run_np, run_index[nm],
+                              self._norm_running[nm], self._run_anti[nm],
+                              intr, cfg, st.node_index, st.pdb_idx)
+        for i in node_pads:
+            _pad_node_row(st.nodes_np, i)
+        for i in pod_pads:
+            _pad_pod_row(st.pods_np, i)
+        for i in run_pads:
+            _pad_running_row(st.run_np, i)
+
+        # New atom/sig table rows + touched group/pdb scalars.
+        for a in range(n_atoms0, len(intr.atoms)):
+            _fill_atom_row(st.tables, a, intr.atoms[a])
+        for s in range(n_sigs0, len(intr.sigs)):
+            _fill_sig_row(st.tables, s, intr.sigs[s])
+        for g in touched_groups:
+            members = self._group_members.get(g, {})
+            st.tables.group_min[st.group_idx[g]] = (
+                max(members.values()) if members else 0
+            )
+        for key in touched_pdbs:
+            members = self._pdb_members.get(key, {})
+            st.tables.pdb_allowed[st.pdb_idx[key]] = float(
+                max(members.values()) if members else 0
+            )
+
+        # Device updates: permutation gathers, then row scatters.
+        h2d = 0
+        rows_written = 0
+        dev = self._device
+        nodes_dev, pods_dev, run_dev = dev.nodes, dev.pods, dev.running
+        if node_perm is not None:
+            nodes_dev = permute_rows(nodes_dev, node_perm)
+            h2d += node_perm.nbytes
+        if pod_perm is not None:
+            pods_dev = permute_rows(pods_dev, pod_perm)
+            h2d += pod_perm.nbytes
+        if run_perm is not None:
+            run_dev = permute_rows(run_dev, run_perm)
+            h2d += run_perm.nbytes
+        if node_reorder:
+            # Ship the remapped node_idx column wholesale (int32 [M]).
+            run_dev = dataclasses.replace(
+                run_dev, node_idx=jax.device_put(st.run_np.node_idx)
+            )
+            h2d += st.run_np.node_idx.nbytes
+
+        def scatter(dev_tree, mirror_tree, rows):
+            nonlocal h2d, rows_written
+            rows = sorted(set(rows))
+            if not rows:
+                return dev_tree
+            idx = _pad_pow2(rows)
+            row_data = jax.tree.map(lambda a: a[idx], mirror_tree)
+            h2d += idx.nbytes + _tree_nbytes(row_data)
+            rows_written += len(rows)
+            return scatter_rows(dev_tree, idx, row_data)
+
+        nodes_dev = scatter(
+            nodes_dev, mirror.nodes,
+            [st.node_index[nm] for nm in node_churn] + list(node_pads),
+        )
+        pods_dev = scatter(
+            pods_dev, mirror.pods,
+            [pod_index[nm] for nm in pod_churn] + list(pod_pads),
+        )
+        run_dev = scatter(
+            run_dev, mirror.running,
+            [run_index[nm] for nm in run_churn] + list(run_pads),
+        )
+        atoms_dev = scatter(dev.atoms, mirror.atoms,
+                            list(range(n_atoms0, len(intr.atoms))))
+        sigs_dev = scatter(dev.sigs, mirror.sigs,
+                           list(range(n_sigs0, len(intr.sigs))))
+        group_dev = scatter(dev.group_min_member, mirror.group_min_member,
+                            [st.group_idx[g] for g in touched_groups])
+        pdb_dev = scatter(dev.pdb_allowed, mirror.pdb_allowed,
+                          [st.pdb_idx[k] for k in touched_pdbs])
+
+        self._device = dataclasses.replace(
+            dev, nodes=nodes_dev, pods=pods_dev, running=run_dev,
+            atoms=atoms_dev, sigs=sigs_dev, group_min_member=group_dev,
+            pdb_allowed=pdb_dev,
+        )
+        self._node_order = new_node_order
+        self._pod_order = new_pod_order
+        self._run_order = new_run_order
+        self._meta = SnapshotMeta(
+            node_names=list(new_node_order),
+            pod_names=list(new_pod_order),
+            n_nodes=len(new_node_order), n_pods=len(new_pod_order),
+            n_running=len(new_run_order), buckets=bk,
+            # ID order, not name order: appended mid-session groups get
+            # ids past the originally-sorted ones, and group_names[i]
+            # must keep naming group id i.
+            group_names=[g for g, _ in sorted(st.group_idx.items(),
+                                              key=lambda kv: kv[1])],
+            running_names=list(new_run_order),
+        )
+        self.delta_updates += 1
+        self.h2d_bytes_last = h2d
+        self.h2d_bytes_total += h2d
+        return ApplyStats(
+            path="delta", h2d_bytes=h2d, rows_scattered=rows_written,
+            reordered=(node_perm is not None or pod_perm is not None
+                       or run_perm is not None),
+        )
+
+    @staticmethod
+    def _perm(old_order: list[str], new_order: list[str], bucket: int):
+        """(perm int32[bucket] | None, vacated-row indices). None when
+        the order is unchanged (the steady-state value-churn cycle)."""
+        if old_order == new_order:
+            return None, []
+        old_pos = {nm: i for i, nm in enumerate(old_order)}
+        perm = np.arange(bucket, dtype=np.int32)
+        for i, nm in enumerate(new_order):
+            perm[i] = old_pos.get(nm, i)
+        pads = list(range(len(new_order), len(old_order)))
+        return perm, pads

@@ -9,8 +9,10 @@ changes; EngineConfig is closed over as compile-time constants.
 from __future__ import annotations
 
 import dataclasses
+import queue
+import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future
 from typing import Any, Callable
 
 import jax
@@ -45,6 +47,69 @@ class ScoreBatchResult:
     feasible: np.ndarray       # [P, N] bool
     scores: np.ndarray         # [P, N] f32
     solve_seconds: float = 0.0
+
+
+class _OrderedFetchWorker:
+    """ONE background fetch thread with strict FIFO order — the
+    replacement for the old single-worker ThreadPoolExecutor. Three
+    differences that matter for serving:
+
+      * the thread is a DAEMON, so an engine that was never close()d
+        cannot wedge interpreter shutdown, and the owning Engine
+        registers a GC finalizer that enqueues the shutdown sentinel —
+        dropped engines release their thread like the old executor's
+        weakref cleanup did;
+      * close(wait=True) DRAINS: the shutdown sentinel enqueues behind
+        every submitted fetch, so in-flight PendingFetch results
+        complete before close returns;
+      * submit after close fails loudly instead of queueing into
+        nothing.
+    """
+
+    def __init__(self, name: str = "tpusched-fetch"):
+        self._name = name
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._closed = False
+
+    def submit(self, fn, *args) -> "Future":
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            if self._thread is None:
+                # Lazy start: idle engines pay nothing, and the lock
+                # keeps concurrent first-submits from double-starting.
+                self._thread = threading.Thread(
+                    target=self._run, name=self._name, daemon=True
+                )
+                self._thread.start()
+            self._q.put((fut, fn, args))
+        return fut
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, fn, args = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as e:  # noqa: BLE001 — relay to waiter
+                fut.set_exception(e)
+
+    def close(self, wait: bool = True) -> None:
+        with self._lock:
+            thread = self._thread
+            if not self._closed:
+                self._closed = True
+                if thread is not None:
+                    self._q.put(None)  # behind all pending work: a drain
+        if wait and thread is not None:
+            thread.join()
 
 
 @dataclasses.dataclass
@@ -182,13 +247,16 @@ class Engine:
         # which fetch-driven transports (axon tunnel) rely on — two
         # concurrent D2H reads would race for the single execution
         # stream. Callers overlap by dispatching the next program while
-        # the worker's np.asarray drives the current one. (Eager: the
-        # executor spawns its thread only on first submit, so idle
-        # engines pay nothing, and handler threads never race a lazy
-        # init.)
-        self._fetch_pool = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="tpusched-fetch"
-        )
+        # the worker's np.asarray drives the current one. The finalizer
+        # restores the old executor's exit-on-GC: an engine dropped
+        # WITHOUT close() enqueues the shutdown sentinel when collected,
+        # so its (daemon) thread parks forever in neither case. The
+        # finalizer must hold the QUEUE, not the worker or self — a
+        # strong ref to either would keep the engine alive.
+        self._fetch_pool = _OrderedFetchWorker()
+        import weakref
+
+        weakref.finalize(self, self._fetch_pool._q.put, None)
 
     # -- public API ---------------------------------------------------------
 
@@ -212,7 +280,7 @@ class Engine:
             rounds=int(buf[-1]),
         )
 
-    def _pool(self) -> ThreadPoolExecutor:
+    def _pool(self) -> _OrderedFetchWorker:
         return self._fetch_pool
 
     @staticmethod
@@ -350,9 +418,11 @@ class Engine:
         """Explicit host->device transfer (otherwise implicit on call)."""
         return jax.device_put(snap)
 
-    def close(self) -> None:
-        """Shut down the background fetch worker. Idle workers also
-        exit when the engine is garbage-collected (executor weakref),
-        so short-lived engines need no explicit close; long-lived
-        processes cycling many engines should call this."""
-        self._fetch_pool.shutdown(wait=False)
+    def close(self, wait: bool = True) -> None:
+        """Shut down the background fetch worker. wait=True (default)
+        DRAINS: every in-flight PendingFetch completes before this
+        returns, so multi-client servers can't leak fetch threads or
+        half-fetched results across test runs. The worker thread is a
+        daemon, so engines that are never closed still can't block
+        interpreter shutdown."""
+        self._fetch_pool.close(wait=wait)

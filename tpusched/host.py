@@ -502,9 +502,9 @@ def run_e2e_benchmark(n_pods: int = 100, n_nodes: int = 10, iters: int = 10,
     from tpusched.rpc.server import make_server
 
     cfg = EngineConfig(mode="fast")
-    server = client = shared_engine = None
+    server = client = shared_engine = svc = None
     if use_grpc:
-        server, port, _ = make_server("127.0.0.1:0", config=cfg)
+        server, port, svc = make_server("127.0.0.1:0", config=cfg)
         server.start()
         client = SchedulerClient(f"127.0.0.1:{port}")
     else:
@@ -528,6 +528,10 @@ def run_e2e_benchmark(n_pods: int = 100, n_nodes: int = 10, iters: int = 10,
             client.close()
         if server is not None:
             server.stop(0)
+        if svc is not None:
+            svc.close()
+        if shared_engine is not None:
+            shared_engine.close()
     times = np.asarray(times)
     return dict(
         p50=float(np.percentile(times, 50)),

@@ -351,6 +351,34 @@ def score_batch(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
     return batched_cycle(cfg, snap, static, snap.nodes.used, st0)
 
 
+# ---------------------------------------------------------------------------
+# Delta-update entry points (device-resident cluster state,
+# tpusched/device_state.py): one XLA scatter / gather over a whole
+# struct-of-arrays group. jit caches per (pytree structure, shapes) —
+# callers bucket the churned-row count to powers of two so the compile
+# set stays bounded. Duplicate scatter indices are only ever written
+# with IDENTICAL row content (idx padding repeats a real row), so the
+# unspecified duplicate-write order cannot change the result.
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def scatter_rows(tree, idx, rows):
+    """tree.leaf[idx[j]] = rows.leaf[j] for every leaf of a
+    struct-of-arrays pytree (NodeArrays / PodArrays / ... or a bare
+    array): the O(churn) device-side write of a delta update."""
+    return jax.tree.map(lambda a, r: a.at[idx].set(r), tree, rows)
+
+
+@jax.jit
+def permute_rows(tree, perm):
+    """Row gather tree.leaf[perm] over a struct-of-arrays pytree: the
+    device-side reorder when record insertion/removal shifts the
+    name-sorted row order (host ships one [rows] int32 permutation, not
+    the arrays)."""
+    return jax.tree.map(lambda a: a[perm], tree)
+
+
 def _spread_waterfill_deal(snap: ClusterSnapshot, pair_st, used, relaxed,
                            score, allowed, rank, K: int):
     """Domain-balanced dealing for spread-constrained pods (round-4):

@@ -102,6 +102,79 @@ def _affinity(msgs) -> list[PodAffinityTerm]:
     ]
 
 
+def node_kwargs(n: "pb.Node") -> dict:
+    """Wire Node -> SnapshotBuilder.add_node kwargs (incl. 'name').
+    The single proto->record authority, shared by the full decoder and
+    the device-resident delta path (rpc.server.DeviceSession)."""
+    return dict(
+        name=n.name,
+        allocatable=_res_map(n.allocatable),
+        labels=_labels(n.labels),
+        taints=[(t.key, t.value, t.effect) for t in n.taints],
+        used=_res_map(n.used),
+        unschedulable=n.unschedulable,
+    )
+
+
+def pod_kwargs(p: "pb.PendingPod") -> dict:
+    """Wire PendingPod -> SnapshotBuilder.add_pod kwargs (incl. 'name')."""
+    return dict(
+        name=p.name,
+        requests=_res_map(p.requests),
+        priority=p.priority,
+        slo_target=p.slo_target,
+        # proto3 cannot distinguish unset from 0.0: clients must set
+        # observed_availability explicitly (0.0 means 0.0; a pod with
+        # no SLO is unaffected either way since pressure clips at 0).
+        observed_avail=p.observed_availability,
+        labels=_labels(p.labels),
+        node_selector=_labels(p.node_selector),
+        required_terms=[
+            NodeSelectorTerm(_exprs(t.expressions))
+            for t in p.required_terms
+        ],
+        preferred_terms=[
+            PreferredTerm(t.weight, NodeSelectorTerm(_exprs(t.term.expressions)))
+            for t in p.preferred_terms
+        ],
+        tolerations=[
+            Toleration(t.key, t.operator or "Equal", t.value, t.effect)
+            for t in p.tolerations
+        ],
+        topology_spread=[
+            TopologySpreadConstraint(
+                topology_key=c.topology_key,
+                max_skew=c.max_skew,
+                when_unsatisfiable=c.when_unsatisfiable,
+                selector=_exprs(c.selector),
+            )
+            for c in p.topology_spread
+        ],
+        pod_affinity=_affinity(p.pod_affinity),
+        pod_group=p.pod_group or None,
+        pod_group_min_member=p.pod_group_min_member,
+        namespace=p.namespace or "default",
+    )
+
+
+def running_kwargs(r: "pb.RunningPod") -> dict:
+    """Wire RunningPod -> SnapshotBuilder.add_running_pod kwargs, plus
+    'name' (the builder doesn't key running pods; delta paths do)."""
+    return dict(
+        name=r.name,
+        node=r.node,
+        requests=_res_map(r.requests),
+        priority=r.priority,
+        slack=r.slack,
+        labels=_labels(r.labels),
+        count_into_used=not r.exclude_from_used,
+        pod_affinity=_affinity(r.pod_affinity),
+        namespace=r.namespace or "default",
+        pdb_group=r.pdb_group or None,
+        pdb_disruptions_allowed=r.pdb_disruptions_allowed,
+    )
+
+
 def snapshot_from_proto(
     msg: pb.ClusterSnapshot,
     config: EngineConfig | None = None,
@@ -117,65 +190,13 @@ def snapshot_from_proto(
     config = config or EngineConfig()
     b = SnapshotBuilder(config, buckets)
     for n in _by_name(msg.nodes):
-        b.add_node(
-            n.name,
-            allocatable=_res_map(n.allocatable),
-            labels=_labels(n.labels),
-            taints=[(t.key, t.value, t.effect) for t in n.taints],
-            used=_res_map(n.used),
-            unschedulable=n.unschedulable,
-        )
+        b.add_node(**node_kwargs(n))
     for p in _by_name(msg.pods):
-        b.add_pod(
-            p.name,
-            requests=_res_map(p.requests),
-            priority=p.priority,
-            slo_target=p.slo_target,
-            # proto3 cannot distinguish unset from 0.0: clients must set
-            # observed_availability explicitly (0.0 means 0.0; a pod with
-            # no SLO is unaffected either way since pressure clips at 0).
-            observed_avail=p.observed_availability,
-            labels=_labels(p.labels),
-            node_selector=_labels(p.node_selector),
-            required_terms=[
-                NodeSelectorTerm(_exprs(t.expressions))
-                for t in p.required_terms
-            ],
-            preferred_terms=[
-                PreferredTerm(t.weight, NodeSelectorTerm(_exprs(t.term.expressions)))
-                for t in p.preferred_terms
-            ],
-            tolerations=[
-                Toleration(t.key, t.operator or "Equal", t.value, t.effect)
-                for t in p.tolerations
-            ],
-            topology_spread=[
-                TopologySpreadConstraint(
-                    topology_key=c.topology_key,
-                    max_skew=c.max_skew,
-                    when_unsatisfiable=c.when_unsatisfiable,
-                    selector=_exprs(c.selector),
-                )
-                for c in p.topology_spread
-            ],
-            pod_affinity=_affinity(p.pod_affinity),
-            pod_group=p.pod_group or None,
-            pod_group_min_member=p.pod_group_min_member,
-            namespace=p.namespace or "default",
-        )
+        b.add_pod(**pod_kwargs(p))
     for r in _by_name(msg.running):
-        b.add_running_pod(
-            node=r.node,
-            requests=_res_map(r.requests),
-            priority=r.priority,
-            slack=r.slack,
-            labels=_labels(r.labels),
-            count_into_used=not r.exclude_from_used,
-            pod_affinity=_affinity(r.pod_affinity),
-            namespace=r.namespace or "default",
-            pdb_group=r.pdb_group or None,
-            pdb_disruptions_allowed=r.pdb_disruptions_allowed,
-        )
+        kw = running_kwargs(r)
+        kw.pop("name")
+        b.add_running_pod(**kw)
     snap, meta = b.build()
     # Running-pod names travel with meta for eviction responses — in the
     # same name-sorted order the arrays were built in, so evicted[m]

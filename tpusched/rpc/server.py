@@ -7,15 +7,26 @@ protoc + grpcio but no grpc_tools codegen); the method table mirrors
 protos/tpusched.proto's service block.
 
 Request handling is STAGED (round 6, SURVEY.md §2.3 PP in-request):
-decode runs outside the device dispatch lane (concurrent across
-handler threads), dispatch holds the lane just long enough to enqueue
-the program (Engine.solve_async / score_topk_async — one ordered
-background fetch worker), and the response's name tables build while
-the device runs. A single pipelined connection (client
-AssignPipeline, depth 2) therefore overlaps request k+1's decode with
-request k's solve — the overlap that previously required two
-concurrent schedulers — and even a strictly sequential client gets
-its response scaffolding for free inside the device window.
+decode runs outside the serialized dispatch section (concurrent across
+handler threads), the dispatch slot is held just long enough to
+enqueue the program (Engine.solve_async / score_topk_async — one
+ordered background fetch worker), and the response's name tables build
+while the device runs. A single pipelined connection (client
+AssignPipeline / ScorePipeline, depth 2) therefore overlaps request
+k+1's decode with request k's solve, and even a strictly sequential
+client gets its response scaffolding for free inside the device window.
+
+Round 7 makes the sidecar MULTI-CLIENT (ISSUE 2 tentpole):
+
+  * DeviceSession keeps each delta lineage's cluster state RESIDENT on
+    the device — deltas apply as O(churn) scatter updates
+    (tpusched/device_state.py) instead of recompose + full decode +
+    full H2D;
+  * the dispatch mutex became _DispatchGate, a bounded FAIR queue
+    (round-robin across client peers, FIFO within one, admission caps
+    -> RESOURCE_EXHAUSTED);
+  * _ScoreCoalescer fuses concurrent identical ScoreBatch deltas into
+    one padded top-k dispatch, sliced per caller.
 
 Observability (SURVEY.md §5): every batch emits one structured JSON log
 line (sizes, rounds, per-phase seconds, placements/sec) on stderr, and
@@ -27,16 +38,21 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
+from collections import deque
 from concurrent import futures
+from contextlib import contextmanager
 
 import numpy as np
 
 import grpc
 
 from tpusched.config import Buckets, EngineConfig
+from tpusched.device_state import DeviceSnapshot
 from tpusched.engine import Engine
 from tpusched.rpc import tpusched_pb2 as pb
+from tpusched.rpc import codec
 from tpusched.rpc.codec import SnapshotStore, decode_snapshot, delta_safe
 
 SERVICE = "tpusched.TpuScheduler"
@@ -44,8 +60,16 @@ SERVICE = "tpusched.TpuScheduler"
 # Recent snapshot stores kept for delta resolution. Each store holds
 # references into decoded request protos (cheap); the cap bounds memory
 # and defines how stale a client's base_id may be before it must resend
-# a full snapshot.
-STORE_CAP = 8
+# a full snapshot. Sized for MULTI-CLIENT fan-in (round 7): K chained
+# lineages each need their latest base plus one in flight to survive
+# the LRU while the other K-1 register new stores every cycle — 8 was
+# borderline at K=4 and forced periodic full resends + device-session
+# re-seeds.
+STORE_CAP = 32
+
+# Device-resident lineages kept alive concurrently (each holds a full
+# cluster's arrays on the accelerator, so the cap is memory, not CPU).
+DEVICE_SESSION_CAP = 8
 
 # Above this many matrix cells a packed_ok ScoreBatch response switches
 # from repeated ScoreRow to the packed-bytes form: the row form costs
@@ -121,6 +145,318 @@ class _Metrics:
         return "\n".join(lines) + "\n"
 
 
+class _Abort(Exception):
+    """Internal abort carrier: raised where the old code called
+    context.abort directly, so COALESCED requests can relay the same
+    status to every fused caller (each grpc context must abort itself)."""
+
+    def __init__(self, code, details: str):
+        super().__init__(details)
+        self.code = code
+        self.details = details
+
+
+class _Overloaded(Exception):
+    """Dispatch gate admission refused (queue caps hit)."""
+
+
+class _DispatchGate:
+    """Bounded FAIR admission to the device dispatch slot — the
+    replacement for the old `_dispatch_lane` mutex.
+
+    A plain lock serializes dispatches but hands the slot to whichever
+    gRPC thread the OS wakes first: one chatty client can starve the
+    rest, and tail latency under fan-in is whoever loses the race
+    longest. The gate keeps one FIFO queue per client (peer string) and
+    serves queue HEADS round-robin, so K clients each see every K'th
+    slot — Assign streams from distinct clients interleave at round
+    granularity — while one client's own requests stay ordered.
+
+    Admission is BOUNDED: beyond `max_waiting_per_client` queued
+    entries for one client (a runaway pipeline) or `max_waiting` total,
+    acquire raises _Overloaded and the handler answers
+    RESOURCE_EXHAUSTED instead of building an unbounded queue.
+    """
+
+    def __init__(self, max_waiting_per_client: int = 16,
+                 max_waiting: int = 128):
+        self._cv = threading.Condition()
+        self._queues: dict[str, deque] = {}
+        self._order: list[str] = []     # clients with waiters, stable order
+        self._last: str | None = None   # round-robin pointer
+        self._busy = False
+        self._waiting = 0
+        self._closed = False
+        self.max_waiting_per_client = max_waiting_per_client
+        self.max_waiting = max_waiting
+        # Observability: served slots + peak depth.
+        self.served = 0
+        self.peak_waiting = 0
+
+    def _choose(self):
+        """(client, head ticket) the slot goes to next, by round-robin
+        from the client AFTER the last served one."""
+        order = self._order
+        if not order:
+            return None, None
+        start = 0
+        if self._last in order:
+            start = order.index(self._last) + 1
+        for i in range(len(order)):
+            c = order[(start + i) % len(order)]
+            q = self._queues.get(c)
+            if q:
+                return c, q[0]
+        return None, None
+
+    @contextmanager
+    def slot(self, client: str):
+        self._acquire(client)
+        try:
+            yield
+        finally:
+            self._release()
+
+    def _acquire(self, client: str) -> None:
+        me = object()
+        with self._cv:
+            if self._closed:
+                raise _Overloaded("server shutting down")
+            q = self._queues.get(client)
+            if self._waiting >= self.max_waiting:
+                raise _Overloaded(
+                    f"dispatch queue full ({self.max_waiting} waiting)"
+                )
+            if q is not None and len(q) >= self.max_waiting_per_client:
+                raise _Overloaded(
+                    f"client {client!r} has {len(q)} dispatches queued "
+                    f"(cap {self.max_waiting_per_client})"
+                )
+            if q is None:
+                q = self._queues[client] = deque()
+                self._order.append(client)
+            q.append(me)
+            self._waiting += 1
+            self.peak_waiting = max(self.peak_waiting, self._waiting)
+            while True:
+                if self._closed:
+                    self._evict(client, me)
+                    raise _Overloaded("server shutting down")
+                if not self._busy:
+                    c, head = self._choose()
+                    if head is me:
+                        break
+                self._cv.wait()
+            # Our turn: take the slot and advance the round-robin.
+            self._busy = True
+            self._last = client
+            self._evict(client, me)
+            self.served += 1
+
+    def _evict(self, client: str, me) -> None:
+        q = self._queues.get(client)
+        if q is not None and me in q:
+            q.remove(me)
+            self._waiting -= 1
+            if not q:
+                del self._queues[client]
+                self._order.remove(client)
+
+    def _release(self) -> None:
+        with self._cv:
+            self._busy = False
+            self._cv.notify_all()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+
+class _Fusion:
+    """One coalesced ScoreBatch dispatch: the LEADER resolves, decodes,
+    dispatches once with k = max over joined callers, and publishes;
+    followers wait and slice their own k columns from the shared
+    result. Joining closes when the leader reaches the dispatch slot."""
+
+    def __init__(self, key):
+        self.key = key
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._ks: list[int] = []
+        self._sealed = False
+        self._payload = None
+        self._error: tuple | None = None
+
+    def try_join(self, k: int) -> bool:
+        with self._lock:
+            if self._sealed:
+                return False
+            self._ks.append(int(k))
+            return True
+
+    def seal(self) -> int:
+        """Stop admitting joiners; returns the fused k (max)."""
+        with self._lock:
+            self._sealed = True
+            return max(self._ks) if self._ks else 0
+
+    def publish(self, payload) -> None:
+        self._payload = payload
+        self._event.set()
+
+    def fail(self, code, details: str) -> None:
+        self._error = (code, details)
+        self._event.set()
+
+    def wait(self, timeout: float):
+        if not self._event.wait(timeout):
+            raise _Abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                         "coalesced dispatch leader timed out")
+        if self._error is not None:
+            raise _Abort(self._error[0],
+                         f"coalesced leader failed: {self._error[1]}")
+        return self._payload
+
+
+class _ScoreCoalescer:
+    """Request-level fusion of concurrent ScoreBatch DELTAS against the
+    same store: identical (base_id, delta bytes) means identical
+    post-delta cluster state, so N callers' matrices are one padded
+    device dispatch — resolve, decode/apply, and rank run ONCE, and
+    per-caller top_k differences collapse to a column slice (lax.top_k
+    is prefix-stable: the first k_i of top k_max IS top k_i)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: dict = {}
+        self.fused_requests = 0   # followers served without a dispatch
+        self.lead_requests = 0
+
+    def join(self, key, k: int):
+        """(fusion, is_leader)."""
+        with self._lock:
+            f = self._pending.get(key)
+            if f is not None and f.try_join(k):
+                self.fused_requests += 1
+                return f, False
+            f = _Fusion(key)
+            f.try_join(k)
+            self._pending[key] = f
+            self.lead_requests += 1
+            return f, True
+
+    def finish(self, fusion) -> None:
+        with self._lock:
+            if self._pending.get(fusion.key) is fusion:
+                del self._pending[fusion.key]
+
+
+class DeviceSession:
+    """One delta lineage's device-resident cluster state (SURVEY.md §7
+    hard part 6 + the tentpole of this round): wire deltas apply as
+    on-device scatter updates through DeviceSnapshot instead of
+    recompose-bytes -> full decode -> full H2D.
+
+    A session answers deltas against TWO base ids:
+
+      * its PIN — the base it was seeded from. Pipelined clients
+        (AssignPipeline / ScorePipeline) send CUMULATIVE deltas that
+        all name the pin; the session applies cumulative delta k+1 on
+        top of cumulative delta k by also RESTORING pin records that
+        delta k touched but k+1 no longer mentions (a record mutated
+        back to its pin content drops out of the diff).
+      * its CURRENT snapshot_id — chain clients (DeltaSession) target
+        the previous response's sid; serving that id re-pins the
+        session there (shallow record-dict copies, O(records) pointer
+        work).
+
+    A fork (a second delta against a base the session has moved past)
+    simply misses and takes the decode path."""
+
+    def __init__(self, device: DeviceSnapshot, pin_sid: str):
+        self.device = device
+        self.lock = threading.Lock()
+        self.last_stats = None   # ApplyStats of the latest load/apply
+        self._pin_sid = pin_sid
+        self._cur_sid = pin_sid
+        self._pin = (dict(device._nodes), dict(device._pods),
+                     dict(device._running))
+        # Names churned since the pin, per collection.
+        self._touched: tuple[set, set, set] = (set(), set(), set())
+
+    def keys(self) -> set[str]:
+        return {self._pin_sid, self._cur_sid}
+
+    @classmethod
+    def from_base_store(cls, store: SnapshotStore, base_id: str,
+                        config: EngineConfig,
+                        buckets: Buckets | None) -> "DeviceSession":
+        """Seed from the BASE (pre-delta) byte store so the pin matches
+        what pipelined clients keep diffing against (the one-time
+        O(cluster) conversion; every later delta is O(churn))."""
+        def parse(cls_pb, raw):
+            return cls_pb.FromString(raw) if isinstance(raw, bytes) else raw
+
+        nodes = [codec.node_kwargs(parse(pb.Node, v))
+                 for v in store.nodes.values()]
+        pods = [codec.pod_kwargs(parse(pb.PendingPod, v))
+                for v in store.pods.values()]
+        running = [codec.running_kwargs(parse(pb.RunningPod, v))
+                   for v in store.running.values()]
+        device = DeviceSnapshot(config, buckets)
+        stats = device.full_load(nodes, pods, running)
+        session = cls(device, pin_sid=base_id)
+        session.last_stats = stats
+        return session
+
+    def apply_delta(self, base_id: str, delta: "pb.SnapshotDelta",
+                    new_sid: str):
+        """Advance to base_id + delta. base_id must be one of keys()."""
+        if base_id == self._cur_sid and base_id != self._pin_sid:
+            # Chain step: the client committed to the current state —
+            # re-pin here (shallow copies; record dicts are replaced,
+            # never mutated, so sharing them is safe).
+            dev = self.device
+            self._pin = (dict(dev._nodes), dict(dev._pods),
+                         dict(dev._running))
+            self._pin_sid = base_id
+            self._touched = (set(), set(), set())
+        elif base_id != self._pin_sid:
+            raise KeyError(f"session cannot serve base {base_id!r}")
+        up_n = [codec.node_kwargs(n) for n in delta.upsert_nodes]
+        up_p = [codec.pod_kwargs(p) for p in delta.upsert_pods]
+        up_r = [codec.running_kwargs(r) for r in delta.upsert_running]
+        rm_n = list(delta.remove_nodes)
+        rm_p = list(delta.remove_pods)
+        rm_r = list(delta.remove_running)
+        new_touched = (
+            {r["name"] for r in up_n} | set(rm_n),
+            {r["name"] for r in up_p} | set(rm_p),
+            {r["name"] for r in up_r} | set(rm_r),
+        )
+        # Restore pin records the previous cumulative delta touched but
+        # this one no longer mentions (mutated back to pin content).
+        for prev, new, pin, ups, rms in (
+            (self._touched[0], new_touched[0], self._pin[0], up_n, rm_n),
+            (self._touched[1], new_touched[1], self._pin[1], up_p, rm_p),
+            (self._touched[2], new_touched[2], self._pin[2], up_r, rm_r),
+        ):
+            for name in prev - new:
+                if name in pin:
+                    ups.append(pin[name])
+                else:
+                    rms.append(name)
+        self.last_stats = self.device.apply(
+            upsert_nodes=up_n, remove_nodes=rm_n,
+            upsert_pods=up_p, remove_pods=rm_p,
+            upsert_running=up_r, remove_running=rm_r,
+        )
+        self._touched = new_touched
+        self._cur_sid = new_sid
+        return self.last_stats
+
+
 class SchedulerService:
     def __init__(
         self,
@@ -128,12 +464,17 @@ class SchedulerService:
         buckets: Buckets | None = None,
         log_stream=None,
         audit_stream=None,
+        device_sessions: int = DEVICE_SESSION_CAP,
     ):
         """audit_stream: optional file-like; when set, every Assign
         emits one JSON record PER POD (pod, node, score, commit_key —
         the upstream per-pod placement-decision audit, SURVEY.md §5
         'Metrics/observability') plus one per eviction. Off by default:
-        at 10k pods a full audit is ~1 MB per batch."""
+        at 10k pods a full audit is ~1 MB per batch.
+
+        device_sessions: how many delta lineages keep their cluster
+        state RESIDENT on the device (0 disables; every delta then
+        recomposes + fully re-decodes as before)."""
         self.config = config or EngineConfig()
         # Floor buckets pin compile shapes across requests (a feature
         # first appearing mid-serving would otherwise trigger a full
@@ -158,16 +499,24 @@ class SchedulerService:
         self._store_lock = threading.Lock()
         self._stores: dict[str, SnapshotStore] = {}  # LRU by insertion
         self._next_store = 0
-        # Device dispatch lane (round 6, in-request decode<->solve
-        # overlap): handlers decode OUTSIDE the lane (pure CPU, runs
-        # concurrently on the gRPC thread pool), hold the lane only to
-        # DISPATCH, then build their response scaffolding while the
-        # engine's background worker fetches. Request k+1's decode and
-        # dispatch therefore overlap request k's in-flight solve even
-        # on a single pipelined connection; the lane plus the engine's
-        # single ordered fetch worker keep dispatch order == fetch
-        # order, which fetch-driven transports require.
-        self._dispatch_lane = threading.Lock()
+        # Dispatch admission (round 7, replaces the `_dispatch_lane`
+        # mutex): handlers still decode OUTSIDE the serialized section
+        # and build responses while the engine's ordered fetch worker
+        # drives the device — but the slot itself is now a bounded FAIR
+        # queue (round-robin across clients, FIFO within one), and
+        # concurrent ScoreBatch deltas against the same store fuse into
+        # one dispatch (_ScoreCoalescer). Dispatch order == fetch order
+        # still holds: only the slot holder dispatches.
+        self._gate = _DispatchGate()
+        self._coalescer = _ScoreCoalescer()
+        # Device-resident lineages: current snapshot_id -> DeviceSession
+        # (LRU by insertion, capped — each holds a cluster on device).
+        self._session_cap = device_sessions
+        self._sessions: dict[str, DeviceSession] = {}
+        self._seeding: set[str] = set()   # base_ids mid-seed (dedupe)
+        self.session_seeds = 0
+        self.session_hits = 0
+        self.session_misses = 0
 
     def _register_store(self, store: SnapshotStore) -> str:
         with self._store_lock:
@@ -179,7 +528,7 @@ class SchedulerService:
         return sid
 
     @staticmethod
-    def _check_delta_upserts(delta, context) -> None:
+    def _check_delta_upserts(delta) -> None:
         """Defense-in-depth behind DeltaSession's client-side guard: a
         delta upsert with an empty or duplicate name would silently
         collapse in the name-keyed store and solve a corrupted snapshot.
@@ -190,55 +539,165 @@ class SchedulerService:
             seen = set()
             for rec in coll:
                 if not rec.name or rec.name in seen:
-                    context.abort(
+                    raise _Abort(
                         grpc.StatusCode.INVALID_ARGUMENT,
                         "delta upserts must carry unique non-empty names "
                         f"(offending record name: {rec.name!r})",
                     )
                 seen.add(rec.name)
 
-    def _resolve(self, request, context):
-        """Full-or-delta request -> (ClusterSnapshot msg, snapshot_id).
-        Unknown/expired base_id aborts FAILED_PRECONDITION so the client
-        falls back to a full snapshot (DeltaSession does). Snapshots
-        whose records lack unique non-empty names are served but not
-        registered (empty snapshot_id): name-keyed stores would collapse
-        them (DeltaSession refuses to delta against those too)."""
+    def _session_put(self, session: DeviceSession) -> None:
+        """(Re-)register under the session's current keys; LRU-evict
+        whole sessions (not keys) past the cap. Sessions stay SHARED in
+        the map while requests use them: a depth-2 pipeline always has
+        one request in flight, and cumulative-from-pin applies are
+        order-independent (every apply restores relative to the pin),
+        so concurrent lineage requests serialize on session.lock
+        instead of missing and re-seeding."""
+        with self._store_lock:
+            for k in [k for k, v in self._sessions.items() if v is session]:
+                del self._sessions[k]
+            for k in session.keys():
+                self._sessions.pop(k, None)
+                self._sessions[k] = session
+            distinct = []
+            for s in self._sessions.values():
+                if s not in distinct:
+                    distinct.append(s)
+            while len(distinct) > max(self._session_cap, 0):
+                victim = distinct.pop(0)
+                for k in list(self._sessions):
+                    if self._sessions[k] is victim:
+                        del self._sessions[k]
+
+    def _resolve_decoded(self, request):
+        """Full-or-delta request -> (snap, meta, snapshot_id,
+        decode_seconds, device_stats|None) with the decoded arrays
+        ready for dispatch.
+
+        Delta requests against a lineage with a live DeviceSession skip
+        the recompose + full decode + full H2D entirely: the delta
+        applies as on-device scatter updates (O(churn) host work) and
+        `device_stats` reports what was shipped. The byte store is
+        still advanced and registered either way — it is the fallback
+        truth for forks, session eviction, and seeding.
+
+        Unknown/expired base_id raises _Abort(FAILED_PRECONDITION) so
+        the client falls back to a full snapshot (DeltaSession does).
+        Snapshots whose records lack unique non-empty names are served
+        but not registered (empty snapshot_id): name-keyed stores would
+        collapse them (DeltaSession refuses to delta against those too).
+        """
         if request.HasField("delta"):
-            if not request.delta.base_id:
+            base_id = request.delta.base_id
+            if not base_id:
                 # Falling through would silently solve the empty default
                 # snapshot; a delta without a base cannot be resolved.
-                context.abort(
+                raise _Abort(
                     grpc.StatusCode.INVALID_ARGUMENT,
                     "delta request carries no base_id",
                 )
-            self._check_delta_upserts(request.delta, context)
+            self._check_delta_upserts(request.delta)
             with self._store_lock:
-                base = self._stores.get(request.delta.base_id)
+                base = self._stores.get(base_id)
                 if base is not None:
                     # True-LRU refresh: a hit keeps the base alive while
                     # unrelated sessions churn the cap.
-                    self._stores.pop(request.delta.base_id)
-                    self._stores[request.delta.base_id] = base
+                    self._stores.pop(base_id)
+                    self._stores[base_id] = base
             if base is None:
-                context.abort(
+                raise _Abort(
                     grpc.StatusCode.FAILED_PRECONDITION,
-                    f"unknown snapshot base_id {request.delta.base_id!r}",
+                    f"unknown snapshot base_id {base_id!r}",
                 )
             store = base.copy()
             store.apply_delta(request.delta)
+            sid = self._register_store(store)
+            t0 = time.perf_counter()
+            seeding = False
+            with self._store_lock:
+                session = self._sessions.get(base_id)
+                if (session is None and self._session_cap > 0
+                        and base_id not in self._seeding):
+                    self._seeding.add(base_id)
+                    seeding = True
+            if seeding:
+                # Lazy seed on the FIRST delta of a lineage, from the
+                # BASE store (so the pin matches what pipelined clients
+                # keep diffing against): one O(cluster) record
+                # conversion + build + upload buys O(churn) host work
+                # for every later delta. Full-send-only clients never
+                # pay this; a concurrent second first-delta skips the
+                # duplicate build (_seeding guard) and decodes.
+                try:
+                    session = DeviceSession.from_base_store(
+                        base, base_id, self.config, self.buckets
+                    )
+                    self.session_seeds += 1
+                except Exception:
+                    import logging
+                    import traceback
+
+                    logging.getLogger("tpusched.rpc.server").warning(
+                        "device session seed failed; serving via the "
+                        "decode path:\n%s", traceback.format_exc(limit=3),
+                    )
+                finally:
+                    with self._store_lock:
+                        self._seeding.discard(base_id)
+            if session is not None:
+                try:
+                    with session.lock:
+                        stats = session.apply_delta(base_id, request.delta,
+                                                    sid)
+                        snap, meta = session.device.snap, session.device.meta
+                except KeyError:
+                    # Expected fork: the lineage moved past this base
+                    # while we waited. Serve via decode; the session is
+                    # untouched. (Counted below as a miss, not a hit.)
+                    pass
+                except Exception:
+                    # Heal through the decode path; the session may be
+                    # inconsistent, so drop it (loud, like the native-
+                    # decoder fallback: silent means a permanent
+                    # O(cluster) regression).
+                    import logging
+                    import traceback
+
+                    logging.getLogger("tpusched.rpc.server").warning(
+                        "device session apply failed; dropping the "
+                        "lineage and re-decoding:\n%s",
+                        traceback.format_exc(limit=3),
+                    )
+                    with self._store_lock:
+                        for k in [k for k, v in self._sessions.items()
+                                  if v is session]:
+                            del self._sessions[k]
+                else:
+                    self._session_put(session)
+                    if not seeding:
+                        # Counted on SUCCESS only, so a fork's KeyError
+                        # (hit-then-decode) is one miss, not hit+miss —
+                        # hits + seeds + misses == delta requests.
+                        self.session_hits += 1
+                    return snap, meta, sid, time.perf_counter() - t0, stats
+            self.session_misses += 1
             # Bytes composition straight into the (native) decoder: no
             # Python ClusterSnapshot is materialized on the delta path.
-            return store.compose_bytes(), self._register_store(store)
+            snap, meta, decode_s = self._decode(store.compose_bytes())
+            return snap, meta, sid, decode_s, None
         msg = request.snapshot
         if not delta_safe(msg):
-            return msg, ""
+            snap, meta, decode_s = self._decode(msg)
+            return snap, meta, "", decode_s, None
         store = SnapshotStore()
         # One serialize pass per record at full-send time so every
         # later delta cycle serializes only its churn (apply_delta) and
         # composes by concatenation.
         store.set_full_bytes(msg)
-        return msg, self._register_store(store)
+        sid = self._register_store(store)
+        snap, meta, decode_s = self._decode(msg)
+        return snap, meta, sid, decode_s, None
 
     def _decode(self, snapshot_msg):
         t0 = time.perf_counter()
@@ -247,8 +706,18 @@ class SchedulerService:
         )
         return snap, meta, time.perf_counter() - t0
 
+    def close(self) -> None:
+        """Release serving resources: refuse queued dispatches, drain
+        the engine's fetch worker (in-flight results complete), drop
+        device-resident sessions. Idempotent; call after server.stop()."""
+        self._gate.close()
+        self._engine.close(wait=True)
+        with self._store_lock:
+            self._sessions.clear()
+
     def _log_batch(self, rpc: str, meta, decode_s: float, solve_s: float,
-                   placed: int, evicted: int, rounds: int):
+                   placed: int, evicted: int, rounds: int,
+                   dstats=None, fused: int = 0):
         rec = dict(
             ts=time.time(), rpc=rpc, pods=meta.n_pods, nodes=meta.n_nodes,
             running=meta.n_running, buckets=[meta.buckets.pods, meta.buckets.nodes],
@@ -256,50 +725,149 @@ class SchedulerService:
             placed=placed, evicted=evicted, rounds=rounds,
             placements_per_sec=round(placed / solve_s, 1) if solve_s > 0 else 0,
         )
+        if dstats is not None:
+            rec["device_path"] = dstats.path
+            rec["h2d_bytes"] = dstats.h2d_bytes
+            if dstats.reason:
+                rec["device_rebuild_reason"] = dstats.reason
+        if fused:
+            rec["fused"] = fused
         print(json.dumps(rec), file=self._log, flush=True)
 
     # -- rpc methods --------------------------------------------------------
 
+    @staticmethod
+    def _peer(context) -> str:
+        """Gate client identity; in-process callers (tests invoking
+        handlers directly) have no grpc context."""
+        return context.peer() if context is not None else "in-process"
+
+    @staticmethod
+    def _score_key(request: pb.ScoreRequest):
+        """Coalescing identity of a ScoreBatch DELTA request: same base
+        + byte-identical delta = identical post-delta cluster state.
+        Full sends never coalesce (hashing the whole snapshot would
+        cost more than it saves), and the form kind separates top-k
+        fusions (k merged) from full-matrix fusions (exact dedupe)."""
+        if not request.HasField("delta"):
+            return None
+        import hashlib
+
+        kind = ("topk" if request.top_k > 0
+                else f"full-packed{int(bool(request.packed_ok))}")
+        digest = hashlib.sha1(request.delta.SerializeToString()).hexdigest()
+        return (request.delta.base_id, digest, kind)
+
+    @staticmethod
+    def _abort(context, code, details):
+        """context.abort, or the raw status as an exception for
+        in-process callers (context=None — see _peer)."""
+        if context is None:
+            raise _Abort(code, details)
+        context.abort(code, details)
+
     def ScoreBatch(self, request: pb.ScoreRequest, context) -> pb.ScoreResponse:
-        msg, sid = self._resolve(request, context)
-        snap, meta, decode_s = self._decode(msg)
-        resp = pb.ScoreResponse(snapshot_id=sid)
+        try:
+            return self._score_batch(request, context)
+        except _Abort as e:
+            self._abort(context, e.code, e.details)
+        except _Overloaded as e:
+            self._abort(context, grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+
+    def _score_batch(self, request: pb.ScoreRequest, context) -> pb.ScoreResponse:
+        key = self._score_key(request)
+        fusion = None
+        if key is not None:
+            fusion, leader = self._coalescer.join(key, int(request.top_k))
+            if not leader:
+                # A leader is already resolving this exact state: wait
+                # for its dispatch and slice our own k from the shared
+                # result — no decode, no dispatch, no extra fetch.
+                payload = fusion.wait(timeout=600.0)
+                resp, solve_s = self._score_response(payload, request)
+                self.metrics.observe(payload["P"], 0, 0, solve_s)
+                return resp
+        try:
+            payload = self._score_dispatch(request, context, fusion)
+        except BaseException as e:
+            if fusion is not None:
+                # Followers must see the SAME status class the leader
+                # got — an _Overloaded leader means the whole fusion was
+                # refused admission (retryable), not a server bug.
+                if isinstance(e, _Abort):
+                    code = e.code
+                elif isinstance(e, _Overloaded):
+                    code = grpc.StatusCode.RESOURCE_EXHAUSTED
+                else:
+                    code = grpc.StatusCode.INTERNAL
+                fusion.fail(code, str(e))
+                self._coalescer.finish(fusion)
+            raise
+        if fusion is not None:
+            fusion.publish(payload)
+            self._coalescer.finish(fusion)
+        resp, solve_s = self._score_response(payload, request)
+        self._log_batch(
+            "ScoreBatch", payload["meta"], payload["decode_s"], solve_s,
+            0, 0, 0, dstats=payload["dstats"],
+            fused=(len(fusion._ks) - 1) if fusion is not None else 0,
+        )
+        self.metrics.observe(payload["P"], 0, 0, payload["decode_s"] + solve_s)
+        return resp
+
+    def _score_dispatch(self, request, context, fusion) -> dict:
+        """Leader path: resolve + decode outside the dispatch slot,
+        dispatch the requested form once (k = fused max for top-k),
+        return the shared payload followers slice from."""
+        snap, meta, sid, decode_s, dstats = self._resolve_decoded(request)
         P, N = meta.n_pods, meta.n_nodes
-        # Staged (see the lane comment in __init__): dispatch the device
-        # work for whichever form was requested, then build the response
-        # name tables — ONE authority, below — while the fetch is in
-        # flight. Both forms fetch through the engine's ordered worker:
-        # a handler-thread fetch would race a pipelined Assign's
-        # in-flight fetch on fetch-driven transports.
         pending_topk = pending_full = None
-        k = 0
-        if request.top_k > 0:
-            # O(P) response: top-k computed on device, [P,N] never
-            # fetched. The only form that serves the headline shape
-            # under budget on bandwidth-limited links. A drained
-            # cluster (N == 0) has nothing to rank: k stays 0 with no
-            # rows, which the client decodes as [P, 0] arrays.
-            if N > 0:
-                k = min(int(request.top_k), N)
-                with self._dispatch_lane:
-                    pending_topk = self._engine.score_topk_async(snap, k)
-        else:
-            with self._dispatch_lane:
+        k_used = 0
+        with self._gate.slot(self._peer(context)):
+            # Seal INSIDE the slot: every request that joined while this
+            # one queued rides the same dispatch.
+            k_fused = fusion.seal() if fusion is not None \
+                else int(request.top_k)
+            if request.top_k > 0:
+                # O(P) response: top-k computed on device, [P,N] never
+                # fetched. A drained cluster (N == 0) has nothing to
+                # rank: k stays 0 with no rows, which the client
+                # decodes as [P, 0] arrays.
+                if N > 0:
+                    k_used = min(max(k_fused, 1), N)
+                    pending_topk = self._engine.score_topk_async(snap, k_used)
+            else:
                 pending_full = self._engine.score_async(snap)
+        return dict(sid=sid, meta=meta, P=P, N=N, decode_s=decode_s,
+                    dstats=dstats, k_used=k_used,
+                    pending_topk=pending_topk, pending_full=pending_full)
+
+    @staticmethod
+    def _score_response(payload: dict, request) -> tuple[pb.ScoreResponse, float]:
+        """Build ONE caller's response from the (possibly shared)
+        payload: name tables now — they ride inside the device window —
+        then join the fetch and pack this caller's k columns."""
+        meta = payload["meta"]
+        P, N = payload["P"], payload["N"]
+        resp = pb.ScoreResponse(snapshot_id=payload["sid"])
         resp.pod_names.extend(meta.pod_names)
         resp.node_names.extend(meta.node_names)
         solve_s = 0.0
-        if pending_topk is not None:
-            idx, val, solve_s = pending_topk.result()
-            resp.k = k
+        if payload["pending_topk"] is not None:
+            idx, val, solve_s = payload["pending_topk"].result()
+            # lax.top_k is prefix-stable: columns [:k_own] of the fused
+            # top-k_used equal a direct top-k_own dispatch, so sliced
+            # responses are byte-identical to unfused serving.
+            k_own = min(int(request.top_k), N)
+            resp.k = k_own
             resp.topk_idx_packed = np.ascontiguousarray(
-                idx[:P], dtype="<i4"
+                idx[:P, :k_own], dtype="<i4"
             ).tobytes()
             resp.topk_score_packed = np.ascontiguousarray(
-                val[:P], dtype="<f4"
+                val[:P, :k_own], dtype="<f4"
             ).tobytes()
-        elif pending_full is not None:
-            res = pending_full.result()
+        elif payload["pending_full"] is not None:
+            res = payload["pending_full"].result()
             solve_s = res.solve_seconds
             if request.packed_ok and P * N >= PACK_CELLS:
                 resp.feasible_packed = np.ascontiguousarray(
@@ -313,19 +881,26 @@ class SchedulerService:
                     row = resp.rows.add()
                     row.feasible.extend(res.feasible[i, :N].tolist())
                     row.scores.extend(res.scores[i, :N].tolist())
-        self._log_batch("ScoreBatch", meta, decode_s, solve_s, 0, 0, 0)
-        self.metrics.observe(P, 0, 0, decode_s + solve_s)
-        return resp
+        return resp, solve_s
 
     def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
-        msg, sid = self._resolve(request, context)
-        snap, meta, decode_s = self._decode(msg)
-        # Staged handling (round 6): decode ran OUTSIDE the lane (so a
-        # concurrent request's decode overlaps this solve), dispatch
-        # holds the lane only long enough to enqueue the program, and
+        try:
+            return self._assign(request, context)
+        except _Abort as e:
+            self._abort(context, e.code, e.details)
+        except _Overloaded as e:
+            self._abort(context, grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+
+    def _assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
+        snap, meta, sid, decode_s, dstats = self._resolve_decoded(request)
+        # Staged handling (round 6): decode runs OUTSIDE the dispatch
+        # slot (so a concurrent request's decode overlaps this solve),
+        # the slot is held only long enough to enqueue the program, and
         # the response's name tables build while the engine's worker
-        # drives the device and fetches the packed buffer.
-        with self._dispatch_lane:
+        # drives the device and fetches the packed buffer. The gate
+        # (round 7) additionally keeps concurrent clients' dispatches
+        # round-robin fair instead of lock-race ordered.
+        with self._gate.slot(self._peer(context)):
             pending = self._engine.solve_async(snap)
         resp = pb.AssignResponse(snapshot_id=sid)
         P = meta.n_pods
@@ -389,7 +964,7 @@ class SchedulerService:
         resp.rounds = res.rounds
         resp.solve_seconds = res.solve_seconds
         self._log_batch("Assign", meta, decode_s, res.solve_seconds,
-                        placed, n_evicted, res.rounds)
+                        placed, n_evicted, res.rounds, dstats=dstats)
         self.metrics.observe(meta.n_pods, placed, n_evicted,
                              decode_s + res.solve_seconds)
         return resp
@@ -409,14 +984,20 @@ def make_server(
     address: str = "127.0.0.1:0",
     config: EngineConfig | None = None,
     buckets: Buckets | None = None,
-    max_workers: int = 4,
+    max_workers: int = 8,
     log_stream=None,
     audit_stream=None,
+    device_sessions: int = DEVICE_SESSION_CAP,
 ):
     """Build (grpc.Server, bound_port, service). Unlimited message size:
-    a 10k-pod snapshot exceeds the 4 MB default."""
+    a 10k-pod snapshot exceeds the 4 MB default. max_workers default 8:
+    4 concurrent clients each keeping 2 requests in flight must all get
+    a decode thread — the dispatch gate, not the thread pool, is the
+    serialization point. Call svc.close() after server.stop() to drain
+    the engine's fetch worker and drop device-resident sessions."""
     svc = SchedulerService(config, buckets, log_stream=log_stream,
-                           audit_stream=audit_stream)
+                           audit_stream=audit_stream,
+                           device_sessions=device_sessions)
 
     def handler(fn, req_cls):
         return grpc.unary_unary_rpc_method_handler(
@@ -449,10 +1030,13 @@ def serve(address: str = "127.0.0.1:50051", config: EngineConfig | None = None,
           audit_path: str | None = None):
     """Blocking entry point: python -m tpusched.rpc.server"""
     audit = open(audit_path, "a") if audit_path else None
-    server, port, _ = make_server(address, config, audit_stream=audit)
+    server, port, svc = make_server(address, config, audit_stream=audit)
     server.start()
     print(f"tpusched sidecar listening on port {port}", file=sys.stderr)
-    server.wait_for_termination()
+    try:
+        server.wait_for_termination()
+    finally:
+        svc.close()
 
 
 if __name__ == "__main__":

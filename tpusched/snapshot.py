@@ -273,6 +273,165 @@ def _try_float(s: str) -> float:
         return float("nan")
 
 
+class _Interner:
+    """The host-side string->id state of one snapshot LINEAGE.
+
+    Extracted from SnapshotBuilder.build()'s closures so it can outlive
+    one build: DeviceSnapshot (device_state.py) keeps an interner alive
+    across delta cycles and compiles only churned records against it —
+    new vocabulary APPENDS, so ids already burned into device arrays
+    stay valid. Id assignment order therefore matches a fresh build only
+    until the first mid-session vocabulary growth; ids are opaque
+    equality tokens everywhere on device, so results are unaffected
+    (the delta-vs-rebuild parity tests pin this)."""
+
+    def __init__(self):
+        self.key_ids: dict[str, int] = {}
+        self.pair_ids: dict[tuple[str, str], int] = {}
+        self.taint_ids: dict[tuple[str, str, str], int] = {}
+        self.atom_ids: dict[tuple, int] = {}
+        self.atoms: list[tuple[int, int, tuple[int, ...], float]] = []
+        self.topo_keys: list[str] = []
+        self.domain_ids: list[dict[str, int]] = []  # per topo key: value -> id
+        self.ns_ids: dict[str, int] = {}
+        self.sig_ids: dict[tuple, int] = {}
+        # each entry: (key_idx, ns_scope, atoms) where ns_scope is "*"
+        # (all namespaces) or a sorted tuple of namespace ids.
+        self.sigs: list[tuple[int, Any, tuple[int, ...]]] = []
+
+    # -- primitive id assignment -------------------------------------------
+
+    def kid(self, k: str) -> int:
+        return self.key_ids.setdefault(k, len(self.key_ids))
+
+    def pid(self, k: str, v: str) -> int:
+        return self.pair_ids.setdefault((k, v), len(self.pair_ids))
+
+    def tid(self, k: str, v: str, effect: str) -> int:
+        if effect not in TAINT_EFFECTS:
+            raise ValueError(f"bad taint effect {effect!r}")
+        return self.taint_ids.setdefault((k, v, effect), len(self.taint_ids))
+
+    def topo_idx(self, k: str) -> int:
+        if k not in self.topo_keys:
+            self.topo_keys.append(k)
+            self.domain_ids.append({})
+        return self.topo_keys.index(k)
+
+    def nsid(self, ns: str) -> int:
+        return self.ns_ids.setdefault(ns, len(self.ns_ids))
+
+    def aid(self, expr: MatchExpression) -> int:
+        op = OPERATORS.index(expr.op)
+        k = self.kid(expr.key)
+        if expr.op in ("In", "NotIn"):
+            pids = tuple(sorted(self.pid(expr.key, v) for v in expr.values))
+            num = float("nan")
+        elif expr.op in ("Gt", "Lt"):
+            pids = ()
+            num = float(expr.values[0])
+        else:
+            pids = ()
+            num = float("nan")
+        # Dedup key must not contain NaN (nan != nan would make every
+        # non-numeric atom "distinct", exploding the atom/signature
+        # tables ~Px): key numeric ops by the number, others by None.
+        sig = (k, op, pids, num if num == num else None)
+        if sig not in self.atom_ids:
+            self.atom_ids[sig] = len(self.atoms)
+            self.atoms.append((k, op, pids, num))
+        return self.atom_ids[sig]
+
+    def sid(self, key_idx: int, atoms_list: list[int], ns_scope) -> int:
+        sig = (key_idx, ns_scope, tuple(sorted(atoms_list)))
+        if sig not in self.sig_ids:
+            self.sig_ids[sig] = len(self.sigs)
+            self.sigs.append(sig)
+        return self.sig_ids[sig]
+
+    def ns_scope_of(self, namespaces: Sequence[str], own_ns: str):
+        """Resolve an affinity term's namespace list against the
+        owning pod's namespace (upstream: empty = own namespace).
+        Iterate names in sorted order so id ASSIGNMENT order is
+        deterministic (set iteration is hash-randomized)."""
+        if not namespaces:
+            return (self.nsid(own_ns),)
+        if "*" in namespaces:
+            return "*"
+        return tuple(sorted(self.nsid(x) for x in sorted(set(namespaces))))
+
+    # -- record-level interning --------------------------------------------
+
+    def compile_pod(self, p: Mapping) -> dict:
+        """Intern everything one pending-pod record references; returns
+        the compiled form row fills consume. MUTATES the interner (new
+        atoms/sigs/namespaces/topology keys append)."""
+        aid = self.aid
+        terms = [NodeSelectorTerm(tuple(
+            MatchExpression(k, "In", (v,))
+            for k, v in sorted(p["node_selector"].items())
+        ))] if p["node_selector"] else []
+        # nodeSelector ANDs with required affinity: encode nodeSelector
+        # as an extra atom set ANDed into every required term (or a
+        # standalone single term when no affinity terms exist).
+        sel_atoms = [aid(e) for t in terms for e in t.expressions]
+        req_terms = []
+        for t in p["required_terms"]:
+            if not t.expressions:
+                continue  # empty term matches no objects -> drop (cannot satisfy)
+            req_terms.append([aid(e) for e in t.expressions] + sel_atoms)
+        if not req_terms and sel_atoms:
+            req_terms = [sel_atoms]
+        pref_terms = [
+            ([aid(e) for e in pt.term.expressions], float(pt.weight))
+            for pt in p["preferred_terms"] if pt.term.expressions
+        ]
+        own_ns = p["namespace"]
+        ts = [
+            dict(key=self.topo_idx(c.topology_key), max_skew=float(c.max_skew),
+                 when=DO_NOT_SCHEDULE if c.when_unsatisfiable == "DoNotSchedule"
+                 else SCHEDULE_ANYWAY,
+                 atoms=[aid(e) for e in c.selector])
+            for c in p["topology_spread"]
+        ]
+        for c in ts:
+            # Spread counting is always scoped to the incoming pod's
+            # own namespace (upstream PodTopologySpread semantics).
+            c["sig"] = self.sid(c["key"], c["atoms"], (self.nsid(own_ns),))
+        ia = [
+            dict(key=self.topo_idx(t.topology_key),
+                 atoms=[aid(e) for e in t.selector],
+                 anti=t.anti, required=t.required, weight=float(t.weight),
+                 ns=self.ns_scope_of(t.namespaces, own_ns))
+            for t in p["pod_affinity"]
+        ]
+        for t in ia:
+            t["sig"] = self.sid(t["key"], t["atoms"], t["ns"])
+        return dict(req_terms=req_terms, pref_terms=pref_terms, ts=ts, ia=ia)
+
+    def compile_running_anti(self, rrec: Mapping) -> tuple[list[int], int]:
+        """Running pods' required anti-affinity terms (symmetric rule):
+        interned into the same signature table as pending terms. Returns
+        (sig ids, widest selector atom count seen)."""
+        sigs_of_pod: list[int] = []
+        atom_max = 0
+        for t in rrec["pod_affinity"]:
+            if not (t.anti and t.required):
+                continue
+            alist = [self.aid(e) for e in t.selector]
+            atom_max = max(atom_max, len(alist))
+            sigs_of_pod.append(self.sid(
+                self.topo_idx(t.topology_key), alist,
+                self.ns_scope_of(t.namespaces, rrec["namespace"]),
+            ))
+        return sigs_of_pod, atom_max
+
+    def intern_labels(self, labels: Mapping[str, str]) -> None:
+        for k, v in labels.items():
+            self.kid(k)
+            self.pid(k, v)
+
+
 class SnapshotBuilder:
     """Accumulates node/pod records and emits a padded ClusterSnapshot.
 
@@ -387,162 +546,45 @@ class SnapshotBuilder:
     # -- build --------------------------------------------------------------
 
     def build(self) -> tuple[ClusterSnapshot, SnapshotMeta]:
+        snap, meta, _ = self.build_state()
+        return snap, meta
+
+    def build_state(self) -> "tuple[ClusterSnapshot, SnapshotMeta, BuiltState]":
+        """build() plus the reusable host state (interner, numpy array
+        holders, index maps) that DeviceSnapshot needs to keep applying
+        O(churn) delta updates against the arrays this call produced."""
         cfg = self.config
         R = len(cfg.resources)
         n_nodes, n_pods, n_running = len(self._nodes), len(self._pods), len(self._running)
 
-        # Interning tables.
-        key_ids: dict[str, int] = {}
-        pair_ids: dict[tuple[str, str], int] = {}
-        taint_ids: dict[tuple[str, str, str], int] = {}
-        atom_ids: dict[tuple, int] = {}
-        atoms: list[tuple[int, int, tuple[int, ...], float]] = []
-        topo_keys: list[str] = []
-        domain_ids: list[dict[str, int]] = []  # per topo key: value -> id
-
-        def kid(k: str) -> int:
-            return key_ids.setdefault(k, len(key_ids))
-
-        def pid(k: str, v: str) -> int:
-            return pair_ids.setdefault((k, v), len(pair_ids))
-
-        def tid(k: str, v: str, effect: str) -> int:
-            if effect not in TAINT_EFFECTS:
-                raise ValueError(f"bad taint effect {effect!r}")
-            return taint_ids.setdefault((k, v, effect), len(taint_ids))
-
-        def topo_idx(k: str) -> int:
-            if k not in topo_keys:
-                topo_keys.append(k)
-                domain_ids.append({})
-            return topo_keys.index(k)
-
-        def aid(expr: MatchExpression) -> int:
-            op = OPERATORS.index(expr.op)
-            k = kid(expr.key)
-            if expr.op in ("In", "NotIn"):
-                pids = tuple(sorted(pid(expr.key, v) for v in expr.values))
-                num = float("nan")
-            elif expr.op in ("Gt", "Lt"):
-                pids = ()
-                num = float(expr.values[0])
-            else:
-                pids = ()
-                num = float("nan")
-            # Dedup key must not contain NaN (nan != nan would make every
-            # non-numeric atom "distinct", exploding the atom/signature
-            # tables ~Px): key numeric ops by the number, others by None.
-            sig = (k, op, pids, num if num == num else None)
-            if sig not in atom_ids:
-                atom_ids[sig] = len(atoms)
-                atoms.append((k, op, pids, num))
-            return atom_ids[sig]
-
-        # Pairwise-constraint signatures: one (topo key, namespace scope,
-        # selector) entry per distinct combination, so domain counting
-        # happens per signature, not per pod (see SigTable).
-        ns_ids: dict[str, int] = {}
-
-        def nsid(ns: str) -> int:
-            return ns_ids.setdefault(ns, len(ns_ids))
-
-        sig_ids: dict[tuple, int] = {}
-        # each entry: (key_idx, ns_scope, atoms) where ns_scope is "*"
-        # (all namespaces) or a sorted tuple of namespace ids.
-        sigs: list[tuple[int, Any, tuple[int, ...]]] = []
-
-        def sid(key_idx: int, atoms_list: list[int], ns_scope) -> int:
-            sig = (key_idx, ns_scope, tuple(sorted(atoms_list)))
-            if sig not in sig_ids:
-                sig_ids[sig] = len(sigs)
-                sigs.append(sig)
-            return sig_ids[sig]
-
-        def ns_scope_of(namespaces: Sequence[str], own_ns: str):
-            """Resolve an affinity term's namespace list against the
-            owning pod's namespace (upstream: empty = own namespace).
-            Iterate names in sorted order so id ASSIGNMENT order is
-            deterministic (set iteration is hash-randomized)."""
-            if not namespaces:
-                return (nsid(own_ns),)
-            if "*" in namespaces:
-                return "*"
-            return tuple(sorted(nsid(x) for x in sorted(set(namespaces))))
+        intr = _Interner()
 
         # First pass: intern everything referenced by pods so vocab sizes
         # are known before arrays are allocated.
-        pod_compiled = []
-        for p in self._pods:
-            terms = [NodeSelectorTerm(tuple(
-                MatchExpression(k, "In", (v,)) for k, v in sorted(p["node_selector"].items())
-            ))] if p["node_selector"] else []
-            # nodeSelector ANDs with required affinity: encode nodeSelector
-            # as an extra atom set ANDed into every required term (or a
-            # standalone single term when no affinity terms exist).
-            sel_atoms = [aid(e) for t in terms for e in t.expressions]
-            req_terms = []
-            for t in p["required_terms"]:
-                if not t.expressions:
-                    continue  # empty term matches no objects -> drop (cannot satisfy)
-                req_terms.append([aid(e) for e in t.expressions] + sel_atoms)
-            if not req_terms and sel_atoms:
-                req_terms = [sel_atoms]
-            pref_terms = [
-                ([aid(e) for e in pt.term.expressions], float(pt.weight))
-                for pt in p["preferred_terms"] if pt.term.expressions
-            ]
-            own_ns = p["namespace"]
-            ts = [
-                dict(key=topo_idx(c.topology_key), max_skew=float(c.max_skew),
-                     when=DO_NOT_SCHEDULE if c.when_unsatisfiable == "DoNotSchedule" else SCHEDULE_ANYWAY,
-                     atoms=[aid(e) for e in c.selector])
-                for c in p["topology_spread"]
-            ]
-            for c in ts:
-                # Spread counting is always scoped to the incoming pod's
-                # own namespace (upstream PodTopologySpread semantics).
-                c["sig"] = sid(c["key"], c["atoms"], (nsid(own_ns),))
-            ia = [
-                dict(key=topo_idx(t.topology_key), atoms=[aid(e) for e in t.selector],
-                     anti=t.anti, required=t.required, weight=float(t.weight),
-                     ns=ns_scope_of(t.namespaces, own_ns))
-                for t in p["pod_affinity"]
-            ]
-            for t in ia:
-                t["sig"] = sid(t["key"], t["atoms"], t["ns"])
-            pod_compiled.append(dict(req_terms=req_terms, pref_terms=pref_terms, ts=ts, ia=ia))
+        pod_compiled = [intr.compile_pod(p) for p in self._pods]
 
         # Running pods' required anti-affinity terms (symmetric rule):
         # interned into the same signature table as pending terms.
         run_anti: list[list[int]] = []
         run_anti_atom_max = 0
         for rrec in self._running:
-            sigs_of_pod = []
-            for t in rrec["pod_affinity"]:
-                if not (t.anti and t.required):
-                    continue
-                alist = [aid(e) for e in t.selector]
-                run_anti_atom_max = max(run_anti_atom_max, len(alist))
-                sigs_of_pod.append(sid(
-                    topo_idx(t.topology_key), alist,
-                    ns_scope_of(t.namespaces, rrec["namespace"]),
-                ))
+            sigs_of_pod, am = intr.compile_running_anti(rrec)
+            run_anti_atom_max = max(run_anti_atom_max, am)
             run_anti.append(sigs_of_pod)
 
         # Intern node labels/taints.
         for nrec in self._nodes:
-            for k, v in nrec["labels"].items():
-                kid(k); pid(k, v)
+            intr.intern_labels(nrec["labels"])
             for (k, v, e) in nrec["taints"]:
-                tid(k, v, e)
+                intr.tid(k, v, e)
         for rrec in self._running:
-            for k, v in rrec["labels"].items():
-                kid(k); pid(k, v)
-            nsid(rrec["namespace"])
+            intr.intern_labels(rrec["labels"])
+            intr.nsid(rrec["namespace"])
         for p in self._pods:
-            for k, v in p["labels"].items():
-                kid(k); pid(k, v)
-            nsid(p["namespace"])
+            intr.intern_labels(p["labels"])
+            intr.nsid(p["namespace"])
+        atoms, sigs, topo_keys = intr.atoms, intr.sigs, intr.topo_keys
+        taint_ids = intr.taint_ids
 
         # Buckets: start minimal (size-0 feature axes, whose kernels the
         # tracer drops entirely) and grow only to observed need, so
@@ -602,203 +644,398 @@ class SnapshotBuilder:
 
         P, N, M = bk.pods, bk.nodes, bk.running_pods
 
-        # Atom table arrays.
-        atom_key = np.full(bk.atoms, -1, np.int32)
-        atom_op = np.zeros(bk.atoms, np.int8)
-        atom_pairs = np.full((bk.atoms, bk.atom_values), -1, np.int32)
-        atom_num = np.full(bk.atoms, np.nan, np.float32)
-        atom_valid = np.zeros(bk.atoms, bool)
-        for i, (k, op, pids, num) in enumerate(atoms):
-            atom_key[i] = k
-            atom_op[i] = op
-            atom_pairs[i, : len(pids)] = pids
-            atom_num[i] = num
-            atom_valid[i] = True
+        # Atom table.
+        tables = _TableArraysNP(bk)
+        for i, atom in enumerate(atoms):
+            _fill_atom_row(tables, i, atom)
 
         # Node arrays.
-        node_alloc = np.zeros((N, R), np.float32)
-        node_used = np.zeros((N, R), np.float32)
-        node_lp = np.full((N, bk.node_labels), -1, np.int32)
-        node_lk = np.full((N, bk.node_labels), -1, np.int32)
-        node_ln = np.full((N, bk.node_labels), np.nan, np.float32)
-        node_t = np.full((N, bk.node_taints), -1, np.int32)
-        node_dom = np.full((N, bk.topo_keys), -1, np.int32)
-        node_sched = np.zeros(N, bool)
-        node_valid = np.zeros(N, bool)
+        nodes_np = _NodeArraysNP(bk, R)
         node_index = {}
         for i, nrec in enumerate(self._nodes):
             node_index[nrec["name"]] = i
-            node_valid[i] = True
-            node_sched[i] = not nrec["unschedulable"]
-            for r, rn in enumerate(cfg.resources):
-                node_alloc[i, r] = float(nrec["allocatable"].get(rn, 0.0))
-                node_used[i, r] = float(nrec["used"].get(rn, 0.0))
-            for j, (k, v) in enumerate(sorted(nrec["labels"].items())):
-                node_lk[i, j] = key_ids[k]
-                node_lp[i, j] = pair_ids[(k, v)]
-                node_ln[i, j] = _try_float(v)
-            for j, (k, v, e) in enumerate(nrec["taints"]):
-                node_t[i, j] = taint_ids[(k, v, e)]
-            for ti, tk in enumerate(topo_keys):
-                if tk in nrec["labels"]:
-                    v = nrec["labels"][tk]
-                    node_dom[i, ti] = domain_ids[ti].setdefault(v, len(domain_ids[ti]))
+            _fill_node_row(nodes_np, i, nrec, intr, cfg)
 
         # Taint effect table.
-        vt = bk.taint_vocab
-        taint_effect = np.zeros(vt, np.int8)
         for (k, v, e), t in taint_ids.items():
-            taint_effect[t] = TAINT_EFFECTS.index(e)
+            tables.taint_effect[t] = TAINT_EFFECTS.index(e)
 
         # Signature table.
-        sig_key = np.full(bk.signatures, -1, np.int32)
-        sig_atoms_arr = np.full((bk.signatures, bk.term_atoms), -1, np.int32)
-        sig_ns = np.full((bk.signatures, bk.sig_namespaces), -1, np.int32)
-        sig_ns_all = np.zeros(bk.signatures, bool)
-        sig_valid = np.zeros(bk.signatures, bool)
-        for s, (k, ns_scope, alist) in enumerate(sigs):
-            sig_key[s] = k
-            sig_atoms_arr[s, : len(alist)] = alist
-            if ns_scope == "*":
-                sig_ns_all[s] = True
-            else:
-                sig_ns[s, : len(ns_scope)] = ns_scope
-            sig_valid[s] = True
+        for s, sig in enumerate(sigs):
+            _fill_sig_row(tables, s, sig)
 
         # Pod arrays.
         pods = _PodArraysNP(bk, R)
         group_list = sorted(self._groups)
         group_idx = {g: i for i, g in enumerate(group_list)}
         for i, (p, pc) in enumerate(zip(self._pods, pod_compiled)):
-            pods.valid[i] = True
-            for r, rn in enumerate(cfg.resources):
-                pods.requests[i, r] = float(p["requests"].get(rn, 0.0))
-            pods.base_priority[i] = p["priority"]
-            pods.slo_target[i] = p["slo_target"]
-            pods.observed_avail[i] = p["observed_avail"]
-            for j, (k, v) in enumerate(sorted(p["labels"].items())):
-                pods.label_keys[i, j] = key_ids[k]
-                pods.label_pairs[i, j] = pair_ids[(k, v)]
-            # Tolerations precompiled against the taint vocab.
-            for (tk, tv, te), t in taint_ids.items():
-                pods.tolerated[i, t] = any(
-                    _tolerates(tol, tk, tv, te) for tol in p["tolerations"]
-                )
-            for t, term in enumerate(pc["req_terms"]):
-                pods.req_term_valid[i, t] = True
-                pods.req_term_atoms[i, t, : len(term)] = term
-            for t, (term, w) in enumerate(pc["pref_terms"]):
-                pods.pref_term_valid[i, t] = True
-                pods.pref_term_atoms[i, t, : len(term)] = term
-                pods.pref_weight[i, t] = w
-            for c, con in enumerate(pc["ts"]):
-                pods.ts_valid[i, c] = True
-                pods.ts_key[i, c] = con["key"]
-                pods.ts_max_skew[i, c] = con["max_skew"]
-                pods.ts_when[i, c] = con["when"]
-                pods.ts_sel_atoms[i, c, : len(con["atoms"])] = con["atoms"]
-                pods.ts_sig[i, c] = con["sig"]
-            for t, term in enumerate(pc["ia"]):
-                pods.ia_valid[i, t] = True
-                pods.ia_key[i, t] = term["key"]
-                pods.ia_sel_atoms[i, t, : len(term["atoms"])] = term["atoms"]
-                pods.ia_sig[i, t] = term["sig"]
-                pods.ia_anti[i, t] = term["anti"]
-                pods.ia_required[i, t] = term["required"]
-                pods.ia_weight[i, t] = term["weight"]
-            if p["pod_group"] is not None:
-                pods.group[i] = group_idx[p["pod_group"]]
-            pods.namespace[i] = ns_ids[p["namespace"]]
-            pods.tolerates_unsched[i] = any(
-                _tolerates(tol, "node.kubernetes.io/unschedulable", "",
-                           "NoSchedule")
-                for tol in p["tolerations"]
-            )
+            _fill_pod_row(pods, i, p, pc, intr, cfg, group_idx)
 
-        group_min = np.zeros(bk.pod_groups, np.int32)
         for g, name in enumerate(group_list):
-            group_min[g] = self._groups[name]
+            tables.group_min[g] = self._groups[name]
 
         # Running pods.
-        run_node = np.full(M, -1, np.int32)
-        run_req = np.zeros((M, R), np.float32)
-        run_prio = np.zeros(M, np.float32)
-        run_slack = np.zeros(M, np.float32)
-        run_lp = np.full((M, bk.pod_labels), -1, np.int32)
-        run_lk = np.full((M, bk.pod_labels), -1, np.int32)
-        run_anti_sig = np.full((M, bk.affinity_terms), -1, np.int32)
-        run_ns = np.full(M, -1, np.int32)
-        run_pdb = np.full(M, -1, np.int32)
-        run_valid = np.zeros(M, bool)
+        run_np = _RunningArraysNP(bk, R)
         pdb_list = sorted(self._pdbs)
         pdb_idx = {g: i for i, g in enumerate(pdb_list)}
-        pdb_allowed = np.zeros(bk.pdb_groups, np.float32)
         for g, name in enumerate(pdb_list):
-            pdb_allowed[g] = float(self._pdbs[name])
+            tables.pdb_allowed[g] = float(self._pdbs[name])
         for i, rrec in enumerate(self._running):
-            ni = node_index[rrec["node"]]
-            run_node[i] = ni
-            run_valid[i] = True
-            for r, rn in enumerate(cfg.resources):
-                run_req[i, r] = float(rrec["requests"].get(rn, 0.0))
-                if rrec["count_into_used"]:
-                    node_used[ni, r] += float(rrec["requests"].get(rn, 0.0))
-            run_prio[i] = rrec["priority"]
-            run_slack[i] = rrec["slack"]
-            for j, (k, v) in enumerate(sorted(rrec["labels"].items())):
-                run_lk[i, j] = key_ids[k]
-                run_lp[i, j] = pair_ids[(k, v)]
-            for j, s in enumerate(run_anti[i]):
-                run_anti_sig[i, j] = s
-            run_ns[i] = ns_ids[rrec["namespace"]]
-            if rrec["pdb_group"] is not None:
-                run_pdb[i] = pdb_idx[rrec["pdb_group"]]
+            _fill_running_row(run_np, i, rrec, run_anti[i], intr, cfg,
+                              node_index, pdb_idx)
+            # Fold counted requests into the node's used row HERE, in
+            # record order, so incremental re-encodes that re-sum a
+            # node's members in the same order stay float-identical.
+            if rrec["count_into_used"]:
+                ni = node_index[rrec["node"]]
+                for r, rn in enumerate(cfg.resources):
+                    nodes_np.used[ni, r] += float(rrec["requests"].get(rn, 0.0))
 
-        snap = ClusterSnapshot(
-            nodes=NodeArrays(
-                allocatable=node_alloc, used=node_used, label_pairs=node_lp,
-                label_keys=node_lk, label_nums=node_ln, taint_ids=node_t,
-                domain=node_dom, schedulable=node_sched, valid=node_valid,
-            ),
-            pods=PodArrays(
-                requests=pods.requests, base_priority=pods.base_priority,
-                slo_target=pods.slo_target, observed_avail=pods.observed_avail,
-                tolerated=pods.tolerated, label_pairs=pods.label_pairs,
-                label_keys=pods.label_keys, req_term_atoms=pods.req_term_atoms,
-                req_term_valid=pods.req_term_valid,
-                pref_term_atoms=pods.pref_term_atoms,
-                pref_term_valid=pods.pref_term_valid, pref_weight=pods.pref_weight,
-                ts_key=pods.ts_key, ts_max_skew=pods.ts_max_skew,
-                ts_when=pods.ts_when, ts_sel_atoms=pods.ts_sel_atoms,
-                ts_sig=pods.ts_sig, ts_valid=pods.ts_valid,
-                ia_key=pods.ia_key, ia_sel_atoms=pods.ia_sel_atoms,
-                ia_sig=pods.ia_sig, ia_anti=pods.ia_anti,
-                ia_required=pods.ia_required, ia_weight=pods.ia_weight,
-                ia_valid=pods.ia_valid, group=pods.group,
-                namespace=pods.namespace,
-                tolerates_unsched=pods.tolerates_unsched, valid=pods.valid,
-            ),
-            running=RunningPodArrays(
-                node_idx=run_node, requests=run_req, priority=run_prio,
-                slack=run_slack, label_pairs=run_lp, label_keys=run_lk,
-                anti_sig=run_anti_sig, namespace=run_ns,
-                pdb_group=run_pdb, valid=run_valid,
-            ),
-            atoms=AtomTable(key=atom_key, op=atom_op, pairs=atom_pairs,
-                            num=atom_num, valid=atom_valid),
-            sigs=SigTable(key=sig_key, atoms=sig_atoms_arr, ns=sig_ns,
-                          ns_all=sig_ns_all, valid=sig_valid),
-            taint_effect=taint_effect,
-            group_min_member=group_min,
-            pdb_allowed=pdb_allowed,
-        )
+        snap = _snapshot_from_arrays(nodes_np, pods, run_np, tables)
         meta = SnapshotMeta(
             node_names=[n["name"] for n in self._nodes],
             pod_names=[p["name"] for p in self._pods],
             n_nodes=n_nodes, n_pods=n_pods, n_running=n_running,
             buckets=bk, group_names=group_list,
         )
-        return snap, meta
+        state = BuiltState(
+            interner=intr, nodes_np=nodes_np, pods_np=pods, run_np=run_np,
+            tables=tables, buckets=bk, node_index=node_index,
+            group_idx=group_idx, pdb_idx=pdb_idx,
+        )
+        return snap, meta, state
+
+
+# ---------------------------------------------------------------------------
+# Numpy array holders + single-row fills (shared by build and the
+# incremental DeviceSnapshot path in device_state.py). Every fill RESETS
+# the row to padding first, so re-encoding a churned row in place is
+# exactly equivalent to building it fresh.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BuiltState:
+    """Host state of one build, reusable for incremental row updates."""
+
+    interner: _Interner
+    nodes_np: "_NodeArraysNP"
+    pods_np: "_PodArraysNP"
+    run_np: "_RunningArraysNP"
+    tables: "_TableArraysNP"
+    buckets: Buckets
+    node_index: dict
+    group_idx: dict
+    pdb_idx: dict
+
+
+class _NodeArraysNP:
+    """Scratch numpy buffers for NodeArrays during build."""
+
+    def __init__(self, bk: Buckets, R: int):
+        N = bk.nodes
+        self.allocatable = np.zeros((N, R), np.float32)
+        self.used = np.zeros((N, R), np.float32)
+        self.label_pairs = np.full((N, bk.node_labels), -1, np.int32)
+        self.label_keys = np.full((N, bk.node_labels), -1, np.int32)
+        self.label_nums = np.full((N, bk.node_labels), np.nan, np.float32)
+        self.taint_ids = np.full((N, bk.node_taints), -1, np.int32)
+        self.domain = np.full((N, bk.topo_keys), -1, np.int32)
+        self.schedulable = np.zeros(N, bool)
+        self.valid = np.zeros(N, bool)
+
+
+class _RunningArraysNP:
+    """Scratch numpy buffers for RunningPodArrays during build."""
+
+    def __init__(self, bk: Buckets, R: int):
+        M = bk.running_pods
+        self.node_idx = np.full(M, -1, np.int32)
+        self.requests = np.zeros((M, R), np.float32)
+        self.priority = np.zeros(M, np.float32)
+        self.slack = np.zeros(M, np.float32)
+        self.label_pairs = np.full((M, bk.pod_labels), -1, np.int32)
+        self.label_keys = np.full((M, bk.pod_labels), -1, np.int32)
+        self.anti_sig = np.full((M, bk.affinity_terms), -1, np.int32)
+        self.namespace = np.full(M, -1, np.int32)
+        self.pdb_group = np.full(M, -1, np.int32)
+        self.valid = np.zeros(M, bool)
+
+
+class _TableArraysNP:
+    """Atom/sig/taint/group/PDB table buffers during build."""
+
+    def __init__(self, bk: Buckets):
+        self.atom_key = np.full(bk.atoms, -1, np.int32)
+        self.atom_op = np.zeros(bk.atoms, np.int8)
+        self.atom_pairs = np.full((bk.atoms, bk.atom_values), -1, np.int32)
+        self.atom_num = np.full(bk.atoms, np.nan, np.float32)
+        self.atom_valid = np.zeros(bk.atoms, bool)
+        self.sig_key = np.full(bk.signatures, -1, np.int32)
+        self.sig_atoms = np.full((bk.signatures, bk.term_atoms), -1, np.int32)
+        self.sig_ns = np.full((bk.signatures, bk.sig_namespaces), -1, np.int32)
+        self.sig_ns_all = np.zeros(bk.signatures, bool)
+        self.sig_valid = np.zeros(bk.signatures, bool)
+        self.taint_effect = np.zeros(bk.taint_vocab, np.int8)
+        self.group_min = np.zeros(bk.pod_groups, np.int32)
+        self.pdb_allowed = np.zeros(bk.pdb_groups, np.float32)
+
+
+def _fill_atom_row(tables: _TableArraysNP, i: int, atom) -> None:
+    k, op, pids, num = atom
+    tables.atom_key[i] = k
+    tables.atom_op[i] = op
+    tables.atom_pairs[i] = -1
+    tables.atom_pairs[i, : len(pids)] = pids
+    tables.atom_num[i] = num
+    tables.atom_valid[i] = True
+
+
+def _fill_sig_row(tables: _TableArraysNP, s: int, sig) -> None:
+    k, ns_scope, alist = sig
+    tables.sig_key[s] = k
+    tables.sig_atoms[s] = -1
+    tables.sig_atoms[s, : len(alist)] = alist
+    tables.sig_ns[s] = -1
+    if ns_scope == "*":
+        tables.sig_ns_all[s] = True
+    else:
+        tables.sig_ns_all[s] = False
+        tables.sig_ns[s, : len(ns_scope)] = ns_scope
+    tables.sig_valid[s] = True
+
+
+def _fill_node_row(nodes_np: _NodeArraysNP, i: int, nrec: dict,
+                   intr: _Interner, cfg: EngineConfig) -> None:
+    """Encode one node record into row i. `used` is the record's OWN
+    usage only — counted running-pod requests are folded in by the
+    caller (build_state / DeviceSnapshot), which owns summation order."""
+    nodes_np.valid[i] = True
+    nodes_np.schedulable[i] = not nrec["unschedulable"]
+    for r, rn in enumerate(cfg.resources):
+        nodes_np.allocatable[i, r] = float(nrec["allocatable"].get(rn, 0.0))
+        nodes_np.used[i, r] = float(nrec["used"].get(rn, 0.0))
+    nodes_np.label_pairs[i] = -1
+    nodes_np.label_keys[i] = -1
+    nodes_np.label_nums[i] = np.nan
+    for j, (k, v) in enumerate(sorted(nrec["labels"].items())):
+        nodes_np.label_keys[i, j] = intr.key_ids[k]
+        nodes_np.label_pairs[i, j] = intr.pair_ids[(k, v)]
+        nodes_np.label_nums[i, j] = _try_float(v)
+    nodes_np.taint_ids[i] = -1
+    for j, (k, v, e) in enumerate(nrec["taints"]):
+        nodes_np.taint_ids[i, j] = intr.taint_ids[(k, v, e)]
+    nodes_np.domain[i] = -1
+    for ti, tk in enumerate(intr.topo_keys):
+        if tk in nrec["labels"]:
+            v = nrec["labels"][tk]
+            nodes_np.domain[i, ti] = intr.domain_ids[ti].setdefault(
+                v, len(intr.domain_ids[ti])
+            )
+
+
+def _fill_pod_row(pods: "_PodArraysNP", i: int, p: dict, pc: dict,
+                  intr: _Interner, cfg: EngineConfig, group_idx: dict) -> None:
+    pods.valid[i] = True
+    for r, rn in enumerate(cfg.resources):
+        pods.requests[i, r] = float(p["requests"].get(rn, 0.0))
+    pods.base_priority[i] = p["priority"]
+    pods.slo_target[i] = p["slo_target"]
+    pods.observed_avail[i] = p["observed_avail"]
+    pods.label_pairs[i] = -1
+    pods.label_keys[i] = -1
+    for j, (k, v) in enumerate(sorted(p["labels"].items())):
+        pods.label_keys[i, j] = intr.key_ids[k]
+        pods.label_pairs[i, j] = intr.pair_ids[(k, v)]
+    # Tolerations precompiled against the taint vocab.
+    pods.tolerated[i] = False
+    for (tk, tv, te), t in intr.taint_ids.items():
+        pods.tolerated[i, t] = any(
+            _tolerates(tol, tk, tv, te) for tol in p["tolerations"]
+        )
+    pods.req_term_valid[i] = False
+    pods.req_term_atoms[i] = -1
+    for t, term in enumerate(pc["req_terms"]):
+        pods.req_term_valid[i, t] = True
+        pods.req_term_atoms[i, t, : len(term)] = term
+    pods.pref_term_valid[i] = False
+    pods.pref_term_atoms[i] = -1
+    pods.pref_weight[i] = 0.0
+    for t, (term, w) in enumerate(pc["pref_terms"]):
+        pods.pref_term_valid[i, t] = True
+        pods.pref_term_atoms[i, t, : len(term)] = term
+        pods.pref_weight[i, t] = w
+    pods.ts_valid[i] = False
+    pods.ts_key[i] = -1
+    pods.ts_max_skew[i] = 0.0
+    pods.ts_when[i] = 0
+    pods.ts_sel_atoms[i] = -1
+    pods.ts_sig[i] = -1
+    for c, con in enumerate(pc["ts"]):
+        pods.ts_valid[i, c] = True
+        pods.ts_key[i, c] = con["key"]
+        pods.ts_max_skew[i, c] = con["max_skew"]
+        pods.ts_when[i, c] = con["when"]
+        pods.ts_sel_atoms[i, c, : len(con["atoms"])] = con["atoms"]
+        pods.ts_sig[i, c] = con["sig"]
+    pods.ia_valid[i] = False
+    pods.ia_key[i] = -1
+    pods.ia_sel_atoms[i] = -1
+    pods.ia_sig[i] = -1
+    pods.ia_anti[i] = False
+    pods.ia_required[i] = False
+    pods.ia_weight[i] = 0.0
+    for t, term in enumerate(pc["ia"]):
+        pods.ia_valid[i, t] = True
+        pods.ia_key[i, t] = term["key"]
+        pods.ia_sel_atoms[i, t, : len(term["atoms"])] = term["atoms"]
+        pods.ia_sig[i, t] = term["sig"]
+        pods.ia_anti[i, t] = term["anti"]
+        pods.ia_required[i, t] = term["required"]
+        pods.ia_weight[i, t] = term["weight"]
+    pods.group[i] = (
+        group_idx[p["pod_group"]] if p["pod_group"] is not None else -1
+    )
+    pods.namespace[i] = intr.ns_ids[p["namespace"]]
+    pods.tolerates_unsched[i] = any(
+        _tolerates(tol, "node.kubernetes.io/unschedulable", "", "NoSchedule")
+        for tol in p["tolerations"]
+    )
+
+
+def _fill_running_row(run_np: _RunningArraysNP, i: int, rrec: dict,
+                      anti_sigs: list, intr: _Interner, cfg: EngineConfig,
+                      node_index: dict, pdb_idx: dict) -> None:
+    ni = node_index[rrec["node"]]
+    run_np.node_idx[i] = ni
+    run_np.valid[i] = True
+    for r, rn in enumerate(cfg.resources):
+        run_np.requests[i, r] = float(rrec["requests"].get(rn, 0.0))
+    run_np.priority[i] = rrec["priority"]
+    run_np.slack[i] = rrec["slack"]
+    run_np.label_pairs[i] = -1
+    run_np.label_keys[i] = -1
+    for j, (k, v) in enumerate(sorted(rrec["labels"].items())):
+        run_np.label_keys[i, j] = intr.key_ids[k]
+        run_np.label_pairs[i, j] = intr.pair_ids[(k, v)]
+    run_np.anti_sig[i] = -1
+    for j, s in enumerate(anti_sigs):
+        run_np.anti_sig[i, j] = s
+    run_np.namespace[i] = intr.ns_ids[rrec["namespace"]]
+    run_np.pdb_group[i] = (
+        pdb_idx[rrec["pdb_group"]] if rrec["pdb_group"] is not None else -1
+    )
+
+
+def _pad_node_row(nodes_np: _NodeArraysNP, i: int) -> None:
+    """Reset row i to the padding encoding (invalid, masked)."""
+    nodes_np.allocatable[i] = 0.0
+    nodes_np.used[i] = 0.0
+    nodes_np.label_pairs[i] = -1
+    nodes_np.label_keys[i] = -1
+    nodes_np.label_nums[i] = np.nan
+    nodes_np.taint_ids[i] = -1
+    nodes_np.domain[i] = -1
+    nodes_np.schedulable[i] = False
+    nodes_np.valid[i] = False
+
+
+def _pad_pod_row(pods: "_PodArraysNP", i: int) -> None:
+    pods.requests[i] = 0.0
+    pods.base_priority[i] = 0.0
+    pods.slo_target[i] = 0.0
+    pods.observed_avail[i] = 1.0
+    pods.tolerated[i] = False
+    pods.label_pairs[i] = -1
+    pods.label_keys[i] = -1
+    pods.req_term_atoms[i] = -1
+    pods.req_term_valid[i] = False
+    pods.pref_term_atoms[i] = -1
+    pods.pref_term_valid[i] = False
+    pods.pref_weight[i] = 0.0
+    pods.ts_key[i] = -1
+    pods.ts_max_skew[i] = 0.0
+    pods.ts_when[i] = 0
+    pods.ts_sel_atoms[i] = -1
+    pods.ts_sig[i] = -1
+    pods.ts_valid[i] = False
+    pods.ia_key[i] = -1
+    pods.ia_sel_atoms[i] = -1
+    pods.ia_sig[i] = -1
+    pods.ia_anti[i] = False
+    pods.ia_required[i] = False
+    pods.ia_weight[i] = 0.0
+    pods.ia_valid[i] = False
+    pods.group[i] = -1
+    pods.namespace[i] = -1
+    pods.tolerates_unsched[i] = False
+    pods.valid[i] = False
+
+
+def _pad_running_row(run_np: _RunningArraysNP, i: int) -> None:
+    run_np.node_idx[i] = -1
+    run_np.requests[i] = 0.0
+    run_np.priority[i] = 0.0
+    run_np.slack[i] = 0.0
+    run_np.label_pairs[i] = -1
+    run_np.label_keys[i] = -1
+    run_np.anti_sig[i] = -1
+    run_np.namespace[i] = -1
+    run_np.pdb_group[i] = -1
+    run_np.valid[i] = False
+
+
+def _snapshot_from_arrays(
+    nodes_np: _NodeArraysNP, pods: "_PodArraysNP",
+    run_np: _RunningArraysNP, tables: _TableArraysNP,
+) -> ClusterSnapshot:
+    """Assemble the device pytree from the host array holders. The
+    arrays are SHARED by reference, not copied: device transfer (put /
+    jit call) copies host->device, after which the holders stay the
+    mutable host mirror."""
+    return ClusterSnapshot(
+        nodes=NodeArrays(
+            allocatable=nodes_np.allocatable, used=nodes_np.used,
+            label_pairs=nodes_np.label_pairs, label_keys=nodes_np.label_keys,
+            label_nums=nodes_np.label_nums, taint_ids=nodes_np.taint_ids,
+            domain=nodes_np.domain, schedulable=nodes_np.schedulable,
+            valid=nodes_np.valid,
+        ),
+        pods=PodArrays(
+            requests=pods.requests, base_priority=pods.base_priority,
+            slo_target=pods.slo_target, observed_avail=pods.observed_avail,
+            tolerated=pods.tolerated, label_pairs=pods.label_pairs,
+            label_keys=pods.label_keys, req_term_atoms=pods.req_term_atoms,
+            req_term_valid=pods.req_term_valid,
+            pref_term_atoms=pods.pref_term_atoms,
+            pref_term_valid=pods.pref_term_valid, pref_weight=pods.pref_weight,
+            ts_key=pods.ts_key, ts_max_skew=pods.ts_max_skew,
+            ts_when=pods.ts_when, ts_sel_atoms=pods.ts_sel_atoms,
+            ts_sig=pods.ts_sig, ts_valid=pods.ts_valid,
+            ia_key=pods.ia_key, ia_sel_atoms=pods.ia_sel_atoms,
+            ia_sig=pods.ia_sig, ia_anti=pods.ia_anti,
+            ia_required=pods.ia_required, ia_weight=pods.ia_weight,
+            ia_valid=pods.ia_valid, group=pods.group,
+            namespace=pods.namespace,
+            tolerates_unsched=pods.tolerates_unsched, valid=pods.valid,
+        ),
+        running=RunningPodArrays(
+            node_idx=run_np.node_idx, requests=run_np.requests,
+            priority=run_np.priority, slack=run_np.slack,
+            label_pairs=run_np.label_pairs, label_keys=run_np.label_keys,
+            anti_sig=run_np.anti_sig, namespace=run_np.namespace,
+            pdb_group=run_np.pdb_group, valid=run_np.valid,
+        ),
+        atoms=AtomTable(key=tables.atom_key, op=tables.atom_op,
+                        pairs=tables.atom_pairs, num=tables.atom_num,
+                        valid=tables.atom_valid),
+        sigs=SigTable(key=tables.sig_key, atoms=tables.sig_atoms,
+                      ns=tables.sig_ns, ns_all=tables.sig_ns_all,
+                      valid=tables.sig_valid),
+        taint_effect=tables.taint_effect,
+        group_min_member=tables.group_min,
+        pdb_allowed=tables.pdb_allowed,
+    )
 
 
 class _PodArraysNP:
