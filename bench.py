@@ -1040,6 +1040,63 @@ def bench_divergence(args):
         print(json.dumps(line), flush=True)
 
 
+def bench_robustness(args):
+    """Recovery time and goodput under faults (ISSUE 3): the chaos
+    harness twin-runs a full host->sidecar workload fault-free and
+    under the seeded default plan (sidecar restart mid-lineage with an
+    UNAVAILABLE outage window, DeviceSession drop, one hung solve the
+    watchdog must kill, one decode error, a kube watch flap) and
+    verifies END placements are identical. Emits:
+
+      chaos_recovery_ms     worst fault->next-completed-cycle time
+      chaos_goodput_frac    placements/sec vs the fault-free twin
+    """
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "tpusched_chaos",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tools", "chaos.py"),
+    )
+    chaos = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(chaos)
+    report = chaos.run_chaos(
+        n_pods=min(args.pods, 120), n_nodes=min(args.nodes, 12),
+        watchdog_s=2.0, log=log,
+    )
+    if not report["end_state"]["identical"]:
+        raise AssertionError(
+            f"chaos end state diverged: {report['end_state']}"
+        )
+    rec = report["recovery_s"]
+    worst = max(rec.values()) if rec else 0.0
+    common = dict(
+        end_state_identical=report["end_state"]["identical"],
+        duplicated_bindings=report["end_state"]["duplicated"],
+        watchdog_trips=report["chaos"]["watchdog_trips"],
+        client_retries=report["chaos"]["client_retries"],
+        failed_cycle_attempts=report["chaos"]["failed_cycle_attempts"],
+        faults_fired=len(report["injected"]["fired"]),
+    )
+    for metric, value, unit, extra in (
+        ("chaos_recovery_ms", round(worst * 1e3, 1), "ms",
+         {"recovery_ms": {k: round(v * 1e3, 1) for k, v in rec.items()}}),
+        ("chaos_goodput_frac", report["goodput_frac"],
+         "frac_of_fault_free",
+         {"fault_free_pps": report["baseline"]["goodput_pps"],
+          "chaos_pps": report["chaos"]["goodput_pps"]}),
+    ):
+        line = {"metric": metric, "value": value, "unit": unit,
+                "vs_baseline": None}
+        if TRANSPORT:
+            line["rtt_ms"] = TRANSPORT["rtt_ms"]
+        line.update(common)
+        line.update(extra)
+        print(json.dumps(line), flush=True)
+        log(f"{metric}: {value} {unit} {extra}")
+
+
 BENCHES = {
     "divergence": bench_divergence,
     "pairwise": bench_pairwise,
@@ -1049,6 +1106,7 @@ BENCHES = {
     "e2e": bench_e2e,
     "wire": bench_wire,
     "serving": bench_serving,
+    "robustness": bench_robustness,
     # headline runs last so the final stdout line is the headline metric
     # (parity mode last within it — the stock-semantics north-star claim)
     "headline": bench_headline,

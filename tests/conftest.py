@@ -45,9 +45,16 @@ def rng():
 @pytest.fixture
 def thread_leak_check():
     """Multi-client/concurrency tests opt in: asserts every NEW
-    tpusched-* worker thread spawned during the test has exited by the
+    tpusched worker thread spawned during the test has exited by the
     end (i.e. Engine.close / SchedulerService.close actually drained).
-    Threads predating the test (module-scoped servers) are exempt."""
+    Threads predating the test (module-scoped servers) are exempt.
+
+    Matches "tpusched" ANYWHERE in the thread name (round 8): besides
+    the fetch workers and bind pools this now covers the failure-
+    domain machinery — fetch workers respawned after a watchdog trip
+    or a deliberate kill (still "tpusched-fetch": abandoned ones must
+    drain and exit, not accumulate) and the chaos harness's delayed
+    restart timers ("tpusched-chaos-restart")."""
     import threading
 
     # Keyed by Thread OBJECT, not ident: the OS recycles idents, and a
@@ -59,7 +66,7 @@ def thread_leak_check():
         return [
             t for t in threading.enumerate()
             if t not in before and t.is_alive()
-            and t.name.startswith("tpusched-")
+            and "tpusched" in t.name
         ]
 
     yield

@@ -678,3 +678,84 @@ def test_kubeconfig_mixed_file_and_data_key(tmp_path):
     load_kubeconfig(str(path))
     assert _leftover_pems(before) == set()
     assert cert_file.exists()  # the user's own file must survive
+
+
+# ---------------------------------------------------------------------------
+# Watch-loop backoff (ISSUE 3 satellite): jittered exponential relist
+# retry instead of the old fixed 0.5 s spin.
+# ---------------------------------------------------------------------------
+
+
+def test_watch_backoff_grows_jittered_and_caps():
+    inf = KubeInformer(KubeApiClient(base_url="http://127.0.0.1:1"))
+    for failures in range(1, 12):
+        base = min(0.5 * 2.0 ** (failures - 1), 30.0)
+        d = inf._watch_backoff(failures)
+        # Jitter scales uniform [0.5, 1.0): never zero, never above base.
+        assert 0.5 * base <= d <= base
+    assert inf._watch_backoff(50) <= 30.0, "capped near 30 s"
+
+
+class _FlappingKube:
+    """Scripted KubeApiClient stand-in: each _watch stream attempt pops
+    one step — "fail" raises, "ok" yields an empty (clean) stream; an
+    exhausted script stops the informer."""
+
+    scheduler_name = "tpu-scheduler"
+
+    def __init__(self, script, informer_box):
+        self.script = list(script)
+        self.box = informer_box
+
+    def _json(self, method, path):
+        return {"items": [], "metadata": {"resourceVersion": "1"}}
+
+    def _request(self, method, path, timeout=None):
+        import urllib.error
+
+        if not self.script:
+            self.box["informer"]._stop.set()
+            raise urllib.error.URLError("script exhausted")
+        step = self.script.pop(0)
+        if step == "fail":
+            raise urllib.error.URLError("apiserver down")
+
+        class _Stream:
+            def __enter__(self):
+                return iter(())
+
+            def __exit__(self, *exc):
+                return False
+
+        return _Stream()
+
+
+def test_watch_loop_backoff_counts_and_resets():
+    """Consecutive failures escalate the backoff (1, 2, ...); one
+    successful stream connection resets the streak to 1."""
+    box = {}
+    client = _FlappingKube(["fail", "fail", "ok", "fail"], box)
+    inf = KubeInformer(client)
+    box["informer"] = inf
+    seen = []
+    inf._watch_backoff = lambda failures: (seen.append(failures), 0.0)[1]
+    inf._watch_loop("/api/v1/pods")
+    assert seen[:3] == [1, 2, 1], \
+        "two failures escalate; a reconnect resets the streak"
+
+
+def test_watch_loop_fault_site_takes_backoff_path():
+    """An injected kube.watch error behaves exactly like a flapping
+    apiserver: logged, backed off, re-listed — never fatal."""
+    from tpusched.faults import FaultPlan, FaultRule
+
+    plan = FaultPlan([FaultRule("kube.watch", "error", at={0})])
+    box = {}
+    client = _FlappingKube(["ok"], box)
+    inf = KubeInformer(client, faults=plan)
+    box["informer"] = inf
+    seen = []
+    inf._watch_backoff = lambda failures: (seen.append(failures), 0.0)[1]
+    inf._watch_loop("/api/v1/pods")
+    assert seen[0] == 1, "the injected fault took the backoff path"
+    assert plan.report()["fired"][0]["site"] == "kube.watch"

@@ -1,0 +1,366 @@
+"""Deterministic chaos harness for the serving path (ISSUE 3).
+
+Runs the SAME synthetic workload twice through the full boundary
+(FakeApiServer -> HostScheduler -> DeltaSession -> gRPC sidecar ->
+Engine): once fault-free, once under a seeded fault schedule — then
+verifies the END-STATE-IDENTICAL guarantee: every pod lands on the
+same node in both runs, no binding lost, none duplicated.
+
+Two fault layers compose:
+
+  * a tpusched.faults.FaultPlan threaded through server + engine
+    (in-process faults: a hung solve at "engine.fetch" that the
+    watchdog must convert to DEADLINE_EXCEEDED, a DeviceSession drop
+    at "server.session", a decode error at "server.decode");
+  * DRIVER events between host cycles (process-level faults a plan
+    inside the server cannot express): a sidecar restart mid-lineage
+    — optionally with an outage window so the client's UNAVAILABLE
+    backoff+retry is exercised, not just the FAILED_PRECONDITION
+    resync — and a kube watch flap (change hints invalidated, the
+    informer-relist contract: the next delta must full-diff).
+
+Determinism: the cluster is seeded, the fault plan is seeded, the
+host's per-cycle batches slice a stable pending order, and the solver
+is deterministic — so the chaos run must reproduce the fault-free
+placements exactly or the harness fails loudly. Recovery time (fault
+event -> next completed cycle) and goodput (placements/sec vs the
+fault-free run) come out in the report; bench.py's "robustness" bench
+and tests/test_faults.py's chaos smoke both drive this module.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/chaos.py --pods 120 --nodes 12
+    python tools/chaos.py --seed 7 --json report.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+from tpusched.config import EngineConfig
+from tpusched.faults import FaultPlan, FaultRule
+from tpusched.host import Conflict, FakeApiServer, HostScheduler, \
+    build_synthetic_cluster
+
+
+class _CountingApi(FakeApiServer):
+    """FakeApiServer that counts bind conflicts: with a single host
+    driving it, every conflict IS a duplicated-binding attempt (nobody
+    else binds), so `conflicts` must stay 0 in a correct chaos run."""
+
+    def __init__(self):
+        super().__init__()
+        self.conflicts = 0
+
+    def bind(self, pod_name: str, node_name: str) -> None:
+        try:
+            super().bind(pod_name, node_name)
+        except Conflict:
+            self.conflicts += 1
+            raise
+
+
+class _Sidecar:
+    """An in-process sidecar that can be killed and restarted on the
+    SAME port (the client's channel reconnects transparently)."""
+
+    def __init__(self, port: int = 0, **make_kw):
+        from tpusched.rpc.server import make_server
+
+        self._make_kw = make_kw
+        self.server, self.port, self.svc = make_server(
+            f"127.0.0.1:{port}", **make_kw
+        )
+        self.server.start()
+        self.restarts = 0
+        # Counters survive restarts (the per-service ones die with the
+        # killed process image): accumulated at stop() time.
+        self.watchdog_trips = 0
+        self.replayed_requests = 0
+        self._stopped = False
+
+    def stop(self) -> None:
+        # Idempotent: a cleanup close racing the outage window must not
+        # stop the same service twice (double-counting its counters).
+        if self._stopped:
+            return
+        self._stopped = True
+        self.server.stop(0)
+        self.svc.close()
+        self.watchdog_trips += self.svc.watchdog_trips
+        self.replayed_requests += self.svc.replayed_requests
+
+    def start_again(self) -> None:
+        from tpusched.rpc.server import make_server
+
+        self.server, port, self.svc = make_server(
+            f"127.0.0.1:{self.port}", **self._make_kw
+        )
+        if port != self.port:
+            raise RuntimeError(f"could not rebind port {self.port}")
+        self.server.start()
+        self.restarts += 1
+        self._stopped = False
+
+    def restart(self) -> None:
+        self.stop()
+        self.start_again()
+
+    def close(self) -> None:
+        self.stop()
+
+
+def make_default_plan(watchdog_s: float, seed: int | None = None,
+                      window: int = 8) -> FaultPlan:
+    """The canonical chaos plan: one hung solve (2.5x the watchdog —
+    it MUST trip), one DeviceSession drop, one decode error. seed=None
+    pins the indices (unit-test friendly); a seed draws them from the
+    first `window` invocations of each site."""
+    if seed is None:
+        return FaultPlan([
+            FaultRule("engine.fetch", "delay", at={2},
+                      delay_s=2.5 * watchdog_s),
+            FaultRule("server.session", "drop", at={1}),
+            FaultRule("server.decode", "error", at={4},
+                      message="chaos: injected decode failure"),
+        ])
+    return FaultPlan.seeded(seed, {
+        "engine.fetch": dict(kind="delay", n=1, window=window,
+                             delay_s=2.5 * watchdog_s),
+        "server.session": dict(kind="drop", n=1, window=window),
+        "server.decode": dict(kind="error", n=1, window=window,
+                              message="chaos: injected decode failure"),
+    })
+
+
+def _placements(api: FakeApiServer) -> dict[str, str]:
+    return {p["name"]: p["node"] for p in api.bound_pods()}
+
+
+def _drive(host: HostScheduler, events: dict, max_cycles: int,
+           max_failed_attempts: int = 60) -> dict:
+    """Run host cycles, applying driver `events` (completed-cycle-count
+    -> [(kind, fn), ...]) and measuring per-fault recovery time (event
+    -> next COMPLETED cycle). Transient rpc failures re-drive the
+    cycle, like HostScheduler.run_until_idle."""
+    completed = 0
+    failed = 0
+    pending_recovery: dict[str, float] = {}
+    recovery_s: dict[str, float] = {}
+    while completed < max_cycles:
+        for kind, fn in events.pop(completed, []):
+            fn()
+            pending_recovery.setdefault(kind, time.perf_counter())
+        try:
+            stats = host.cycle()
+        except BaseException as e:
+            if not host._transient_rpc_error(e):
+                raise
+            failed += 1
+            if failed > max_failed_attempts:
+                raise
+            continue
+        if stats is None:
+            if events:
+                # Queue drained before a scheduled event: nothing left
+                # for it to disturb — fire the stragglers as no-ops so
+                # the report shows them (count as instant recovery).
+                for evs in events.values():
+                    for kind, fn in evs:
+                        fn()
+                        recovery_s.setdefault(kind, 0.0)
+                events.clear()
+            break
+        completed += 1
+        now = time.perf_counter()
+        for kind, t0 in pending_recovery.items():
+            recovery_s.setdefault(kind, now - t0)
+        pending_recovery.clear()
+    return dict(cycles=completed, failed_attempts=failed,
+                recovery_s={k: round(v, 4) for k, v in recovery_s.items()})
+
+
+def run_chaos(
+    n_pods: int = 120,
+    n_nodes: int = 12,
+    seed: int = 0,
+    batch_size: int | None = None,
+    watchdog_s: float = 1.0,
+    outage_s: float = 0.4,
+    plan: FaultPlan | None = None,
+    plan_seed: int | None = None,
+    restart_after_cycle: int = 1,
+    flap_after_cycle: int = 2,
+    log=print,
+) -> dict:
+    """One full chaos experiment; returns the report dict (see module
+    docstring). Faults covered: sidecar restart mid-lineage (with an
+    UNAVAILABLE outage window), DeviceSession loss, one hung solve
+    (watchdog), one decode error, and a kube watch flap."""
+    from tpusched.rpc.client import SchedulerClient
+
+    cfg = EngineConfig(mode="fast")
+    batch = batch_size or max(n_pods // 4, 1)
+
+    def fresh_api():
+        api = _CountingApi()
+        build_synthetic_cluster(api, np.random.default_rng(seed),
+                                n_pods, n_nodes)
+        return api
+
+    # -- fault-free twin ----------------------------------------------------
+    base_side = _Sidecar(config=cfg, watchdog_s=watchdog_s)
+    base_client = SchedulerClient(f"127.0.0.1:{base_side.port}",
+                                  retry_seed=seed)
+    api0 = fresh_api()
+    host0 = HostScheduler(api0, cfg, client=base_client, batch_size=batch)
+    try:
+        t0 = time.perf_counter()
+        base_drive = _drive(host0, {}, max_cycles=200)
+        base_wall = time.perf_counter() - t0
+        base_placements = _placements(api0)
+        base_placed = sum(c.placed for c in host0.cycles)
+    finally:
+        host0.close()
+        base_client.close()
+        base_side.close()
+    log(f"[chaos] fault-free: {base_drive['cycles']} cycles, "
+        f"{base_placed} placed in {base_wall:.2f}s")
+
+    # -- chaos run ----------------------------------------------------------
+    plan = plan if plan is not None else make_default_plan(
+        watchdog_s, seed=plan_seed
+    )
+    side = _Sidecar(config=cfg, watchdog_s=watchdog_s, faults=plan)
+    client = SchedulerClient(f"127.0.0.1:{side.port}", retry_seed=seed)
+    api = fresh_api()
+    host = HostScheduler(api, cfg, client=client, batch_size=batch)
+    timers: list = []
+
+    def restart_with_outage():
+        # Stop now; come back only after outage_s — the cycles in the
+        # window exercise UNAVAILABLE backoff+retry, then the first
+        # delta against the fresh server exercises FAILED_PRECONDITION
+        # -> full-snapshot resync (the mid-lineage crash-resync path).
+        side.stop()
+        import threading
+
+        t = threading.Timer(outage_s, side.start_again)
+        t.name = "tpusched-chaos-restart"
+        t.daemon = True
+        t.start()
+        timers.append(t)
+
+    def kube_flap():
+        # The FakeApiServer twin of an informer re-list: hints are no
+        # longer trustworthy, the next delta must diff everything.
+        api.restore_changed(None)
+
+    events: dict[int, list] = {}
+    events.setdefault(restart_after_cycle, []).append(
+        ("sidecar_restart", restart_with_outage))
+    events.setdefault(flap_after_cycle, []).append(
+        ("kube_watch_flap", kube_flap))
+    try:
+        t0 = time.perf_counter()
+        chaos_drive = _drive(host, events, max_cycles=400)
+        chaos_wall = time.perf_counter() - t0
+        chaos_placements = _placements(api)
+        chaos_placed = sum(c.placed for c in host.cycles)
+        health = client.health()
+        delta = host._delta
+    finally:
+        # An exception mid-run (even inside the outage window) must not
+        # leak the server/engine/channel into the caller — bench.py runs
+        # more benches after this. Cancel an unfired restart timer first
+        # so it cannot resurrect a server nobody stops (stop() is
+        # idempotent, so a FIRED timer's server is simply stopped here).
+        for t in timers:
+            t.cancel()
+            t.join(timeout=outage_s + 5.0)
+        host.close()
+        client.close()
+        side.close()  # folds the final service's counters into side totals
+
+    lost = sorted(set(base_placements) - set(chaos_placements))
+    extra = sorted(set(chaos_placements) - set(base_placements))
+    moved = sorted(
+        p for p in set(base_placements) & set(chaos_placements)
+        if base_placements[p] != chaos_placements[p]
+    )
+    identical = not (lost or extra or moved)
+    base_pps = base_placed / max(base_wall, 1e-9)
+    chaos_pps = chaos_placed / max(chaos_wall, 1e-9)
+    report = dict(
+        pods=n_pods, nodes=n_nodes, seed=seed, batch_size=batch,
+        watchdog_s=watchdog_s,
+        baseline=dict(cycles=base_drive["cycles"], placed=base_placed,
+                      wall_s=round(base_wall, 3),
+                      goodput_pps=round(base_pps, 2)),
+        chaos=dict(
+            cycles=chaos_drive["cycles"], placed=chaos_placed,
+            wall_s=round(chaos_wall, 3),
+            goodput_pps=round(chaos_pps, 2),
+            failed_cycle_attempts=chaos_drive["failed_attempts"],
+            bind_conflicts=api.conflicts,
+            client_retries=client.retries,
+            delta_fallbacks=delta.fallbacks if delta else 0,
+            watchdog_trips=side.watchdog_trips,
+            serving_path=health.serving_path,
+            replayed_requests=side.replayed_requests,
+            sidecar_restarts=side.restarts,
+        ),
+        injected=plan.report(),
+        recovery_s=chaos_drive["recovery_s"],
+        goodput_frac=round(chaos_pps / max(base_pps, 1e-9), 3),
+        end_state=dict(
+            identical=identical, lost=lost, duplicated=api.conflicts,
+            extra=extra, moved=moved,
+        ),
+    )
+    log(f"[chaos] chaos: {chaos_drive['cycles']} cycles "
+        f"(+{chaos_drive['failed_attempts']} failed attempts), "
+        f"{chaos_placed} placed in {chaos_wall:.2f}s, "
+        f"goodput {report['goodput_frac']:.2f}x of fault-free, "
+        f"recovery {chaos_drive['recovery_s']}")
+    log(f"[chaos] end state identical: {identical} "
+        f"(lost={len(lost)} extra={len(extra)} moved={len(moved)} "
+        f"conflicts={api.conflicts})")
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--pods", type=int, default=120)
+    ap.add_argument("--nodes", type=int, default=12)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--watchdog-s", type=float, default=1.0)
+    ap.add_argument("--plan-seed", type=int, default=None,
+                    help="draw fault indices from this seed instead of "
+                         "the pinned defaults")
+    ap.add_argument("--json", default=None,
+                    help="write the full report to this path")
+    args = ap.parse_args()
+    report = run_chaos(
+        n_pods=args.pods, n_nodes=args.nodes, seed=args.seed,
+        batch_size=args.batch, watchdog_s=args.watchdog_s,
+        plan_seed=args.plan_seed,
+        log=lambda *a: print(*a, file=sys.stderr, flush=True),
+    )
+    out = json.dumps(report, indent=2)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(out + "\n")
+    print(out)
+    return 0 if report["end_state"]["identical"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,116 @@
+"""Regenerate tpusched/rpc/tpusched_pb2.py WITHOUT protoc.
+
+This image has the protobuf runtime but no protoc binary and no
+grpc_tools codegen, so proto evolution edits the serialized
+FileDescriptorProto that the generated module embeds: parse the blob
+out of the current tpusched_pb2.py, apply the (additive, wire-
+compatible) field additions declared in SCHEMA_EDITS below, and emit a
+fresh module. protos/tpusched.proto stays the human-readable source of
+truth; keep SCHEMA_EDITS in lockstep with it.
+
+Only ADDITIVE edits are supported (new optional fields on existing
+messages): anything else would break wire compatibility with deployed
+clients anyway.
+
+Usage:  python tools/regen_pb2.py          # rewrites tpusched_pb2.py
+        python tools/regen_pb2.py --check  # verify pb2 matches edits
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+from google.protobuf import descriptor_pb2
+
+REPO = Path(__file__).resolve().parent.parent
+PB2_PATH = REPO / "tpusched" / "rpc" / "tpusched_pb2.py"
+
+F = descriptor_pb2.FieldDescriptorProto
+
+# message name -> [(field name, number, type, json_name)]
+SCHEMA_EDITS = {
+    "SnapshotDelta": [
+        ("lineage_id", 8, F.TYPE_STRING, "lineageId"),
+        ("seq", 9, F.TYPE_UINT64, "seq"),
+    ],
+    "HealthResponse": [
+        ("serving_path", 4, F.TYPE_STRING, "servingPath"),
+        ("watchdog_trips", 5, F.TYPE_INT64, "watchdogTrips"),
+        ("ladder_demotions", 6, F.TYPE_INT64, "ladderDemotions"),
+        ("ladder_recoveries", 7, F.TYPE_INT64, "ladderRecoveries"),
+        ("replayed_requests", 8, F.TYPE_INT64, "replayedRequests"),
+    ],
+}
+
+TEMPLATE = '''# -*- coding: utf-8 -*-
+# Generated protocol buffer code.  DO NOT EDIT BY HAND.
+# source: protos/tpusched.proto, via tools/regen_pb2.py (this image has
+# no protoc; the script splices additive field edits into the embedded
+# serialized FileDescriptorProto).
+"""Generated protocol buffer code."""
+from google.protobuf.internal import builder as _builder
+from google.protobuf import descriptor as _descriptor
+from google.protobuf import descriptor_pool as _descriptor_pool
+from google.protobuf import symbol_database as _symbol_database
+# @@protoc_insertion_point(imports)
+
+_sym_db = _symbol_database.Default()
+
+
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile({blob!r})
+
+_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
+_builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, 'tpusched_pb2', globals())
+# @@protoc_insertion_point(module_scope)
+'''
+
+
+def extract_blob(source: str) -> bytes:
+    m = re.search(r"AddSerializedFile\((b'.*')\)", source, re.S)
+    if m is None:
+        raise SystemExit("no AddSerializedFile blob in " + str(PB2_PATH))
+    return eval(m.group(1))  # noqa: S307 — our own generated literal
+
+
+def apply_edits(fd: descriptor_pb2.FileDescriptorProto) -> bool:
+    """Add missing SCHEMA_EDITS fields in place; True if anything new."""
+    changed = False
+    by_name = {m.name: m for m in fd.message_type}
+    for msg_name, fields in SCHEMA_EDITS.items():
+        msg = by_name[msg_name]
+        have = {f.name for f in msg.field}
+        for name, number, ftype, json_name in fields:
+            if name in have:
+                continue
+            msg.field.add(
+                name=name, number=number, type=ftype,
+                label=F.LABEL_OPTIONAL, json_name=json_name,
+            )
+            changed = True
+    return changed
+
+
+def main() -> int:
+    fd = descriptor_pb2.FileDescriptorProto.FromString(
+        extract_blob(PB2_PATH.read_text())
+    )
+    changed = apply_edits(fd)
+    if "--check" in sys.argv:
+        if changed:
+            print("tpusched_pb2.py is MISSING schema edits; rerun "
+                  "tools/regen_pb2.py", file=sys.stderr)
+            return 1
+        print("tpusched_pb2.py is up to date")
+        return 0
+    if not changed:
+        print("no edits needed; tpusched_pb2.py left untouched")
+        return 0
+    PB2_PATH.write_text(TEMPLATE.format(blob=fd.SerializeToString()))
+    print(f"rewrote {PB2_PATH}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

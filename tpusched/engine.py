@@ -64,6 +64,14 @@ class _OrderedFetchWorker:
         complete before close returns;
       * submit after close fails loudly instead of queueing into
         nothing.
+
+    Self-healing (ISSUE 3): per-item exceptions relay into the item's
+    Future, so the loop itself only dies on something catastrophic
+    (interpreter teardown, a corrupted queue item). A dead-but-not-
+    closed worker would silently park every later PendingFetch forever;
+    submit() detects that state and RESTARTS the thread — the queue
+    survives, only the item that killed the loop is lost (its waiter's
+    watchdog/timeout converts the loss into an error).
     """
 
     def __init__(self, name: str = "tpusched-fetch"):
@@ -72,12 +80,24 @@ class _OrderedFetchWorker:
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._closed = False
+        self.restarts = 0
 
     def submit(self, fn, *args) -> "Future":
         fut: Future = Future()
         with self._lock:
             if self._closed:
                 raise RuntimeError("engine is closed")
+            if self._thread is not None and not self._thread.is_alive():
+                # The loop died on an unexpected exception (not via the
+                # shutdown sentinel — _closed is False). Respawn it.
+                import logging
+
+                logging.getLogger("tpusched.engine").warning(
+                    "fetch worker %s died unexpectedly; restarting",
+                    self._name,
+                )
+                self._thread = None
+                self.restarts += 1
             if self._thread is None:
                 # Lazy start: idle engines pay nothing, and the lock
                 # keeps concurrent first-submits from double-starting.
@@ -102,6 +122,10 @@ class _OrderedFetchWorker:
                 fut.set_exception(e)
 
     def close(self, wait: bool = True) -> None:
+        """Idempotent and safe to race: the first caller enqueues the
+        shutdown sentinel; every waiting caller joins the same thread
+        (joining a finished thread is a no-op), so concurrent close vs
+        in-flight fetch drains exactly once."""
         with self._lock:
             thread = self._thread
             if not self._closed:
@@ -128,8 +152,14 @@ class PendingFetch:
     _fut: Any          # Future[(np buffer, completion perf_counter)]
     _t0: float
 
-    def result(self):
-        raw, done_t = self._fut.result()
+    def result(self, timeout: float | None = None):
+        """Join the fetch. `timeout` (seconds) raises
+        concurrent.futures.TimeoutError when the fetch has not landed
+        in time — the sidecar's per-dispatch watchdog uses this to
+        convert a hung solve into DEADLINE_EXCEEDED instead of wedging
+        the handler thread (the fetch itself keeps running on the
+        worker and is simply abandoned)."""
+        raw, done_t = self._fut.result(timeout)
         return self._unpack(raw, done_t - self._t0)
 
 
@@ -176,12 +206,20 @@ def solve_core(cfg: EngineConfig, snap: ClusterSnapshot, mesh=None):
 
 
 class Engine:
-    def __init__(self, config: EngineConfig | None = None, mesh=None):
+    def __init__(self, config: EngineConfig | None = None, mesh=None,
+                 faults=None):
         """mesh: optional jax.sharding.Mesh for multi-device solves;
         required when config.ring_counts routes the pairwise counts
-        through the ring kernel."""
+        through the ring kernel.
+
+        faults: optional tpusched.faults.FaultPlan; the background
+        fetch fires site "engine.fetch" per fetched buffer (a delay
+        rule there is a hung solve — what the sidecar watchdog hunts)."""
+        from tpusched.faults import NO_FAULTS
+
         self.config = config or EngineConfig()
         self.mesh = mesh
+        self._faults = faults if faults is not None else NO_FAULTS
         cfg = self.config
         if cfg.mode not in ("parity", "fast"):
             raise ValueError(f"mode={cfg.mode!r}: want 'parity' or 'fast'")
@@ -253,10 +291,14 @@ class Engine:
         # so its (daemon) thread parks forever in neither case. The
         # finalizer must hold the QUEUE, not the worker or self — a
         # strong ref to either would keep the engine alive.
+        self._pool_lock = threading.Lock()  # pool swap vs close vs submit
+        self._closing = False               # close() wins over restarts
         self._fetch_pool = _OrderedFetchWorker()
         import weakref
 
-        weakref.finalize(self, self._fetch_pool._q.put, None)
+        self._pool_finalizer = weakref.finalize(
+            self, self._fetch_pool._q.put, None
+        )
 
     # -- public API ---------------------------------------------------------
 
@@ -281,16 +323,47 @@ class Engine:
         )
 
     def _pool(self) -> _OrderedFetchWorker:
-        return self._fetch_pool
+        with self._pool_lock:
+            return self._fetch_pool
 
-    @staticmethod
-    def _fetch(buf):
+    def restart_fetch_worker(self) -> None:
+        """Abandon a wedged fetch worker (ISSUE 3 watchdog): a fresh
+        worker takes all NEW fetches; the old one keeps draining its
+        own queue if it ever unwedges (its in-flight futures still
+        complete), and its daemon thread can't block shutdown either
+        way. Tradeoff, documented: across the swap, fetch order ==
+        dispatch order no longer holds between old and new queues — on
+        fetch-driven transports two D2H reads may briefly race. A
+        worker hung past the watchdog means the device stream is
+        already suspect; the ladder quarantines the fast path and this
+        swap buys back availability. A no-op once close() has begun:
+        swapping a fresh (never-closed) worker in behind a concurrent
+        close would void close's drain guarantee and leak the thread."""
+        import weakref
+
+        with self._pool_lock:
+            if self._closing:
+                return
+            old = self._fetch_pool
+            self._fetch_pool = _OrderedFetchWorker()
+            # Detach the abandoned pool's finalizer (its sentinel is
+            # enqueued explicitly below): finalizers must not pile up
+            # one-per-restart on a persistently wedged device — each
+            # would pin a dead worker's queue for the engine's life.
+            self._pool_finalizer.detach()
+            self._pool_finalizer = weakref.finalize(
+                self, self._fetch_pool._q.put, None
+            )
+        old.close(wait=False)
+
+    def _fetch(self, buf):
         # Completion time measured INSIDE the worker so solve_seconds
         # covers dispatch->fetch-done, not whatever CPU work the caller
         # overlapped with the wait. np.asarray releases the GIL inside
         # the transport wait and, on fetch-driven transports, is what
         # actually runs the program. tree.map: score_async fetches a
         # (feasible, scores) pair through the same worker.
+        self._faults.fire("engine.fetch")
         out = jax.tree.map(np.asarray, buf)
         return out, time.perf_counter()
 
@@ -424,5 +497,13 @@ class Engine:
         returns, so multi-client servers can't leak fetch threads or
         half-fetched results across test runs. The worker thread is a
         daemon, so engines that are never closed still can't block
-        interpreter shutdown."""
-        self._fetch_pool.close(wait=wait)
+        interpreter shutdown. Idempotent, and safe against a concurrent
+        close or restart_fetch_worker: `_closing` is set under the pool
+        lock BEFORE the current pool is read, so a racing watchdog
+        restart either completed its swap (we close the new pool) or
+        becomes a no-op — no fresh never-closed worker can appear
+        behind us (worker.close is itself re-entrant)."""
+        with self._pool_lock:
+            self._closing = True
+            pool = self._fetch_pool
+        pool.close(wait=wait)

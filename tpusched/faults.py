@@ -1,0 +1,171 @@
+"""Deterministic fault injection (ISSUE 3 tentpole part 4).
+
+A `FaultPlan` is a seedable, fully deterministic schedule of faults at
+named SITES threaded through the serving stack. Code under test calls
+``plan.fire(site)`` at each injection point; the plan counts invocations
+per site and, when a rule matches the current invocation index, fires:
+
+  * ``error``  — raise :class:`FaultError` (the caller's normal
+    exception handling converts it: the sidecar heals through the
+    decode path / aborts the RPC, the informer takes its relist path);
+  * ``delay``  — sleep ``delay_s`` (a hung solve, a slow fetch);
+  * ``drop``   — return ``"drop"`` so the CALLER discards state (a
+    DeviceSession eviction, a lost watch event).
+
+Determinism is the point: a chaos run and its fault-free twin must be
+comparable placement-for-placement, so rules fire at exact invocation
+indices — either given explicitly (tests) or drawn once from a seeded
+RNG (`FaultPlan.seeded`), never from wall-clock randomness.
+
+Wired injection sites (callers document theirs; this list is the
+contract the chaos harness and tests rely on):
+
+  ``server.decode``    before a snapshot/delta decodes (rpc/server.py)
+  ``server.session``   before a device-session delta apply; ``drop``
+                       evicts the lineage's DeviceSession first
+  ``engine.fetch``     inside the engine's background fetch worker —
+                       ``delay`` is a hung solve (the watchdog's prey)
+  ``kube.watch``       top of each informer watch-stream attempt
+                       (kube.py) — ``error`` forces the relist/backoff
+                       path, a flapping apiserver
+
+One plan instance may be shared across components (server + engine +
+informer): counters are per-site and thread-safe, and ``fired`` records
+every shot for the chaos report.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Iterable
+
+
+class FaultError(RuntimeError):
+    """An injected failure (kind="error"). Deliberately a RuntimeError:
+    injection points sit inside code whose real failure modes are
+    unexpected exceptions, and the handlers under test must take the
+    same path for both."""
+
+    def __init__(self, site: str, index: int, message: str = ""):
+        super().__init__(
+            message or f"injected fault at {site}[{index}]"
+        )
+        self.site = site
+        self.index = index
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    """Fire `kind` at `site` on the given 0-based invocation indices."""
+
+    site: str
+    kind: str                      # "error" | "delay" | "drop"
+    at: frozenset
+    delay_s: float = 0.0
+    message: str = ""
+
+    def __post_init__(self):
+        if self.kind not in ("error", "delay", "drop"):
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        object.__setattr__(self, "at", frozenset(int(i) for i in self.at))
+
+
+class FaultPlan:
+    """A deterministic set of FaultRules plus per-site invocation
+    counters. The no-rule fast path is one dict lookup, so production
+    code can call fire() unconditionally with a shared NO_FAULTS."""
+
+    def __init__(self, rules: Iterable[FaultRule] = ()):
+        self._rules: dict[str, list[FaultRule]] = {}
+        for r in rules:
+            self._rules.setdefault(r.site, []).append(r)
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+        self.fired: list[tuple[str, int, str]] = []  # (site, index, kind)
+
+    @classmethod
+    def seeded(cls, seed: int, spec: dict) -> "FaultPlan":
+        """Draw rule indices deterministically from `seed`.
+
+        spec: site -> dict(kind=..., n=shots, window=index range the
+        shots are drawn from [0, window), delay_s=..., message=...).
+        A site may also map to a LIST of such dicts. Same (seed, spec)
+        always yields the same plan.
+        """
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        rules = []
+        for site in sorted(spec):
+            entries = spec[site]
+            if isinstance(entries, dict):
+                entries = [entries]
+            for e in entries:
+                window = int(e.get("window", 16))
+                n = min(int(e.get("n", 1)), window)
+                at = rng.choice(window, size=n, replace=False)
+                rules.append(FaultRule(
+                    site=site, kind=e["kind"],
+                    at=frozenset(int(i) for i in at),
+                    delay_s=float(e.get("delay_s", 0.0)),
+                    message=e.get("message", ""),
+                ))
+        return cls(rules)
+
+    def count(self, site: str) -> int:
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def fire(self, site: str) -> str | None:
+        """Count one invocation of `site`; apply any matching rule.
+        Returns "drop" when a drop-rule fires, else None. Raises
+        FaultError for error rules; sleeps for delay rules (the sleep
+        happens OUTSIDE the lock — a hung site must not wedge counting
+        at other sites).
+
+        A rule-less plan (NO_FAULTS, shared process-wide) returns
+        immediately without touching the lock or counters: fire() sits
+        on per-request hot paths across every server/engine in the
+        process, and invocation counts are only consumed by chaos
+        reports, which always use a rule-bearing plan."""
+        if not self._rules:
+            return None
+        rules = self._rules.get(site)
+        with self._lock:
+            index = self._counts.get(site, 0)
+            self._counts[site] = index + 1
+            hit = None
+            if rules:
+                for r in rules:
+                    if index in r.at:
+                        hit = r
+                        break
+                if hit is not None:
+                    self.fired.append((site, index, hit.kind))
+        if hit is None:
+            return None
+        if hit.kind == "delay":
+            time.sleep(hit.delay_s)
+            return None
+        if hit.kind == "drop":
+            return "drop"
+        raise FaultError(site, index, hit.message)
+
+    def report(self) -> dict:
+        """Chaos-harness summary: what fired, and how often each site
+        was exercised (a site with count 0 means the plan never reached
+        that code path — a silent no-op chaos run)."""
+        with self._lock:
+            return dict(
+                fired=[
+                    dict(site=s, index=i, kind=k) for s, i, k in self.fired
+                ],
+                site_counts=dict(self._counts),
+            )
+
+
+# Shared no-op plan: the default `faults=None` resolves here so hot
+# paths skip the None-check dance and fire() stays one dict miss.
+NO_FAULTS = FaultPlan()

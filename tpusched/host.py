@@ -214,6 +214,11 @@ class HostScheduler:
         self._clock = clock if clock is not None else time.monotonic
         self._backoff: dict[str, tuple[float, int]] = {}  # key -> (retry_at, attempts)
         self._io_pool: ThreadPoolExecutor | None = None
+        # Cycles that died on a transient sidecar failure and were
+        # re-driven by run_until_idle (ISSUE 3: the host survives its
+        # scheduler backend's failures the way kube-scheduler survives
+        # an apiserver hiccup — state is re-read, the cycle re-runs).
+        self.failed_cycles = 0
 
     def _io(self) -> ThreadPoolExecutor:
         """Lazy pool for concurrent API-server writes (binds/deletes)."""
@@ -450,14 +455,59 @@ class HostScheduler:
         self.cycles.append(stats)
         return stats
 
-    def run_until_idle(self, max_cycles: int = 100) -> int:
+    @staticmethod
+    def _transient_rpc_error(exc: BaseException) -> bool:
+        """A sidecar RpcError the host loop may safely re-drive: the
+        failed cycle mutated nothing (binds happen after a successful
+        response; change hints were restored by cycle()'s unwind), the
+        snapshot is rebuilt from API-server truth next cycle, and a
+        retried applied-but-unacked delta is deduped server-side by its
+        (lineage_id, seq). Retryable statuses (UNAVAILABLE /
+        RESOURCE_EXHAUSTED) were already retried inside the client's
+        deadline budget, DEADLINE_EXCEEDED means the watchdog killed
+        one dispatch (re-submit as a new cycle, exactly what re-driving
+        does), and even INTERNAL is worth bounded re-reads —
+        kube-scheduler keeps cycling through apiserver hiccups. NOT
+        re-driven: statuses the server taxonomy marks as request bugs
+        (INVALID_ARGUMENT, UNIMPLEMENTED) — the identical cycle would
+        deterministically fail again, and re-drives each paying an
+        O(cluster) rebuild would only mask the bug. The
+        consecutive-failure cap is the give-up switch for the rest."""
+        try:
+            import grpc
+        except ImportError:  # in-process host: nothing rpc to tolerate
+            return False
+        if not isinstance(exc, grpc.RpcError):
+            return False
+        return exc.code() not in (grpc.StatusCode.INVALID_ARGUMENT,
+                                  grpc.StatusCode.UNIMPLEMENTED)
+
+    def run_until_idle(self, max_cycles: int = 100,
+                       max_consecutive_failures: int = 8) -> int:
         """Cycle until the ACTIVE queue drains (unschedulable pods land
         in backoff and stop participating — a live host would keep
         polling and retry them as windows expire). Returns the number of
-        cycles executed."""
+        cycles executed (failed transient attempts count toward
+        max_cycles so a dead sidecar cannot spin this loop forever).
+
+        Transient sidecar failures (any grpc RpcError — see
+        _transient_rpc_error for why re-driving is safe) are tolerated
+        up to max_consecutive_failures in a row; the first success
+        resets the streak. Anything else propagates immediately."""
         n = 0
+        streak = 0
         while n < max_cycles:
-            stats = self.cycle()
+            try:
+                stats = self.cycle()
+            except BaseException as e:
+                if streak >= max_consecutive_failures \
+                        or not self._transient_rpc_error(e):
+                    raise
+                streak += 1
+                self.failed_cycles += 1
+                n += 1
+                continue
+            streak = 0
             n += 1 if stats else 0
             if stats is None:
                 break

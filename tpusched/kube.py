@@ -648,9 +648,17 @@ class KubeInformer:
     _POD_PATH = "/api/v1/pods"
     _NODE_PATH = "/api/v1/nodes"
 
-    def __init__(self, client: KubeApiClient, poll_timeout: float = 30.0):
+    def __init__(self, client: KubeApiClient, poll_timeout: float = 30.0,
+                 faults=None, backoff_seed: int | None = None):
         self.client = client
         self.poll_timeout = poll_timeout
+        # faults: optional tpusched.faults.FaultPlan; site "kube.watch"
+        # fires at the top of every watch-stream attempt (an error rule
+        # is a flapping apiserver: the loop takes its relist/backoff
+        # path, exactly like a real watch failure).
+        from tpusched.faults import NO_FAULTS
+
+        self._faults = faults if faults is not None else NO_FAULTS
         self.scheduler_name = client.scheduler_name
         self._lock = threading.Lock()
         self._objs: dict[str, dict[str, dict]] = {
@@ -681,6 +689,19 @@ class KubeInformer:
         self._err_log_lock = threading.Lock()
         self._err_last: dict[tuple[str, str], tuple[float, int]] = {}
         self.watch_err_interval = 30.0
+        # Watch-retry backoff (ISSUE 3 satellite): consecutive failures
+        # back off exponentially from watch_backoff_initial to the
+        # ~watch_backoff_max cap, jittered, instead of the old fixed
+        # 0.5 s relist spin against an unreachable apiserver. The
+        # jitter rng seeds from ENTROPY by default — K replicas
+        # sharing one fixed seed would relist in lockstep, the exact
+        # herd the jitter exists to break; tests/chaos pass
+        # backoff_seed to pin the sequence.
+        self.watch_backoff_initial = 0.5
+        self.watch_backoff_max = 30.0
+        import random
+
+        self._watch_rng = random.Random(backoff_seed)
 
     def _log_watch_failure(self, path: str, exc: BaseException) -> None:
         """One stderr line per (path, failure class) per
@@ -748,9 +769,30 @@ class KubeInformer:
             self._changed.clear()
         return obj.get("metadata", {}).get("resourceVersion", "")
 
+    def _watch_backoff(self, failures: int) -> float:
+        """Delay before watch-relist attempt number `failures` (1-based):
+        0.5 s, 1 s, 2 s, ... capped near watch_backoff_max, scaled by a
+        uniform [0.5, 1.0) jitter so K informers hammering one
+        unreachable apiserver desynchronize instead of relisting in
+        lockstep. The failure counter resets as soon as a watch stream
+        connects again."""
+        # Exponent clamped BEFORE the power: an hours-long outage grows
+        # `failures` unbounded and 2.0**1025 raises OverflowError inside
+        # the except handler — killing the watch thread for good.
+        base = min(
+            self.watch_backoff_initial
+            * 2.0 ** min(max(failures - 1, 0), 16),
+            self.watch_backoff_max,
+        )
+        return base * (0.5 + 0.5 * self._watch_rng.random())
+
     def _watch_loop(self, path: str, rv: str = ""):
+        from tpusched.faults import FaultError
+
+        failures = 0
         while not self._stop.is_set():
             try:
+                self._faults.fire("kube.watch")
                 if not rv:
                     rv = self._relist(path)
                 q = urllib.parse.urlencode(
@@ -761,6 +803,8 @@ class KubeInformer:
                     "GET", f"{path}?{q}",
                     timeout=self.poll_timeout + 10.0,
                 ) as resp:
+                    # Connected: the apiserver is back, stop backing off.
+                    failures = 0
                     for line in resp:
                         if self._stop.is_set():
                             return
@@ -784,10 +828,11 @@ class KubeInformer:
                                 self._objs[path][key] = obj
                             self._changed.add(key)
             except (urllib.error.URLError, urllib.error.HTTPError,
-                    OSError, json.JSONDecodeError) as e:
+                    OSError, json.JSONDecodeError, FaultError) as e:
                 self._log_watch_failure(path, e)
                 rv = ""
-                if self._stop.wait(0.5):
+                failures += 1
+                if self._stop.wait(self._watch_backoff(failures)):
                     return
 
     # -- FakeApiServer read interface, served from the cache ----------------

@@ -3,9 +3,32 @@
 Mirrors what the Go `--score-backend=tpu` plugin would do: serialize the
 cluster snapshot, call ScoreBatch (the Score-plugin path) or Assign (the
 full batched solve), read back scores/assignments by name.
+
+Failure-domain contract (round 8, ISSUE 3 — the client half of the
+taxonomy documented in rpc/server.py):
+
+  * every RPC carries a DEADLINE (the channel-level timeout);
+  * RETRYABLE statuses (UNAVAILABLE — sidecar restarting;
+    RESOURCE_EXHAUSTED — dispatch-gate admission refused) retry with
+    capped exponential backoff + jitter inside the original deadline
+    budget (RetryPolicy);
+  * RESYNC statuses (FAILED_PRECONDITION — unknown base / degraded
+    stateless mode) make DeltaSession fall back to a full send and the
+    pipelines transparently re-send the doomed cycles as full
+    snapshots recomposed from the pinned store (no lost responses);
+  * everything else is FATAL and surfaces to the caller.
+
+Retry-safety: every delta is stamped with (lineage_id, seq); a retry
+whose first attempt was applied-but-unacked is deduped server-side and
+the cached response replayed (SnapshotDelta proto comment).
 """
 
 from __future__ import annotations
+
+import dataclasses
+import random
+import time
+import uuid
 
 import grpc
 import numpy as np
@@ -13,6 +36,51 @@ import numpy as np
 from tpusched.rpc import codec
 from tpusched.rpc import tpusched_pb2 as pb
 from tpusched.rpc.server import SERVICE
+
+# Error taxonomy (rpc/server.py module docstring is the authority).
+RETRYABLE_CODES = frozenset({
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+})
+RESYNC_CODES = frozenset({grpc.StatusCode.FAILED_PRECONDITION})
+
+
+def classify_error(code) -> str:
+    """'retryable' | 'resync' | 'fatal' for a grpc StatusCode."""
+    if code in RETRYABLE_CODES:
+        return "retryable"
+    if code in RESYNC_CODES:
+        return "resync"
+    return "fatal"
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff + jitter for RETRYABLE statuses.
+    Retries always stay inside the caller's original deadline budget —
+    the deadline is the contract, the retries are how the budget is
+    spent. jitter_frac spreads K clients retrying a restarted sidecar
+    so they don't re-arrive in lockstep (the thundering-herd half of
+    the kube-scheduler backoff discipline)."""
+
+    max_attempts: int = 6
+    initial_backoff_s: float = 0.05
+    max_backoff_s: float = 2.0
+    multiplier: float = 2.0
+    jitter_frac: float = 0.25
+    codes: frozenset = RETRYABLE_CODES
+
+    def backoff_s(self, attempt: int, rng: random.Random) -> float:
+        """Delay before retry `attempt` (0-based)."""
+        base = min(
+            self.initial_backoff_s * self.multiplier ** attempt,
+            self.max_backoff_s,
+        )
+        return base * (1.0 + self.jitter_frac * (2.0 * rng.random() - 1.0))
+
+
+# Retries disabled: surface the first error (tests pin exact statuses).
+NO_RETRY = RetryPolicy(max_attempts=1)
 
 
 def score_response_arrays(resp: pb.ScoreResponse):
@@ -81,8 +149,18 @@ def assign_response_arrays(resp: pb.AssignResponse):
 
 
 class SchedulerClient:
-    def __init__(self, address: str, timeout: float = 120.0):
+    def __init__(self, address: str, timeout: float = 120.0,
+                 retry: RetryPolicy | None = None,
+                 retry_seed: int | None = None):
+        """timeout: per-RPC deadline budget (seconds) — retries spend
+        the SAME budget, they don't extend it. retry: RetryPolicy for
+        RETRYABLE statuses (None = defaults; pass NO_RETRY to surface
+        first errors). retry_seed pins the backoff jitter for
+        deterministic tests/chaos runs."""
         self.timeout = timeout
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.retries = 0          # observability: attempts beyond the first
+        self._retry_rng = random.Random(retry_seed)
         self._channel = grpc.insecure_channel(
             address,
             options=[
@@ -103,23 +181,48 @@ class SchedulerClient:
         self._health = method("Health", pb.HealthRequest, pb.HealthResponse)
         self._metrics = method("Metrics", pb.MetricsRequest, pb.MetricsResponse)
 
+    def _call(self, method, request):
+        """Blocking unary call under the deadline + retry contract:
+        RETRYABLE statuses back off (capped, jittered) and re-send
+        inside the ORIGINAL deadline budget; a retried delta carries
+        its original (lineage_id, seq) so an applied-but-unacked first
+        attempt is deduped server-side. Everything else raises.
+        _BasePipeline._join_entry is this loop's future-shaped twin —
+        keep their retry discipline in lockstep."""
+        deadline = time.monotonic() + self.timeout
+        attempt = 0
+        while True:
+            remaining = deadline - time.monotonic()
+            try:
+                return method(request, timeout=max(remaining, 1e-3))
+            except grpc.RpcError as e:
+                attempt += 1
+                if (e.code() not in self.retry.codes
+                        or attempt >= self.retry.max_attempts):
+                    raise
+                delay = self.retry.backoff_s(attempt - 1, self._retry_rng)
+                if deadline - time.monotonic() <= delay:
+                    raise
+                self.retries += 1
+                time.sleep(delay)
+
     def health(self) -> pb.HealthResponse:
-        return self._health(pb.HealthRequest(), timeout=self.timeout)
+        return self._call(self._health, pb.HealthRequest())
 
     def score_batch(self, snapshot: pb.ClusterSnapshot, *,
                     packed_ok: bool = False,
                     top_k: int = 0) -> pb.ScoreResponse:
-        return self._score(
+        return self._call(
+            self._score,
             pb.ScoreRequest(snapshot=snapshot, packed_ok=packed_ok,
                             top_k=top_k),
-            timeout=self.timeout,
         )
 
     def assign(self, snapshot: pb.ClusterSnapshot, *,
                packed_ok: bool = False) -> pb.AssignResponse:
-        return self._assign(
+        return self._call(
+            self._assign,
             pb.AssignRequest(snapshot=snapshot, packed_ok=packed_ok),
-            timeout=self.timeout,
         )
 
     def assign_future(self, snapshot: pb.ClusterSnapshot, *,
@@ -144,9 +247,9 @@ class SchedulerClient:
     def score_batch_delta(self, delta: pb.SnapshotDelta, *,
                           packed_ok: bool = False,
                           top_k: int = 0) -> pb.ScoreResponse:
-        return self._score(
+        return self._call(
+            self._score,
             pb.ScoreRequest(delta=delta, packed_ok=packed_ok, top_k=top_k),
-            timeout=self.timeout,
         )
 
     def score_batch_future(self, snapshot: pb.ClusterSnapshot, *,
@@ -169,15 +272,13 @@ class SchedulerClient:
 
     def assign_delta(self, delta: pb.SnapshotDelta, *,
                      packed_ok: bool = False) -> pb.AssignResponse:
-        return self._assign(
+        return self._call(
+            self._assign,
             pb.AssignRequest(delta=delta, packed_ok=packed_ok),
-            timeout=self.timeout,
         )
 
     def metrics_text(self) -> str:
-        return self._metrics(
-            pb.MetricsRequest(), timeout=self.timeout
-        ).prometheus_text
+        return self._call(self._metrics, pb.MetricsRequest()).prometheus_text
 
     def close(self):
         self._channel.close()
@@ -202,6 +303,11 @@ class DeltaSession:
         self.client = client
         self._base: codec.SnapshotStore | None = None
         self._base_id: str | None = None
+        # Retry-safety lineage identity: every delta this session sends
+        # carries (lineage_id, seq) so a client-level retry of an
+        # applied-but-unacked delta replays server-side (proto comment).
+        self._lineage_id = uuid.uuid4().hex[:16]
+        self._seq = 0
         # After a fallback (sidecar restart / base evicted from its LRU),
         # skip the delta attempt for exponentially more sends: a client
         # whose base is always evicted (many interleaved sessions) must
@@ -235,6 +341,9 @@ class DeltaSession:
                 self._base, snapshot, self._base_id, new_bytes=new_bytes,
                 changed=changed,
             )
+            self._seq += 1
+            delta.lineage_id = self._lineage_id
+            delta.seq = self._seq
             self.bytes_sent += delta.ByteSize()  # transmitted even on reject
             try:
                 resp = send_delta(delta)
@@ -314,11 +423,18 @@ class DeltaSession:
 
 class StaleBase(Exception):
     """An in-flight pipelined delta named a base the sidecar no longer
-    holds (restart / LRU eviction). The caller still has its current
-    snapshot: re-pin by submitting it with changed=None (a full send).
+    holds (restart / LRU eviction) and transparent resync is OFF
+    (auto_resync=False). The caller still has its current snapshot:
+    re-pin by submitting it with changed=None (a full send).
     `completed` carries the responses that HAD already been received
     before the stale request — earlier cycles' assignments are handed
-    to the caller, not dropped in the unwind."""
+    to the caller, not dropped in the unwind.
+
+    With auto_resync (the default) this never escapes: the pipeline
+    recomposes each doomed cycle's FULL snapshot from its pinned store
+    plus that cycle's cumulative delta and re-sends it, so every
+    submitted cycle still yields exactly one response — the crash-
+    resync path with the end-state-identical guarantee (ISSUE 3)."""
 
     def __init__(self, msg: str, completed=()):
         super().__init__(msg)
@@ -351,17 +467,25 @@ class _BasePipeline:
     pipelined — same limit as pipeline.solve_stream documents."""
 
     def __init__(self, client: SchedulerClient, depth: int = 2,
-                 refresh_frac: float = 0.25):
+                 refresh_frac: float = 0.25, auto_resync: bool = True):
         self.client = client
         self.depth = max(1, int(depth))
         self.refresh_frac = refresh_frac
+        self.auto_resync = auto_resync
         self._pinned: codec.SnapshotStore | None = None
         self._pinned_id: str | None = None
         self._churn: set = set()
+        # In-flight entries: dict(fut, delta, packed_ok) — the delta is
+        # retained so a retry re-sends the SAME (lineage_id, seq) and a
+        # resync can recompose the cycle's full snapshot from pin+delta.
         self._inflight: list = []
+        self._lineage_id = uuid.uuid4().hex[:16]
+        self._seq = 0
         self.full_sends = 0
         self.delta_sends = 0
         self.bytes_sent = 0
+        self.resyncs = 0      # doomed cycles re-sent as full snapshots
+        self.retried = 0      # retryable-status future re-issues
 
     # -- rpc binding (subclass responsibility) ------------------------------
 
@@ -371,19 +495,74 @@ class _BasePipeline:
     def _send_delta_future(self, delta: pb.SnapshotDelta, packed_ok: bool):
         raise NotImplementedError
 
-    def _join(self, fut):
-        try:
-            return fut.result()
-        except grpc.RpcError as e:
-            if e.code() == grpc.StatusCode.FAILED_PRECONDITION:
-                self._pinned = self._pinned_id = None
-                self._drop_inflight()
-                raise StaleBase(str(e)) from e
-            raise
+    def _join_entry(self, entry) -> object:
+        """Join one in-flight delta under the taxonomy: RETRYABLE
+        statuses re-issue the SAME delta future (same lineage/seq —
+        the server dedupes an applied-but-unacked first attempt) with
+        capped backoff; FAILED_PRECONDITION resyncs the cycle as a
+        full send (auto_resync) or raises StaleBase; the rest raise.
+
+        Like SchedulerClient._call, re-issues spend ONE deadline
+        budget (client.timeout, measured from this join): without the
+        cutoff, each fresh future carries its own full timeout and a
+        blackholed sidecar could stall a join for max_attempts x
+        timeout instead of roughly the configured budget (the last
+        in-flight future can still run to its own deadline — ~2x
+        worst case, not Nx).
+
+        This loop is _call's FUTURE-shaped twin, kept separate because
+        the first "attempt" here is joining an already-issued future
+        and the resync path has no blocking-call analogue — but the
+        retry DISCIPLINE (policy codes, attempt cap, backoff-must-fit-
+        the-remaining-budget) must stay in lockstep with _call; change
+        them together."""
+        policy = self.client.retry
+        deadline = time.monotonic() + self.client.timeout
+        attempt = 0
+        while True:
+            try:
+                return entry["fut"].result()
+            except grpc.RpcError as e:
+                code = e.code()
+                if code in policy.codes and attempt < policy.max_attempts - 1:
+                    delay = policy.backoff_s(attempt, self.client._retry_rng)
+                    if deadline - time.monotonic() > delay:
+                        time.sleep(delay)
+                        attempt += 1
+                        self.retried += 1
+                        entry["fut"] = self._send_delta_future(
+                            entry["delta"], entry["packed_ok"]
+                        )
+                        continue
+                if code in RESYNC_CODES:
+                    return self._resync_entry(entry, e)
+                raise
+
+    def _resync_entry(self, entry, err):
+        """The sidecar lost this cycle's base (restart, LRU eviction,
+        stateless degrade). The cycle is NOT lost: its cumulative delta
+        applied to the pinned store reproduces the cycle's exact full
+        snapshot — recompose and re-send it as a full request. The pin
+        id is cleared (the next submit re-pins with a full send) but
+        the pin STORE is kept so remaining in-flight cycles can resync
+        the same way."""
+        if not self.auto_resync or self._pinned is None:
+            self._pinned = self._pinned_id = None
+            self._drop_inflight()
+            raise StaleBase(str(err)) from err
+        full = self._pinned.copy()
+        full.apply_delta(entry["delta"])
+        msg = full.compose()
+        resp = self._send_full(msg, entry["packed_ok"])
+        self.resyncs += 1
+        self.full_sends += 1
+        self.bytes_sent += msg.ByteSize()
+        self._pinned_id = None
+        return resp
 
     def _drop_inflight(self):
-        for f in self._inflight:
-            f.cancel()
+        for entry in self._inflight:
+            entry["fut"].cancel()
         self._inflight = []
 
     def submit(self, snapshot: pb.ClusterSnapshot,
@@ -401,7 +580,8 @@ class _BasePipeline:
             self._churn | set(changed) if changed is not None else None
         )
         if (
-            self._pinned is None or churn_next is None
+            self._pinned is None or self._pinned_id is None
+            or churn_next is None
             or len(churn_next) > self.refresh_frac * max(n_rec, 1)
             or not codec.delta_safe(snapshot)
         ):
@@ -422,8 +602,14 @@ class _BasePipeline:
         delta = codec.delta_between(
             self._pinned, snapshot, self._pinned_id, changed=self._churn
         )
+        self._seq += 1
+        delta.lineage_id = self._lineage_id
+        delta.seq = self._seq
         self.bytes_sent += delta.ByteSize()
-        self._inflight.append(self._send_delta_future(delta, packed_ok))
+        self._inflight.append(dict(
+            fut=self._send_delta_future(delta, packed_ok),
+            delta=delta, packed_ok=packed_ok,
+        ))
         self.delta_sends += 1
         done = []
         while len(self._inflight) >= self.depth:
@@ -439,10 +625,10 @@ class _BasePipeline:
 
     def _join_into(self, done: list) -> None:
         """Join the oldest in-flight request into `done`; on StaleBase
-        the already-joined responses ride the exception (`completed`)
-        instead of being lost in the unwind."""
+        (auto_resync off) the already-joined responses ride the
+        exception (`completed`) instead of being lost in the unwind."""
         try:
-            done.append(self._join(self._inflight.pop(0)))
+            done.append(self._join_entry(self._inflight.pop(0)))
         except StaleBase as e:
             e.completed = list(done) + e.completed
             raise
@@ -469,8 +655,10 @@ class ScorePipeline(_BasePipeline):
     such clients fuse server-side into one dispatch."""
 
     def __init__(self, client: SchedulerClient, depth: int = 2,
-                 refresh_frac: float = 0.25, top_k: int = 8):
-        super().__init__(client, depth=depth, refresh_frac=refresh_frac)
+                 refresh_frac: float = 0.25, top_k: int = 8,
+                 auto_resync: bool = True):
+        super().__init__(client, depth=depth, refresh_frac=refresh_frac,
+                         auto_resync=auto_resync)
         self.top_k = int(top_k)
 
     def _send_full(self, snapshot, packed_ok):

@@ -32,6 +32,51 @@ Observability (SURVEY.md §5): every batch emits one structured JSON log
 line (sizes, rounds, per-phase seconds, placements/sec) on stderr, and
 the Metrics rpc serves Prometheus text with upstream-compatible metric
 names (scheduler_e2e_scheduling_duration_seconds etc.).
+
+Round 8 (ISSUE 3) gives the sidecar a FAILURE-DOMAIN CONTRACT.
+
+Error taxonomy — every status the sidecar returns falls in one of
+three classes, and the client (rpc/client.py RetryPolicy) keys its
+behavior off the class, never the message text:
+
+  RETRYABLE (same request may succeed soon; capped backoff + retry)
+    UNAVAILABLE          channel down / sidecar restarting
+    RESOURCE_EXHAUSTED   dispatch-gate admission refused (queue full)
+  RESYNC-REQUIRED (retrying the same delta can NEVER succeed; the
+  client must fall back to a full snapshot and re-pin)
+    FAILED_PRECONDITION  unknown/expired base_id, seq replayed past the
+                         dedupe cache, or stateless degraded mode
+  FATAL (a bug in the request or the server; retrying is wrong)
+    INVALID_ARGUMENT     malformed delta (no base_id, duplicate names)
+    DEADLINE_EXCEEDED    per-dispatch watchdog fired (the REQUEST is
+                         dead; the server stays healthy — callers may
+                         re-submit as a NEW cycle, not a blind retry)
+    INTERNAL             unexpected server exception
+
+Retry-safety: deltas carry (lineage_id, seq); a retried delta whose
+first attempt was applied-but-unacked replays the cached response
+instead of re-applying (SnapshotDelta proto comment).
+
+Watchdog: every device-result join runs under `watchdog_s`; a hung
+solve becomes DEADLINE_EXCEEDED for ITS caller, the wedged fetch
+worker is abandoned (Engine.restart_fetch_worker), and the server
+keeps serving other clients — a stuck dispatch can no longer wedge
+the gate.
+
+Degradation ladder (DegradationLadder): repeated device-path failures
+quarantine the fast path one rung at a time —
+
+    delta      device-resident DeviceSessions, O(churn) serving
+    rebuild    sessions quarantined: every delta recomposes bytes and
+               fully re-decodes (correct, slower)
+    stateless  deltas refused (FAILED_PRECONDITION) and snapshot_ids
+               withheld: clients full-send every cycle; the sidecar
+               holds NO cross-request state a fault could corrupt
+
+with automatic probe-based recovery: after a cooldown with successes,
+the ladder promotes one rung on probation — one failure at the
+restored rung demotes immediately, a success keeps it. Health reports
+the rung and counters; Metrics exports them.
 """
 
 from __future__ import annotations
@@ -51,6 +96,7 @@ import grpc
 from tpusched.config import Buckets, EngineConfig
 from tpusched.device_state import DeviceSnapshot
 from tpusched.engine import Engine
+from tpusched.faults import FaultError
 from tpusched.rpc import tpusched_pb2 as pb
 from tpusched.rpc import codec
 from tpusched.rpc.codec import SnapshotStore, decode_snapshot, delta_safe
@@ -70,6 +116,23 @@ STORE_CAP = 32
 # Device-resident lineages kept alive concurrently (each holds a full
 # cluster's arrays on the accelerator, so the cap is memory, not CPU).
 DEVICE_SESSION_CAP = 8
+
+# Per-dispatch watchdog default: how long a handler waits on a device
+# result before declaring the solve hung (DEADLINE_EXCEEDED + fetch
+# worker abandoned). Generous — a 10k x 5k parity solve on a loaded
+# CPU host takes tens of seconds; the watchdog exists for WEDGED
+# dispatches (a transport hang, a stuck D2H), not slow ones.
+WATCHDOG_S = 120.0
+
+# Replayable responses kept per delta lineage for seq dedupe. A depth-2
+# pipeline has at most 2 unacked requests in flight; 4 leaves margin
+# for a retry racing a new submit. Responses above REPLAY_MAX_BYTES are
+# NOT cached (a full-matrix ScoreBatch at 10k x 5k is ~250 MB; 4 per
+# lineage x 32 lineages would be multi-GB): deterministic solves make
+# re-processing an uncached retry safe — it re-applies against the
+# still-stored base and rebuilds the identical response.
+REPLAY_PER_LINEAGE = 4
+REPLAY_MAX_BYTES = 8 << 20
 
 # Above this many matrix cells a packed_ok ScoreBatch response switches
 # from repeated ScoreRow to the packed-bytes form: the row form costs
@@ -143,6 +206,85 @@ class _Metrics:
             f"scheduler_e2e_scheduling_duration_seconds_count {self.batches}"
         )
         return "\n".join(lines) + "\n"
+
+
+class DegradationLadder:
+    """Quarantine state machine for the device fast path (module
+    docstring, "Degradation ladder").
+
+    Demotion: `demote_after` CONSECUTIVE failures at the current rung
+    (or a single failure while on probation) drop one rung. Recovery:
+    once `recover_after_s` has passed since the demotion AND at least
+    one success has landed at the degraded rung, the next level() read
+    promotes one rung ON PROBATION — the probe. All transitions are
+    clock-injectable and deterministic for tests."""
+
+    LEVELS = ("delta", "rebuild", "stateless")
+
+    def __init__(self, demote_after: int = 2, recover_after_s: float = 30.0,
+                 clock=time.monotonic):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self.demote_after = int(demote_after)
+        self.recover_after_s = float(recover_after_s)
+        self._idx = 0
+        self._consec_failures = 0
+        self._demoted_at: float | None = None
+        self._successes_since_demote = 0
+        self._probation = False
+        self.demotions = 0
+        self.recoveries = 0
+
+    def level(self) -> str:
+        """Current rung; performs the probe-promotion check."""
+        with self._lock:
+            self._maybe_promote_locked()
+            return self.LEVELS[self._idx]
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consec_failures = 0
+            self._probation = False  # the probe survived: rung is kept
+            self._successes_since_demote += 1
+
+    def record_failure(self) -> bool:
+        """One device-path failure; returns True when it demoted."""
+        with self._lock:
+            self._consec_failures += 1
+            trip = (self._probation
+                    or self._consec_failures >= self.demote_after)
+            if trip and self._idx < len(self.LEVELS) - 1:
+                self._idx += 1
+                self.demotions += 1
+                self._consec_failures = 0
+                self._probation = False
+                self._demoted_at = self._clock()
+                self._successes_since_demote = 0
+                return True
+            return False
+
+    def _maybe_promote_locked(self) -> None:
+        if (
+            self._idx > 0
+            and self._demoted_at is not None
+            and self._successes_since_demote > 0
+            and self._clock() - self._demoted_at >= self.recover_after_s
+        ):
+            self._idx -= 1
+            self.recoveries += 1
+            self._probation = True
+            self._successes_since_demote = 0
+            # Still degraded after the promotion: arm the next probe.
+            self._demoted_at = self._clock() if self._idx else None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(
+                level=self.LEVELS[self._idx],
+                demotions=self.demotions,
+                recoveries=self.recoveries,
+                probation=self._probation,
+            )
 
 
 class _Abort(Exception):
@@ -465,6 +607,9 @@ class SchedulerService:
         log_stream=None,
         audit_stream=None,
         device_sessions: int = DEVICE_SESSION_CAP,
+        faults=None,
+        watchdog_s: float = WATCHDOG_S,
+        ladder: DegradationLadder | None = None,
     ):
         """audit_stream: optional file-like; when set, every Assign
         emits one JSON record PER POD (pod, node, score, commit_key —
@@ -474,7 +619,19 @@ class SchedulerService:
 
         device_sessions: how many delta lineages keep their cluster
         state RESIDENT on the device (0 disables; every delta then
-        recomposes + fully re-decodes as before)."""
+        recomposes + fully re-decodes as before).
+
+        faults: optional tpusched.faults.FaultPlan, shared with the
+        engine — sites "server.decode" and "server.session" here,
+        "engine.fetch" inside the fetch worker (chaos harness).
+
+        watchdog_s: per-dispatch result-join budget; a solve that has
+        not landed in time becomes DEADLINE_EXCEEDED for its caller and
+        the wedged fetch worker is abandoned (module docstring).
+
+        ladder: injectable DegradationLadder (tests pin the clock)."""
+        from tpusched.faults import NO_FAULTS
+
         self.config = config or EngineConfig()
         # Floor buckets pin compile shapes across requests (a feature
         # first appearing mid-serving would otherwise trigger a full
@@ -490,7 +647,8 @@ class SchedulerService:
 
             shape = tuple(self.config.mesh_shape)
             mesh = make_mesh(None if shape == (1, 1) else shape)
-        self._engine = Engine(self.config, mesh=mesh)
+        self._faults = faults if faults is not None else NO_FAULTS
+        self._engine = Engine(self.config, mesh=mesh, faults=self._faults)
         self._log = log_stream if log_stream is not None else sys.stderr
         self._audit = audit_stream
         import threading
@@ -517,6 +675,21 @@ class SchedulerService:
         self.session_seeds = 0
         self.session_hits = 0
         self.session_misses = 0
+        # Failure-domain state (round 8, ISSUE 3): watchdog budget,
+        # degradation ladder, and the per-lineage seq replay cache.
+        self.watchdog_s = watchdog_s
+        self.watchdog_trips = 0
+        self._ladder = ladder if ladder is not None else DegradationLadder()
+        self._watchdog_lock = threading.Lock()
+        self._last_worker_restart = 0.0
+        # lineage_id -> {(seq, rpc): response message}; LRU at both
+        # levels. Deterministic solves make an evicted entry SAFE to
+        # re-process — the replay is an optimization plus the dedupe
+        # guarantee for the applied-but-unacked retry window.
+        self._replay_lock = threading.Lock()
+        self._replay: dict[str, dict] = {}
+        self.replayed_requests = 0
+        self._closed = False
 
     def _register_store(self, store: SnapshotStore) -> str:
         with self._store_lock:
@@ -555,8 +728,7 @@ class SchedulerService:
         so concurrent lineage requests serialize on session.lock
         instead of missing and re-seeding."""
         with self._store_lock:
-            for k in [k for k, v in self._sessions.items() if v is session]:
-                del self._sessions[k]
+            self._drop_session_locked(session)
             for k in session.keys():
                 self._sessions.pop(k, None)
                 self._sessions[k] = session
@@ -569,6 +741,100 @@ class SchedulerService:
                 for k in list(self._sessions):
                     if self._sessions[k] is victim:
                         del self._sessions[k]
+
+    def _drop_session_locked(self, session) -> None:
+        """Forget every key mapping to `session` (caller holds
+        _store_lock) — the single authority for session eviction, so
+        the injected-fault paths and the real-failure heal path cannot
+        silently diverge."""
+        for k in [k for k, v in self._sessions.items() if v is session]:
+            del self._sessions[k]
+
+    def _drop_session(self, session) -> None:
+        with self._store_lock:
+            self._drop_session_locked(session)
+
+    # -- failure-domain helpers (round 8) -----------------------------------
+
+    @staticmethod
+    def _replay_key(request) -> "tuple[str, int] | None":
+        if not request.HasField("delta"):
+            return None
+        d = request.delta
+        if not d.lineage_id or not d.seq:
+            return None
+        return (d.lineage_id, int(d.seq))
+
+    def _replay_lookup(self, rpc: str, request):
+        """Cached response for a retried (lineage_id, seq), or None."""
+        key = self._replay_key(request)
+        if key is None:
+            return None
+        lineage, seq = key
+        with self._replay_lock:
+            per = self._replay.get(lineage)
+            if per is None:
+                return None
+            resp = per.get((seq, rpc))
+            if resp is not None:
+                self.replayed_requests += 1
+            return resp
+
+    def _replay_record(self, rpc: str, request, resp) -> None:
+        key = self._replay_key(request)
+        if key is None or resp.ByteSize() > REPLAY_MAX_BYTES:
+            return
+        lineage, seq = key
+        with self._replay_lock:
+            per = self._replay.pop(lineage, None)
+            if per is None:
+                per = {}
+            per[(seq, rpc)] = resp
+            while len(per) > REPLAY_PER_LINEAGE:
+                per.pop(next(iter(per)))
+            self._replay[lineage] = per           # LRU refresh
+            while len(self._replay) > STORE_CAP:
+                self._replay.pop(next(iter(self._replay)))
+
+    def _join_guarded(self, pending, what: str):
+        """Join a device result under the per-dispatch watchdog. A
+        timeout converts the hung solve into DEADLINE_EXCEEDED for THIS
+        caller, demotes the ladder, and abandons the wedged fetch
+        worker so later dispatches get a live one (throttled: N callers
+        waiting on the same wedged worker trigger ONE restart)."""
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        try:
+            return pending.result(timeout=self.watchdog_s)
+        except _FutTimeout:
+            now = time.monotonic()
+            with self._watchdog_lock:
+                self.watchdog_trips += 1
+                restart = now - self._last_worker_restart > 1.0
+                if restart:
+                    self._last_worker_restart = now
+            if restart:
+                # One ladder demerit + one worker swap per hang event:
+                # N coalesced callers timing out on the SAME wedged
+                # dispatch are one device failure, not N.
+                self._device_failure()
+                self._engine.restart_fetch_worker()
+            raise _Abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"{what} result did not land within the "
+                f"{self.watchdog_s:.1f}s dispatch watchdog; fetch worker "
+                "restarted and the device fast path demoted — the server "
+                "keeps serving, re-submit as a new cycle",
+            )
+
+    def _device_failure(self, demote_from_delta: bool = True) -> None:
+        """Ladder bookkeeping for a device-path failure; on demotion
+        out of 'delta', drop resident sessions (their device arrays are
+        the state under suspicion, and the memory buys nothing while
+        quarantined)."""
+        if self._ladder.record_failure() and demote_from_delta:
+            with self._store_lock:
+                self._sessions.clear()
 
     def _resolve_decoded(self, request):
         """Full-or-delta request -> (snap, meta, snapshot_id,
@@ -587,8 +853,23 @@ class SchedulerService:
         Snapshots whose records lack unique non-empty names are served
         but not registered (empty snapshot_id): name-keyed stores would
         collapse them (DeltaSession refuses to delta against those too).
+
+        Degradation (round 8): at the 'rebuild' rung device sessions
+        are skipped entirely (every delta recomposes + re-decodes); at
+        'stateless' deltas are refused with FAILED_PRECONDITION and
+        full sends are served WITHOUT registering a store (empty
+        snapshot_id), so clients settle into full-send-per-cycle
+        instead of ping-ponging delta attempts off a refusing server.
         """
+        self._faults.fire("server.decode")
+        level = self._ladder.level()
         if request.HasField("delta"):
+            if level == "stateless":
+                raise _Abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    "sidecar degraded to stateless serving "
+                    "(degradation ladder); resend a full snapshot",
+                )
             base_id = request.delta.base_id
             if not base_id:
                 # Falling through would silently solve the empty default
@@ -615,12 +896,16 @@ class SchedulerService:
             sid = self._register_store(store)
             t0 = time.perf_counter()
             seeding = False
+            session = None
             with self._store_lock:
-                session = self._sessions.get(base_id)
-                if (session is None and self._session_cap > 0
-                        and base_id not in self._seeding):
-                    self._seeding.add(base_id)
-                    seeding = True
+                # The 'rebuild' rung quarantines the device-resident
+                # path: no lookups, no seeding — pure decode serving.
+                if level == "delta":
+                    session = self._sessions.get(base_id)
+                    if (session is None and self._session_cap > 0
+                            and base_id not in self._seeding):
+                        self._seeding.add(base_id)
+                        seeding = True
             if seeding:
                 # Lazy seed on the FIRST delta of a lineage, from the
                 # BASE store (so the pin matches what pipelined clients
@@ -642,9 +927,29 @@ class SchedulerService:
                         "device session seed failed; serving via the "
                         "decode path:\n%s", traceback.format_exc(limit=3),
                     )
+                    self._device_failure()
                 finally:
                     with self._store_lock:
                         self._seeding.discard(base_id)
+            if session is not None:
+                try:
+                    shot = self._faults.fire("server.session")
+                except FaultError:
+                    # Injected apply-path failure: same handling as a
+                    # real session exception — drop the lineage, demote
+                    # the ladder, heal through decode.
+                    self._drop_session(session)
+                    self._device_failure()
+                    session = None
+                else:
+                    if shot == "drop":
+                        # Injected eviction (chaos: DeviceSession LRU
+                        # pressure / store-cap fork): forget the
+                        # lineage; this request and the lineage's next
+                        # delta heal through decode + re-seed — no
+                        # ladder demerit, eviction is a normal event.
+                        self._drop_session(session)
+                        session = None
             if session is not None:
                 try:
                     with session.lock:
@@ -669,10 +974,10 @@ class SchedulerService:
                         "lineage and re-decoding:\n%s",
                         traceback.format_exc(limit=3),
                     )
-                    with self._store_lock:
-                        for k in [k for k, v in self._sessions.items()
-                                  if v is session]:
-                            del self._sessions[k]
+                    self._drop_session(session)
+                    # Ladder bookkeeping: repeated apply failures
+                    # quarantine the whole device-resident path.
+                    self._device_failure()
                 else:
                     self._session_put(session)
                     if not seeding:
@@ -687,7 +992,7 @@ class SchedulerService:
             snap, meta, decode_s = self._decode(store.compose_bytes())
             return snap, meta, sid, decode_s, None
         msg = request.snapshot
-        if not delta_safe(msg):
+        if not delta_safe(msg) or level == "stateless":
             snap, meta, decode_s = self._decode(msg)
             return snap, meta, "", decode_s, None
         store = SnapshotStore()
@@ -709,11 +1014,20 @@ class SchedulerService:
     def close(self) -> None:
         """Release serving resources: refuse queued dispatches, drain
         the engine's fetch worker (in-flight results complete), drop
-        device-resident sessions. Idempotent; call after server.stop()."""
+        device-resident sessions and the replay cache. Idempotent and
+        safe to race with in-flight handlers or a concurrent close
+        (every step below is itself re-entrant); call after
+        server.stop()."""
+        with self._store_lock:
+            already = self._closed
+            self._closed = True
         self._gate.close()
         self._engine.close(wait=True)
         with self._store_lock:
             self._sessions.clear()
+        if not already:
+            with self._replay_lock:
+                self._replay.clear()
 
     def _log_batch(self, rpc: str, meta, decode_s: float, solve_s: float,
                    placed: int, evicted: int, rounds: int,
@@ -748,14 +1062,24 @@ class SchedulerService:
         + byte-identical delta = identical post-delta cluster state.
         Full sends never coalesce (hashing the whole snapshot would
         cost more than it saves), and the form kind separates top-k
-        fusions (k merged) from full-matrix fusions (exact dedupe)."""
+        fusions (k merged) from full-matrix fusions (exact dedupe).
+        lineage_id/seq are retry bookkeeping, NOT cluster state — they
+        are scrubbed before hashing so identical deltas from distinct
+        client lineages still fuse."""
         if not request.HasField("delta"):
             return None
         import hashlib
 
         kind = ("topk" if request.top_k > 0
                 else f"full-packed{int(bool(request.packed_ok))}")
-        digest = hashlib.sha1(request.delta.SerializeToString()).hexdigest()
+        d = request.delta
+        if d.lineage_id or d.seq:
+            scrub = pb.SnapshotDelta()
+            scrub.CopyFrom(d)
+            scrub.lineage_id = ""
+            scrub.seq = 0
+            d = scrub
+        digest = hashlib.sha1(d.SerializeToString()).hexdigest()
         return (request.delta.base_id, digest, kind)
 
     @staticmethod
@@ -767,12 +1091,23 @@ class SchedulerService:
         context.abort(code, details)
 
     def ScoreBatch(self, request: pb.ScoreRequest, context) -> pb.ScoreResponse:
+        replay = self._replay_lookup("ScoreBatch", request)
+        if replay is not None:
+            return replay
         try:
-            return self._score_batch(request, context)
+            resp = self._score_batch(request, context)
         except _Abort as e:
             self._abort(context, e.code, e.details)
         except _Overloaded as e:
             self._abort(context, grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except Exception as e:  # taxonomy: fatal (a bug, not a retry)
+            self._log_internal("ScoreBatch", e)
+            self._abort(context, grpc.StatusCode.INTERNAL,
+                        f"unexpected server error: {type(e).__name__}: {e}")
+        else:
+            self._replay_record("ScoreBatch", request, resp)
+            self._record_ladder_success(request)
+            return resp
 
     def _score_batch(self, request: pb.ScoreRequest, context) -> pb.ScoreResponse:
         key = self._score_key(request)
@@ -842,11 +1177,11 @@ class SchedulerService:
                     dstats=dstats, k_used=k_used,
                     pending_topk=pending_topk, pending_full=pending_full)
 
-    @staticmethod
-    def _score_response(payload: dict, request) -> tuple[pb.ScoreResponse, float]:
+    def _score_response(self, payload: dict, request) -> tuple[pb.ScoreResponse, float]:
         """Build ONE caller's response from the (possibly shared)
         payload: name tables now — they ride inside the device window —
-        then join the fetch and pack this caller's k columns."""
+        then join the fetch (watchdog-guarded) and pack this caller's
+        k columns."""
         meta = payload["meta"]
         P, N = payload["P"], payload["N"]
         resp = pb.ScoreResponse(snapshot_id=payload["sid"])
@@ -854,7 +1189,9 @@ class SchedulerService:
         resp.node_names.extend(meta.node_names)
         solve_s = 0.0
         if payload["pending_topk"] is not None:
-            idx, val, solve_s = payload["pending_topk"].result()
+            idx, val, solve_s = self._join_guarded(
+                payload["pending_topk"], "ScoreBatch top-k"
+            )
             # lax.top_k is prefix-stable: columns [:k_own] of the fused
             # top-k_used equal a direct top-k_own dispatch, so sliced
             # responses are byte-identical to unfused serving.
@@ -867,7 +1204,8 @@ class SchedulerService:
                 val[:P, :k_own], dtype="<f4"
             ).tobytes()
         elif payload["pending_full"] is not None:
-            res = payload["pending_full"].result()
+            res = self._join_guarded(payload["pending_full"],
+                                     "ScoreBatch full")
             solve_s = res.solve_seconds
             if request.packed_ok and P * N >= PACK_CELLS:
                 resp.feasible_packed = np.ascontiguousarray(
@@ -884,12 +1222,44 @@ class SchedulerService:
         return resp, solve_s
 
     def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
+        replay = self._replay_lookup("Assign", request)
+        if replay is not None:
+            return replay
         try:
-            return self._assign(request, context)
+            resp = self._assign(request, context)
         except _Abort as e:
             self._abort(context, e.code, e.details)
         except _Overloaded as e:
             self._abort(context, grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except Exception as e:  # taxonomy: fatal (a bug, not a retry)
+            self._log_internal("Assign", e)
+            self._abort(context, grpc.StatusCode.INTERNAL,
+                        f"unexpected server error: {type(e).__name__}: {e}")
+        else:
+            self._replay_record("Assign", request, resp)
+            self._record_ladder_success(request)
+            return resp
+
+    def _record_ladder_success(self, request) -> None:
+        """Probe discipline: a success arms/confirms recovery only when
+        it exercised the CURRENT rung's serving path. Delta requests do
+        (device sessions at 'delta', store+decode at 'rebuild'); full
+        sends are rubber stamps at those rungs and must not clear a
+        probation the probe never tested — but at 'stateless' full
+        sends ARE the serving path (deltas are refused), so they count
+        there, or the ladder could never climb back."""
+        if request.HasField("delta") or self._ladder.level() == "stateless":
+            self._ladder.record_success()
+
+    @staticmethod
+    def _log_internal(rpc: str, exc: BaseException) -> None:
+        import logging
+        import traceback
+
+        logging.getLogger("tpusched.rpc.server").error(
+            "%s failed unexpectedly (INTERNAL):\n%s",
+            rpc, traceback.format_exc(limit=5),
+        )
 
     def _assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
         snap, meta, sid, decode_s, dstats = self._resolve_decoded(request)
@@ -912,7 +1282,7 @@ class SchedulerService:
             # Indices resolve against the DECODER's canonical (sorted)
             # node order, not the request's wire order — ship the table.
             resp.node_names.extend(meta.node_names)
-        res = pending.result()
+        res = self._join_guarded(pending, "Assign solve")
         ni = np.asarray(res.assignment[:P], dtype=np.int32)
         sc = np.asarray(res.chosen_score[:P], dtype=np.float32).copy()
         sc[~np.isfinite(sc)] = 0.0  # -inf (unplaced/preempted) -> 0
@@ -970,14 +1340,41 @@ class SchedulerService:
         return resp
 
     def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
+        """Liveness + the failure-domain surface a sidecar watchdog
+        (liveness probe, chaos harness, operator) reads: which ladder
+        rung is serving and the trip/demotion/recovery/replay counters."""
         import jax
 
+        lad = self._ladder.snapshot()
         return pb.HealthResponse(
-            ok=True, backend=jax.default_backend(), devices=len(jax.devices())
+            ok=True, backend=jax.default_backend(),
+            devices=len(jax.devices()),
+            serving_path=lad["level"],
+            watchdog_trips=self.watchdog_trips,
+            ladder_demotions=lad["demotions"],
+            ladder_recoveries=lad["recoveries"],
+            replayed_requests=self.replayed_requests,
         )
 
     def Metrics(self, request: pb.MetricsRequest, context) -> pb.MetricsResponse:
-        return pb.MetricsResponse(prometheus_text=self.metrics.render())
+        lad = self._ladder.snapshot()
+        level_idx = DegradationLadder.LEVELS.index(lad["level"])
+        extra = [
+            "# TYPE scheduler_watchdog_trips_total counter",
+            f"scheduler_watchdog_trips_total {self.watchdog_trips}",
+            "# TYPE scheduler_ladder_demotions_total counter",
+            f"scheduler_ladder_demotions_total {lad['demotions']}",
+            "# TYPE scheduler_ladder_recoveries_total counter",
+            f"scheduler_ladder_recoveries_total {lad['recoveries']}",
+            "# TYPE scheduler_replayed_requests_total counter",
+            f"scheduler_replayed_requests_total {self.replayed_requests}",
+            "# TYPE scheduler_degradation_level gauge",
+            f'scheduler_degradation_level{{path="{lad["level"]}"}} '
+            f"{level_idx}",
+        ]
+        return pb.MetricsResponse(
+            prometheus_text=self.metrics.render() + "\n".join(extra) + "\n"
+        )
 
 
 def make_server(
@@ -988,16 +1385,22 @@ def make_server(
     log_stream=None,
     audit_stream=None,
     device_sessions: int = DEVICE_SESSION_CAP,
+    faults=None,
+    watchdog_s: float = WATCHDOG_S,
+    ladder: DegradationLadder | None = None,
 ):
     """Build (grpc.Server, bound_port, service). Unlimited message size:
     a 10k-pod snapshot exceeds the 4 MB default. max_workers default 8:
     4 concurrent clients each keeping 2 requests in flight must all get
     a decode thread — the dispatch gate, not the thread pool, is the
     serialization point. Call svc.close() after server.stop() to drain
-    the engine's fetch worker and drop device-resident sessions."""
+    the engine's fetch worker and drop device-resident sessions.
+    faults/watchdog_s/ladder: failure-domain knobs (SchedulerService)."""
     svc = SchedulerService(config, buckets, log_stream=log_stream,
                            audit_stream=audit_stream,
-                           device_sessions=device_sessions)
+                           device_sessions=device_sessions,
+                           faults=faults, watchdog_s=watchdog_s,
+                           ladder=ladder)
 
     def handler(fn, req_cls):
         return grpc.unary_unary_rpc_method_handler(
@@ -1027,10 +1430,11 @@ def make_server(
 
 
 def serve(address: str = "127.0.0.1:50051", config: EngineConfig | None = None,
-          audit_path: str | None = None):
+          audit_path: str | None = None, watchdog_s: float = WATCHDOG_S):
     """Blocking entry point: python -m tpusched.rpc.server"""
     audit = open(audit_path, "a") if audit_path else None
-    server, port, svc = make_server(address, config, audit_stream=audit)
+    server, port, svc = make_server(address, config, audit_stream=audit,
+                                    watchdog_s=watchdog_s)
     server.start()
     print(f"tpusched sidecar listening on port {port}", file=sys.stderr)
     try:
@@ -1047,10 +1451,14 @@ if __name__ == "__main__":
     ap.add_argument("--config", default=None, help="EngineConfig YAML path")
     ap.add_argument("--audit", default=None,
                     help="append per-pod placement audit JSONL to this file")
+    ap.add_argument("--watchdog-s", type=float, default=WATCHDOG_S,
+                    help="per-dispatch result-join budget before a hung "
+                         "solve is aborted as DEADLINE_EXCEEDED")
     args = ap.parse_args()
     cfg = None
     if args.config:
         from tpusched.config import load_config
 
         cfg = load_config(args.config)
-    serve(args.address, cfg, audit_path=args.audit)
+    serve(args.address, cfg, audit_path=args.audit,
+          watchdog_s=args.watchdog_s)
