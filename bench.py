@@ -729,6 +729,27 @@ def _session_h2d(svc) -> dict:
     }
 
 
+def _stage_breakdown() -> dict:
+    """Per-stage latency columns from the process trace ring (ISSUE 4):
+    stage name -> {p50_ms, p99_ms, n} over the server/engine spans
+    collected since the last ring clear. Empty when tracing is off —
+    the columns degrade away instead of breaking the bench."""
+    from tpusched import trace as _tr
+
+    by: dict[str, list] = {}
+    for s in _tr.DEFAULT.spans():
+        if s.cat in ("server", "engine"):
+            by.setdefault(s.name, []).append(s.dur_s)
+    return {
+        name: {
+            "p50_ms": round(float(np.percentile(v, 50)) * 1e3, 2),
+            "p99_ms": round(float(np.percentile(v, 99)) * 1e3, 2),
+            "n": len(v),
+        }
+        for name, v in sorted(by.items())
+    }
+
+
 def _serve_score_phase(svc, clients, msgs, rngs, pods, churn, shape,
                        K, cycles):
     """COALESCED scoring fan-in: K replicas ranking the SAME cluster
@@ -906,7 +927,12 @@ def bench_serving(args):
             **({"rtt_ms": TRANSPORT["rtt_ms"]} if TRANSPORT else {}),
         }), flush=True)
 
-        # 2. Closed-loop fan-in: K clients back-to-back.
+        # 2. Closed-loop fan-in: K clients back-to-back. The trace ring
+        # is cleared first so the per-stage breakdown columns cover
+        # exactly this phase.
+        from tpusched import trace as _tr
+
+        _tr.DEFAULT.clear()
         lat: list[list[float]] = [[] for _ in range(K)]
 
         def drive(i):
@@ -926,9 +952,18 @@ def bench_serving(args):
         agg_qps = K * cycles / wall
         alllat = np.asarray([x for l in lat for x in l])
         speedup = agg_qps / seq_qps
+        stage_ms = _stage_breakdown()
         log(f"  {K}-client closed loop: {agg_qps:.2f} cycles/s aggregate "
             f"({speedup:.2f}x sequential), per-request p50 "
             f"{np.percentile(alllat, 50)*1e3:.0f}ms")
+        if stage_ms:
+            # Where each millisecond of a request goes (ISSUE 4): one
+            # column per serving stage, p50/p99 over this phase.
+            cols = "  ".join(
+                f"{name} {v['p50_ms']:.1f}/{v['p99_ms']:.1f}ms"
+                for name, v in stage_ms.items()
+            )
+            log(f"  stage p50/p99: {cols}")
         print(json.dumps({
             "metric": f"serve_qps_{K}c_{shape}", "value": round(agg_qps, 3),
             "unit": "qps",
@@ -940,6 +975,7 @@ def bench_serving(args):
             "clients": K,
             "p50_ms": round(float(np.percentile(alllat, 50)) * 1e3, 1),
             "p99_ms": round(float(np.percentile(alllat, 99)) * 1e3, 1),
+            "stage_ms": stage_ms,
             **({"rtt_ms": TRANSPORT["rtt_ms"]} if TRANSPORT else {}),
         }), flush=True)
 
@@ -1151,7 +1187,16 @@ def main():
     ap.add_argument("--no-isolate", action="store_true",
                     help="run headline modes in-process even with "
                          "--mode both (isolation subprocess off)")
+    ap.add_argument("--trace", choices=["on", "off"], default="on",
+                    help="span collection (tpusched.trace) during the "
+                         "benches; 'off' measures the disabled "
+                         "zero-overhead path (ISSUE 4 acceptance: "
+                         "serve_qps within noise of traced runs)")
     args = ap.parse_args()
+
+    from tpusched import trace as _tr
+
+    _tr.set_enabled(args.trace == "on")
 
     import jax
 

@@ -54,7 +54,14 @@ def thread_leak_check():
     domain machinery — fetch workers respawned after a watchdog trip
     or a deliberate kill (still "tpusched-fetch": abandoned ones must
     drain and exit, not accumulate) and the chaos harness's delayed
-    restart timers ("tpusched-chaos-restart")."""
+    restart timers ("tpusched-chaos-restart").
+
+    Round 9 additionally pins the trace collector's THREADLESS design:
+    tpusched.trace must never spawn a worker (span collection is a
+    ring append on the caller's thread; export happens on demand), so
+    after any traced test NO new thread may carry "trace" in its name
+    — a regression here would put a leakable thread on every traced
+    hot path."""
     import threading
 
     # Keyed by Thread OBJECT, not ident: the OS recycles idents, and a
@@ -74,6 +81,13 @@ def thread_leak_check():
     while leaked() and time.monotonic() < deadline:
         time.sleep(0.05)
     assert leaked() == [], f"leaked worker threads: {leaked()}"
+    tracers = [
+        t for t in threading.enumerate()
+        if t not in before and t.is_alive() and "trace" in t.name.lower()
+    ]
+    assert tracers == [], (
+        f"the trace collector must not add threads: {tracers}"
+    )
 
 
 def pytest_configure(config):

@@ -8,9 +8,10 @@ compatible) field additions declared in SCHEMA_EDITS below, and emit a
 fresh module. protos/tpusched.proto stays the human-readable source of
 truth; keep SCHEMA_EDITS in lockstep with it.
 
-Only ADDITIVE edits are supported (new optional fields on existing
-messages): anything else would break wire compatibility with deployed
-clients anyway.
+Only ADDITIVE edits are supported — new optional fields on existing
+messages (SCHEMA_EDITS), whole new messages (MESSAGE_ADDS), and new
+service methods (METHOD_ADDS): anything else would break wire
+compatibility with deployed clients anyway.
 
 Usage:  python tools/regen_pb2.py          # rewrites tpusched_pb2.py
         python tools/regen_pb2.py --check  # verify pb2 matches edits
@@ -41,6 +42,35 @@ SCHEMA_EDITS = {
         ("ladder_demotions", 6, F.TYPE_INT64, "ladderDemotions"),
         ("ladder_recoveries", 7, F.TYPE_INT64, "ladderRecoveries"),
         ("replayed_requests", 8, F.TYPE_INT64, "replayedRequests"),
+    ],
+    # Round 9 (ISSUE 4): cross-wire trace stitching — the client stamps
+    # its trace id and active span id; absent id => server-minted.
+    "ScoreRequest": [
+        ("request_id", 5, F.TYPE_STRING, "requestId"),
+        ("parent_span", 6, F.TYPE_UINT64, "parentSpan"),
+    ],
+    "AssignRequest": [
+        ("request_id", 4, F.TYPE_STRING, "requestId"),
+        ("parent_span", 5, F.TYPE_UINT64, "parentSpan"),
+    ],
+}
+
+# Whole new messages: message name -> field list (same tuple shape).
+MESSAGE_ADDS = {
+    "DebugzRequest": [
+        ("max_traces", 1, F.TYPE_INT32, "maxTraces"),
+        ("include_flight", 2, F.TYPE_BOOL, "includeFlight"),
+    ],
+    "DebugzResponse": [
+        ("trace_json", 1, F.TYPE_STRING, "traceJson"),
+        ("flight_json", 2, F.TYPE_STRING, "flightJson"),
+    ],
+}
+
+# New unary service methods: service name -> [(method, input, output)].
+METHOD_ADDS = {
+    "TpuScheduler": [
+        ("Debugz", ".tpusched.DebugzRequest", ".tpusched.DebugzResponse"),
     ],
 }
 
@@ -75,10 +105,17 @@ def extract_blob(source: str) -> bytes:
 
 
 def apply_edits(fd: descriptor_pb2.FileDescriptorProto) -> bool:
-    """Add missing SCHEMA_EDITS fields in place; True if anything new."""
+    """Add missing SCHEMA_EDITS fields, MESSAGE_ADDS messages, and
+    METHOD_ADDS service methods in place; True if anything new."""
     changed = False
     by_name = {m.name: m for m in fd.message_type}
-    for msg_name, fields in SCHEMA_EDITS.items():
+    for msg_name, fields in MESSAGE_ADDS.items():
+        if msg_name in by_name:
+            continue
+        msg = fd.message_type.add(name=msg_name)
+        by_name[msg_name] = msg
+        changed = True
+    for msg_name, fields in {**SCHEMA_EDITS, **MESSAGE_ADDS}.items():
         msg = by_name[msg_name]
         have = {f.name for f in msg.field}
         for name, number, ftype, json_name in fields:
@@ -87,6 +124,17 @@ def apply_edits(fd: descriptor_pb2.FileDescriptorProto) -> bool:
             msg.field.add(
                 name=name, number=number, type=ftype,
                 label=F.LABEL_OPTIONAL, json_name=json_name,
+            )
+            changed = True
+    services = {s.name: s for s in fd.service}
+    for svc_name, methods in METHOD_ADDS.items():
+        svc = services[svc_name]
+        have = {m.name for m in svc.method}
+        for name, input_type, output_type in methods:
+            if name in have:
+                continue
+            svc.method.add(
+                name=name, input_type=input_type, output_type=output_type,
             )
             changed = True
     return changed

@@ -1,0 +1,151 @@
+"""Export tpusched traces as Chrome/Perfetto trace-event JSON.
+
+Two modes:
+
+  * ``--address host:port`` — fetch the last-N traces (and optionally
+    the flight-recorder dumps) from a LIVE sidecar's Debugz rpc and
+    convert them;
+  * ``--demo`` — spin up an in-process sidecar, drive it with K
+    concurrent delta-cycling clients (optionally tripping the watchdog
+    through a deterministic fault plan), and export the STITCHED
+    client+server ring — the zero-infrastructure way to look at a
+    trace in this image.
+
+Open the output at chrome://tracing or https://ui.perfetto.dev. Each
+span carries its ``trace_id`` (the wire request_id), ``span_id`` and
+``parent_span`` in args; rows are real thread names, so a coalesced
+request shows the follower's ``coalesce.wait`` parked against the
+leader's ``dispatch``, and a client's ``client.send`` brackets the
+server's stage spans for the same request_id.
+
+Usage:
+  python tools/tracez.py --demo --clients 4 --cycles 6 --out /tmp/t.json
+  python tools/tracez.py --demo --trip-watchdog --flight-out /tmp/f.json
+  python tools/tracez.py --address 127.0.0.1:50051 --last 32 --out t.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tpusched import trace  # noqa: E402
+
+
+def chrome_doc(events) -> dict:
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def spans_from_debugz(resp) -> list:
+    traces = json.loads(resp.trace_json).get("traces", {})
+    out = []
+    for spans in traces.values():
+        out.extend(spans)
+    out.sort(key=lambda s: s["t_wall"])
+    return out
+
+
+def run_demo(clients: int, cycles: int, trip_watchdog: bool):
+    """In-process multi-client serving demo; returns (span_dicts,
+    flight_dumps). Small shapes — this is about the trace, not load."""
+    import threading
+
+    from tpusched.faults import FaultPlan, FaultRule
+    from tpusched.rpc.client import DeltaSession, SchedulerClient
+    from tpusched.rpc.codec import snapshot_to_proto
+    from tpusched.rpc.server import make_server
+
+    trace.DEFAULT.clear()
+    faults = None
+    watchdog_s = 120.0
+    if trip_watchdog:
+        # One delayed fetch, 2.5x the watchdog: the affected caller
+        # gets DEADLINE_EXCEEDED, the server records a flight dump and
+        # keeps serving everyone else.
+        watchdog_s = 1.0
+        faults = FaultPlan([FaultRule(site="engine.fetch", kind="delay",
+                                      at=frozenset({2}), delay_s=2.5)])
+    server, port, svc = make_server("127.0.0.1:0", faults=faults,
+                                    watchdog_s=watchdog_s)
+    server.start()
+
+    def drive(i: int):
+        nodes = [dict(name=f"n{i}-{j}",
+                      allocatable={"cpu": 4000.0, "memory": float(16 << 30)})
+                 for j in range(4)]
+        pods = [dict(name=f"p{i}-{j}",
+                     requests={"cpu": 500.0, "memory": float(1 << 30)})
+                for j in range(6)]
+        with SchedulerClient(f"127.0.0.1:{port}", timeout=30.0) as c:
+            sess = DeltaSession(c)
+            for k in range(cycles):
+                nodes[0]["allocatable"] = {
+                    "cpu": 4000.0 + k, "memory": float(16 << 30)}
+                msg = snapshot_to_proto(nodes, pods, [])
+                try:
+                    sess.assign(msg, changed={f"n{i}-0"}, packed_ok=True)
+                except Exception as e:  # noqa: BLE001 — the tripped caller
+                    print(f"client {i} cycle {k}: {e}", file=sys.stderr)
+
+    threads = [threading.Thread(target=drive, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = [trace.span_dict(s) for s in trace.DEFAULT.spans()]
+    flight = svc.flight.dumps()
+    server.stop(0)
+    svc.close()
+    return spans, flight
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--address", help="live sidecar to fetch Debugz from")
+    mode.add_argument("--demo", action="store_true",
+                      help="in-process multi-client run")
+    ap.add_argument("--out", default="trace.json",
+                    help="Chrome trace-event JSON output path")
+    ap.add_argument("--flight-out", default=None,
+                    help="also dump flight-recorder JSON here")
+    ap.add_argument("--last", type=int, default=32,
+                    help="--address: how many recent traces to fetch")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--cycles", type=int, default=6)
+    ap.add_argument("--trip-watchdog", action="store_true",
+                    help="--demo: inject a hung fetch so the watchdog "
+                         "trips and the flight recorder dumps")
+    args = ap.parse_args()
+
+    if args.demo:
+        spans, flight = run_demo(args.clients, args.cycles,
+                                 args.trip_watchdog)
+    else:
+        from tpusched.rpc.client import SchedulerClient
+
+        with SchedulerClient(args.address) as c:
+            resp = c.debugz(max_traces=args.last,
+                            include_flight=bool(args.flight_out))
+        spans = spans_from_debugz(resp)
+        flight = json.loads(resp.flight_json) if resp.flight_json else []
+
+    doc = chrome_doc(trace.to_chrome(spans))
+    Path(args.out).write_text(json.dumps(doc))
+    n_traces = len({s["trace_id"] for s in spans if s["trace_id"]})
+    print(f"wrote {args.out}: {len(spans)} spans across "
+          f"{n_traces} traces", file=sys.stderr)
+    if args.flight_out:
+        Path(args.flight_out).write_text(json.dumps(flight))
+        print(f"wrote {args.flight_out}: {len(flight)} flight dumps "
+              f"({[d['reason'] for d in flight]})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

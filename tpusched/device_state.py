@@ -43,6 +43,7 @@ Invariants (the delta-vs-rebuild parity tests pin these):
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Iterable, Mapping
 
 import jax
@@ -115,6 +116,10 @@ class DeviceSnapshot:
                  buckets: Buckets | None = None):
         self.config = config or EngineConfig()
         self._floor_buckets = buckets
+        # Span collector for device.rebuild events; None = the process
+        # default at emit time (the sidecar points this at its own
+        # collector when one was injected).
+        self.tracer = None
         # Raw record kwargs by name (rebuild source of truth) and the
         # normalized forms row fills consume.
         self._nodes: dict[str, dict] = {}
@@ -241,6 +246,7 @@ class DeviceSnapshot:
             rec = {k: v for k, v in self._running[name].items()
                    if k != "name"}
             b.add_running_pod(**rec)
+        t0 = time.perf_counter()
         snap_np, meta, state = b.build_state()
         meta.running_names = list(self._run_order)
         self._state = state
@@ -263,6 +269,15 @@ class DeviceSnapshot:
             self.rebuild_reasons.append(reason)
         self.h2d_bytes_last = nbytes
         self.h2d_bytes_total += nbytes
+        # Event span (round 9): a rebuild is the expensive surprise of
+        # the device-resident path — it must be visible in the trace
+        # ring (and flight dumps) with its trigger, not just a counter.
+        from tpusched import trace as tracing
+
+        (self.tracer or tracing.DEFAULT).record(
+            "device.rebuild", dur_s=time.perf_counter() - t0, cat="device",
+            reason=reason, h2d_bytes=nbytes,
+        )
         return ApplyStats(path="rebuild", reason=reason, h2d_bytes=nbytes)
 
     # -- incremental apply --------------------------------------------------

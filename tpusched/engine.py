@@ -220,6 +220,11 @@ class Engine:
         self.config = config or EngineConfig()
         self.mesh = mesh
         self._faults = faults if faults is not None else NO_FAULTS
+        # Span collector for engine.fetch events; None = the process
+        # default at emit time (SchedulerService points this at its own
+        # collector so fetch spans land in the same ring — and flight
+        # dumps — as the handler spans).
+        self.tracer = None
         cfg = self.config
         if cfg.mode not in ("parity", "fast"):
             raise ValueError(f"mode={cfg.mode!r}: want 'parity' or 'fast'")
@@ -356,16 +361,34 @@ class Engine:
             )
         old.close(wait=False)
 
-    def _fetch(self, buf):
+    def _fetch(self, buf, tctx=None):
         # Completion time measured INSIDE the worker so solve_seconds
         # covers dispatch->fetch-done, not whatever CPU work the caller
         # overlapped with the wait. np.asarray releases the GIL inside
         # the transport wait and, on fetch-driven transports, is what
         # actually runs the program. tree.map: score_async fetches a
         # (feasible, scores) pair through the same worker.
+        # tctx: the dispatching request's trace context (captured on
+        # the caller's thread at dispatch time — thread-locals don't
+        # cross into the worker); the fetch records one span against
+        # it, so the stitched trace shows the device window alongside
+        # the handler's fetch.join wait.
         self._faults.fire("engine.fetch")
+        t0 = time.perf_counter()
         out = jax.tree.map(np.asarray, buf)
-        return out, time.perf_counter()
+        done = time.perf_counter()
+        from tpusched import trace as tracing
+
+        (self.tracer or tracing.DEFAULT).record(
+            "engine.fetch", dur_s=done - t0, cat="engine", ctx=tctx)
+        return out, done
+
+    def _submit_fetch(self, buf):
+        """Queue the D2H fetch, carrying the caller's trace context."""
+        from tpusched import trace as tracing
+
+        tr = self.tracer or tracing.DEFAULT
+        return self._pool().submit(self._fetch, buf, tr.current())
 
     def solve(self, snap: ClusterSnapshot) -> SolveResult:
         """Full batched scheduling: assign every pending pod (or -1).
@@ -395,7 +418,7 @@ class Engine:
             res.solve_seconds = seconds
             return res
 
-        return PendingFetch(unpack, self._pool().submit(self._fetch, buf), t0)
+        return PendingFetch(unpack, self._submit_fetch(buf), t0)
 
     def score(self, snap: ClusterSnapshot) -> ScoreBatchResult:
         """ScoreBatch: [P, N] feasibility + normalized weighted scores,
@@ -416,7 +439,7 @@ class Engine:
 
         t0 = time.perf_counter()
         out = self._score_jit(snap)  # async dispatch
-        return PendingFetch(unpack, self._pool().submit(self._fetch, out), t0)
+        return PendingFetch(unpack, self._submit_fetch(out), t0)
 
     def score_topk(self, snap: ClusterSnapshot, k: int):
         """Top-k of the ScoreBatch matrix computed ON DEVICE: each
@@ -468,7 +491,7 @@ class Engine:
 
         t0 = time.perf_counter()
         buf = fn(snap)  # async dispatch
-        return PendingFetch(unpack, self._pool().submit(self._fetch, buf), t0)
+        return PendingFetch(unpack, self._submit_fetch(buf), t0)
 
     def score_top1(self, snap: ClusterSnapshot):
         """Full [P, N] scoring on device, returning only each pod's best

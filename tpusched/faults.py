@@ -84,6 +84,10 @@ class FaultPlan:
         self._lock = threading.Lock()
         self._counts: dict[str, int] = {}
         self.fired: list[tuple[str, int, str]] = []  # (site, index, kind)
+        # Span collector for fault.* events; None = the process default
+        # at emit time (SchedulerService points this at its own
+        # collector so shots land in its flight dumps).
+        self.tracer = None
 
     @classmethod
     def seeded(cls, seed: int, spec: dict) -> "FaultPlan":
@@ -146,9 +150,22 @@ class FaultPlan:
                     self.fired.append((site, index, hit.kind))
         if hit is None:
             return None
+        # Fault events are SPANS (round 9, ISSUE 4): every injected
+        # shot lands in the process trace ring (cat="fault"), so a
+        # chaos run's flight-recorder dumps and Chrome export show the
+        # injection alongside the stages it broke. Inherits the firing
+        # thread's active trace (a server.decode shot lands inside its
+        # request's stitched trace); delay shots carry their duration.
+        from tpusched import trace as tracing
+
+        tr = self.tracer or tracing.DEFAULT
         if hit.kind == "delay":
             time.sleep(hit.delay_s)
+            tr.record("fault.delay", dur_s=hit.delay_s,
+                      cat="fault", site=site, index=index)
             return None
+        tr.record(f"fault.{hit.kind}", cat="fault",
+                  site=site, index=index)
         if hit.kind == "drop":
             return "drop"
         raise FaultError(site, index, hit.message)

@@ -218,7 +218,14 @@ class HostScheduler:
         # re-driven by run_until_idle (ISSUE 3: the host survives its
         # scheduler backend's failures the way kube-scheduler survives
         # an apiserver hiccup — state is re-read, the cycle re-runs).
+        # Round 9 exports the count as a Prometheus counter in the
+        # process-default registry (it was in-memory-only state).
+        from tpusched import metrics as pm
+
         self.failed_cycles = 0
+        self._m_failed_cycles = pm.Counter(
+            "tpusched_host_failed_cycles_total",
+            "scheduling cycles re-driven after a transient rpc failure")
 
     def _io(self) -> ThreadPoolExecutor:
         """Lazy pool for concurrent API-server writes (binds/deletes)."""
@@ -453,6 +460,15 @@ class HostScheduler:
             build_seconds=build_s, solve_seconds=solve_s, bind_seconds=bind_s,
         )
         self.cycles.append(stats)
+        # One retroactive span per completed cycle: the host-side roof
+        # over the per-request client/server traces (the rpc spans
+        # carry their own request_ids; this one carries the batch).
+        from tpusched import trace as tracing
+
+        tracing.DEFAULT.record(
+            "host.cycle", dur_s=stats.total_seconds, cat="host",
+            batch=stats.batch_size, placed=placed, evicted=len(evicted),
+        )
         return stats
 
     @staticmethod
@@ -505,6 +521,7 @@ class HostScheduler:
                     raise
                 streak += 1
                 self.failed_cycles += 1
+                self._m_failed_cycles.inc()
                 n += 1
                 continue
             streak = 0

@@ -659,6 +659,9 @@ class KubeInformer:
         from tpusched.faults import NO_FAULTS
 
         self._faults = faults if faults is not None else NO_FAULTS
+        # Span collector for kube.watch.reconnect events; None = the
+        # process default at emit time.
+        self.tracer = None
         self.scheduler_name = client.scheduler_name
         self._lock = threading.Lock()
         self._objs: dict[str, dict[str, dict]] = {
@@ -702,6 +705,22 @@ class KubeInformer:
         import random
 
         self._watch_rng = random.Random(backoff_seed)
+        # Prometheus export (round 9, ISSUE 4 satellite): reconnects and
+        # backoff time were in-memory-only state; now they're counters
+        # in the process-default registry (tpusched.metrics.render_
+        # default()) — shared across informers in one process, like
+        # prometheus_client families — plus instance mirrors for tests.
+        from tpusched import metrics as pm
+
+        self.watch_reconnects = 0
+        self.watch_backoff_s = 0.0
+        self._m_reconnects = pm.Counter(
+            "tpusched_kube_watch_reconnects_total",
+            "watch-stream failures that took the relist/backoff path",
+            ("path",))
+        self._m_backoff = pm.Counter(
+            "tpusched_kube_watch_backoff_seconds_total",
+            "seconds spent backing off failed watch streams", ("path",))
 
     def _log_watch_failure(self, path: str, exc: BaseException) -> None:
         """One stderr line per (path, failure class) per
@@ -832,7 +851,23 @@ class KubeInformer:
                 self._log_watch_failure(path, e)
                 rv = ""
                 failures += 1
-                if self._stop.wait(self._watch_backoff(failures)):
+                delay = self._watch_backoff(failures)
+                self.watch_reconnects += 1
+                self._m_reconnects.labels(path).inc()
+                from tpusched import trace as tracing
+
+                (self.tracer or tracing.DEFAULT).record(
+                    "kube.watch.reconnect", cat="kube", path=path,
+                    failures=failures, backoff_s=round(delay, 3),
+                )
+                t0 = time.monotonic()
+                stopped = self._stop.wait(delay)
+                # Seconds actually SPENT backing off — stop() mid-wait
+                # must not bank the full capped delay.
+                waited = time.monotonic() - t0
+                self.watch_backoff_s += waited
+                self._m_backoff.labels(path).inc(waited)
+                if stopped:
                     return
 
     # -- FakeApiServer read interface, served from the cache ----------------

@@ -1,0 +1,366 @@
+"""Tiny labeled Prometheus registry (round 9, ISSUE 4).
+
+Replaces the sidecar's hand-rolled `_Metrics` lines and gives host-side
+components (kube informer reconnects, HostScheduler failed cycles) a
+real exposition surface. Deliberately a subset of prometheus_client —
+this image must not grow dependencies — but a STRICT one: the render
+always emits `# TYPE` lines, escapes label values, keeps histogram
+bucket cumulative counts monotone, and emits `_sum`/`_count` per
+histogram series (tests/test_metrics.py parses the full render with a
+line-format checker).
+
+Counters/Gauges/Histograms are name-keyed in a Registry; constructing
+a metric whose name already exists in the registry RETURNS the
+existing metric (labelnames must match) — prometheus_client's
+get-or-create discipline, so K informers in one process share one
+`tpusched_kube_watch_reconnects_total` family instead of colliding.
+
+Bucket helpers replace the old 5s-capped linear BUCKETS: log-scale
+duration buckets span 100 µs .. 600 s+ (a 10k x 5k CPU solve runs far
+past 5 s — the round-8 histogram put every real solve in +Inf),
+power-of-4 byte buckets span 1 KiB .. 1 GiB for H2D accounting.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus text exposition escaping for label values."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def format_value(v) -> str:
+    """Canonical sample value: integers render bare, floats repr-exact,
+    infinities as +Inf/-Inf."""
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def log_buckets(lo: float, hi: float, per_decade: int = 3) -> tuple:
+    """Log-spaced upper bounds from `lo` up to and including the first
+    bound >= `hi` (e.g. 1e-4 .. 600 at 3/decade: 0.0001, 0.000215,
+    0.000464, 0.001, ... 464.2, 1000)."""
+    out = []
+    step = 10.0 ** (1.0 / per_decade)
+    b = float(lo)
+    while True:
+        out.append(round(b, 10))
+        if b >= hi:
+            break
+        b *= step
+    return tuple(out)
+
+
+def pow_buckets(lo: int, hi: int, factor: int = 4) -> tuple:
+    """Geometric integer bounds (bytes): lo, lo*factor, ... >= hi."""
+    out = []
+    b = int(lo)
+    while True:
+        out.append(b)
+        if b >= hi:
+            break
+        b *= factor
+    return tuple(out)
+
+
+# Serving-stage durations: 100 µs (a gate pass-through) .. 600 s (a
+# watchdog-scale hung solve) — the fix for the 5.0s truncation.
+DURATION_BUCKETS = log_buckets(1e-4, 600.0, per_decade=3)
+BYTE_BUCKETS = pow_buckets(1 << 10, 1 << 30, factor=4)
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, "_Metric"] = {}  # insertion-ordered
+
+    def _get_or_register(self, name: str, factory, kind: str,
+                         labelnames: tuple):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if m.kind != kind or m.labelnames != labelnames:
+                    raise ValueError(
+                        f"metric {name!r} re-registered as {kind}"
+                        f"{labelnames} but exists as {m.kind}"
+                        f"{m.labelnames}"
+                    )
+                return m
+            m = factory()
+            self._metrics[name] = m
+            return m
+
+    def render(self) -> str:
+        """Full text exposition: one `# TYPE` line then the samples of
+        each metric family, in registration order."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines = []
+        for m in metrics:
+            lines.extend(m.render_lines())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: tuple):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: dict[tuple, object] = {}
+
+    def labels(self, *values, **kv):
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            values = tuple(kv[n] for n in self.labelnames)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: want labels {self.labelnames}, got {values!r}"
+            )
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._new_child()
+            return child
+        # (children are never removed: bounded by real label use)
+
+    def _series(self) -> "list[tuple[tuple, object]]":
+        with self._lock:
+            return list(self._children.items())
+
+    def _label_str(self, key: tuple, extra: str = "") -> str:
+        parts = [
+            f'{n}="{escape_label_value(v)}"'
+            for n, v in zip(self.labelnames, key)
+        ]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _CounterChild:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def inc(self, n=1):
+        with self._lock:
+            self.value += n
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    # The factory passed to _get_or_register FULLY initializes the
+    # instance before it is published under the registry lock — a
+    # concurrent constructor of the same family must never see a
+    # half-built metric (__init__ runs after __new__ returns, outside
+    # the lock, so it must not be what builds the object).
+
+    def __new__(cls, name, help="", labelnames=(), registry=None):
+        registry = registry if registry is not None else DEFAULT
+
+        def make():
+            m = super(Counter, cls).__new__(cls)
+            _Metric.__init__(m, name, help, tuple(labelnames))
+            return m
+
+        return registry._get_or_register(
+            name, make, "counter", tuple(labelnames),
+        )
+
+    def __init__(self, name, help="", labelnames=(), registry=None):
+        pass  # built by the __new__ factory (comment above)
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, n=1):
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} has labels {self.labelnames}; use .labels()"
+            )
+        self.labels().inc(n)
+
+    def value(self, *label_values) -> float:
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            child = self._children.get(key)
+        return child.value if child is not None else 0
+
+    def render_lines(self) -> list:
+        lines = [f"# TYPE {self.name} counter"]
+        series = self._series()
+        if not series and not self.labelnames:
+            series = [((), _CounterChild())]
+        for key, child in series:
+            lines.append(
+                f"{self.name}{self._label_str(key)} "
+                f"{format_value(child.value)}"
+            )
+        return lines
+
+
+class _GaugeChild:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def set(self, v):
+        with self._lock:
+            self.value = v
+
+    def inc(self, n=1):
+        with self._lock:
+            self.value += n
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __new__(cls, name, help="", labelnames=(), registry=None):
+        registry = registry if registry is not None else DEFAULT
+
+        def make():
+            m = super(Gauge, cls).__new__(cls)
+            _Metric.__init__(m, name, help, tuple(labelnames))
+            return m
+
+        return registry._get_or_register(
+            name, make, "gauge", tuple(labelnames),
+        )
+
+    def __init__(self, name, help="", labelnames=(), registry=None):
+        pass  # built by the __new__ factory (see Counter)
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def set(self, v):
+        if self.labelnames:
+            raise ValueError(f"{self.name} has labels; use .labels().set()")
+        self.labels().set(v)
+
+    def render_lines(self) -> list:
+        lines = [f"# TYPE {self.name} gauge"]
+        series = self._series()
+        if not series and not self.labelnames:
+            series = [((), _GaugeChild())]
+        for key, child in series:
+            lines.append(
+                f"{self.name}{self._label_str(key)} "
+                f"{format_value(child.value)}"
+            )
+        return lines
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets):
+        self._lock = threading.Lock()
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # last = overflow (+Inf)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self.sum += v
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __new__(cls, name, help="", buckets=DURATION_BUCKETS,
+                labelnames=(), registry=None):
+        registry = registry if registry is not None else DEFAULT
+
+        def make():
+            m = super(Histogram, cls).__new__(cls)
+            _Metric.__init__(m, name, help, tuple(labelnames))
+            m.buckets = tuple(float(b) for b in buckets)
+            return m
+
+        return registry._get_or_register(
+            name, make, "histogram", tuple(labelnames),
+        )
+
+    def __init__(self, name, help="", buckets=DURATION_BUCKETS,
+                 labelnames=(), registry=None):
+        # Built by the __new__ factory (see Counter); only the
+        # get-or-create layout check remains: a silently-different
+        # bucket layout would mis-bucket this caller's observations —
+        # the exact failure mode this module fixes.
+        if tuple(float(b) for b in buckets) != self.buckets:
+            raise ValueError(
+                f"metric {name!r} re-registered with buckets "
+                f"{tuple(buckets)!r} but exists with {self.buckets!r}"
+            )
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, v):
+        if self.labelnames:
+            raise ValueError(f"{self.name} has labels; use .labels().observe()")
+        self.labels().observe(v)
+
+    def render_lines(self) -> list:
+        lines = [f"# TYPE {self.name} histogram"]
+        for key, child in self._series():
+            with child._lock:
+                counts = list(child.counts)
+                total, ssum = child.count, child.sum
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                le = self._label_str(key, f'le="{format_value(b)}"')
+                lines.append(f"{self.name}_bucket{le} {cum}")
+            le = self._label_str(key, 'le="+Inf"')
+            lines.append(f"{self.name}_bucket{le} {total}")
+            lines.append(
+                f"{self.name}_sum{self._label_str(key)} {ssum:.6f}"
+            )
+            lines.append(
+                f"{self.name}_count{self._label_str(key)} {total}"
+            )
+        return lines
+
+
+# Process-default registry: host-side components (kube informer,
+# HostScheduler) register here so one process-wide render_default()
+# exposes them; the sidecar's _Metrics uses its OWN Registry (its
+# Metrics rpc is per-server).
+DEFAULT = Registry()
+
+
+def render_default() -> str:
+    return DEFAULT.render()

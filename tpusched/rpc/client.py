@@ -33,6 +33,7 @@ import uuid
 import grpc
 import numpy as np
 
+from tpusched import trace as tracing
 from tpusched.rpc import codec
 from tpusched.rpc import tpusched_pb2 as pb
 from tpusched.rpc.server import SERVICE
@@ -161,6 +162,11 @@ class SchedulerClient:
         self.retry = retry if retry is not None else RetryPolicy()
         self.retries = 0          # observability: attempts beyond the first
         self._retry_rng = random.Random(retry_seed)
+        # Trace stitching (round 9, ISSUE 4): every Score/Assign request
+        # is stamped with a trace id (request_id) + the caller's active
+        # span (parent_span); the sidecar roots its stage spans there,
+        # so the client and server rings merge into one causal trace.
+        self.tracer = tracing.DEFAULT
         self._channel = grpc.insecure_channel(
             address,
             options=[
@@ -180,8 +186,32 @@ class SchedulerClient:
         self._assign = method("Assign", pb.AssignRequest, pb.AssignResponse)
         self._health = method("Health", pb.HealthRequest, pb.HealthResponse)
         self._metrics = method("Metrics", pb.MetricsRequest, pb.MetricsResponse)
+        self._debugz = method("Debugz", pb.DebugzRequest, pb.DebugzResponse)
 
-    def _call(self, method, request):
+    def _stamp(self, request, request_id: str = "") -> str:
+        """Stamp a Score/Assign request with its trace identity; keeps
+        an id the caller (a pipeline re-issue) already minted. With no
+        explicit id, an enclosing client span on this thread donates
+        its trace: a resync full-send issued under a client.resync span
+        parents into the doomed request's trace instead of starting an
+        unrelated one."""
+        if request_id:
+            request.request_id = request_id
+        if not request.request_id:
+            ctx = self.tracer.current()
+            if ctx is not None and ctx[0]:
+                request.request_id = ctx[0]
+                if not request.parent_span:
+                    request.parent_span = ctx[1]
+            else:
+                request.request_id = self.tracer.new_trace_id()
+        elif not request.parent_span:
+            ctx = self.tracer.current()
+            if ctx is not None and ctx[0] == request.request_id:
+                request.parent_span = ctx[1]
+        return request.request_id
+
+    def _call(self, method, request, rpc: str = ""):
         """Blocking unary call under the deadline + retry contract:
         RETRYABLE statuses back off (capped, jittered) and re-send
         inside the ORIGINAL deadline budget; a retried delta carries
@@ -189,12 +219,21 @@ class SchedulerClient:
         attempt is deduped server-side. Everything else raises.
         _BasePipeline._join_entry is this loop's future-shaped twin —
         keep their retry discipline in lockstep."""
+        rid = ""
+        if "request_id" in type(request).DESCRIPTOR.fields_by_name:
+            rid = self._stamp(request)
         deadline = time.monotonic() + self.timeout
         attempt = 0
         while True:
             remaining = deadline - time.monotonic()
             try:
-                return method(request, timeout=max(remaining, 1e-3))
+                if not rid:
+                    return method(request, timeout=max(remaining, 1e-3))
+                with self.tracer.span("client.send", cat="client",
+                                      trace_id=rid,
+                                      parent_id=int(request.parent_span),
+                                      rpc=rpc, attempt=attempt):
+                    return method(request, timeout=max(remaining, 1e-3))
             except grpc.RpcError as e:
                 attempt += 1
                 if (e.code() not in self.retry.codes
@@ -205,6 +244,14 @@ class SchedulerClient:
                     raise
                 self.retries += 1
                 time.sleep(delay)
+                if rid:
+                    # The backoff wait, as a span: retries are visible
+                    # gaps in the stitched trace, not silent latency.
+                    self.tracer.record(
+                        "client.retry", dur_s=delay, cat="client",
+                        ctx=(rid, int(request.parent_span)),
+                        rpc=rpc, code=e.code().name, attempt=attempt,
+                    )
 
     def health(self) -> pb.HealthResponse:
         return self._call(self._health, pb.HealthRequest())
@@ -216,6 +263,7 @@ class SchedulerClient:
             self._score,
             pb.ScoreRequest(snapshot=snapshot, packed_ok=packed_ok,
                             top_k=top_k),
+            rpc="ScoreBatch",
         )
 
     def assign(self, snapshot: pb.ClusterSnapshot, *,
@@ -223,25 +271,37 @@ class SchedulerClient:
         return self._call(
             self._assign,
             pb.AssignRequest(snapshot=snapshot, packed_ok=packed_ok),
+            rpc="Assign",
         )
 
+    def _send_future(self, method, request, rpc: str, request_id: str):
+        """Issue a stamped future; the send itself is an instant span
+        (the in-flight wait is the caller's join — pipelines record it
+        as client.join against the same trace id)."""
+        rid = self._stamp(request, request_id)
+        self.tracer.record("client.send", cat="client",
+                           ctx=(rid, int(request.parent_span)), rpc=rpc)
+        return method.future(request, timeout=self.timeout)
+
     def assign_future(self, snapshot: pb.ClusterSnapshot, *,
-                      packed_ok: bool = False):
+                      packed_ok: bool = False, request_id: str = ""):
         """Non-blocking Assign: returns a grpc Future. With the
         sidecar's staged handlers (decode outside the dispatch lane), a
         second in-flight request is what lets ONE client overlap its
         next request's decode with the previous solve — see
         AssignPipeline."""
-        return self._assign.future(
+        return self._send_future(
+            self._assign,
             pb.AssignRequest(snapshot=snapshot, packed_ok=packed_ok),
-            timeout=self.timeout,
+            "Assign", request_id,
         )
 
     def assign_delta_future(self, delta: pb.SnapshotDelta, *,
-                            packed_ok: bool = False):
-        return self._assign.future(
+                            packed_ok: bool = False, request_id: str = ""):
+        return self._send_future(
+            self._assign,
             pb.AssignRequest(delta=delta, packed_ok=packed_ok),
-            timeout=self.timeout,
+            "Assign", request_id,
         )
 
     def score_batch_delta(self, delta: pb.SnapshotDelta, *,
@@ -250,24 +310,29 @@ class SchedulerClient:
         return self._call(
             self._score,
             pb.ScoreRequest(delta=delta, packed_ok=packed_ok, top_k=top_k),
+            rpc="ScoreBatch",
         )
 
     def score_batch_future(self, snapshot: pb.ClusterSnapshot, *,
-                           packed_ok: bool = False, top_k: int = 0):
+                           packed_ok: bool = False, top_k: int = 0,
+                           request_id: str = ""):
         """Non-blocking ScoreBatch (see assign_future): the second
         in-flight request that lets ONE scoring client overlap its next
         request's decode with the previous ranking — ScorePipeline."""
-        return self._score.future(
+        return self._send_future(
+            self._score,
             pb.ScoreRequest(snapshot=snapshot, packed_ok=packed_ok,
                             top_k=top_k),
-            timeout=self.timeout,
+            "ScoreBatch", request_id,
         )
 
     def score_batch_delta_future(self, delta: pb.SnapshotDelta, *,
-                                 packed_ok: bool = False, top_k: int = 0):
-        return self._score.future(
+                                 packed_ok: bool = False, top_k: int = 0,
+                                 request_id: str = ""):
+        return self._send_future(
+            self._score,
             pb.ScoreRequest(delta=delta, packed_ok=packed_ok, top_k=top_k),
-            timeout=self.timeout,
+            "ScoreBatch", request_id,
         )
 
     def assign_delta(self, delta: pb.SnapshotDelta, *,
@@ -275,10 +340,21 @@ class SchedulerClient:
         return self._call(
             self._assign,
             pb.AssignRequest(delta=delta, packed_ok=packed_ok),
+            rpc="Assign",
         )
 
     def metrics_text(self) -> str:
         return self._call(self._metrics, pb.MetricsRequest()).prometheus_text
+
+    def debugz(self, max_traces: int = 16,
+               include_flight: bool = False) -> pb.DebugzResponse:
+        """Fetch the sidecar's last-N traces (+ flight dumps) — see
+        SchedulerService.Debugz and tools/tracez.py."""
+        return self._call(
+            self._debugz,
+            pb.DebugzRequest(max_traces=max_traces,
+                             include_flight=include_flight),
+        )
 
     def close(self):
         self._channel.close()
@@ -361,7 +437,15 @@ class DeltaSession:
                         2 ** (self._consec_fallbacks - 1), 64
                     )
                 self._base = self._base_id = None
-                resp = send_full(snapshot)
+                # Minted trace id: the span AND the full send under it
+                # (which inherits the id via _stamp) group as one trace
+                # in Debugz — trace_id=None here would record untraced.
+                with self.client.tracer.span(
+                    "client.resync", cat="client",
+                    trace_id=self.client.tracer.new_trace_id(),
+                    lineage=self._lineage_id, seq=self._seq,
+                ):
+                    resp = send_full(snapshot)
                 self.full_sends += 1
                 self.bytes_sent += full_bytes
                 # delta_safe already verified this cycle (guard above).
@@ -492,7 +576,8 @@ class _BasePipeline:
     def _send_full(self, snapshot: pb.ClusterSnapshot, packed_ok: bool):
         raise NotImplementedError
 
-    def _send_delta_future(self, delta: pb.SnapshotDelta, packed_ok: bool):
+    def _send_delta_future(self, delta: pb.SnapshotDelta, packed_ok: bool,
+                           request_id: str = ""):
         raise NotImplementedError
 
     def _join_entry(self, entry) -> object:
@@ -517,11 +602,15 @@ class _BasePipeline:
         the-remaining-budget) must stay in lockstep with _call; change
         them together."""
         policy = self.client.retry
+        tracer = self.client.tracer
+        rid = entry.get("rid", "")
         deadline = time.monotonic() + self.client.timeout
         attempt = 0
         while True:
             try:
-                return entry["fut"].result()
+                with tracer.span("client.join", cat="client",
+                                 trace_id=rid, attempt=attempt):
+                    return entry["fut"].result()
             except grpc.RpcError as e:
                 code = e.code()
                 if code in policy.codes and attempt < policy.max_attempts - 1:
@@ -530,8 +619,14 @@ class _BasePipeline:
                         time.sleep(delay)
                         attempt += 1
                         self.retried += 1
+                        # Re-issue keeps the SAME trace id as well as
+                        # the same (lineage, seq): the retry lands in
+                        # the original request's stitched trace.
+                        tracer.record("client.retry", dur_s=delay,
+                                      cat="client", ctx=(rid, 0),
+                                      code=code.name, attempt=attempt)
                         entry["fut"] = self._send_delta_future(
-                            entry["delta"], entry["packed_ok"]
+                            entry["delta"], entry["packed_ok"], rid
                         )
                         continue
                 if code in RESYNC_CODES:
@@ -550,10 +645,12 @@ class _BasePipeline:
             self._pinned = self._pinned_id = None
             self._drop_inflight()
             raise StaleBase(str(err)) from err
-        full = self._pinned.copy()
-        full.apply_delta(entry["delta"])
-        msg = full.compose()
-        resp = self._send_full(msg, entry["packed_ok"])
+        with self.client.tracer.span("client.resync", cat="client",
+                                     trace_id=entry.get("rid", "")):
+            full = self._pinned.copy()
+            full.apply_delta(entry["delta"])
+            msg = full.compose()
+            resp = self._send_full(msg, entry["packed_ok"])
         self.resyncs += 1
         self.full_sends += 1
         self.bytes_sent += msg.ByteSize()
@@ -606,9 +703,10 @@ class _BasePipeline:
         delta.lineage_id = self._lineage_id
         delta.seq = self._seq
         self.bytes_sent += delta.ByteSize()
+        rid = self.client.tracer.new_trace_id()
         self._inflight.append(dict(
-            fut=self._send_delta_future(delta, packed_ok),
-            delta=delta, packed_ok=packed_ok,
+            fut=self._send_delta_future(delta, packed_ok, rid),
+            delta=delta, packed_ok=packed_ok, rid=rid,
         ))
         self.delta_sends += 1
         done = []
@@ -640,8 +738,10 @@ class AssignPipeline(_BasePipeline):
     def _send_full(self, snapshot, packed_ok):
         return self.client.assign(snapshot, packed_ok=packed_ok)
 
-    def _send_delta_future(self, delta, packed_ok):
-        return self.client.assign_delta_future(delta, packed_ok=packed_ok)
+    def _send_delta_future(self, delta, packed_ok, request_id=""):
+        return self.client.assign_delta_future(
+            delta, packed_ok=packed_ok, request_id=request_id
+        )
 
 
 class ScorePipeline(_BasePipeline):
@@ -665,7 +765,8 @@ class ScorePipeline(_BasePipeline):
         return self.client.score_batch(snapshot, packed_ok=packed_ok,
                                        top_k=self.top_k)
 
-    def _send_delta_future(self, delta, packed_ok):
+    def _send_delta_future(self, delta, packed_ok, request_id=""):
         return self.client.score_batch_delta_future(
-            delta, packed_ok=packed_ok, top_k=self.top_k
+            delta, packed_ok=packed_ok, top_k=self.top_k,
+            request_id=request_id,
         )

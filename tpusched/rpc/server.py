@@ -77,6 +77,22 @@ with automatic probe-based recovery: after a cooldown with successes,
 the ladder promotes one rung on probation — one failure at the
 restored rung demotes immediately, a success keeps it. Health reports
 the rung and counters; Metrics exports them.
+
+Round 9 (ISSUE 4) makes the whole pipeline OBSERVABLE:
+
+  * every handler roots a trace (tpusched.trace) at the request's
+    wire request_id/parent_span (client-minted; absent => server-
+    minted) and emits one span per stage — gate.wait, decode,
+    delta.apply (+H2D bytes), dispatch, coalesce.lead/coalesce.wait,
+    fetch.join, reply.pack — ring-buffered, exported by the Debugz
+    rpc and tools/tracez.py as Chrome/Perfetto trace-event JSON;
+  * a FlightRecorder snapshots the ring on watchdog trips, ladder
+    demotions, and resync storms (>= 4 FAILED_PRECONDITION answers in
+    5 s), so every PR-3 degradation event carries its causal trace;
+  * _Metrics is a labeled registry (tpusched.metrics): per-rpc
+    counters, per-stage log-scale histograms (the old 5s-capped
+    buckets parked every real 10k x 5k solve in +Inf), H2D byte and
+    fuse-size histograms, and request outcome counts by status code.
 """
 
 from __future__ import annotations
@@ -93,6 +109,7 @@ import numpy as np
 
 import grpc
 
+from tpusched import trace as tracing
 from tpusched.config import Buckets, EngineConfig
 from tpusched.device_state import DeviceSnapshot
 from tpusched.engine import Engine
@@ -100,6 +117,7 @@ from tpusched.faults import FaultError
 from tpusched.rpc import tpusched_pb2 as pb
 from tpusched.rpc import codec
 from tpusched.rpc.codec import SnapshotStore, decode_snapshot, delta_safe
+from tpusched.trace import FlightRecorder, StormDetector
 
 SERVICE = "tpusched.TpuScheduler"
 
@@ -143,69 +161,81 @@ PACK_CELLS = 1 << 15
 
 
 class _Metrics:
-    """Tiny Prometheus registry: counters + a duration histogram with
-    upstream scheduler metric names."""
+    """Labeled Prometheus registry for the serving path (round 9,
+    ISSUE 4 — replaces four unlabeled counters + one 5s-capped
+    histogram). Built on tpusched.metrics: every family gets a `# TYPE`
+    line, label values are escaped, histograms emit `_sum`/`_count`,
+    and bucket ranges are shape-aware — durations log-scale out past
+    the watchdog (a 10k x 5k CPU solve runs far beyond the old 5.0s
+    top bucket, which parked every real solve in +Inf), H2D bytes in
+    power-of-4 byte buckets, fuse sizes in small linear buckets.
 
-    BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+    Upstream-compatible names are kept (scheduler_schedule_attempts_
+    total etc.), now labeled by rpc; per-stage serving telemetry lands
+    in scheduler_stage_duration_seconds{stage=...} where stage follows
+    the trace span names (gate.wait, decode, delta.apply, dispatch,
+    fetch.join, reply.pack) so a histogram anomaly points at the same
+    name a trace shows."""
 
     def __init__(self):
-        import threading
+        from tpusched import metrics as pm
 
-        self._lock = threading.Lock()  # handlers run on a thread pool
-        self.attempts = 0
-        self.placements = 0
-        self.evictions = 0
-        self.batches = 0
-        self.hist = [0] * (len(self.BUCKETS) + 1)
-        self.dur_sum = 0.0
+        r = self.registry = pm.Registry()
+        self.attempts = pm.Counter(
+            "scheduler_schedule_attempts_total",
+            "pods offered to the solver", ("rpc",), registry=r)
+        self.placements = pm.Counter(
+            "scheduler_pod_placements_total",
+            "pods placed", ("rpc",), registry=r)
+        self.evictions = pm.Counter(
+            "scheduler_preemption_victims_total",
+            "running pods evicted by preemption", ("rpc",), registry=r)
+        self.batches = pm.Counter(
+            "scheduler_batches_total",
+            "request batches served", ("rpc",), registry=r)
+        self.requests = pm.Counter(
+            "scheduler_requests_total",
+            "requests by final grpc status", ("rpc", "code"), registry=r)
+        self.resyncs = pm.Counter(
+            "scheduler_resync_required_total",
+            "FAILED_PRECONDITION answers (client must full-resync)",
+            ("rpc",), registry=r)
+        self.overloaded = pm.Counter(
+            "scheduler_overloaded_total",
+            "dispatch-gate admission refusals", ("rpc",), registry=r)
+        self.e2e = pm.Histogram(
+            "scheduler_e2e_scheduling_duration_seconds",
+            "decode + solve wall per batch",
+            buckets=pm.DURATION_BUCKETS, labelnames=("rpc",), registry=r)
+        self.stage = pm.Histogram(
+            "scheduler_stage_duration_seconds",
+            "per-stage serving latency (stage == trace span name)",
+            buckets=pm.DURATION_BUCKETS, labelnames=("stage",), registry=r)
+        self.h2d = pm.Histogram(
+            "scheduler_h2d_bytes",
+            "host->device bytes shipped per delta cycle",
+            buckets=pm.BYTE_BUCKETS, labelnames=("path",), registry=r)
+        self.fuse = pm.Histogram(
+            "scheduler_coalesced_fuse_size",
+            "callers sharing one coalesced ScoreBatch dispatch",
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16), registry=r)
 
-    def observe(self, n_pods: int, n_placed: int, n_evicted: int, dur: float):
-        with self._lock:
-            self.attempts += n_pods
-            self.placements += n_placed
-            self.evictions += n_evicted
-            self.batches += 1
-            self.dur_sum += dur
-            for i, b in enumerate(self.BUCKETS):
-                if dur <= b:
-                    self.hist[i] += 1
-                    break
-            else:
-                self.hist[-1] += 1
+    def observe(self, n_pods: int, n_placed: int, n_evicted: int,
+                dur: float, rpc: str = "Assign"):
+        self.attempts.labels(rpc).inc(n_pods)
+        self.placements.labels(rpc).inc(n_placed)
+        self.evictions.labels(rpc).inc(n_evicted)
+        self.batches.labels(rpc).inc()
+        self.e2e.labels(rpc).observe(dur)
+
+    def observe_stage(self, stage: str, dur_s: float) -> None:
+        self.stage.labels(stage).observe(dur_s)
+
+    def count_request(self, rpc: str, code: str) -> None:
+        self.requests.labels(rpc, code).inc()
 
     def render(self) -> str:
-        with self._lock:
-            return self._render_locked()
-
-    def _render_locked(self) -> str:
-        lines = [
-            "# TYPE scheduler_schedule_attempts_total counter",
-            f"scheduler_schedule_attempts_total {self.attempts}",
-            "# TYPE scheduler_pod_placements_total counter",
-            f"scheduler_pod_placements_total {self.placements}",
-            "# TYPE scheduler_preemption_victims_total counter",
-            f"scheduler_preemption_victims_total {self.evictions}",
-            "# TYPE scheduler_batches_total counter",
-            f"scheduler_batches_total {self.batches}",
-            "# TYPE scheduler_e2e_scheduling_duration_seconds histogram",
-        ]
-        cum = 0
-        for b, c in zip(self.BUCKETS, self.hist):
-            cum += c
-            lines.append(
-                f'scheduler_e2e_scheduling_duration_seconds_bucket{{le="{b}"}} {cum}'
-            )
-        cum += self.hist[-1]
-        lines.append(
-            f'scheduler_e2e_scheduling_duration_seconds_bucket{{le="+Inf"}} {cum}'
-        )
-        lines.append(
-            f"scheduler_e2e_scheduling_duration_seconds_sum {self.dur_sum:.6f}"
-        )
-        lines.append(
-            f"scheduler_e2e_scheduling_duration_seconds_count {self.batches}"
-        )
-        return "\n".join(lines) + "\n"
+        return self.registry.render()
 
 
 class DegradationLadder:
@@ -610,6 +640,8 @@ class SchedulerService:
         faults=None,
         watchdog_s: float = WATCHDOG_S,
         ladder: DegradationLadder | None = None,
+        tracer: "tracing.TraceCollector | None" = None,
+        flight: FlightRecorder | None = None,
     ):
         """audit_stream: optional file-like; when set, every Assign
         emits one JSON record PER POD (pod, node, score, commit_key —
@@ -629,7 +661,11 @@ class SchedulerService:
         not landed in time becomes DEADLINE_EXCEEDED for its caller and
         the wedged fetch worker is abandoned (module docstring).
 
-        ladder: injectable DegradationLadder (tests pin the clock)."""
+        ladder: injectable DegradationLadder (tests pin the clock).
+
+        tracer: span collector (default: the process-wide
+        tpusched.trace.DEFAULT, so in-process clients and the sidecar
+        share one stitched ring). flight: injectable FlightRecorder."""
         from tpusched.faults import NO_FAULTS
 
         self.config = config or EngineConfig()
@@ -689,6 +725,19 @@ class SchedulerService:
         self._replay_lock = threading.Lock()
         self._replay: dict[str, dict] = {}
         self.replayed_requests = 0
+        # Observability (round 9, ISSUE 4): span collector, flight
+        # recorder (ring snapshots on failure events), and the resync-
+        # storm detector feeding it.
+        self._trace = tracer if tracer is not None else tracing.DEFAULT
+        if tracer is not None:
+            # Non-default collector: point the emitters this service
+            # owns (engine.fetch, fault.* shots, device.rebuild via
+            # DeviceSession seeding below) at the same ring, so Debugz
+            # and flight dumps still carry the full causal chain.
+            self._engine.tracer = tracer
+            self._faults.tracer = tracer
+        self.flight = flight if flight is not None else FlightRecorder()
+        self._resync_storm = StormDetector(n=4, window_s=5.0)
         self._closed = False
 
     def _register_store(self, store: SnapshotStore) -> str:
@@ -796,6 +845,14 @@ class SchedulerService:
             while len(self._replay) > STORE_CAP:
                 self._replay.pop(next(iter(self._replay)))
 
+    def _stage_done(self, stage: str, t0: float) -> None:
+        """A stage that ended NOW and started at perf_counter t0: one
+        retroactive trace span + the per-stage histogram observation —
+        for stages whose start can't be wrapped (gate wait)."""
+        dur = time.perf_counter() - t0
+        self._trace.record(stage, dur_s=dur, cat="server")
+        self.metrics.observe_stage(stage, dur)
+
     def _join_guarded(self, pending, what: str):
         """Join a device result under the per-dispatch watchdog. A
         timeout converts the hung solve into DEADLINE_EXCEEDED for THIS
@@ -804,9 +861,19 @@ class SchedulerService:
         waiting on the same wedged worker trigger ONE restart)."""
         from concurrent.futures import TimeoutError as _FutTimeout
 
+        t0 = time.perf_counter()
         try:
-            return pending.result(timeout=self.watchdog_s)
+            with self._trace.span("fetch.join", cat="server", what=what):
+                res = pending.result(timeout=self.watchdog_s)
+            self.metrics.observe_stage("fetch.join",
+                                       time.perf_counter() - t0)
+            return res
         except _FutTimeout:
+            # The hung join IS the long tail the log-scale buckets exist
+            # for — it must land in the stage histogram, not only in the
+            # trip counter (the success path above can't record it).
+            self.metrics.observe_stage("fetch.join",
+                                       time.perf_counter() - t0)
             now = time.monotonic()
             with self._watchdog_lock:
                 self.watchdog_trips += 1
@@ -816,7 +883,11 @@ class SchedulerService:
             if restart:
                 # One ladder demerit + one worker swap per hang event:
                 # N coalesced callers timing out on the SAME wedged
-                # dispatch are one device failure, not N.
+                # dispatch are one device failure, not N — and ONE
+                # flight-recorder dump carries the causal trace of the
+                # hang (the spans that led to the wedged dispatch).
+                self.flight.record("watchdog_trip", self._trace,
+                                   what=what, watchdog_s=self.watchdog_s)
                 self._device_failure()
                 self._engine.restart_fetch_worker()
             raise _Abort(
@@ -831,10 +902,16 @@ class SchedulerService:
         """Ladder bookkeeping for a device-path failure; on demotion
         out of 'delta', drop resident sessions (their device arrays are
         the state under suspicion, and the memory buys nothing while
-        quarantined)."""
-        if self._ladder.record_failure() and demote_from_delta:
+        quarantined). Every demotion snapshots the trace ring: the
+        operator gets the spans that spent the ladder's patience, not
+        just a counter bump."""
+        demoted = self._ladder.record_failure()
+        if demoted and demote_from_delta:
             with self._store_lock:
                 self._sessions.clear()
+        if demoted:
+            self.flight.record("ladder_demotion", self._trace,
+                               level=self._ladder.snapshot()["level"])
 
     def _resolve_decoded(self, request):
         """Full-or-delta request -> (snap, meta, snapshot_id,
@@ -915,9 +992,12 @@ class SchedulerService:
                 # pay this; a concurrent second first-delta skips the
                 # duplicate build (_seeding guard) and decodes.
                 try:
-                    session = DeviceSession.from_base_store(
-                        base, base_id, self.config, self.buckets
-                    )
+                    with self._trace.span("session.seed", cat="server",
+                                          base_id=base_id):
+                        session = DeviceSession.from_base_store(
+                            base, base_id, self.config, self.buckets
+                        )
+                        session.device.tracer = self._trace
                     self.session_seeds += 1
                 except Exception:
                     import logging
@@ -953,8 +1033,14 @@ class SchedulerService:
             if session is not None:
                 try:
                     with session.lock:
-                        stats = session.apply_delta(base_id, request.delta,
-                                                    sid)
+                        t_a = time.perf_counter()
+                        with self._trace.span("delta.apply",
+                                              cat="server") as sp:
+                            stats = session.apply_delta(
+                                base_id, request.delta, sid)
+                            sp.attrs.update(h2d_bytes=stats.h2d_bytes,
+                                            path=stats.path)
+                        apply_s = time.perf_counter() - t_a
                         snap, meta = session.device.snap, session.device.meta
                 except KeyError:
                     # Expected fork: the lineage moved past this base
@@ -980,6 +1066,9 @@ class SchedulerService:
                     self._device_failure()
                 else:
                     self._session_put(session)
+                    self.metrics.observe_stage("delta.apply", apply_s)
+                    self.metrics.h2d.labels(stats.path).observe(
+                        stats.h2d_bytes)
                     if not seeding:
                         # Counted on SUCCESS only, so a fork's KeyError
                         # (hit-then-decode) is one miss, not hit+miss —
@@ -989,7 +1078,9 @@ class SchedulerService:
             self.session_misses += 1
             # Bytes composition straight into the (native) decoder: no
             # Python ClusterSnapshot is materialized on the delta path.
-            snap, meta, decode_s = self._decode(store.compose_bytes())
+            with self._trace.span("store.compose", cat="server"):
+                raw = store.compose_bytes()
+            snap, meta, decode_s = self._decode(raw)
             return snap, meta, sid, decode_s, None
         msg = request.snapshot
         if not delta_safe(msg) or level == "stateless":
@@ -1006,10 +1097,14 @@ class SchedulerService:
 
     def _decode(self, snapshot_msg):
         t0 = time.perf_counter()
-        snap, meta = decode_snapshot(
-            snapshot_msg, self.config, self.buckets
-        )
-        return snap, meta, time.perf_counter() - t0
+        with self._trace.span("decode", cat="server") as sp:
+            snap, meta = decode_snapshot(
+                snapshot_msg, self.config, self.buckets
+            )
+            sp.attrs.update(pods=meta.n_pods, nodes=meta.n_nodes)
+        decode_s = time.perf_counter() - t0
+        self.metrics.observe_stage("decode", decode_s)
+        return snap, meta, decode_s
 
     def close(self) -> None:
         """Release serving resources: refuse queued dispatches, drain
@@ -1090,24 +1185,63 @@ class SchedulerService:
             raise _Abort(code, details)
         context.abort(code, details)
 
+    def _serve(self, rpc: str, request, context, inner):
+        """Shared outermost handler path: one trace root span per
+        request (rooted at the wire request_id/parent_span; absent id
+        => server-minted), replay dedupe, outcome counting by final
+        status code, taxonomy conversion, and the flight-recorder
+        resync-storm trigger. Aborts raise THROUGH the span, which
+        records the error attr on the way out."""
+        rid = request.request_id or self._trace.new_trace_id()
+        with self._trace.request(rid, int(request.parent_span),
+                                 name=f"server.{rpc}", cat="server",
+                                 peer=self._peer(context)) as root:
+            replay = self._replay_lookup(rpc, request)
+            if replay is not None:
+                root.attrs["replayed"] = True
+                self.metrics.count_request(rpc, "OK")
+                return replay
+            try:
+                resp = inner(request, context)
+            except _Abort as e:
+                self._count_abort(rpc, e.code, root)
+                self._abort(context, e.code, e.details)
+            except _Overloaded as e:
+                self.metrics.overloaded.labels(rpc).inc()
+                self._count_abort(rpc, grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                  root)
+                self._abort(context, grpc.StatusCode.RESOURCE_EXHAUSTED,
+                            str(e))
+            except Exception as e:  # taxonomy: fatal (a bug, not a retry)
+                self._log_internal(rpc, e)
+                self._count_abort(rpc, grpc.StatusCode.INTERNAL, root)
+                self._abort(context, grpc.StatusCode.INTERNAL,
+                            f"unexpected server error: "
+                            f"{type(e).__name__}: {e}")
+            else:
+                self.metrics.count_request(rpc, "OK")
+                self._replay_record(rpc, request, resp)
+                self._record_ladder_success(request)
+                return resp
+
+    def _count_abort(self, rpc: str, code, root) -> None:
+        name = getattr(code, "name", str(code))
+        self.metrics.count_request(rpc, name)
+        root.attrs["code"] = name
+        if code == grpc.StatusCode.FAILED_PRECONDITION:
+            self.metrics.resyncs.labels(rpc).inc()
+            if self._resync_storm.hit():
+                # A resync STORM (every client re-pinning at once —
+                # restart fallout, ladder stateless, LRU thrash) gets a
+                # causal dump, not just per-request errors.
+                self.flight.record(
+                    "resync_storm", self._trace, rpc=rpc,
+                    n=self._resync_storm.n,
+                    window_s=self._resync_storm.window_s,
+                )
+
     def ScoreBatch(self, request: pb.ScoreRequest, context) -> pb.ScoreResponse:
-        replay = self._replay_lookup("ScoreBatch", request)
-        if replay is not None:
-            return replay
-        try:
-            resp = self._score_batch(request, context)
-        except _Abort as e:
-            self._abort(context, e.code, e.details)
-        except _Overloaded as e:
-            self._abort(context, grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
-        except Exception as e:  # taxonomy: fatal (a bug, not a retry)
-            self._log_internal("ScoreBatch", e)
-            self._abort(context, grpc.StatusCode.INTERNAL,
-                        f"unexpected server error: {type(e).__name__}: {e}")
-        else:
-            self._replay_record("ScoreBatch", request, resp)
-            self._record_ladder_success(request)
-            return resp
+        return self._serve("ScoreBatch", request, context, self._score_batch)
 
     def _score_batch(self, request: pb.ScoreRequest, context) -> pb.ScoreResponse:
         key = self._score_key(request)
@@ -1118,9 +1252,11 @@ class SchedulerService:
                 # A leader is already resolving this exact state: wait
                 # for its dispatch and slice our own k from the shared
                 # result — no decode, no dispatch, no extra fetch.
-                payload = fusion.wait(timeout=600.0)
+                with self._trace.span("coalesce.wait", cat="server"):
+                    payload = fusion.wait(timeout=600.0)
                 resp, solve_s = self._score_response(payload, request)
-                self.metrics.observe(payload["P"], 0, 0, solve_s)
+                self.metrics.observe(payload["P"], 0, 0, solve_s,
+                                     rpc="ScoreBatch")
                 return resp
         try:
             payload = self._score_dispatch(request, context, fusion)
@@ -1141,13 +1277,15 @@ class SchedulerService:
         if fusion is not None:
             fusion.publish(payload)
             self._coalescer.finish(fusion)
+            self.metrics.fuse.observe(len(fusion._ks))
         resp, solve_s = self._score_response(payload, request)
         self._log_batch(
             "ScoreBatch", payload["meta"], payload["decode_s"], solve_s,
             0, 0, 0, dstats=payload["dstats"],
             fused=(len(fusion._ks) - 1) if fusion is not None else 0,
         )
-        self.metrics.observe(payload["P"], 0, 0, payload["decode_s"] + solve_s)
+        self.metrics.observe(payload["P"], 0, 0, payload["decode_s"] + solve_s,
+                             rpc="ScoreBatch")
         return resp
 
     def _score_dispatch(self, request, context, fusion) -> dict:
@@ -1158,21 +1296,26 @@ class SchedulerService:
         P, N = meta.n_pods, meta.n_nodes
         pending_topk = pending_full = None
         k_used = 0
+        t_q = time.perf_counter()
         with self._gate.slot(self._peer(context)):
+            self._stage_done("gate.wait", t_q)
             # Seal INSIDE the slot: every request that joined while this
             # one queued rides the same dispatch.
             k_fused = fusion.seal() if fusion is not None \
                 else int(request.top_k)
-            if request.top_k > 0:
-                # O(P) response: top-k computed on device, [P,N] never
-                # fetched. A drained cluster (N == 0) has nothing to
-                # rank: k stays 0 with no rows, which the client
-                # decodes as [P, 0] arrays.
-                if N > 0:
-                    k_used = min(max(k_fused, 1), N)
-                    pending_topk = self._engine.score_topk_async(snap, k_used)
-            else:
-                pending_full = self._engine.score_async(snap)
+            with self._trace.span("dispatch", cat="server",
+                                  fused=len(fusion._ks) if fusion else 1):
+                if request.top_k > 0:
+                    # O(P) response: top-k computed on device, [P,N]
+                    # never fetched. A drained cluster (N == 0) has
+                    # nothing to rank: k stays 0 with no rows, which
+                    # the client decodes as [P, 0] arrays.
+                    if N > 0:
+                        k_used = min(max(k_fused, 1), N)
+                        pending_topk = self._engine.score_topk_async(
+                            snap, k_used)
+                else:
+                    pending_full = self._engine.score_async(snap)
         return dict(sid=sid, meta=meta, P=P, N=N, decode_s=decode_s,
                     dstats=dstats, k_used=k_used,
                     pending_topk=pending_topk, pending_full=pending_full)
@@ -1185,60 +1328,53 @@ class SchedulerService:
         meta = payload["meta"]
         P, N = payload["P"], payload["N"]
         resp = pb.ScoreResponse(snapshot_id=payload["sid"])
-        resp.pod_names.extend(meta.pod_names)
-        resp.node_names.extend(meta.node_names)
+        with self._trace.span("reply.names", cat="server"):
+            resp.pod_names.extend(meta.pod_names)
+            resp.node_names.extend(meta.node_names)
         solve_s = 0.0
+        t_p = None
         if payload["pending_topk"] is not None:
             idx, val, solve_s = self._join_guarded(
                 payload["pending_topk"], "ScoreBatch top-k"
             )
-            # lax.top_k is prefix-stable: columns [:k_own] of the fused
-            # top-k_used equal a direct top-k_own dispatch, so sliced
-            # responses are byte-identical to unfused serving.
-            k_own = min(int(request.top_k), N)
-            resp.k = k_own
-            resp.topk_idx_packed = np.ascontiguousarray(
-                idx[:P, :k_own], dtype="<i4"
-            ).tobytes()
-            resp.topk_score_packed = np.ascontiguousarray(
-                val[:P, :k_own], dtype="<f4"
-            ).tobytes()
+            t_p = time.perf_counter()
+            with self._trace.span("reply.pack", cat="server"):
+                # lax.top_k is prefix-stable: columns [:k_own] of the
+                # fused top-k_used equal a direct top-k_own dispatch, so
+                # sliced responses are byte-identical to unfused serving.
+                k_own = min(int(request.top_k), N)
+                resp.k = k_own
+                resp.topk_idx_packed = np.ascontiguousarray(
+                    idx[:P, :k_own], dtype="<i4"
+                ).tobytes()
+                resp.topk_score_packed = np.ascontiguousarray(
+                    val[:P, :k_own], dtype="<f4"
+                ).tobytes()
         elif payload["pending_full"] is not None:
             res = self._join_guarded(payload["pending_full"],
                                      "ScoreBatch full")
             solve_s = res.solve_seconds
-            if request.packed_ok and P * N >= PACK_CELLS:
-                resp.feasible_packed = np.ascontiguousarray(
-                    res.feasible[:P, :N], dtype=np.uint8
-                ).tobytes()
-                resp.scores_packed = np.ascontiguousarray(
-                    res.scores[:P, :N], dtype="<f4"
-                ).tobytes()
-            else:
-                for i in range(P):
-                    row = resp.rows.add()
-                    row.feasible.extend(res.feasible[i, :N].tolist())
-                    row.scores.extend(res.scores[i, :N].tolist())
+            t_p = time.perf_counter()
+            with self._trace.span("reply.pack", cat="server"):
+                if request.packed_ok and P * N >= PACK_CELLS:
+                    resp.feasible_packed = np.ascontiguousarray(
+                        res.feasible[:P, :N], dtype=np.uint8
+                    ).tobytes()
+                    resp.scores_packed = np.ascontiguousarray(
+                        res.scores[:P, :N], dtype="<f4"
+                    ).tobytes()
+                else:
+                    for i in range(P):
+                        row = resp.rows.add()
+                        row.feasible.extend(res.feasible[i, :N].tolist())
+                        row.scores.extend(res.scores[i, :N].tolist())
+        if t_p is not None:
+            self.metrics.observe_stage("reply.pack",
+                                       time.perf_counter() - t_p)
         return resp, solve_s
 
     def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
-        replay = self._replay_lookup("Assign", request)
-        if replay is not None:
-            return replay
-        try:
-            resp = self._assign(request, context)
-        except _Abort as e:
-            self._abort(context, e.code, e.details)
-        except _Overloaded as e:
-            self._abort(context, grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
-        except Exception as e:  # taxonomy: fatal (a bug, not a retry)
-            self._log_internal("Assign", e)
-            self._abort(context, grpc.StatusCode.INTERNAL,
-                        f"unexpected server error: {type(e).__name__}: {e}")
-        else:
-            self._replay_record("Assign", request, resp)
-            self._record_ladder_success(request)
-            return resp
+        return self._serve("Assign", request, context, self._assign)
 
     def _record_ladder_success(self, request) -> None:
         """Probe discipline: a success arms/confirms recovery only when
@@ -1270,39 +1406,47 @@ class SchedulerService:
         # drives the device and fetches the packed buffer. The gate
         # (round 7) additionally keeps concurrent clients' dispatches
         # round-robin fair instead of lock-race ordered.
+        t_q = time.perf_counter()
         with self._gate.slot(self._peer(context)):
-            pending = self._engine.solve_async(snap)
+            self._stage_done("gate.wait", t_q)
+            with self._trace.span("dispatch", cat="server"):
+                pending = self._engine.solve_async(snap)
         resp = pb.AssignResponse(snapshot_id=sid)
         P = meta.n_pods
         if request.packed_ok:
             # Name tables now, result arrays after the join: the two
             # string extends are the response's CPU-heavy part at 10k
             # pods and ride inside the device window for free.
-            resp.pod_names.extend(meta.pod_names)
-            # Indices resolve against the DECODER's canonical (sorted)
-            # node order, not the request's wire order — ship the table.
-            resp.node_names.extend(meta.node_names)
+            with self._trace.span("reply.names", cat="server"):
+                resp.pod_names.extend(meta.pod_names)
+                # Indices resolve against the DECODER's canonical
+                # (sorted) node order, not the request's wire order —
+                # ship the table.
+                resp.node_names.extend(meta.node_names)
         res = self._join_guarded(pending, "Assign solve")
-        ni = np.asarray(res.assignment[:P], dtype=np.int32)
-        sc = np.asarray(res.chosen_score[:P], dtype=np.float32).copy()
-        sc[~np.isfinite(sc)] = 0.0  # -inf (unplaced/preempted) -> 0
-        ck = np.asarray(res.commit_key[:P], dtype=np.int32)
-        placed = int((ni >= 0).sum())
-        if request.packed_ok:
-            # Parallel-array form: three tobytes() instead of P Python
-            # message constructions (~30 ms saved at 10k pods).
-            resp.node_idx_packed = ni.astype("<i4").tobytes()
-            resp.score_packed = sc.astype("<f4").tobytes()
-            resp.commit_key_packed = ck.astype("<i4").tobytes()
-        else:
-            for i, name in enumerate(meta.pod_names):
-                a = resp.assignments.add()
-                a.pod = name
-                n = int(ni[i])
-                if n >= 0:
-                    a.node = meta.node_names[n]
-                    a.score = float(sc[i])
-                a.commit_key = int(ck[i])
+        t_p = time.perf_counter()
+        with self._trace.span("reply.pack", cat="server"):
+            ni = np.asarray(res.assignment[:P], dtype=np.int32)
+            sc = np.asarray(res.chosen_score[:P], dtype=np.float32).copy()
+            sc[~np.isfinite(sc)] = 0.0  # -inf (unplaced/preempted) -> 0
+            ck = np.asarray(res.commit_key[:P], dtype=np.int32)
+            placed = int((ni >= 0).sum())
+            if request.packed_ok:
+                # Parallel-array form: three tobytes() instead of P
+                # Python message constructions (~30 ms saved at 10k).
+                resp.node_idx_packed = ni.astype("<i4").tobytes()
+                resp.score_packed = sc.astype("<f4").tobytes()
+                resp.commit_key_packed = ck.astype("<i4").tobytes()
+            else:
+                for i, name in enumerate(meta.pod_names):
+                    a = resp.assignments.add()
+                    a.pod = name
+                    n = int(ni[i])
+                    if n >= 0:
+                        a.node = meta.node_names[n]
+                        a.score = float(sc[i])
+                    a.commit_key = int(ck[i])
+        self.metrics.observe_stage("reply.pack", time.perf_counter() - t_p)
         n_evicted = 0
         if res.evicted is not None and res.evicted.any():
             running_names = getattr(meta, "running_names", None) or []
@@ -1359,6 +1503,9 @@ class SchedulerService:
     def Metrics(self, request: pb.MetricsRequest, context) -> pb.MetricsResponse:
         lad = self._ladder.snapshot()
         level_idx = DegradationLadder.LEVELS.index(lad["level"])
+        # Live service-state families rendered at scrape time (the
+        # registry holds observation-fed metrics; these read the
+        # authoritative in-memory counters directly).
         extra = [
             "# TYPE scheduler_watchdog_trips_total counter",
             f"scheduler_watchdog_trips_total {self.watchdog_trips}",
@@ -1371,9 +1518,48 @@ class SchedulerService:
             "# TYPE scheduler_degradation_level gauge",
             f'scheduler_degradation_level{{path="{lad["level"]}"}} '
             f"{level_idx}",
+            "# TYPE scheduler_device_session_events_total counter",
+            f'scheduler_device_session_events_total{{event="seed"}} '
+            f"{self.session_seeds}",
+            f'scheduler_device_session_events_total{{event="hit"}} '
+            f"{self.session_hits}",
+            f'scheduler_device_session_events_total{{event="miss"}} '
+            f"{self.session_misses}",
+            "# TYPE scheduler_gate_served_total counter",
+            f"scheduler_gate_served_total {self._gate.served}",
+            "# TYPE scheduler_gate_peak_waiting gauge",
+            f"scheduler_gate_peak_waiting {self._gate.peak_waiting}",
+            "# TYPE scheduler_coalesced_requests_total counter",
+            f'scheduler_coalesced_requests_total{{role="leader"}} '
+            f"{self._coalescer.lead_requests}",
+            f'scheduler_coalesced_requests_total{{role="follower"}} '
+            f"{self._coalescer.fused_requests}",
+            "# TYPE scheduler_flight_dumps_total counter",
+            f"scheduler_flight_dumps_total {self.flight.trips}",
         ]
         return pb.MetricsResponse(
             prometheus_text=self.metrics.render() + "\n".join(extra) + "\n"
+        )
+
+    def Debugz(self, request: pb.DebugzRequest, context) -> pb.DebugzResponse:
+        """Last-N stitched traces from the span ring (+ flight-recorder
+        dumps on request), as JSON — tools/tracez.py converts to
+        Chrome/Perfetto trace-event format. A debug surface: span
+        records follow tpusched.trace.span_dict, not a stable API."""
+        # <= 0 (absent OR a hostile negative) falls back to the default:
+        # traces(last=-1) must not become an unbounded response.
+        n = int(request.max_traces)
+        if n <= 0:
+            n = 16
+        traces = {
+            tid: [tracing.span_dict(s) for s in spans]
+            for tid, spans in self._trace.traces(last=n).items()
+        }
+        flight = ""
+        if request.include_flight:
+            flight = json.dumps(self.flight.dumps())
+        return pb.DebugzResponse(
+            trace_json=json.dumps({"traces": traces}), flight_json=flight
         )
 
 
@@ -1388,6 +1574,8 @@ def make_server(
     faults=None,
     watchdog_s: float = WATCHDOG_S,
     ladder: DegradationLadder | None = None,
+    tracer=None,
+    flight: FlightRecorder | None = None,
 ):
     """Build (grpc.Server, bound_port, service). Unlimited message size:
     a 10k-pod snapshot exceeds the 4 MB default. max_workers default 8:
@@ -1395,12 +1583,13 @@ def make_server(
     a decode thread — the dispatch gate, not the thread pool, is the
     serialization point. Call svc.close() after server.stop() to drain
     the engine's fetch worker and drop device-resident sessions.
-    faults/watchdog_s/ladder: failure-domain knobs (SchedulerService)."""
+    faults/watchdog_s/ladder: failure-domain knobs; tracer/flight:
+    observability knobs (SchedulerService)."""
     svc = SchedulerService(config, buckets, log_stream=log_stream,
                            audit_stream=audit_stream,
                            device_sessions=device_sessions,
                            faults=faults, watchdog_s=watchdog_s,
-                           ladder=ladder)
+                           ladder=ladder, tracer=tracer, flight=flight)
 
     def handler(fn, req_cls):
         return grpc.unary_unary_rpc_method_handler(
@@ -1414,6 +1603,7 @@ def make_server(
         "Assign": handler(svc.Assign, pb.AssignRequest),
         "Health": handler(svc.Health, pb.HealthRequest),
         "Metrics": handler(svc.Metrics, pb.MetricsRequest),
+        "Debugz": handler(svc.Debugz, pb.DebugzRequest),
     }
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
