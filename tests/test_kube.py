@@ -343,6 +343,22 @@ class FakeKubeRest:
                 self._bump("Pod", "DELETED", obj)
             return send(200, {"kind": "Status", "status": "Success"})
 
+        if handler.command == "PATCH":
+            # Merge-patch of pod metadata.annotations (the QoS
+            # observed-availability write-back path).
+            name = path.split("/")[-1]
+            length = int(handler.headers.get("Content-Length", 0))
+            body = json.loads(handler.rfile.read(length))
+            with self.lock:
+                pod = self.pods.get(name)
+                if pod is None:
+                    return send(404, {"message": f"pod {name} not found"})
+                anns = body.get("metadata", {}).get("annotations", {})
+                pod.setdefault("metadata", {}).setdefault(
+                    "annotations", {}).update(anns)
+                self._bump("Pod", "MODIFIED", pod)
+                return send(200, pod)
+
         return send(404, {"message": "unhandled"})
 
 
@@ -361,6 +377,9 @@ def fake_kube():
             state.handle(self)
 
         def do_DELETE(self):
+            state.handle(self)
+
+        def do_PATCH(self):
             state.handle(self)
 
     srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
@@ -759,3 +778,125 @@ def test_watch_loop_fault_site_takes_backoff_path():
     inf._watch_loop("/api/v1/pods")
     assert seen[0] == 1, "the injected fault took the backoff path"
     assert plan.report()["fired"][0]["site"] == "kube.watch"
+
+
+# ---------------------------------------------------------------------------
+# Annotation clamping + the observed-availability write-back path
+# (ISSUE 5 satellites).
+# ---------------------------------------------------------------------------
+
+
+def _pod_obj(name="p-clamp", slo="0.9", observed="0.5"):
+    return {
+        "metadata": {
+            "name": name, "namespace": "default",
+            "annotations": {ANN_SLO_TARGET: slo, ANN_OBSERVED: observed},
+        },
+        "spec": {
+            "containers": [
+                {"resources": {"requests": {"cpu": "100m",
+                                            "memory": "64Mi"}}}
+            ],
+        },
+    }
+
+
+def test_out_of_range_annotations_clamped_to_unit_interval():
+    """slo-target 1.7 / observed -0.2 would flow straight into
+    clip(slo - avail, 0, 1) and pin maximum pressure forever; the
+    parse side clamps both to [0, 1]."""
+    rec = pending_record(_pod_obj(slo="1.7", observed="-0.25"))
+    assert rec["slo_target"] == 1.0
+    assert rec["observed_avail"] == 0.0
+    rec = pending_record(_pod_obj(slo="-3", observed="17"))
+    assert rec["slo_target"] == 0.0
+    assert rec["observed_avail"] == 1.0
+    # in-range values pass through untouched
+    rec = pending_record(_pod_obj(slo="0.95", observed="0.25"))
+    assert rec["slo_target"] == pytest.approx(0.95)
+    assert rec["observed_avail"] == pytest.approx(0.25)
+
+    from tpusched.kube import running_record
+
+    robj = _pod_obj(slo="2.0", observed="0.5")
+    robj["spec"]["nodeName"] = "n0"
+    # slack computed from CLAMPED values: 0.5 - 1.0, not 0.5 - 2.0
+    assert running_record(robj)["slack"] == pytest.approx(-0.5)
+
+
+def test_non_finite_annotations_fall_back_to_defaults():
+    """float() happily parses "nan"/"inf", and Python's min/max would
+    pass NaN straight through a naive clamp into the pressure math —
+    non-finite values collapse to the field's default instead."""
+    rec = pending_record(_pod_obj(slo="nan", observed="nan"))
+    assert rec["slo_target"] == 0.0        # DEFAULT_SLO_TARGET
+    assert rec["observed_avail"] == 1.0    # DEFAULT_OBSERVED_AVAIL
+    rec = pending_record(_pod_obj(slo="inf", observed="-inf"))
+    assert rec["slo_target"] == 0.0
+    assert rec["observed_avail"] == 1.0
+
+
+def test_write_back_clamps_non_finite(fake_kube):
+    state, url = fake_kube
+    state.add_pod("p0", annotations={ANN_SLO_TARGET: "0.9"})
+    client = KubeApiClient(base_url=url)
+    client.write_observed_availability("default/p0", float("nan"))
+    (rec,) = client.pending_pods()
+    assert rec["observed_avail"] == 1.0, \
+        "NaN write-back publishes the default, not the string 'nan'"
+
+
+def test_clamp_warning_rate_limited(capsys):
+    import tpusched.kube as kube_mod
+
+    with kube_mod._clamp_warn_lock:
+        kube_mod._clamp_warn_last.clear()
+    for _ in range(5):
+        pending_record(_pod_obj(slo="1.7"))
+    err = capsys.readouterr().err
+    assert err.count("clamped") == 1, \
+        "five identical clamps within the interval emit ONE warning"
+
+
+def test_annotate_pod_write_back(fake_kube):
+    """KubeApiClient.annotate_pod merge-patches annotations; the next
+    list sees the written observed availability (clamped), closing the
+    QoS loop over a real HTTP boundary."""
+    state, url = fake_kube
+    state.add_pod("p0", annotations={ANN_SLO_TARGET: "0.9"})
+    client = KubeApiClient(base_url=url)
+    client.write_observed_availability("default/p0", 0.4)
+    (rec,) = client.pending_pods()
+    assert rec["observed_avail"] == pytest.approx(0.4)
+    assert rec["slo_target"] == pytest.approx(0.9)
+    # out-of-range writes are clamped BEFORE they hit the wire
+    client.write_observed_availability("default/p0", 3.5)
+    (rec,) = client.pending_pods()
+    assert rec["observed_avail"] == 1.0
+
+
+def test_annotate_pod_deleted_race_is_nonfatal(fake_kube):
+    """A pod deleted between measure and PATCH returns False (same
+    'try again later' contract as delete_pod) instead of raising —
+    the routine write-back race must never kill a monitor loop."""
+    state, url = fake_kube
+    state.add_pod("p0", annotations={ANN_SLO_TARGET: "0.9"})
+    client = KubeApiClient(base_url=url)
+    assert client.write_observed_availability("default/p0", 0.4) is True
+    assert client.write_observed_availability("default/gone", 0.4) is False
+
+
+def test_informer_annotate_assumes_and_hints(fake_kube):
+    """The informer applies the write to its cache immediately (assume)
+    and hints the pod for the next delta."""
+    state, url = fake_kube
+    state.add_pod("p0", annotations={ANN_SLO_TARGET: "0.9"})
+    informer = KubeInformer(KubeApiClient(base_url=url)).start()
+    try:
+        assert informer.drain_changed() is None  # baseline
+        informer.write_observed_availability("default/p0", 0.25)
+        (rec,) = informer.pending_pods()
+        assert rec["observed_avail"] == pytest.approx(0.25)
+        assert "default/p0" in (informer.drain_changed() or set())
+    finally:
+        informer.stop()

@@ -1,0 +1,150 @@
+"""qos.py edge cases (ISSUE 5 satellite): pressure at the SLO-target
+endpoints, slack sign conventions, urgency-reweight endpoints — the
+pure-arithmetic contracts every layer above (oracle, kernels, sim)
+assumes without re-checking."""
+
+import numpy as np
+import pytest
+
+from tpusched import qos
+from tpusched.config import EngineConfig, QoSConfig
+
+
+def _cfg(**kw):
+    return EngineConfig(qos=QoSConfig(**kw))
+
+
+def _p(slo, avail):
+    """pressure_of works on numpy/jax ARRAY-LIKES (pure ufunc
+    arithmetic, shared with the device kernels); scalar edge cases go
+    through 0-d numpy scalars like the oracle's per-pod path does."""
+    return float(qos.pressure_of(np.float64(slo), np.float64(avail)))
+
+
+# ---------------------------------------------------------------------------
+# pressure = clip(slo_target - observed_avail, 0, 1)
+# ---------------------------------------------------------------------------
+
+
+def test_pressure_at_slo_target_endpoints():
+    # slo_target 0 ("no SLO"): pressure is 0 at ANY availability —
+    # including avail 0 (a starved pod with no target carries none).
+    for avail in (0.0, 0.5, 1.0):
+        assert _p(0.0, avail) == 0.0
+    # slo_target 1 (perfect availability required): pressure is exactly
+    # the shortfall.
+    assert _p(1.0, 0.0) == 1.0
+    assert _p(1.0, 1.0) == 0.0
+    assert _p(1.0, 0.25) == pytest.approx(0.75)
+
+
+def test_pressure_clips_out_of_range_inputs():
+    # An avail above target can't produce negative pressure, and a
+    # (pre-clamp) out-of-range avail can't push pressure past 1.
+    assert _p(0.5, 1.0) == 0.0
+    assert _p(1.0, -3.0) == 1.0
+
+
+def test_pressure_is_elementwise_on_arrays():
+    slo = np.array([0.0, 0.9, 1.0, 0.5], np.float32)
+    avail = np.array([0.0, 0.5, 1.0, 0.9], np.float32)
+    np.testing.assert_allclose(
+        qos.pressure_of(slo, avail),
+        np.array([0.0, 0.4, 0.0, 0.0], np.float32),
+        atol=1e-6,
+    )
+
+
+# ---------------------------------------------------------------------------
+# slack sign conventions: slack = observed - slo; >0 = above SLO
+# ("cheap victim"), <0 = below SLO (boosted as a victim).
+# ---------------------------------------------------------------------------
+
+
+def test_slack_sign_conventions():
+    assert qos.slack_of(0.9, 0.8) == pytest.approx(-0.1)  # below SLO
+    assert qos.slack_of(0.5, 0.9) == pytest.approx(0.4)   # above SLO
+    assert qos.slack_of(0.0, 1.0) == pytest.approx(1.0)   # no SLO: max slack
+
+
+def test_victim_boost_mirrors_pending_pressure():
+    """A victim below its SLO gets the same qos_gain boost a pending
+    pod at that pressure would: victim_eff(prio, -p) == eff(prio, slo,
+    slo - p)."""
+    cfg = _cfg(qos_gain=100.0)
+    for p in (0.0, 0.25, 1.0):
+        pending = qos.effective_priority(
+            cfg, 10.0, np.float64(0.9), np.float64(0.9 - p))
+        victim = qos.victim_effective_priority(cfg, 10.0, np.float64(-p))
+        assert float(pending) == pytest.approx(float(victim))
+    # positive slack gives NO boost (clip at 0)
+    assert qos.victim_effective_priority(
+        cfg, 10.0, np.float64(0.5)
+    ) == pytest.approx(10.0)
+
+
+def test_evict_cost_discounts_positive_slack_only():
+    cfg = _cfg(qos_gain=100.0, evict_slack_weight=40.0)
+    # Above-SLO victim: cheaper by evict_slack_weight * slack.
+    assert qos.evict_cost_raw(
+        cfg, 10.0, np.float64(0.5)
+    ) == pytest.approx(10.0 - 40.0 * 0.5)
+    # Slack past 1 doesn't discount further (clip), and negative slack
+    # RAISES the cost via the victim boost instead of discounting.
+    assert qos.evict_cost_raw(
+        cfg, 10.0, np.float64(2.0)
+    ) == pytest.approx(10.0 - 40.0)
+    assert qos.evict_cost_raw(
+        cfg, 10.0, np.float64(-0.3)
+    ) == pytest.approx(10.0 + 100.0 * 0.3)
+
+
+# ---------------------------------------------------------------------------
+# urgency_reweight endpoints: pressure 0 = configured profile,
+# pressure 1 = all weight on least_requested, total mass preserved.
+# ---------------------------------------------------------------------------
+
+
+def test_effective_weights_endpoint_zero_is_base_profile():
+    cfg = EngineConfig()
+    assert qos.effective_weights(cfg, 0.0) == qos.base_weights(cfg)
+
+
+def test_effective_weights_endpoint_one_is_pure_least_requested():
+    cfg = EngineConfig()
+    base = qos.base_weights(cfg)
+    w = qos.effective_weights(cfg, 1.0)
+    assert w["least_requested"] == pytest.approx(sum(base.values()))
+    for plugin, v in w.items():
+        if plugin != "least_requested":
+            assert v == pytest.approx(0.0)
+
+
+def test_effective_weights_preserve_total_mass_at_any_pressure():
+    cfg = EngineConfig()
+    total = sum(qos.base_weights(cfg).values())
+    for p in (0.0, 0.3, 0.7, 1.0):
+        assert sum(qos.effective_weights(cfg, p).values()) == \
+            pytest.approx(total)
+
+
+def test_urgency_reweight_off_ignores_pressure():
+    cfg = _cfg(urgency_reweight=False)
+    base = qos.base_weights(cfg)
+    for p in (0.0, 1.0):
+        assert qos.effective_weights(cfg, p) == base
+    # Array pressure with reweight off: weights broadcast but stay base.
+    w = qos.effective_weights(cfg, np.array([0.0, 1.0], np.float32))
+    for plugin, v in w.items():
+        np.testing.assert_allclose(np.asarray(v) + 0.0,
+                                   np.full(2, base[plugin]), atol=1e-6)
+
+
+def test_effective_priority_gain_zero_is_static():
+    """qos_gain=0 (the twin run's static baseline) reduces effective
+    priority to the base priority at ANY pressure."""
+    cfg = _cfg(qos_gain=0.0)
+    for avail in (0.0, 0.5, 1.0):
+        assert float(qos.effective_priority(
+            cfg, 7.0, np.float64(0.9), np.float64(avail)
+        )) == pytest.approx(7.0)

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Virtual-time cluster simulator CLI (ISSUE 5).
+
+Run one scenario, or the headline TWIN run (QoS-driven vs static
+priority on the same seed and timeline):
+
+    # the paper's central claim as one number
+    python tools/simulate.py --scenario pressure_skew --twin
+
+    # a single arm, full report
+    python tools/simulate.py --scenario failure_storm --seed 3
+
+    # the full host -> gRPC sidecar path (AssignPipeline transport)
+    python tools/simulate.py --scenario steady_state --backend grpc
+
+    # machine-readable output
+    python tools/simulate.py --scenario pressure_skew --twin --json out.json
+
+Everything runs on a virtual clock: --horizon is SIMULATED seconds
+(the wall cost is solve latency per tick, not the horizon).
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from tpusched.config import EngineConfig, SimConfig
+    from tpusched.sim import report
+    from tpusched.sim.driver import run_scenario, twin_run
+    from tpusched.sim.workloads import SCENARIOS
+
+    ap = argparse.ArgumentParser(
+        description="Discrete-event virtual-clock cluster simulator"
+    )
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS),
+                    default="pressure_skew")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--twin", action="store_true",
+                    help="twin run: QoS-driven vs static-priority "
+                         "baseline on the same seed")
+    ap.add_argument("--backend", choices=["inprocess", "grpc"],
+                    default="inprocess",
+                    help="grpc = spin an in-process sidecar and drive "
+                         "the full host->rpc path")
+    ap.add_argument("--horizon", type=float, default=None,
+                    help="override the scenario's virtual horizon (s)")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="override the scenario's arrival rate (pods/s)")
+    ap.add_argument("--nodes", type=int, default=None,
+                    help="override the scenario's node count")
+    ap.add_argument("--tick", type=float, default=1.0,
+                    help="virtual seconds per tick")
+    ap.add_argument("--resolve-every", type=int, default=1,
+                    help="scheduling cycles every N ticks")
+    ap.add_argument("--qos-gain", type=float, default=None,
+                    help="override qos_gain for the (single) run")
+    ap.add_argument("--mode", choices=["fast", "parity"], default="fast")
+    ap.add_argument("--preemption", action="store_true",
+                    help="force preemption on regardless of scenario")
+    ap.add_argument("--json", default=None,
+                    help="also write the report as JSON to this path")
+    args = ap.parse_args()
+
+    sc = SCENARIOS[args.scenario]
+    overrides = {}
+    if args.horizon is not None:
+        overrides["horizon_s"] = args.horizon
+    if args.rate is not None:
+        overrides["rate"] = args.rate
+    if args.nodes is not None:
+        overrides["n_nodes"] = args.nodes
+    if args.preemption:
+        overrides["preemption"] = True
+    if overrides:
+        sc = dataclasses.replace(sc, **overrides)
+
+    cfg = EngineConfig(mode=args.mode)
+    if args.qos_gain is not None:
+        cfg = dataclasses.replace(
+            cfg, qos=dataclasses.replace(cfg.qos, qos_gain=args.qos_gain)
+        )
+    sim = SimConfig(tick_s=args.tick, resolve_every=args.resolve_every)
+
+    def log(msg):
+        print(msg, file=sys.stderr, flush=True)
+
+    if args.twin:
+        out = twin_run(sc, seed=args.seed, config=cfg, sim=sim,
+                       backend=args.backend, log=log)
+        print(report.render_twin(out))
+    else:
+        res = run_scenario(sc, seed=args.seed, config=cfg, sim=sim,
+                           backend=args.backend)
+        out = report.summarize(res)
+        print(report.render_text(out))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+        log(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

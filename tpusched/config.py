@@ -9,6 +9,7 @@ shape) that have no upstream equivalent.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Mapping, Sequence
 
 # ---------------------------------------------------------------------------
@@ -57,6 +58,29 @@ OPERATORS = ("In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt")
 # whenUnsatisfiable codes for topology spread.
 DO_NOT_SCHEDULE = 0
 SCHEDULE_ANYWAY = 1
+
+# QoS defaults, threaded through every layer that parses pod records
+# (kube annotations, host records, the wire codec): slo_target 0 means
+# "no availability SLO" (pressure is always 0), and a pod with no
+# observed-availability history is OPTIMISTICALLY compliant (1.0) until
+# lifecycle accounting produces a real number — the never-scheduled
+# fallback the sim's closed loop and the kube annotation default share.
+DEFAULT_SLO_TARGET = 0.0
+DEFAULT_OBSERVED_AVAIL = 1.0
+
+
+def clamp01(v: float, default: float = 0.0) -> float:
+    """Clamp to the unit interval. The ONE clamp both ends of the QoS
+    availability path share (annotation parse, write-back, lifecycle
+    accounting, FakeApiServer pinning) so the domain contract cannot
+    drift between them. Non-finite input (NaN/inf from a hostile or
+    garbage annotation) collapses to `default` — Python's min/max would
+    propagate NaN straight through a naive clamp and poison the
+    pressure math downstream."""
+    v = float(v)
+    if not math.isfinite(v):
+        return float(default)
+    return min(max(v, 0.0), 1.0)
 
 
 def _next_pow2(x: int) -> int:
@@ -186,6 +210,41 @@ class QoSConfig:
     # cheapest. Costs are shifted positive per snapshot (+1 per victim),
     # which also encodes the upstream "fewer victims" preference.
     evict_slack_weight: float = 100.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Virtual-time cluster simulator knobs (tpusched/sim).
+
+    The simulator advances a virtual clock in fixed ticks; events
+    (arrivals, completions, node failures) apply at tick boundaries and
+    the scheduler re-solves on a tick-driven cadence — `resolve_every`
+    ticks between cycles models a batching scheduler that lets pressure
+    accumulate, the analogue of kube-scheduler's percentage-based
+    batching intervals. All durations are VIRTUAL seconds: a run's wall
+    time is dominated by solve latency, not the simulated horizon.
+    """
+
+    tick_s: float = 1.0        # virtual seconds per tick
+    resolve_every: int = 1     # scheduling cycles every N ticks
+    batch_size: int = 256      # host batch cap per cycle
+    # Host backoff under simulation. The reference's QoS queue re-sorts
+    # EVERY cycle (priority is dynamic, so yesterday's unschedulable
+    # pod may be today's most-pressured) — kube-style exponential
+    # backoff would exclude exactly the pods whose pressure just rose
+    # from the batch, hiding the priority signal the sim exists to
+    # measure. Default 0: the full pending queue is reconsidered every
+    # cycle. Set >0 to model backoff-queue semantics instead.
+    backoff_initial_s: float = 0.0
+    backoff_max_s: float = 0.0
+
+    def __post_init__(self):
+        if self.tick_s <= 0:
+            raise ValueError(f"tick_s={self.tick_s}: must be > 0")
+        if self.resolve_every < 1:
+            raise ValueError(
+                f"resolve_every={self.resolve_every}: must be >= 1"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -25,8 +25,15 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-from tpusched.config import Buckets, EngineConfig
+from tpusched.config import (
+    DEFAULT_OBSERVED_AVAIL,
+    DEFAULT_SLO_TARGET,
+    Buckets,
+    EngineConfig,
+    clamp01,
+)
 from tpusched.engine import Engine
+from tpusched.qos import observed_availability, slack_of
 from tpusched.rpc.codec import decode_snapshot, snapshot_to_proto
 
 
@@ -34,8 +41,19 @@ class Conflict(Exception):
     """Bind of a pod that is no longer pending (double-bind guard)."""
 
 
+# Minimum availability drift before a read re-hints a pod into the
+# change accumulator (see FakeApiServer._with_avail): large enough that
+# wall-clock unit tests reading milliseconds apart see no hint churn,
+# small enough (~0.4%) that a sim tick's worth of waiting registers.
+AVAIL_REHINT_EPS = 1.0 / 256.0
+
+
 class FakeApiServer:
-    def __init__(self):
+    def __init__(self, clock=None):
+        # clock: zero-arg callable for pod timestamps (submitted /
+        # bound_at). The simulator injects a VirtualClock so lifecycle
+        # accounting runs on virtual time; default is wall time.
+        self._clock = clock if clock is not None else time.time
         self._lock = threading.Lock()
         self._nodes: dict[str, dict] = {}
         self._pods: dict[str, dict] = {}      # pending + bound
@@ -47,6 +65,9 @@ class FakeApiServer:
         # baseline"), matching the informer contract.
         self._changed: set[str] = set()
         self._dirty_all = True
+        # Last computed observed_avail each pod was served with — the
+        # drift baseline for read-time re-hinting (see _with_avail).
+        self._avail_served: dict[str, float] = {}
 
     # -- cluster setup ------------------------------------------------------
 
@@ -55,22 +76,58 @@ class FakeApiServer:
             self._nodes[name] = dict(spec, name=name)
             self._changed.add(name)
 
-    def add_pod(self, name: str, **spec) -> None:
+    def delete_node(self, name: str) -> bool:
+        """Node removal (sim: a node failure); idempotent. Pods bound
+        to it are the CALLER's problem — a real apiserver likewise
+        keeps orphaned pods until something evicts them."""
         with self._lock:
-            self._pods[name] = dict(
-                spec, name=name, phase="Pending", node=None,
-                submitted=time.time(),
-            )
+            if name not in self._nodes:
+                return False
+            del self._nodes[name]
+            self._changed.add(name)
+            return True
+
+    def add_pod(self, name: str, **spec) -> None:
+        """`submitted` / `run_seconds` may ride in via spec: the sim
+        driver re-queues evicted pods with their lifecycle history
+        preserved, so availability keeps decaying across requeues
+        instead of resetting."""
+        with self._lock:
+            rec = dict(spec, name=name, phase="Pending", node=None)
+            rec.setdefault("submitted", self._clock())
+            rec.setdefault("run_seconds", 0.0)
+            self._pods[name] = rec
             self._changed.add(name)
 
     def add_bound_pod(self, name: str, node: str, **spec) -> None:
         """A pod already running on a node (pre-existing workload)."""
         with self._lock:
-            self._pods[name] = dict(
-                spec, name=name, phase="Bound", node=node,
-                submitted=time.time(),
-            )
+            now = self._clock()
+            rec = dict(spec, name=name, phase="Bound", node=node)
+            rec.setdefault("submitted", now)
+            rec.setdefault("run_seconds", 0.0)
+            rec.setdefault("bound_at", now)
+            self._pods[name] = rec
             self._changed.add(name)
+
+    def get_pod(self, name: str) -> "dict | None":
+        with self._lock:
+            p = self._pods.get(name)
+            return dict(p) if p is not None else None
+
+    def set_observed_availability(self, name: str, avail: float) -> bool:
+        """Pin a pod's observed availability explicitly (the
+        FakeApiServer twin of the kube annotation write-back path,
+        KubeApiClient.write_observed_availability). An explicit value
+        OVERRIDES lifecycle accounting until cleared."""
+        with self._lock:
+            p = self._pods.get(name)
+            if p is None:
+                return False
+            p["observed_avail"] = clamp01(
+                avail, default=DEFAULT_OBSERVED_AVAIL)
+            self._changed.add(name)
+            return True
 
     # -- delta hints --------------------------------------------------------
 
@@ -100,13 +157,55 @@ class FakeApiServer:
         with self._lock:
             return [dict(n) for n in self._nodes.values()]
 
+    def _with_avail(self, p: dict, now: float) -> dict:
+        """Record copy with lifecycle-accounted observed_avail (ISSUE 5:
+        the closed QoS loop). An explicit spec value PINS it (tests,
+        annotation write-back); otherwise availability is computed from
+        submitted / run_seconds / bound_at at read time, so every
+        cycle's snapshot sees pressure that reflects how long the pod
+        has actually waited vs run. Never-observed pods (zero age) fall
+        back to 1.0 — see tpusched.qos.observed_availability.
+
+        Read-time computation silently mutates a record no api write
+        ever touched, which would break the delta codec's changed-hint
+        contract ("name everything you touch": delta_between trusts
+        un-hinted records as byte-identical) — a waiting pod would ship
+        its arrival-time availability forever and the sidecar's
+        pressure signal would freeze. So each read re-hints the pod
+        into the change accumulator whenever the computed value drifts
+        beyond AVAIL_REHINT_EPS from the last value it was served with;
+        the hint drains NEXT cycle, so delta/pipeline transports see
+        availability one cycle stale — the same lag the real kube
+        annotation write-back path has."""
+        q = dict(p)
+        if "observed_avail" not in q:
+            avail = observed_availability(
+                q.get("submitted", now), q.get("run_seconds", 0.0),
+                q.get("bound_at") if q["phase"] == "Bound" else None, now,
+            )
+            q["observed_avail"] = avail
+            name = q["name"]
+            last = self._avail_served.get(name)
+            if last is None:
+                # First read: the creation hint (add_pod/add_bound_pod)
+                # already covers this cycle's value.
+                self._avail_served[name] = avail
+            elif abs(avail - last) > AVAIL_REHINT_EPS:
+                self._avail_served[name] = avail
+                self._changed.add(name)
+        return q
+
     def pending_pods(self) -> list[dict]:
         with self._lock:
-            return [dict(p) for p in self._pods.values() if p["phase"] == "Pending"]
+            now = self._clock()
+            return [self._with_avail(p, now) for p in self._pods.values()
+                    if p["phase"] == "Pending"]
 
     def bound_pods(self) -> list[dict]:
         with self._lock:
-            return [dict(p) for p in self._pods.values() if p["phase"] == "Bound"]
+            now = self._clock()
+            return [self._with_avail(p, now) for p in self._pods.values()
+                    if p["phase"] == "Bound"]
 
     # -- write side ---------------------------------------------------------
 
@@ -123,6 +222,7 @@ class FakeApiServer:
                 raise Conflict(f"bind: node {node_name} does not exist")
             pod["phase"] = "Bound"
             pod["node"] = node_name
+            pod["bound_at"] = self._clock()
             self.bind_count += 1
             self._changed.add(pod_name)
 
@@ -132,6 +232,7 @@ class FakeApiServer:
             if pod_name not in self._pods:
                 return False
             del self._pods[pod_name]
+            self._avail_served.pop(pod_name, None)
             self.delete_count += 1
             self._changed.add(pod_name)
             return True
@@ -176,6 +277,7 @@ class HostScheduler:
         backoff_max: float = 10.0,
         clock=None,
         use_delta: bool = True,
+        transport: str = "delta",
     ):
         self.api = api
         self.config = config or EngineConfig()
@@ -189,16 +291,36 @@ class HostScheduler:
             self._engine = None
         else:
             self._engine = engine if engine is not None else Engine(self.config)
-        # Sidecar transport: wrap the client in a DeltaSession so each
-        # cycle ships only churned records (SURVEY.md §7 hard part 6),
-        # with changed-name hints from the api's change log (informer
-        # events or FakeApiServer's mutation log) making the diff
-        # O(churn). use_delta=False forces full sends every cycle.
+        # Sidecar transport (chosen by `transport`; use_delta=False is
+        # the legacy spelling of "full"):
+        #   "delta"    — DeltaSession: each cycle ships only churned
+        #                records against the previous cycle's base
+        #                (SURVEY.md §7 hard part 6), with changed-name
+        #                hints from the api's change log making the
+        #                diff O(churn);
+        #   "pipeline" — AssignPipeline at depth 1: the pinned-base
+        #                cumulative-delta discipline plus its retry /
+        #                lineage-resync machinery (ISSUE 5: the sim's
+        #                gRPC mode rides this, so long simulated runs
+        #                heal through sidecar restarts the way the
+        #                robustness suite pins);
+        #   "full"     — full snapshot every cycle.
+        if transport not in ("delta", "pipeline", "full"):
+            raise ValueError(
+                f"transport={transport!r}: want delta|pipeline|full"
+            )
+        if not use_delta and transport == "delta":
+            transport = "full"
         self._delta = None
-        if client is not None and use_delta:
+        self._pipeline = None
+        if client is not None and transport == "delta":
             from tpusched.rpc.client import DeltaSession
 
             self._delta = DeltaSession(client)
+        elif client is not None and transport == "pipeline":
+            from tpusched.rpc.client import AssignPipeline
+
+            self._pipeline = AssignPipeline(client, depth=1)
         self.cycles: list[CycleStats] = []
         # Queue semantics (SURVEY.md §1.2 L5: activeQ/backoffQ): a pod
         # that fails to place enters backoff with exponentially growing
@@ -250,7 +372,7 @@ class HostScheduler:
 
     def _restore_hints(self, changed) -> None:
         """Un-drain change hints a cycle consumed but never shipped."""
-        if self._delta is not None:
+        if self._delta is not None or self._pipeline is not None:
             restore = getattr(self.api, "restore_changed", None)
             if restore is not None:
                 restore(changed)
@@ -289,10 +411,15 @@ class HostScheduler:
             rec["pdb_disruptions_allowed"] = p.get("pdb_disruptions_allowed", 0)
         # QoS slack of a running pod: observed availability minus SLO
         # (SURVEY.md C10); specs carry both or a precomputed slack.
+        # Defaults live in ONE place (config.py) shared with the kube
+        # annotation parser and the wire codec.
         if "slack" in p:
             rec["slack"] = p["slack"]
         else:
-            rec["slack"] = p.get("observed_avail", 1.0) - p.get("slo_target", 0.0)
+            rec["slack"] = slack_of(
+                p.get("slo_target", DEFAULT_SLO_TARGET),
+                p.get("observed_avail", DEFAULT_OBSERVED_AVAIL),
+            )
         return rec
 
     def _wire_snapshot(self, pending: list[dict]):
@@ -320,7 +447,7 @@ class HostScheduler:
         # snapshot missed — shipping a stale delta record next cycle.
         changed = None
         epoch_fn = e0 = None
-        if self._delta is not None:
+        if self._delta is not None or self._pipeline is not None:
             drain = getattr(self.api, "drain_changed", None)
             epoch_fn = getattr(self.api, "relist_epoch", None)
             if epoch_fn is not None:
@@ -361,7 +488,14 @@ class HostScheduler:
 
             t0 = time.perf_counter()
             if self.client is not None:
-                if self._delta is not None:
+                if self._pipeline is not None:
+                    # Depth-1 AssignPipeline: submit drains the pipe
+                    # before returning, so exactly one response comes
+                    # back per cycle while the pinned-base cumulative
+                    # delta + resync/retry machinery stays engaged.
+                    resp = self._pipeline.submit(msg, changed=changed,
+                                                 packed_ok=True)[-1]
+                elif self._delta is not None:
                     resp = self._delta.assign(msg, changed=changed,
                                               packed_ok=True)
                 else:
@@ -549,13 +683,17 @@ def build_synthetic_cluster(api: FakeApiServer, rng, n_pods: int, n_nodes: int):
         )
     for i in range(n_pods):
         slo = float(rng.choice([0.0, 0.9, 0.99]))
+        # No observed_avail pin (ISSUE 5): availability comes from the
+        # api's lifecycle accounting at read time — a never-scheduled
+        # pod starts at the optimistic 1.0 fallback and decays as it
+        # waits, so pressure reflects real queueing instead of the old
+        # rng.uniform(0.5, 1.0) demo draw that left the QoS loop open.
         api.add_pod(
             f"pod-{i}",
             requests={"cpu": float(rng.integers(100, 500)),
                       "memory": float(rng.integers(1 << 28, 1 << 30))},
             priority=float(rng.integers(0, 100)),
             slo_target=slo,
-            observed_avail=float(rng.uniform(0.5, 1.0)),
             labels={"app": ["web", "db", "cache"][int(rng.integers(3))]},
         )
 

@@ -39,6 +39,11 @@ import urllib.error
 import urllib.parse
 import urllib.request
 
+from tpusched.config import (
+    DEFAULT_OBSERVED_AVAIL,
+    DEFAULT_SLO_TARGET,
+    clamp01,
+)
 from tpusched.snapshot import (
     MatchExpression,
     NodeSelectorTerm,
@@ -79,6 +84,51 @@ def _ann_int(ann: dict, key: str, default: int) -> int:
         return int(float(ann.get(key, default)))
     except (TypeError, ValueError):
         return int(default)
+
+
+# Rate-limited clamp warnings: (annotation key, direction) -> (last
+# emit monotonic time, suppressed count). Same shape as the informer's
+# watch-failure limiter — out-of-range annotations on a popular
+# deployment would otherwise print once per pod per cycle.
+_clamp_warn_lock = threading.Lock()
+_clamp_warn_last: dict[tuple[str, str], tuple[float, int]] = {}
+CLAMP_WARN_INTERVAL = 30.0
+
+
+def _warn_clamped(key: str, raw: float, clamped: float) -> None:
+    direction = "high" if raw > clamped else "low"
+    now = time.monotonic()
+    with _clamp_warn_lock:
+        last, suppressed = _clamp_warn_last.get((key, direction), (0.0, 0))
+        if now - last < CLAMP_WARN_INTERVAL:
+            _clamp_warn_last[(key, direction)] = (last, suppressed + 1)
+            return
+        _clamp_warn_last[(key, direction)] = (now, 0)
+    extra = f" ({suppressed} repeats suppressed)" if suppressed else ""
+    print(
+        f"tpusched: annotation {key}={raw!r} outside [0, 1]; clamped "
+        f"to {clamped}{extra}",
+        file=sys.stderr, flush=True,
+    )
+
+
+def _ann_unit(ann: dict, key: str, default: float) -> float:
+    """_ann_float restricted to the unit interval: slo-target and
+    observed-availability are FRACTIONS, and an out-of-range value
+    (slo-target "1.5", observed "-3") would otherwise flow straight
+    into the pressure math — clip(slo - avail, 0, 1) saturates, every
+    such pod pins maximum pressure forever and the queue inverts.
+    Clamp on parse, with a rate-limited warning so a misconfigured
+    deployment is visible without a per-pod-per-cycle stderr flood."""
+    v = _ann_float(ann, key, default)
+    if 0.0 <= v <= 1.0:
+        return v
+    # Non-finite falls back to the DEFAULT, not a clamp edge: NaN
+    # carries no ordering information at all (and would sail through
+    # min/max), and ±inf is equally meaningless as a fraction.
+    clamped = clamp01(v, default=default)
+    _warn_clamped(key, v, clamped)
+    return clamped
 
 # Sentinel distinguishing "no drain has pinned a PDB resolver yet"
 # from a pinned resolver of None (no PDBs / RBAC-denied).
@@ -236,8 +286,8 @@ def pending_record(obj: dict) -> dict:
         namespace=ns,
         requests=pod_requests(spec),
         priority=float(spec.get("priority", 0)),
-        slo_target=_ann_float(ann, ANN_SLO_TARGET, 0.0),
-        observed_avail=_ann_float(ann, ANN_OBSERVED, 1.0),
+        slo_target=_ann_unit(ann, ANN_SLO_TARGET, DEFAULT_SLO_TARGET),
+        observed_avail=_ann_unit(ann, ANN_OBSERVED, DEFAULT_OBSERVED_AVAIL),
         labels=labels,
         node_selector=dict(spec.get("nodeSelector") or {}),
         required_terms=required_terms,
@@ -279,8 +329,8 @@ def running_record(obj: dict, pdb_of=None) -> dict:
     ann = meta.get("annotations") or {}
     labels = dict(meta.get("labels") or {})
     ns = meta.get("namespace", "default")
-    slo = _ann_float(ann, ANN_SLO_TARGET, 0.0)
-    observed = _ann_float(ann, ANN_OBSERVED, 1.0)
+    slo = _ann_unit(ann, ANN_SLO_TARGET, DEFAULT_SLO_TARGET)
+    observed = _ann_unit(ann, ANN_OBSERVED, DEFAULT_OBSERVED_AVAIL)
     rec = dict(
         name=qualified_name(ns, meta["name"]),
         namespace=ns,
@@ -460,21 +510,24 @@ class KubeApiClient:
     # -- raw REST -----------------------------------------------------------
 
     def _request(self, method: str, path: str, body: dict | None = None,
-                 timeout: float | None = None):
+                 timeout: float | None = None,
+                 content_type: str = "application/json"):
         url = self._server + path
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
         for k, v in self._headers.items():
             req.add_header(k, v)
         if data is not None:
-            req.add_header("Content-Type", "application/json")
+            req.add_header("Content-Type", content_type)
         kw = {"timeout": timeout or self.timeout}
         if self._ssl is not None:
             kw["context"] = self._ssl
         return urllib.request.urlopen(req, **kw)
 
-    def _json(self, method: str, path: str, body: dict | None = None):
-        with self._request(method, path, body) as resp:
+    def _json(self, method: str, path: str, body: dict | None = None,
+              content_type: str = "application/json"):
+        with self._request(method, path, body,
+                           content_type=content_type) as resp:
             return json.loads(resp.read() or b"{}")
 
     # -- reads (FakeApiServer interface) ------------------------------------
@@ -580,6 +633,43 @@ class KubeApiClient:
             raise
         with self._count_lock:
             self.bind_count += 1
+
+    def annotate_pod(self, pod_name: str, annotations: dict) -> bool:
+        """Merge-PATCH annotations onto a pod (RFC 7386: absent keys
+        keep their values). The QoS write-back primitive: an
+        availability monitor publishes what it measured so the NEXT
+        scheduling cycle's pressure math sees it — the out-of-band
+        channel the reference stores SLO observations in. pod_name is
+        the qualified 'namespace/name' record identity. Same race
+        contract as delete_pod: a pod deleted between measure and
+        PATCH (404) or a throttled apiserver (429) returns False —
+        'try again later', never a cycle-fatal error."""
+        namespace, name = split_qualified(pod_name)
+        try:
+            self._json(
+                "PATCH",
+                f"/api/v1/namespaces/{namespace}/pods/"
+                f"{urllib.parse.quote(name)}",
+                {"metadata": {"annotations": {
+                    str(k): str(v) for k, v in annotations.items()
+                }}},
+                content_type="application/merge-patch+json",
+            )
+        except urllib.error.HTTPError as e:
+            if e.code in (404, 410, 429):
+                return False
+            raise
+        return True
+
+    def write_observed_availability(self, pod_name: str,
+                                    avail: float) -> bool:
+        """Publish one pod's lifecycle-accounted availability to the
+        tpusched.io/observed-availability annotation, clamped to the
+        unit interval the parse side enforces (_ann_unit) — the two
+        ends of the write-back path agree on the domain by
+        construction."""
+        clamped = clamp01(avail, default=DEFAULT_OBSERVED_AVAIL)
+        return self.annotate_pod(pod_name, {ANN_OBSERVED: f"{clamped:.6f}"})
 
     def delete_pod(self, pod_name: str) -> bool:
         """Eviction subresource; falls back to plain DELETE where the
@@ -944,6 +1034,32 @@ class KubeInformer:
             if obj is not None:
                 obj.setdefault("spec", {})["nodeName"] = node_name
                 self._changed.add(pod_name)
+
+    def annotate_pod(self, pod_name: str, annotations: dict) -> bool:
+        """Delegate + assume, like bind(): the cache applies the merge
+        immediately so the next cycle's records already carry the
+        written values (the real MODIFIED event confirms or corrects),
+        and the pod is hinted — an annotation change alters its wire
+        record, and the delta codec's contract is 'name everything you
+        touch'. A raced-away pod (False from the client) leaves the
+        cache untouched: the DELETED event is already in flight."""
+        if not self.client.annotate_pod(pod_name, annotations):
+            return False
+        with self._lock:
+            obj = self._objs[self._POD_PATH].get(pod_name)
+            if obj is not None:
+                anns = obj.setdefault("metadata", {}).setdefault(
+                    "annotations", {})
+                anns.update(
+                    {str(k): str(v) for k, v in annotations.items()}
+                )
+                self._changed.add(pod_name)
+        return True
+
+    def write_observed_availability(self, pod_name: str,
+                                    avail: float) -> bool:
+        clamped = clamp01(avail, default=DEFAULT_OBSERVED_AVAIL)
+        return self.annotate_pod(pod_name, {ANN_OBSERVED: f"{clamped:.6f}"})
 
     def delete_pod(self, pod_name: str) -> bool:
         ok = self.client.delete_pod(pod_name)

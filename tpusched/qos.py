@@ -22,12 +22,41 @@ from __future__ import annotations
 
 import numpy as np
 
-from tpusched.config import EngineConfig
+from tpusched.config import DEFAULT_OBSERVED_AVAIL, EngineConfig, clamp01
+
+# Ages below this are "never observed": avoids 0/0 at the submission
+# instant and gives a pod its fallback-1.0 grace until time has
+# actually passed.
+MIN_OBSERVED_AGE_S = 1e-9
 
 
 def pressure_of(slo_target, observed_avail):
     """Works on numpy and jax arrays alike (pure ufunc arithmetic)."""
     return (slo_target - observed_avail).clip(0.0, 1.0)
+
+
+def observed_availability(
+    submitted: float,
+    run_seconds: float,
+    bound_at: "float | None",
+    now: float,
+    default: float = DEFAULT_OBSERVED_AVAIL,
+) -> float:
+    """Availability of one pod at `now`: banked run time plus the
+    current in-progress run (bound_at is the start of the CURRENT bind,
+    None while pending), over total age — the running-time-over-
+    wall-time ratio the reference scores SLOs against. Never-observed
+    pods (age below MIN_OBSERVED_AGE_S) return `default`. The input
+    side of the QoS feedback loop: this value feeds pressure_of, which
+    feeds effective_priority. Shared by host.FakeApiServer (read-time
+    accounting) and sim.lifecycle (cross-requeue history)."""
+    age = now - submitted
+    if age < MIN_OBSERVED_AGE_S:
+        return float(default)
+    run = float(run_seconds)
+    if bound_at is not None:
+        run += max(now - bound_at, 0.0)
+    return clamp01(run / age, default=default)
 
 
 def effective_priority(cfg: EngineConfig, base_priority, slo_target, observed_avail):

@@ -9,7 +9,8 @@ proto directly from its cache.
 
 from __future__ import annotations
 
-from tpusched.config import Buckets, EngineConfig
+from tpusched.config import (Buckets, DEFAULT_OBSERVED_AVAIL,
+                             DEFAULT_SLO_TARGET, EngineConfig)
 from tpusched.rpc import tpusched_pb2 as pb
 from tpusched.snapshot import (
     MatchExpression,
@@ -454,8 +455,9 @@ def snapshot_to_proto(
         pm.name = p["name"]
         _set_resources(pm.requests, p.get("requests", {}))
         pm.priority = float(p.get("priority", 0.0))
-        pm.slo_target = float(p.get("slo_target", 0.0))
-        pm.observed_availability = float(p.get("observed_avail", 1.0))
+        pm.slo_target = float(p.get("slo_target", DEFAULT_SLO_TARGET))
+        pm.observed_availability = float(
+            p.get("observed_avail", DEFAULT_OBSERVED_AVAIL))
         _set_labels(pm.labels, p.get("labels", {}))
         _set_labels(pm.node_selector, p.get("node_selector", {}))
         for term in p.get("required_terms", []):

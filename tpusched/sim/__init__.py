@@ -1,0 +1,36 @@
+"""Virtual-time cluster simulator (ISSUE 5): the evaluation subsystem
+that closes the QoS availability loop and measures SLO attainment
+end-to-end.
+
+Submodules (import what you need; this package root stays light so
+host.py can import `lifecycle` without dragging in the driver stack):
+
+  clock      VirtualClock — zero-real-sleep virtual time
+  lifecycle  per-pod availability accounting (the closed loop's state)
+  events     seeded event queue + arrival/failure processes
+  workloads  scenario library (steady_state / burst / pressure_skew /
+             failure_storm)
+  driver     SimDriver + run_scenario + twin_run (QoS vs static)
+  report     SLO-attainment summaries, CDFs, text rendering
+"""
+
+from tpusched.sim.clock import VirtualClock  # noqa: F401
+from tpusched.sim.lifecycle import (  # noqa: F401
+    LifecycleTracker,
+    observed_availability,
+)
+
+
+def __getattr__(name):
+    # Lazy: driver/report import host/engine/rpc layers; workloads pulls
+    # synth. Loading them only on demand keeps `import tpusched.sim`
+    # cheap for the host's lifecycle import.
+    if name in ("SimDriver", "run_scenario", "twin_run"):
+        from tpusched.sim import driver
+
+        return getattr(driver, name)
+    if name in ("Scenario", "SCENARIOS", "generate"):
+        from tpusched.sim import workloads
+
+        return getattr(workloads, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

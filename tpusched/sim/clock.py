@@ -1,0 +1,49 @@
+"""Virtual time for the cluster simulator (ISSUE 5 tentpole).
+
+One rule makes long-horizon SLO evaluation tractable: NOTHING in a sim
+run sleeps on the wall clock. The clock is a number that only moves when
+the driver advances it, so a 2-hour diurnal scenario runs in seconds and
+two runs with the same seed see byte-identical timelines — the property
+the event-log-hash determinism test pins. The same instance is injected
+everywhere host-side code would otherwise reach for time.time /
+time.monotonic: FakeApiServer pod timestamps (lifecycle accounting) and
+HostScheduler's backoff book (a pod's retry window expires in VIRTUAL
+seconds, so backoff interacts with queue pressure the way it would on a
+live cluster, just faster).
+"""
+
+from __future__ import annotations
+
+
+class VirtualClock:
+    """A manually-advanced monotone clock. Callable so it drops into
+    any `clock=` injection point that expects a time.monotonic-like
+    zero-arg callable."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def now(self) -> float:
+        return self._now
+
+    def __call__(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> float:
+        """Move time forward by dt (>= 0) virtual seconds."""
+        if dt < 0:
+            raise ValueError(f"advance({dt}): virtual time is monotone")
+        self._now += float(dt)
+        return self._now
+
+    def advance_to(self, t: float) -> float:
+        """Jump to absolute time t; never moves backwards (a target in
+        the past is a no-op, matching monotone-clock semantics)."""
+        if t > self._now:
+            self._now = float(t)
+        return self._now
+
+    def sleep(self, dt: float) -> None:
+        """Drop-in for time.sleep under simulation: advances virtual
+        time instantly, zero real blocking."""
+        self.advance(max(dt, 0.0))

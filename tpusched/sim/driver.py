@@ -1,0 +1,451 @@
+"""Sim driver: tick loop, event application, and the twin run (ISSUE 5).
+
+SimDriver marries the event timeline (events/workloads) to the REAL
+scheduling stack — FakeApiServer + HostScheduler + Engine, or the full
+host -> gRPC sidecar path — under a virtual clock. Nothing is mocked
+below the API-server boundary: batches build wire snapshots through the
+C12 codec, solves run the jitted kernels, binds/evictions go through
+the same idempotent-bind machinery live hosts use. The gRPC mode rides
+HostScheduler's AssignPipeline transport, so a simulated week of
+cluster time also exercises the pinned-base delta + resync path.
+
+Per tick:
+  1. apply due events (arrivals, completions, node fail/recover);
+  2. every `resolve_every` ticks, run one scheduling cycle — the
+     snapshot it builds reads lifecycle-accounted observed_avail, so
+     QoS pressure is DYNAMIC: this cycle's decisions move next cycle's
+     availability, the loop the reference system is named for;
+  3. account outcomes: newly-bound pods get completion events at
+     now + remaining_duration; pods evicted by preemption are re-queued
+     with their lifecycle history (availability keeps decaying);
+  4. sample the pressure distribution, advance the clock.
+
+The headline entry is twin_run(): the same scenario and seed under the
+QoS-driven config and under a static-priority baseline (qos_gain=0,
+urgency_reweight off) — attainment_gain_vs_static is the paper's
+central claim as one repeatable number.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from tpusched import metrics as pm
+from tpusched import qos
+from tpusched.config import (DEFAULT_OBSERVED_AVAIL, DEFAULT_SLO_TARGET,
+                             EngineConfig, QoSConfig, SimConfig)
+from tpusched.host import FakeApiServer, HostScheduler
+from tpusched.sim.clock import VirtualClock
+from tpusched.sim.lifecycle import LifecycleTracker
+from tpusched.sim.workloads import Scenario, SimSetup, generate
+
+# Sim-run counters in the process-default registry: sim runs export
+# through the same Prometheus surface as serving (ISSUE 5 "sim runs
+# emit the same spans/counters").
+_M_EVENTS = pm.Counter(
+    "tpusched_sim_events_total",
+    "virtual-time simulator events applied", ("kind",))
+_M_COMPLETIONS = pm.Counter(
+    "tpusched_sim_completions_total",
+    "simulated pods that ran to completion")
+_M_REQUEUES = pm.Counter(
+    "tpusched_sim_requeues_total",
+    "simulated pods returned to pending", ("reason",))
+_M_AVAIL = pm.Histogram(
+    "tpusched_sim_final_availability",
+    "per-pod final observed availability at completion/horizon",
+    buckets=tuple(round(i / 10, 1) for i in range(11)))
+
+# Floor on a pod's remaining duration after an interruption: an evicted
+# pod always needs at least one more tick of service.
+_MIN_REMAINING_S = 1e-3
+
+
+def effective_config(sc: Scenario, config: "EngineConfig | None") -> EngineConfig:
+    """Scenario knobs that live on EngineConfig (preemption) merged
+    into the caller's config — shared by SimDriver and the gRPC-mode
+    server construction so both sides run the same program."""
+    cfg = config or EngineConfig(mode="fast")
+    if sc.preemption and not cfg.preemption:
+        cfg = dataclasses.replace(cfg, preemption=True)
+    return cfg
+
+
+@dataclasses.dataclass
+class PodOutcome:
+    name: str
+    tenant: int
+    slo: float
+    priority: float
+    submitted: float
+    completed: bool
+    end_time: float
+    ran_s: float
+    waited_s: float
+    evictions: int
+    final_avail: float
+    attained: "bool | None"    # None for SLO-less pods (slo == 0)
+
+
+@dataclasses.dataclass
+class SimResult:
+    scenario: str
+    seed: int
+    backend: str
+    qos_gain: float
+    horizon_s: float
+    ticks: int
+    cycles: int
+    events_applied: int
+    placed: int
+    evicted: int
+    completions: int
+    requeues: int
+    node_failures: int
+    pods: list          # [PodOutcome]
+    pressure_samples: list   # (t, n_pending, mean_pressure, max_pressure)
+    event_log_hash: str
+    wall_seconds: float
+
+
+class SimDriver:
+    def __init__(
+        self,
+        scenario: Scenario,
+        seed: int = 0,
+        config: "EngineConfig | None" = None,
+        sim: "SimConfig | None" = None,
+        client=None,
+        engine=None,
+        faults=None,
+        tracer=None,
+    ):
+        self.sc = scenario
+        self.seed = int(seed)
+        self.cfg = effective_config(scenario, config)
+        self.sim = sim or SimConfig()
+        self.tracer = tracer
+        self.clock = VirtualClock()
+        self.api = FakeApiServer(clock=self.clock)
+        self.setup: SimSetup = generate(scenario, self.seed)
+        for n in self.setup.nodes:
+            self.api.add_node(**n)
+        self._node_specs = {n["name"]: n for n in self.setup.nodes}
+        self._down: set[str] = set()
+
+        self._owns_engine = False
+        if client is None and engine is None:
+            from tpusched.engine import Engine
+
+            engine = Engine(self.cfg, faults=faults)
+            self._owns_engine = True
+        self.engine = engine
+        self.host = HostScheduler(
+            self.api, self.cfg, client=client, engine=engine,
+            clock=self.clock, batch_size=self.sim.batch_size,
+            backoff_initial=self.sim.backoff_initial_s,
+            backoff_max=self.sim.backoff_max_s,
+            transport="pipeline" if client is not None else "delta",
+        )
+        self.backend = "grpc" if client is not None else "inprocess"
+
+        self.life = LifecycleTracker()
+        self.q = self.setup.queue
+        self._remaining: dict[str, float] = {}
+        self._gen: dict[str, int] = {}
+        self._arrived: list[str] = []
+        self.events_applied = 0
+        self.completions = 0
+        self.requeues = 0
+        self.node_failures = 0
+        self.pressure_samples: list[tuple] = []
+
+    # -- event application --------------------------------------------------
+
+    def _apply(self, ev) -> None:
+        now = self.clock.now()
+        _M_EVENTS.labels(ev.kind).inc()
+        if ev.kind == "arrival":
+            name = ev.data["pod"]
+            spec = self.setup.specs[name]
+            meta = self.setup.meta[name]
+            self.api.add_pod(name, **spec)
+            self.life.on_submit(name, now, slo_target=meta["slo"])
+            self._remaining[name] = meta["duration_s"]
+            self._gen[name] = 0
+            self._arrived.append(name)
+            self.q.note(ev.time, "arrival", pod=name)
+        elif ev.kind == "complete":
+            name = ev.data["pod"]
+            if ev.data["gen"] != self._gen.get(name):
+                return  # stale: the pod was interrupted after scheduling
+            pod = self.api.get_pod(name)
+            if pod is None or pod.get("phase") != "Bound":
+                return
+            avail = self.life.on_complete(name, now)
+            self.api.delete_pod(name)
+            self.completions += 1
+            _M_COMPLETIONS.inc()
+            _M_AVAIL.observe(avail)
+            self.q.note(now, "complete", pod=name,
+                        avail=round(avail, 6))
+        elif ev.kind == "node_fail":
+            node = ev.data["node"]
+            if node in self._down or node not in self._node_specs:
+                return
+            self._down.add(node)
+            self.node_failures += 1
+            victims = sorted(
+                p["name"] for p in self.api.bound_pods()
+                if p.get("node") == node
+            )
+            for name in victims:
+                self._interrupt(name, now, reason="node_fail")
+            self.api.delete_node(node)
+            self.q.note(ev.time, "node_fail", node=node,
+                        victims=victims)
+        elif ev.kind == "node_recover":
+            node = ev.data["node"]
+            if node not in self._down:
+                return
+            self._down.discard(node)
+            self.api.add_node(**self._node_specs[node])
+            self.q.note(ev.time, "node_recover", node=node)
+        else:
+            raise ValueError(f"unknown sim event kind {ev.kind!r}")
+        self.events_applied += 1
+
+    def _interrupt(self, name: str, now: float, reason: str) -> None:
+        """A running pod loses its node (preemption or node failure):
+        bank its run credit, shorten the remaining duration by what it
+        already ran, bump its completion generation (pending completion
+        events become stale), and re-queue it with lifecycle history so
+        availability keeps decaying from where it was."""
+        ran = self.life.on_unbind(name, now, evicted=True)
+        self._remaining[name] = max(
+            self._remaining.get(name, 0.0) - ran, _MIN_REMAINING_S
+        )
+        self._gen[name] = self._gen.get(name, 0) + 1
+        life = self.life.pods[name]
+        self.api.delete_pod(name)
+        self.api.add_pod(
+            name, **self.setup.specs[name],
+            submitted=life.submitted, run_seconds=life.run_seconds,
+        )
+        self.requeues += 1
+        _M_REQUEUES.labels(reason).inc()
+
+    # -- scheduling cycle ---------------------------------------------------
+
+    def _cycle(self, now: float) -> None:
+        bound_prev = {p["name"] for p in self.api.bound_pods()}
+        self.host.cycle()
+        bound_now = {p["name"]: p.get("node") for p in self.api.bound_pods()}
+
+        for name in sorted(set(bound_now) - bound_prev):
+            self.life.on_bind(name, now)
+            gen = self._gen.get(name, 0)
+            self.q.push(now + self._remaining[name], "complete",
+                        pod=name, gen=gen)
+            self.q.note(now, "bind", pod=name, node=bound_now[name])
+
+        # Bound before the cycle, gone after it, and not re-added:
+        # evicted by the scheduler's preemption path (the host already
+        # issued the delete). Re-queue with history.
+        for name in sorted(bound_prev - set(bound_now)):
+            if self.api.get_pod(name) is not None:
+                continue
+            self._interrupt(name, now, reason="preempted")
+            self.q.note(now, "evict", pod=name)
+
+    def _sample_pressure(self, now: float) -> None:
+        pend = self.api.pending_pods()
+        if not pend:
+            self.pressure_samples.append((now, 0, 0.0, 0.0))
+            return
+        slo = np.asarray(
+            [p.get("slo_target", DEFAULT_SLO_TARGET) for p in pend])
+        avail = np.asarray(
+            [p.get("observed_avail", DEFAULT_OBSERVED_AVAIL) for p in pend])
+        pressure = qos.pressure_of(slo, avail)
+        self.pressure_samples.append((
+            now, len(pend),
+            round(float(pressure.mean()), 6),
+            round(float(pressure.max()), 6),
+        ))
+
+    # -- main loop ----------------------------------------------------------
+
+    def run(self) -> SimResult:
+        from tpusched import trace as tracing
+
+        tr = self.tracer or tracing.DEFAULT
+        sc, sim = self.sc, self.sim
+        wall0 = time.perf_counter()
+        ticks = 0
+        try:
+            while self.clock.now() < sc.horizon_s - 1e-9:
+                now = self.clock.now()
+                t0 = time.perf_counter()
+                due = self.q.pop_until(now)
+                for event in due:
+                    self._apply(event)
+                if ticks % sim.resolve_every == 0:
+                    self._cycle(now)
+                self._sample_pressure(now)
+                tr.record(
+                    "sim.tick", dur_s=time.perf_counter() - t0, cat="sim",
+                    t=now, events=len(due),
+                    pending=self.pressure_samples[-1][1],
+                )
+                self.clock.advance(sim.tick_s)
+                ticks += 1
+            # Final drain: the loop's last pop ran one tick before the
+            # horizon, so events due in the closing window — completions
+            # of pods bound on the final tick among them — would be
+            # silently dropped and systematically undercount attainment.
+            for event in self.q.pop_until(self.clock.now()):
+                self._apply(event)
+        finally:
+            self.host.close()
+            if self._owns_engine and self.engine is not None:
+                self.engine.close()
+        return self._result(ticks, time.perf_counter() - wall0)
+
+    def _result(self, ticks: int, wall_s: float) -> SimResult:
+        horizon = self.clock.now()
+        outcomes = []
+        for name in self._arrived:
+            life = self.life.pods[name]
+            meta = self.setup.meta[name]
+            completed = life.completed_at is not None
+            end = life.completed_at if completed else horizon
+            avail = life.availability(end)
+            ran = life.run_seconds + (
+                max(end - life.bound_at, 0.0)
+                if life.bound_at is not None else 0.0
+            )
+            slo = meta["slo"]
+            outcomes.append(PodOutcome(
+                name=name, tenant=meta["tenant"], slo=slo,
+                priority=meta["priority"], submitted=life.submitted,
+                completed=completed, end_time=end, ran_s=ran,
+                waited_s=max(end - life.submitted - ran, 0.0),
+                evictions=life.evictions, final_avail=avail,
+                attained=(avail + 1e-9 >= slo) if slo > 0 else None,
+            ))
+        placed = sum(c.placed for c in self.host.cycles)
+        evicted = sum(c.evicted for c in self.host.cycles)
+        return SimResult(
+            scenario=self.sc.name, seed=self.seed, backend=self.backend,
+            qos_gain=self.cfg.qos.qos_gain, horizon_s=horizon,
+            ticks=ticks, cycles=len(self.host.cycles),
+            events_applied=self.events_applied, placed=placed,
+            evicted=evicted, completions=self.completions,
+            requeues=self.requeues, node_failures=self.node_failures,
+            pods=outcomes, pressure_samples=self.pressure_samples,
+            event_log_hash=self.q.log_hash(), wall_seconds=wall_s,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Entry points.
+# ---------------------------------------------------------------------------
+
+
+def run_scenario(
+    scenario: Scenario,
+    seed: int = 0,
+    config: "EngineConfig | None" = None,
+    sim: "SimConfig | None" = None,
+    backend: str = "inprocess",
+    engine=None,
+    faults=None,
+    tracer=None,
+) -> SimResult:
+    """One sim run. backend="grpc" spins an in-process sidecar and
+    drives the full host -> gRPC path (AssignPipeline transport);
+    "inprocess" solves through a local Engine (pass `engine` to share
+    one jit cache across runs of the SAME config)."""
+    if backend == "inprocess":
+        return SimDriver(scenario, seed, config=config, sim=sim,
+                         engine=engine, faults=faults, tracer=tracer).run()
+    if backend != "grpc":
+        raise ValueError(f"backend={backend!r}: want inprocess|grpc")
+    from tpusched.rpc.client import SchedulerClient
+    from tpusched.rpc.server import make_server
+
+    cfg = effective_config(scenario, config)
+    server, port, svc = make_server("127.0.0.1:0", config=cfg,
+                                    faults=faults)
+    server.start()
+    client = SchedulerClient(f"127.0.0.1:{port}")
+    try:
+        return SimDriver(scenario, seed, config=cfg, sim=sim,
+                         client=client, tracer=tracer).run()
+    finally:
+        client.close()
+        server.stop(0)
+        svc.close()
+
+
+def static_baseline(config: "EngineConfig | None" = None) -> EngineConfig:
+    """The twin run's control arm: identical config with the QoS loop
+    severed — qos_gain 0 (priority is the static pod.spec.priority
+    again) and urgency_reweight off (no pressure-driven plugin-weight
+    interpolation). Preemption/eviction-cost machinery stays as
+    configured, so the ONLY difference is the dynamic-priority signal."""
+    cfg = config or EngineConfig(mode="fast")
+    return dataclasses.replace(
+        cfg,
+        qos=dataclasses.replace(cfg.qos, qos_gain=0.0,
+                                urgency_reweight=False),
+    )
+
+
+def twin_run(
+    scenario: Scenario,
+    seed: int = 0,
+    config: "EngineConfig | None" = None,
+    sim: "SimConfig | None" = None,
+    backend: str = "inprocess",
+    log=None,
+) -> dict:
+    """The headline experiment: same scenario, same seed, QoS-driven vs
+    static-priority baseline. Returns both summaries plus
+    attainment_gain_vs_static (fraction of SLO-carrying pods attaining
+    their target, QoS minus static) — the reference paper's central
+    claim as a repeatable bench number."""
+    from tpusched.sim import report
+
+    cfg = effective_config(scenario, config)
+    if cfg.qos.qos_gain <= 0:
+        raise ValueError(
+            "twin_run wants a QoS-driven config (qos_gain > 0) as the "
+            "treatment arm; got qos_gain="
+            f"{cfg.qos.qos_gain}"
+        )
+    results = {}
+    for arm, arm_cfg in (("qos", cfg), ("static", static_baseline(cfg))):
+        if log:
+            log(f"[sim] twin-run arm={arm} scenario={scenario.name} "
+                f"seed={seed} qos_gain={arm_cfg.qos.qos_gain}")
+        res = run_scenario(scenario, seed, config=arm_cfg, sim=sim,
+                           backend=backend)
+        results[arm] = report.summarize(res)
+        if log:
+            s = results[arm]
+            log(f"[sim]   attainment={s['slo_attainment_frac']} "
+                f"completions={s['completions']} evictions={s['evicted']} "
+                f"hash={s['event_log_hash'][:12]}")
+    gain = (results["qos"]["slo_attainment_frac"]
+            - results["static"]["slo_attainment_frac"])
+    return dict(
+        scenario=scenario.name, seed=seed, backend=backend,
+        qos=results["qos"], static=results["static"],
+        slo_attainment_frac=results["qos"]["slo_attainment_frac"],
+        attainment_gain_vs_static=round(gain, 6),
+    )

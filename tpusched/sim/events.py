@@ -1,0 +1,161 @@
+"""Seeded discrete-event queue + arrival/failure processes (ISSUE 5).
+
+Everything stochastic in a sim run is drawn HERE, once, from one seeded
+numpy Generator — the event timeline is fully determined before the
+first tick executes, so two runs with the same (scenario, seed) apply
+byte-identical event sequences and the log hash pins it. Scheduling
+OUTCOMES (binds, evictions, completions) are appended to the same log
+as they happen, so the hash covers the whole causal chain: a solver
+nondeterminism would show up as a hash mismatch, not just a metric
+wobble.
+
+Processes offered (the trace-driven-simulation staples Borg/k8s
+evaluations lean on):
+
+  * Poisson arrivals — exponential inter-arrival gaps at a fixed rate;
+  * bursty — a Poisson base load plus periodic arrival spikes (the
+    batch-submission pattern that builds queues);
+  * diurnal — a sinusoidally modulated rate via thinning (day/night
+    load swing over the horizon);
+  * node failure/flap — per-node exponential MTBF/MTTR fail->recover
+    pairs (the availability threat SLOs exist to measure).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import heapq
+import json
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    time: float
+    seq: int          # tie-break: push order, so equal times stay stable
+    kind: str
+    data: dict
+
+
+class EventQueue:
+    """Min-heap of events plus the applied-event log the determinism
+    hash is computed over. The driver pops due events each tick and
+    `note()`s outcomes (binds/evictions/completions) into the log."""
+
+    def __init__(self):
+        self._heap: list = []
+        self._seq = 0
+        self.log: list[dict] = []
+
+    def push(self, time: float, kind: str, **data) -> Event:
+        ev = Event(float(time), self._seq, kind, data)
+        heapq.heappush(self._heap, (ev.time, ev.seq, ev))
+        self._seq += 1
+        return ev
+
+    def pop_until(self, t: float) -> list[Event]:
+        """All events due at or before t, in (time, push-order)."""
+        out = []
+        while self._heap and self._heap[0][0] <= t + 1e-9:
+            out.append(heapq.heappop(self._heap)[2])
+        return out
+
+    def next_time(self) -> "float | None":
+        return self._heap[0][0] if self._heap else None
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def note(self, time: float, kind: str, **data) -> None:
+        """Append one applied-event/outcome record to the log."""
+        self.log.append(dict(t=round(float(time), 9), kind=kind, **data))
+
+    def log_hash(self) -> str:
+        """Canonical digest of the applied log: sorted-key JSON lines.
+        Floats go through repr via json — identical arithmetic yields
+        identical text, which is exactly the determinism being pinned
+        (virtual time makes the arithmetic reproducible)."""
+        h = hashlib.sha256()
+        for entry in self.log:
+            h.update(json.dumps(entry, sort_keys=True).encode())
+            h.update(b"\n")
+        return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# Arrival processes: each returns a sorted list of arrival times in
+# [t0, horizon). All randomness comes from the caller's Generator.
+# ---------------------------------------------------------------------------
+
+
+def poisson_times(rng: np.random.Generator, rate: float, horizon: float,
+                  t0: float = 0.0) -> list[float]:
+    if rate <= 0:
+        return []
+    out = []
+    t = t0
+    while True:
+        t += float(rng.exponential(1.0 / rate))
+        if t >= horizon:
+            return out
+        out.append(t)
+
+
+def bursty_times(rng: np.random.Generator, base_rate: float, horizon: float,
+                 burst_every_s: float, burst_size: int,
+                 burst_span_s: float = 2.0, t0: float = 0.0) -> list[float]:
+    """Poisson base load plus `burst_size` arrivals packed into a
+    `burst_span_s` window every `burst_every_s` (first burst one full
+    period in, so the queue starts from the base load)."""
+    out = poisson_times(rng, base_rate, horizon, t0)
+    t = t0 + burst_every_s
+    while t < horizon:
+        out.extend(
+            float(t + x) for x in rng.uniform(0.0, burst_span_s, burst_size)
+            if t + x < horizon
+        )
+        t += burst_every_s
+    return sorted(out)
+
+
+def diurnal_times(rng: np.random.Generator, base_rate: float, horizon: float,
+                  period_s: float, amplitude: float = 0.8,
+                  t0: float = 0.0) -> list[float]:
+    """Thinning (Lewis-Shedler): candidates at the peak rate
+    base*(1+amplitude), kept with probability lambda(t)/peak where
+    lambda(t) = base * (1 + amplitude * sin(2 pi t / period))."""
+    amplitude = min(max(amplitude, 0.0), 1.0)
+    peak = base_rate * (1.0 + amplitude)
+    out = []
+    for t in poisson_times(rng, peak, horizon, t0):
+        lam = base_rate * (1.0 + amplitude * np.sin(2.0 * np.pi * t / period_s))
+        if rng.uniform() * peak < lam:
+            out.append(t)
+    return out
+
+
+def failure_times(rng: np.random.Generator, node_names: list[str],
+                  mtbf_s: float, mttr_s: float,
+                  horizon: float) -> list[tuple[float, str, str]]:
+    """Per-node alternating fail/recover epochs: exponential up-time
+    (mean mtbf_s) then exponential down-time (mean mttr_s), repeated to
+    the horizon. Returns (time, "node_fail"|"node_recover", node)
+    sorted by time. A recovery beyond the horizon is dropped — the node
+    simply stays down for the rest of the run."""
+    out: list[tuple[float, str, str]] = []
+    if mtbf_s <= 0:
+        return out
+    for name in node_names:
+        t = 0.0
+        while True:
+            t += float(rng.exponential(mtbf_s))
+            if t >= horizon:
+                break
+            out.append((t, "node_fail", name))
+            t += float(rng.exponential(max(mttr_s, 1e-6)))
+            if t >= horizon:
+                break
+            out.append((t, "node_recover", name))
+    return sorted(out)

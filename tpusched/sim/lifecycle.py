@@ -1,0 +1,121 @@
+"""Per-pod availability accounting (ISSUE 5: close the QoS loop).
+
+The reference system's defining feedback loop is
+
+    observed availability -> pressure -> scheduling decision
+                 ^                              |
+                 +------- time running <--------+
+
+and before this module `observed_avail` was a dead input (a kube
+annotation default, or a uniform draw in the demo cluster). Lifecycle
+accounting makes it real: availability is the fraction of a pod's life
+it actually spent running,
+
+    avail(t) = run_seconds(t) / (t - submitted)        clipped to [0, 1]
+
+the same running-time-over-wall-time ratio the QoS paper scores SLOs
+against (and Borg-style trace simulation measures). A pod that has
+never been OBSERVED (age zero — just submitted this instant) falls back
+to optimistic compliance (config.DEFAULT_OBSERVED_AVAIL = 1.0): with no
+history there is no evidence of SLO violation, so a fresh pod carries
+no pressure and cannot jump the queue the tick it arrives. From its
+first tick of waiting, avail decays toward 0 and pressure climbs toward
+`slo_target` — the dynamic-priority signal qos.py turns into queue
+position and preemption appetite.
+
+Two consumers:
+
+  * host.FakeApiServer computes availability inline from the fields
+    this module reads (`submitted`, `run_seconds`, `bound_at`) for any
+    pod record that does not PIN an explicit `observed_avail` — so the
+    whole closed loop works for plain host runs, not only under the
+    simulator;
+  * the sim driver keeps a LifecycleTracker as the cross-requeue
+    authority: evictions and node failures DELETE the api record, so
+    accumulated run credit must survive outside the api and ride back
+    in on resubmission.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tpusched.config import DEFAULT_OBSERVED_AVAIL
+
+# The availability formula itself lives in tpusched.qos next to the
+# pressure/slack math it feeds (host.py reads it from there too — sim
+# must not be a dependency of core host); re-exported here because
+# this module is the accounting authority that documents it.
+from tpusched.qos import MIN_OBSERVED_AGE_S, observed_availability
+
+__all__ = [
+    "MIN_OBSERVED_AGE_S",
+    "observed_availability",
+    "PodLife",
+    "LifecycleTracker",
+]
+
+
+@dataclasses.dataclass
+class PodLife:
+    """One pod's accounting state, from submission to completion."""
+
+    name: str
+    submitted: float
+    slo_target: float = 0.0
+    run_seconds: float = 0.0     # banked (completed) run intervals
+    bound_at: "float | None" = None   # start of the current run, if any
+    evictions: int = 0
+    completed_at: "float | None" = None
+
+    def availability(self, now: float) -> float:
+        end = self.completed_at if self.completed_at is not None else now
+        return observed_availability(
+            self.submitted, self.run_seconds, self.bound_at, end
+        )
+
+
+class LifecycleTracker:
+    """The sim driver's authority on pod history. api records are
+    transient (evictions delete them); this book is not."""
+
+    def __init__(self):
+        self.pods: dict[str, PodLife] = {}
+
+    def on_submit(self, name: str, now: float, slo_target: float = 0.0):
+        if name not in self.pods:
+            self.pods[name] = PodLife(
+                name=name, submitted=now, slo_target=float(slo_target)
+            )
+        return self.pods[name]
+
+    def on_bind(self, name: str, now: float) -> None:
+        life = self.pods[name]
+        life.bound_at = now
+
+    def on_unbind(self, name: str, now: float, evicted: bool = True) -> float:
+        """End the current run (eviction / node failure), banking its
+        credit; returns the seconds this run lasted."""
+        life = self.pods[name]
+        ran = 0.0
+        if life.bound_at is not None:
+            ran = max(now - life.bound_at, 0.0)
+            life.run_seconds += ran
+            life.bound_at = None
+        if evicted:
+            life.evictions += 1
+        return ran
+
+    def on_complete(self, name: str, now: float) -> float:
+        """Terminal: bank the final run and freeze availability at the
+        completion instant. Returns final availability."""
+        self.on_unbind(name, now, evicted=False)
+        life = self.pods[name]
+        life.completed_at = now
+        return life.availability(now)
+
+    def availability(self, name: str, now: float) -> float:
+        life = self.pods.get(name)
+        if life is None:
+            return DEFAULT_OBSERVED_AVAIL
+        return life.availability(now)

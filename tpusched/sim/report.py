@@ -1,0 +1,123 @@
+"""SLO-attainment reporting for sim runs (ISSUE 5).
+
+Turns a SimResult into the numbers the paper's evaluation methodology
+is built on: the fraction of SLO-carrying pods whose final observed
+availability met their target (long-horizon SLO attainment, the
+Borg-style trace-sim metric), availability CDFs, wait/run percentiles,
+pressure summaries, and goodput. Everything here is pure numpy over
+the recorded outcomes — no scheduling state, so reports are cheap to
+recompute and stable to compare across twin runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _pct(xs, q) -> float:
+    return round(float(np.percentile(np.asarray(xs, np.float64), q)), 6) \
+        if len(xs) else 0.0
+
+
+def attainment_cdf(pods, points: int = 11) -> list:
+    """CDF of final availability over SLO-carrying pods: points evenly
+    spaced availability thresholds in [0, 1] with the fraction of pods
+    at or below each — the distribution behind the single attainment
+    number (two policies with equal attainment can still have very
+    different tails)."""
+    avails = sorted(p.final_avail for p in pods if p.slo > 0)
+    if not avails:
+        return []
+    n = len(avails)
+    out = []
+    for i in range(points):
+        x = i / (points - 1)
+        frac = sum(1 for a in avails if a <= x + 1e-12) / n
+        out.append((round(x, 4), round(frac, 6)))
+    return out
+
+
+def summarize(res) -> dict:
+    """One sim run -> flat report dict (json-friendly)."""
+    pods = res.pods
+    slo_pods = [p for p in pods if p.slo > 0]
+    attained = [p for p in slo_pods if p.attained]
+    waits = [p.waited_s for p in pods]
+    runs = [p.ran_s for p in pods]
+    press_mean = [s[2] for s in res.pressure_samples]
+    press_max = [s[3] for s in res.pressure_samples]
+    by_slo: dict[float, list] = {}
+    for p in slo_pods:
+        by_slo.setdefault(p.slo, []).append(p)
+    return dict(
+        scenario=res.scenario, seed=res.seed, backend=res.backend,
+        qos_gain=res.qos_gain, horizon_s=res.horizon_s,
+        ticks=res.ticks, cycles=res.cycles,
+        events_applied=res.events_applied,
+        pods_submitted=len(pods),
+        completions=res.completions,
+        placed=res.placed, evicted=res.evicted,
+        requeues=res.requeues, node_failures=res.node_failures,
+        slo_pods=len(slo_pods),
+        slo_attained=len(attained),
+        slo_attainment_frac=(
+            round(len(attained) / len(slo_pods), 6) if slo_pods else 1.0
+        ),
+        attainment_by_slo={
+            str(slo): round(
+                sum(1 for p in ps if p.attained) / len(ps), 6
+            )
+            for slo, ps in sorted(by_slo.items())
+        },
+        attainment_cdf=attainment_cdf(pods),
+        wait_p50_s=_pct(waits, 50), wait_p99_s=_pct(waits, 99),
+        run_p50_s=_pct(runs, 50),
+        goodput_run_s=round(float(np.sum(runs)), 3) if runs else 0.0,
+        completed_frac=(
+            round(res.completions / len(pods), 6) if pods else 1.0
+        ),
+        pressure_mean=_pct(press_mean, 50),
+        pressure_peak=_pct(press_max, 100),
+        event_log_hash=res.event_log_hash,
+        wall_seconds=round(res.wall_seconds, 3),
+    )
+
+
+def render_text(summary: dict) -> str:
+    """Human-readable block for the CLI."""
+    lines = [
+        f"scenario={summary['scenario']} seed={summary['seed']} "
+        f"backend={summary['backend']} qos_gain={summary['qos_gain']}",
+        f"  horizon={summary['horizon_s']}s ticks={summary['ticks']} "
+        f"cycles={summary['cycles']} events={summary['events_applied']} "
+        f"wall={summary['wall_seconds']}s",
+        f"  pods={summary['pods_submitted']} "
+        f"completed={summary['completions']} "
+        f"placed={summary['placed']} evicted={summary['evicted']} "
+        f"requeues={summary['requeues']} "
+        f"node_failures={summary['node_failures']}",
+        f"  SLO attainment: {summary['slo_attained']}/"
+        f"{summary['slo_pods']} = {summary['slo_attainment_frac']}"
+        f"   by target: {summary['attainment_by_slo']}",
+        f"  wait p50/p99: {summary['wait_p50_s']}/"
+        f"{summary['wait_p99_s']}s   pressure mean/peak: "
+        f"{summary['pressure_mean']}/{summary['pressure_peak']}",
+        f"  event-log hash: {summary['event_log_hash']}",
+    ]
+    return "\n".join(lines)
+
+
+def render_twin(twin: dict) -> str:
+    """Twin-run comparison block."""
+    q, s = twin["qos"], twin["static"]
+    lines = [
+        f"twin-run scenario={twin['scenario']} seed={twin['seed']} "
+        f"backend={twin['backend']}",
+        f"  qos-driven : attainment={q['slo_attainment_frac']} "
+        f"(evictions={q['evicted']}, wait_p99={q['wait_p99_s']}s)",
+        f"  static     : attainment={s['slo_attainment_frac']} "
+        f"(evictions={s['evicted']}, wait_p99={s['wait_p99_s']}s)",
+        f"  attainment_gain_vs_static = "
+        f"{twin['attainment_gain_vs_static']}",
+    ]
+    return "\n".join(lines)

@@ -29,6 +29,8 @@ from flax import struct
 
 from tpusched.config import (
     Buckets,
+    DEFAULT_OBSERVED_AVAIL,
+    DEFAULT_SLO_TARGET,
     EngineConfig,
     OPERATORS,
     RESOURCE_PODS,
@@ -474,8 +476,8 @@ class SnapshotBuilder:
         name: str,
         requests: Mapping[str, float],
         priority: float = 0.0,
-        slo_target: float = 0.0,
-        observed_avail: float = 1.0,
+        slo_target: float = DEFAULT_SLO_TARGET,
+        observed_avail: float = DEFAULT_OBSERVED_AVAIL,
         labels: Mapping[str, str] | None = None,
         node_selector: Mapping[str, str] | None = None,
         required_terms: Sequence[NodeSelectorTerm] = (),
