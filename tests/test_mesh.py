@@ -15,7 +15,26 @@ from tpusched.mesh import make_mesh, matrix_sharding, shard_snapshot, snapshot_s
 from tpusched.synth import make_cluster
 
 
-MESH_SHAPES = [(8, 1), (4, 2), (2, 4), (1, 8)]
+# Quarantine (ROADMAP item 5, first slice): these exact cases have
+# failed identically since the seed — sharded solves diverge from the
+# single-device reference on meshes that split the node axis (and the
+# two-process CPU backend can't run collectives at all). ROADMAP item 1
+# ("shard the serving path over the (p,n) mesh") owns the real fix;
+# until then they are xfail(strict=False) so tier-1 regains a binary
+# exit signal — a fix flips them to XPASS without breaking the run,
+# and any NEW failure elsewhere is no longer drowned in these six.
+_ROADMAP1_XFAIL = pytest.mark.xfail(
+    reason="pre-existing sharded-solve divergence; quarantined pending "
+           "ROADMAP item 1 (make multichip real)",
+    strict=False,
+)
+
+MESH_SHAPES = [
+    (8, 1),
+    pytest.param((4, 2), marks=_ROADMAP1_XFAIL),
+    pytest.param((2, 4), marks=_ROADMAP1_XFAIL),
+    (1, 8),
+]
 
 
 def _snap(rng, **kw):
@@ -57,7 +76,10 @@ def test_sharded_sequential_matches_single(rng, shape):
     )
 
 
-@pytest.mark.parametrize("shape", [(4, 2), (1, 8)])
+@pytest.mark.parametrize("shape", [
+    pytest.param((4, 2), marks=_ROADMAP1_XFAIL),
+    (1, 8),
+])
 def test_sharded_fast_matches_single(rng, shape):
     snap, _ = _snap(rng)
     cfg = EngineConfig(mode="fast")
@@ -72,7 +94,9 @@ def test_sharded_fast_matches_single(rng, shape):
     np.testing.assert_array_equal(np.asarray(single[0]), np.asarray(sharded[0]))
 
 
-@pytest.mark.parametrize("shape", [(2, 4)])
+@pytest.mark.parametrize("shape", [
+    pytest.param((2, 4), marks=_ROADMAP1_XFAIL),
+])
 def test_sharded_score_batch_matches_single(rng, shape):
     snap, _ = _snap(rng)
     cfg = EngineConfig()
@@ -96,6 +120,7 @@ def test_default_mesh_uses_all_devices():
     assert mesh.devices.size == len(jax.devices())
 
 
+@_ROADMAP1_XFAIL
 def test_dryrun_multichip_entry():
     """The driver-facing dryrun must pass in-process (8 devices here)."""
     import __graft_entry__ as g

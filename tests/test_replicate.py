@@ -1,0 +1,318 @@
+"""Warm-standby replication + failover correctness (ISSUE 6).
+
+Tier-1-sized smokes over tpusched/replicate.py + the fleet surfaces in
+rpc/server.py, rpc/client.py, host.py, and tools/chaos.py:
+
+  * replay-log determinism: a standby that applied the leader's op log
+    holds BYTE-IDENTICAL stores under the leader-minted snapshot_ids;
+  * mid-pipeline leader kill: the client fails over along its ordered
+    endpoint list, the standby promotes, and the end state is
+    identical to the fault-free twin (zero lost/duplicated binds);
+  * stale standby: a follower that never streamed forces the
+    failed-over client through FAILED_PRECONDITION + full-snapshot
+    resync — warm state is an optimization, never a correctness
+    dependency;
+  * deterministic fault sites replica.stream / replica.takeover;
+  * the ReplicationLog retention/rebase contract as a pure unit.
+
+Engines compile per server (~1-2 s each); shapes stay tiny and servers
+are shared across asserts within a test.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+
+from tpusched.config import EngineConfig
+from tpusched.faults import FaultPlan, FaultRule
+from tpusched.host import FakeApiServer, HostScheduler, \
+    build_synthetic_cluster
+from tpusched.replicate import ReplicaSet, ReplicationLog
+from tpusched.rpc.client import SchedulerClient
+
+
+def _chaos_module():
+    spec = importlib.util.spec_from_file_location(
+        "tpusched_chaos",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "chaos.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _small_cluster(api, n_pods=16, n_nodes=3, seed=0):
+    build_synthetic_cluster(api, np.random.default_rng(seed),
+                            n_pods, n_nodes)
+
+
+# -- ReplicationLog unit ------------------------------------------------------
+
+
+def test_replication_log_since_and_rebase_contract():
+    log = ReplicationLog(cap=4)
+    assert log.since(1) == ([], 0, False)  # empty log, nothing to want
+    for i in range(6):
+        log.append("delta", f"snap-{i}", b"d%d" % i,
+                   base_id=f"snap-{i - 1}")
+    assert log.end_seq == 6
+    # cap=4: seqs 1-2 fell off; asking for them is stale.
+    ops, end, stale = log.since(1)
+    assert stale and ops == [] and end == 6
+    ops, end, stale = log.since(3)
+    assert not stale and [op.seq for op in ops] == [3, 4, 5, 6]
+    assert ops[0].kind == "delta" and ops[0].snapshot_id == "snap-2"
+    # Caught-up follower: empty, not stale.
+    assert log.since(7) == ([], 6, False)
+    # Mirroring preserves leader seqs and advances the mint point.
+    standby = ReplicationLog(cap=4)
+    for op in ops:
+        standby.mirror(op)
+    assert standby.end_seq == 6
+    assert standby.append("full", "snap-7", b"f") == 7
+
+
+# -- replay-log determinism ---------------------------------------------------
+
+
+def test_standby_stores_byte_identical_after_deltas(thread_leak_check):
+    """After a full send + N delta cycles, every store the standby
+    replicated is byte-identical to the leader's under the SAME
+    snapshot_id, and the standby's mirrored log continues the leader's
+    seqs. This is the determinism floor takeover correctness rests on."""
+    cfg = EngineConfig(mode="fast")
+    fleet = ReplicaSet(2, poll_s=0.02, config=cfg)
+    api = FakeApiServer()
+    _small_cluster(api)
+    host = HostScheduler(api, cfg, client=fleet.addresses(), batch_size=4)
+    try:
+        host.run_until_idle()
+        assert fleet.wait_caught_up(timeout=15.0), \
+            "standby never caught up with the leader's op log"
+        lead, stand = fleet.services
+        assert lead._replog.end_seq >= 3  # 1 full + >=2 delta cycles
+        shared = set(lead._stores) & set(stand._stores)
+        assert shared == set(lead._stores), \
+            f"standby missing stores: {set(lead._stores) - shared}"
+        for sid in shared:
+            assert (lead._stores[sid].compose_bytes()
+                    == stand._stores[sid].compose_bytes()), \
+                f"store {sid} diverged between leader and standby"
+        assert stand._replog.end_seq == lead._replog.end_seq
+        assert stand.replication_applied == lead._replog.appended
+        assert stand.replication_skipped == 0
+        # Roles + replication surface over the wire.
+        h0 = SchedulerClient(fleet.addresses()[:1])
+        h1 = SchedulerClient(fleet.addresses()[1:])
+        try:
+            assert h0.health().role == "leader"
+            hs = h1.health()
+            assert hs.role == "standby" and hs.takeovers == 0
+            text = h1.metrics_text()
+            assert 'scheduler_replica_role{role="standby"} 1' in text
+            assert "scheduler_replication_lag_seq 0" in text
+        finally:
+            h0.close()
+            h1.close()
+    finally:
+        host.close()
+        fleet.close()
+
+
+# -- failover -----------------------------------------------------------------
+
+
+def test_client_fails_over_along_endpoint_list(thread_leak_check):
+    """A dead first endpoint rotates the client to the live replica;
+    the rotation is counted and subsequent calls stay on the survivor."""
+    cfg = EngineConfig(mode="fast")
+    fleet = ReplicaSet(1, config=cfg)
+    # A port nothing listens on, then the live server.
+    dead = "127.0.0.1:1"
+    client = SchedulerClient([dead] + fleet.addresses(), timeout=10.0,
+                             retry_seed=0)
+    try:
+        h = client.health()
+        assert h.ok and client.failovers == 1
+        assert client.endpoint() != dead
+        client.health()
+        assert client.failovers == 1  # stays put once somewhere live
+    finally:
+        client.close()
+        fleet.close()
+
+
+def test_leader_kill_end_state_identical(thread_leak_check):
+    """The acceptance scenario at replicas=2: kill-the-leader twin run
+    via tools/chaos.py — end placements identical to fault-free, zero
+    lost/duplicated binds, exactly one takeover, and (the standby being
+    caught up at the kill) ZERO delta fallbacks: the failed-over delta
+    was served from replicated state, not a resync storm."""
+    chaos = _chaos_module()
+    report = chaos.run_chaos_fleet(
+        n_pods=36, n_nodes=5, seed=3, batch_size=9, replicas=2,
+        kill_after_cycle=1, outage_s=0.3, poll_s=0.02,
+        log=lambda *a: None,
+    )
+    end = report["end_state"]
+    assert end["identical"], f"placements diverged: {end}"
+    assert end["lost"] == [] and end["duplicated"] == 0
+    assert report["chaos"]["takeovers"] == 1
+    assert report["chaos"]["client_failovers"] >= 1
+    assert report["chaos"]["delta_fallbacks"] == 0, \
+        "warm standby should have served the failed-over delta"
+    assert report["chaos"]["serving_role"] == "leader"
+    assert report["failover_recovery_s"] is not None
+    assert report["failover_recovery_s"] < 30.0
+
+
+def test_stale_standby_forces_client_resync(thread_leak_check):
+    """Kill the leader while the standby is COLD (its follower never
+    polled: replica.stream erred on every attempt). The failed-over
+    delta gets FAILED_PRECONDITION and DeltaSession's full-snapshot
+    resync heals the cycle — every submitted pod still binds."""
+    cfg = EngineConfig(mode="fast")
+    plan = FaultPlan([
+        FaultRule("replica.stream", "error", at=set(range(4096))),
+    ])
+    fleet = ReplicaSet(2, poll_s=0.01, config=cfg, faults=plan)
+    api = FakeApiServer()
+    _small_cluster(api, n_pods=12, n_nodes=3)
+    host = HostScheduler(api, cfg, client=fleet.addresses(), batch_size=6)
+    try:
+        host.run_until_idle()
+        stand = fleet.services[1]
+        assert stand.replication_applied == 0, \
+            "fault plan should have starved the follower"
+        fleet.kill_leader()
+        api.add_pod("late-pod",
+                    requests={"cpu": 100.0, "memory": float(1 << 28)},
+                    priority=50.0, slo_target=0.9)
+        host.run_until_idle()
+        assert host.client.failovers >= 1
+        assert host._delta.fallbacks >= 1, \
+            "a cold standby must force the full-snapshot resync path"
+        assert stand.role == "leader" and stand.takeovers == 1
+        assert api.get_pod("late-pod")["phase"] == "Bound"
+        pending = [p["name"] for p in api.pending_pods()]
+        assert pending == [], f"still pending after failover: {pending}"
+        assert api.bind_count == 13  # 12 seeded + late-pod, each ONCE
+    finally:
+        host.close()
+        fleet.close()
+
+
+def test_takeover_fault_site_refuses_then_admits(thread_leak_check):
+    """replica.takeover firing 'error' on the FIRST promotion attempt:
+    the standby answers UNAVAILABLE (split-brain-attempt guard), the
+    client rotates on (and back), and the second attempt promotes —
+    deterministic, seeded like every other fault."""
+    cfg = EngineConfig(mode="fast")
+    plan = FaultPlan([FaultRule("replica.takeover", "error", at={0})])
+    fleet = ReplicaSet(2, poll_s=0.02, config=cfg, faults=plan)
+    api = FakeApiServer()
+    _small_cluster(api, n_pods=8, n_nodes=2, seed=1)
+    host = HostScheduler(api, cfg, client=fleet.addresses(), batch_size=8)
+    try:
+        host.run_until_idle()
+        fleet.wait_caught_up(timeout=15.0)
+        fleet.kill_leader()
+        api.add_pod("late-pod",
+                    requests={"cpu": 100.0, "memory": float(1 << 28)},
+                    priority=50.0, slo_target=0.9)
+        host.run_until_idle()
+        stand = fleet.services[1]
+        assert plan.count("replica.takeover") >= 2
+        assert stand.role == "leader" and stand.takeovers == 1
+        # The refusal cost one extra endpoint rotation (standby ->
+        # dead leader -> standby again).
+        assert host.client.failovers >= 2
+        assert api.get_pod("late-pod")["phase"] == "Bound"
+    finally:
+        host.close()
+        fleet.close()
+
+
+def test_takeover_flight_dump_carries_handoff_chain(thread_leak_check):
+    """A promotion snapshots the standby's trace ring: the flight dump
+    must carry the replication stream spans (the hand-off causal
+    chain), and the trace ring must hold the replica.takeover event."""
+    from tpusched import trace as tracing
+
+    cfg = EngineConfig(mode="fast")
+    tracer = tracing.TraceCollector(seed=7)
+    fleet = ReplicaSet(2, poll_s=0.02, config=cfg, tracer=tracer)
+    api = FakeApiServer()
+    _small_cluster(api, n_pods=8, n_nodes=2, seed=2)
+    host = HostScheduler(api, cfg, client=fleet.addresses(), batch_size=8)
+    try:
+        host.run_until_idle()
+        fleet.wait_caught_up(timeout=15.0)
+        fleet.kill_leader()
+        api.add_pod("late-pod",
+                    requests={"cpu": 100.0, "memory": float(1 << 28)},
+                    priority=50.0, slo_target=0.9)
+        host.run_until_idle()
+        stand = fleet.services[1]
+        assert stand.takeovers == 1
+        dumps = stand.flight.dumps()
+        takeover_dumps = [d for d in dumps
+                          if d["reason"] == "replica_takeover"]
+        assert takeover_dumps, f"no takeover dump: {dumps}"
+        names = {s["name"] for s in takeover_dumps[-1]["spans"]}
+        assert "replica.stream" in names, \
+            f"hand-off chain missing stream spans: {sorted(names)}"
+        assert "replica.apply" in names
+        ring = {s.name for s in tracer.spans()}
+        assert "replica.takeover" in ring
+        mtext = SchedulerClient(fleet.addresses()[1:])
+        try:
+            exported = mtext.metrics_text()
+        finally:
+            mtext.close()
+        assert 'scheduler_replica_role{role="leader"} 1' in exported
+        assert "scheduler_replica_takeovers_total 1" in exported
+    finally:
+        host.close()
+        fleet.close()
+
+
+def test_replication_stream_delay_builds_lag(thread_leak_check):
+    """replica.stream delay shots wedge the follower's first two polls
+    for 1s each; ops the leader appends meanwhile are measurably
+    UNAPPLIED (lag in ops > 0), and once the shots are spent the
+    follower drains the backlog — lag is transient, not lost."""
+    from tpusched.rpc import tpusched_pb2 as pb
+
+    cfg = EngineConfig(mode="fast")
+    plan = FaultPlan([
+        FaultRule("replica.stream", "delay", at={0, 1}, delay_s=1.0),
+    ])
+    fleet = ReplicaSet(2, poll_s=0.01, config=cfg, faults=plan)
+    try:
+        lead, stand = fleet.services
+        # Append while the follower sits inside its first delay shot
+        # (1s window vs the microseconds these appends take).
+        payload = pb.ClusterSnapshot().SerializeToString()
+        for i in range(3):
+            lead._replog.append("full", f"snap-lagtest-{i}", payload)
+        gap = lead._replog.end_seq - fleet.followers[1].applied_seq
+        assert gap >= 3, f"expected >=3 unapplied ops, gap={gap}"
+        # Shots exhausted -> the backlog drains and the ops were
+        # APPLIED (not skipped): lag was latency, never data loss.
+        assert fleet.wait_caught_up(timeout=10.0)
+        assert stand.replication_applied >= 3
+        assert stand.replication_skipped == 0
+        # At least the first poll's shot fired (catch-up can complete
+        # on that very poll — the delay stalls it, the fetch after the
+        # stall still applies everything).
+        assert plan.count("replica.stream") >= 1
+        h = SchedulerClient(fleet.addresses()[:1])
+        try:
+            assert h.health().role == "leader"
+        finally:
+            h.close()
+    finally:
+        fleet.close()

@@ -27,9 +27,18 @@ event -> next completed cycle) and goodput (placements/sec vs the
 fault-free run) come out in the report; bench.py's "robustness" bench
 and tests/test_faults.py's chaos smoke both drive this module.
 
+Round 11 (ISSUE 6) adds the FLEET experiment (`run_chaos_fleet`,
+--replicas): the same twin-run discipline over an N-replica
+tpusched.replicate.ReplicaSet with a kill-the-leader fault — the
+client fails over along its ordered endpoint list, the warm standby
+promotes, and END placements must still be identical with zero
+lost/duplicated binds. goodput_frac at replica counts 1/2/3 under the
+SAME kill is the high-availability claim as a bench number.
+
 Usage:
     JAX_PLATFORMS=cpu python tools/chaos.py --pods 120 --nodes 12
     python tools/chaos.py --seed 7 --json report.json
+    python tools/chaos.py --replicas 2 --json fleet.json
 """
 
 from __future__ import annotations
@@ -335,6 +344,194 @@ def run_chaos(
     return report
 
 
+def run_chaos_fleet(
+    n_pods: int = 120,
+    n_nodes: int = 12,
+    seed: int = 0,
+    batch_size: int | None = None,
+    replicas: int = 2,
+    kill_after_cycle: int = 2,
+    outage_s: float = 0.4,
+    watchdog_s: float = 30.0,
+    poll_s: float = 0.05,
+    plan: FaultPlan | None = None,
+    warmup_arm: bool = False,
+    log=print,
+) -> dict:
+    """Kill-the-leader twin run over an N-replica fleet (ISSUE 6).
+
+    Both arms run the SAME fleet shape (replicas, followers polling) so
+    goodput is comparable; the chaos arm kills the leader after
+    `kill_after_cycle` completed cycles (waiting for the standbys to be
+    CAUGHT UP first, so 'warm standby' is a property the harness
+    controls, not a race) and resurrects it `outage_s` later — as the
+    sole leader again at replicas=1 (nothing else can serve), as a
+    STANDBY rejoining the fleet at replicas>=2 (the promoted standby
+    keeps leading; the ex-leader must not reclaim and split the brain).
+
+    The client rides the ordered endpoint list: at replicas=1 it backs
+    off on UNAVAILABLE until the restart (the availability gap IS the
+    single-sidecar story); at replicas>=2 its first retry fails over to
+    the warm standby, whose replicated stores answer the delta against
+    the leader-minted base — failover recovery is one retry, not one
+    outage. End state must be IDENTICAL to the fault-free arm either
+    way; `goodput_frac` is the availability claim as a number.
+
+    warmup_arm: run one UNMEASURED fault-free arm first. The first
+    fleet run in a process pays the XLA compiles for this workload's
+    shapes (later arms hit the in-process compile caches); without a
+    warmup, a cold fault-free twin can lose to a warm chaos arm and
+    invert the goodput fraction. Callers comparing goodput across
+    replica counts set it on their FIRST run (bench.py does)."""
+    from tpusched.replicate import ReplicaSet
+    from tpusched.rpc.client import SchedulerClient
+
+    cfg = EngineConfig(mode="fast")
+    batch = batch_size or max(n_pods // 4, 1)
+
+    def fresh_api():
+        api = _CountingApi()
+        build_synthetic_cluster(api, np.random.default_rng(seed),
+                                n_pods, n_nodes)
+        return api
+
+    def run_arm(events_fn, faults=None):
+        # `faults` lands on the CHAOS arm only — the baseline/warmup
+        # fleets must stay genuinely fault-free (and a plan's pinned
+        # invocation indices must not be burned in the wrong arm); the
+        # single-sidecar run_chaos follows the same discipline.
+        fleet = ReplicaSet(replicas, poll_s=poll_s, config=cfg,
+                           watchdog_s=watchdog_s, faults=faults)
+        client = SchedulerClient(fleet.addresses(), retry_seed=seed)
+        api = fresh_api()
+        host = HostScheduler(api, cfg, client=client, batch_size=batch)
+        timers: list = []
+        try:
+            t0 = time.perf_counter()
+            drive = _drive(host, events_fn(fleet, timers), max_cycles=400)
+            wall = time.perf_counter() - t0
+            placements = _placements(api)
+            placed = sum(c.placed for c in host.cycles)
+            health = client.health()
+            stats = dict(
+                drive=drive, wall=wall, placements=placements,
+                placed=placed, conflicts=api.conflicts,
+                failovers=client.failovers, retries=client.retries,
+                fallbacks=host._delta.fallbacks if host._delta else 0,
+                takeovers=fleet.takeovers(),
+                serving_role=health.role,
+                replication=[
+                    dict(role=svc.role,
+                         applied=svc.replication_applied,
+                         skipped=svc.replication_skipped,
+                         appended=svc._replog.appended)
+                    for svc in fleet.services
+                ],
+            )
+        finally:
+            for t in timers:
+                t.cancel()
+                t.join(timeout=outage_s + 5.0)
+            host.close()
+            client.close()
+            fleet.close()
+        return stats
+
+    def no_events(fleet, timers):
+        return {}
+
+    def kill_events(fleet, timers):
+        def kill_leader():
+            # Deterministic warmness: standbys catch up BEFORE the kill.
+            # A timeout here is a harness precondition failure — killing
+            # a cold standby would silently turn the warm-failover
+            # experiment into a resync-storm one (delta_fallbacks > 0,
+            # asserted 0 by the tier-1 smoke); fail loudly instead.
+            if not fleet.wait_caught_up(timeout=10.0):
+                raise RuntimeError(
+                    "standbys failed to catch up with the leader's op "
+                    "log before the kill (10s): warm-standby "
+                    "precondition not met"
+                )
+            idx = fleet.kill_leader()
+
+            def resurrect():
+                fleet.restart(idx, role="leader" if replicas == 1
+                              else "standby")
+
+            import threading
+
+            t = threading.Timer(outage_s, resurrect)
+            t.name = "tpusched-chaos-restart"
+            t.daemon = True
+            t.start()
+            timers.append(t)
+
+        return {kill_after_cycle: [("leader_kill", kill_leader)]}
+
+    if warmup_arm:
+        t0 = time.perf_counter()
+        run_arm(no_events)
+        log(f"[chaos-fleet r{replicas}] warmup arm (unmeasured, "
+            f"compiles): {time.perf_counter() - t0:.2f}s")
+    base = run_arm(no_events)
+    log(f"[chaos-fleet r{replicas}] fault-free: "
+        f"{base['drive']['cycles']} cycles, {base['placed']} placed "
+        f"in {base['wall']:.2f}s")
+    chaos = run_arm(kill_events, faults=plan)
+    log(f"[chaos-fleet r{replicas}] kill-the-leader: "
+        f"{chaos['drive']['cycles']} cycles "
+        f"(+{chaos['drive']['failed_attempts']} failed attempts), "
+        f"{chaos['placed']} placed in {chaos['wall']:.2f}s, "
+        f"failovers={chaos['failovers']} takeovers={chaos['takeovers']} "
+        f"fallbacks={chaos['fallbacks']}")
+
+    lost = sorted(set(base["placements"]) - set(chaos["placements"]))
+    extra = sorted(set(chaos["placements"]) - set(base["placements"]))
+    moved = sorted(
+        p for p in set(base["placements"]) & set(chaos["placements"])
+        if base["placements"][p] != chaos["placements"][p]
+    )
+    identical = not (lost or extra or moved)
+    base_pps = base["placed"] / max(base["wall"], 1e-9)
+    chaos_pps = chaos["placed"] / max(chaos["wall"], 1e-9)
+    rec = chaos["drive"]["recovery_s"]
+    report = dict(
+        pods=n_pods, nodes=n_nodes, seed=seed, batch_size=batch,
+        replicas=replicas, outage_s=outage_s,
+        baseline=dict(cycles=base["drive"]["cycles"],
+                      placed=base["placed"],
+                      wall_s=round(base["wall"], 3),
+                      goodput_pps=round(base_pps, 2)),
+        chaos=dict(
+            cycles=chaos["drive"]["cycles"], placed=chaos["placed"],
+            wall_s=round(chaos["wall"], 3),
+            goodput_pps=round(chaos_pps, 2),
+            failed_cycle_attempts=chaos["drive"]["failed_attempts"],
+            bind_conflicts=chaos["conflicts"],
+            client_retries=chaos["retries"],
+            client_failovers=chaos["failovers"],
+            delta_fallbacks=chaos["fallbacks"],
+            takeovers=chaos["takeovers"],
+            serving_role=chaos["serving_role"],
+            replication=chaos["replication"],
+        ),
+        recovery_s=rec,
+        failover_recovery_s=rec.get("leader_kill"),
+        goodput_frac=round(chaos_pps / max(base_pps, 1e-9), 3),
+        end_state=dict(
+            identical=identical, lost=lost,
+            duplicated=chaos["conflicts"], extra=extra, moved=moved,
+        ),
+    )
+    log(f"[chaos-fleet r{replicas}] goodput "
+        f"{report['goodput_frac']:.2f}x of fault-free, recovery {rec}; "
+        f"end state identical: {identical} "
+        f"(lost={len(lost)} extra={len(extra)} moved={len(moved)} "
+        f"conflicts={chaos['conflicts']})")
+    return report
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--pods", type=int, default=120)
@@ -345,15 +542,30 @@ def main() -> int:
     ap.add_argument("--plan-seed", type=int, default=None,
                     help="draw fault indices from this seed instead of "
                          "the pinned defaults")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="run the kill-the-leader FLEET experiment at "
+                         "this replica count instead of the single-"
+                         "sidecar fault plan")
+    ap.add_argument("--kill-after-cycle", type=int, default=2)
+    ap.add_argument("--outage-s", type=float, default=0.4)
     ap.add_argument("--json", default=None,
                     help="write the full report to this path")
     args = ap.parse_args()
-    report = run_chaos(
-        n_pods=args.pods, n_nodes=args.nodes, seed=args.seed,
-        batch_size=args.batch, watchdog_s=args.watchdog_s,
-        plan_seed=args.plan_seed,
-        log=lambda *a: print(*a, file=sys.stderr, flush=True),
-    )
+    err = lambda *a: print(*a, file=sys.stderr, flush=True)  # noqa: E731
+    if args.replicas is not None:
+        report = run_chaos_fleet(
+            n_pods=args.pods, n_nodes=args.nodes, seed=args.seed,
+            batch_size=args.batch, replicas=args.replicas,
+            kill_after_cycle=args.kill_after_cycle,
+            outage_s=args.outage_s,
+            watchdog_s=max(args.watchdog_s, 30.0), log=err,
+        )
+    else:
+        report = run_chaos(
+            n_pods=args.pods, n_nodes=args.nodes, seed=args.seed,
+            batch_size=args.batch, watchdog_s=args.watchdog_s,
+            plan_seed=args.plan_seed, log=err,
+        )
     out = json.dumps(report, indent=2)
     if args.json:
         with open(args.json, "w") as f:

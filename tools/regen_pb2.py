@@ -30,7 +30,10 @@ PB2_PATH = REPO / "tpusched" / "rpc" / "tpusched_pb2.py"
 
 F = descriptor_pb2.FieldDescriptorProto
 
-# message name -> [(field name, number, type, json_name)]
+# message name -> [(field name, number, type, json_name)] for optional
+# scalar fields, or 6-tuples (name, number, type, json_name, label,
+# type_name) for repeated and/or message-typed fields (type_name is the
+# fully-qualified ".tpusched.X" message name, "" for scalars).
 SCHEMA_EDITS = {
     "SnapshotDelta": [
         ("lineage_id", 8, F.TYPE_STRING, "lineageId"),
@@ -42,6 +45,10 @@ SCHEMA_EDITS = {
         ("ladder_demotions", 6, F.TYPE_INT64, "ladderDemotions"),
         ("ladder_recoveries", 7, F.TYPE_INT64, "ladderRecoveries"),
         ("replayed_requests", 8, F.TYPE_INT64, "replayedRequests"),
+        # Round 11 (ISSUE 6): replication role + lag + takeover counter.
+        ("role", 9, F.TYPE_STRING, "role"),
+        ("replication_lag_seq", 10, F.TYPE_UINT64, "replicationLagSeq"),
+        ("takeovers", 11, F.TYPE_INT64, "takeovers"),
     ],
     # Round 9 (ISSUE 4): cross-wire trace stitching — the client stamps
     # its trace id and active span id; absent id => server-minted.
@@ -55,7 +62,7 @@ SCHEMA_EDITS = {
     ],
 }
 
-# Whole new messages: message name -> field list (same tuple shape).
+# Whole new messages: message name -> field list (same tuple shapes).
 MESSAGE_ADDS = {
     "DebugzRequest": [
         ("max_traces", 1, F.TYPE_INT32, "maxTraces"),
@@ -65,12 +72,33 @@ MESSAGE_ADDS = {
         ("trace_json", 1, F.TYPE_STRING, "traceJson"),
         ("flight_json", 2, F.TYPE_STRING, "flightJson"),
     ],
+    # Round 11 (ISSUE 6): warm-standby op-log replication.
+    "ReplicateRequest": [
+        ("from_seq", 1, F.TYPE_UINT64, "fromSeq"),
+        ("follower_id", 2, F.TYPE_STRING, "followerId"),
+    ],
+    "ReplicationOp": [
+        ("seq", 1, F.TYPE_UINT64, "seq"),
+        ("kind", 2, F.TYPE_STRING, "kind"),
+        ("snapshot_id", 3, F.TYPE_STRING, "snapshotId"),
+        ("base_id", 4, F.TYPE_STRING, "baseId"),
+        ("payload", 5, F.TYPE_BYTES, "payload"),
+    ],
+    "ReplicateResponse": [
+        ("ops", 1, F.TYPE_MESSAGE, "ops", F.LABEL_REPEATED,
+         ".tpusched.ReplicationOp"),
+        ("end_seq", 2, F.TYPE_UINT64, "endSeq"),
+        ("resync", 3, F.TYPE_BOOL, "resync"),
+        ("role", 4, F.TYPE_STRING, "role"),
+    ],
 }
 
 # New unary service methods: service name -> [(method, input, output)].
 METHOD_ADDS = {
     "TpuScheduler": [
         ("Debugz", ".tpusched.DebugzRequest", ".tpusched.DebugzResponse"),
+        ("Replicate", ".tpusched.ReplicateRequest",
+         ".tpusched.ReplicateResponse"),
     ],
 }
 
@@ -118,13 +146,18 @@ def apply_edits(fd: descriptor_pb2.FileDescriptorProto) -> bool:
     for msg_name, fields in {**SCHEMA_EDITS, **MESSAGE_ADDS}.items():
         msg = by_name[msg_name]
         have = {f.name for f in msg.field}
-        for name, number, ftype, json_name in fields:
+        for spec in fields:
+            name, number, ftype, json_name = spec[:4]
+            label = spec[4] if len(spec) > 4 else F.LABEL_OPTIONAL
+            type_name = spec[5] if len(spec) > 5 else ""
             if name in have:
                 continue
-            msg.field.add(
+            f = msg.field.add(
                 name=name, number=number, type=ftype,
-                label=F.LABEL_OPTIONAL, json_name=json_name,
+                label=label, json_name=json_name,
             )
+            if type_name:
+                f.type_name = type_name
             changed = True
     services = {s.name: s for s in fd.service}
     for svc_name, methods in METHOD_ADDS.items():

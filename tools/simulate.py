@@ -48,6 +48,10 @@ def main() -> int:
                     default="inprocess",
                     help="grpc = spin an in-process sidecar and drive "
                          "the full host->rpc path")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="grpc only: serve from an N-replica warm-"
+                         "standby fleet (tpusched.replicate.ReplicaSet)"
+                         " instead of one sidecar")
     ap.add_argument("--horizon", type=float, default=None,
                     help="override the scenario's virtual horizon (s)")
     ap.add_argument("--rate", type=float, default=None,
@@ -90,13 +94,21 @@ def main() -> int:
     def log(msg):
         print(msg, file=sys.stderr, flush=True)
 
+    if args.replicas != 1 and args.backend != "grpc":
+        ap.error("--replicas needs --backend grpc (a fleet is a wire-"
+                 "level construct; the in-process engine has no "
+                 "endpoints to fail over between)")
     if args.twin:
+        if args.replicas != 1:
+            ap.error("--twin does not support --replicas yet: both "
+                     "arms run a single sidecar so the QoS-vs-static "
+                     "comparison is apples-to-apples")
         out = twin_run(sc, seed=args.seed, config=cfg, sim=sim,
                        backend=args.backend, log=log)
         print(report.render_twin(out))
     else:
         res = run_scenario(sc, seed=args.seed, config=cfg, sim=sim,
-                           backend=args.backend)
+                           backend=args.backend, replicas=args.replicas)
         out = report.summarize(res)
         print(report.render_text(out))
     if args.json:

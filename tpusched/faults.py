@@ -28,6 +28,17 @@ contract the chaos harness and tests rely on):
   ``kube.watch``       top of each informer watch-stream attempt
                        (kube.py) — ``error`` forces the relist/backoff
                        path, a flapping apiserver
+  ``replica.stream``   top of each standby replication poll
+                       (replicate.py StandbyFollower) — ``error`` is a
+                       failed poll (retried next tick), ``delay``
+                       builds replication lag, so kill-the-leader and
+                       stale-standby scenarios are seeded like every
+                       other fault
+  ``replica.takeover`` inside a standby's promotion to leader
+                       (rpc/server.py _maybe_takeover) — ``error``
+                       refuses the takeover with UNAVAILABLE, the
+                       split-brain-attempt guard scenario: the client
+                       rotates to the next endpoint and retries
 
 One plan instance may be shared across components (server + engine +
 informer): counters are per-site and thread-safe, and ``fired`` records

@@ -281,6 +281,16 @@ class HostScheduler:
     ):
         self.api = api
         self.config = config or EngineConfig()
+        # Transport config accepts ADDRESSES, not just a built client
+        # (round 11, ISSUE 6): a str or an ordered list/tuple of
+        # replica endpoints builds a failover-capable SchedulerClient
+        # owned (and closed) by this host.
+        self._owns_client = False
+        if isinstance(client, (str, list, tuple)):
+            from tpusched.rpc.client import SchedulerClient
+
+            client = SchedulerClient(client)
+            self._owns_client = True
         self.client = client
         self.batch_size = batch_size
         self.buckets = buckets
@@ -359,11 +369,16 @@ class HostScheduler:
 
     def close(self) -> None:
         """Shut down the bind/delete worker pool (idle workers also
-        exit when the host is garbage-collected); long-lived processes
-        cycling many hosts should call this."""
+        exit when the host is garbage-collected) and any client this
+        host built from addresses; long-lived processes cycling many
+        hosts should call this."""
         if self._io_pool is not None:
             self._io_pool.shutdown(wait=False)
             self._io_pool = None
+        if self._owns_client and self.client is not None:
+            self.client.close()
+            self.client = None
+            self._owns_client = False
 
     @staticmethod
     def _backoff_key(p: dict) -> str:

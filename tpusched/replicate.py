@@ -1,0 +1,440 @@
+"""N-way sidecar replication with warm-standby failover (ISSUE 6).
+
+The (lineage_id, seq) retry machinery of ISSUE 3 made every sidecar
+state mutation replayable; this module treats those mutations as an OP
+LOG and streams it to standby replicas so the scheduler stops being a
+single process:
+
+  * `ReplicationLog` — the leader records one op per store
+    registration: "full" (a full-send snapshot, payload = serialized
+    ClusterSnapshot) or "delta" (payload = serialized SnapshotDelta
+    against a prior op's snapshot_id). Ops carry the SAME snapshot_ids
+    the leader handed its clients, so a replica that applied the log
+    can answer a failed-over client's delta against a leader-era
+    base_id directly — no full-resync storm on takeover.
+  * The `Replicate` rpc (rpc/server.py) serves ops from a follower's
+    next wanted seq; a follower that fell behind the log's retention
+    gets `resync=true` plus ONE full-rebase op (the leader's newest
+    store), and resumes from the log end.
+  * `StandbyFollower` — the polling loop a standby runs: fetch ops,
+    apply them into its own SchedulerService (byte stores + a warm
+    DeviceSession for delta lineages), mirror them into its OWN log
+    (preserving leader seqs) so a second standby can re-follow a
+    promoted leader, and export replication lag. The loop exits on
+    takeover (role flip) or stop().
+  * `ReplicaSet` — an in-process fleet (tests, chaos harness, sim):
+    replica 0 starts as leader, the rest as standbys following the
+    ordered endpoint list. `kill_leader()` is the canonical fault;
+    clients built on the same address list fail over on UNAVAILABLE
+    (rpc/client.py) and the first serving request promotes the standby
+    (SchedulerService._maybe_takeover).
+
+Failure domains (the ISSUE 3 taxonomy extends, it does not change):
+replication is ASYNC — a client ack never waits on a standby, so the
+op(s) in flight at the moment the leader dies may be lost. That is
+safe by construction: a failed-over client whose base_id the standby
+never saw gets FAILED_PRECONDITION and the existing resync machinery
+(DeltaSession fallback / pipeline pinned-base recompose) re-sends the
+full snapshot. Warm standby is an optimization with a correctness
+floor, exactly like every other cache in the serving path.
+
+Leadership is PROMOTION-BY-FIRST-REQUEST, not an election: any serving
+request landing on a standby promotes it, and nothing demotes an old
+leader at runtime — a resurrected ex-leader rejoining as a standby can
+be re-promoted if a client's retry lands on it while still rotating.
+That is a deliberate trade: the ordered endpoint list plus the
+generation-guarded failover (rpc/client.py _maybe_failover) keeps
+clients parked on the first live replica in practice, and even a
+double-promotion only costs a full resync (each "leader" serves
+correct answers from whatever state clients re-send) — never a lost or
+duplicated bind, because binds are committed by the HOST against the
+api server, not by sidecar state. A real multi-writer deployment wants
+an external lease (the k8s Lease pattern); the "replica.takeover"
+fault site is where that guard would veto.
+
+Fault sites (tpusched.faults): "replica.stream" fires at the top of
+every follower poll (error = a failed poll, retried next tick; delay =
+replication lag building); "replica.takeover" fires inside a standby's
+promotion (error = the takeover is refused with UNAVAILABLE — the
+split-brain-attempt guard scenario: the client moves on to the next
+endpoint and retries this one later).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from tpusched.faults import FaultError
+from tpusched.rpc import tpusched_pb2 as pb
+
+# Ops retained before a slow follower is forced onto the full-rebase
+# path. Each delta op is O(churn) bytes and each full op O(cluster);
+# 256 covers minutes of steady-state serving while bounding memory.
+REPLOG_CAP = 256
+
+# Hard byte ceiling on retained payloads (on TOP of the op cap): a
+# big-cluster leader in a full-send-heavy mode (ladder-degraded or
+# resync-storm traffic, multi-MB snapshots) must not hold 256 x O(MB)
+# for followers that may not even exist. Evicting early just moves a
+# lagging follower onto the full-rebase path — the protocol's normal
+# slow-follower answer, not an error.
+REPLOG_MAX_BYTES = 64 << 20
+
+# Follower poll cadence. Replication lag in TIME is ~one poll interval
+# plus apply cost; the chaos/bench fleets override it downward so a
+# kill-the-leader arrives at a caught-up standby.
+POLL_S = 0.2
+
+
+class ReplicationLog:
+    """Bounded, thread-safe op log. The leader appends (minting seqs);
+    a standby mirrors leader ops verbatim (preserving seqs) so that
+    after a takeover its own appends continue the same sequence and a
+    surviving second standby can keep following without a rebase."""
+
+    def __init__(self, cap: int = REPLOG_CAP,
+                 max_bytes: int = REPLOG_MAX_BYTES):
+        self._lock = threading.Lock()
+        self._ops: deque = deque(maxlen=int(cap))
+        self._max_bytes = int(max_bytes)
+        self._bytes = 0        # retained payload bytes
+        self._seq = 0          # newest seq ever seen (minted or mirrored)
+        self.appended = 0      # leader-side appends
+        self.mirrored = 0      # follower-side mirrors
+
+    @property
+    def end_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    @property
+    def first_seq(self) -> int:
+        """Oldest retained seq (0 = empty log)."""
+        with self._lock:
+            return int(self._ops[0].seq) if self._ops else 0
+
+    def _push_locked(self, op: pb.ReplicationOp) -> None:
+        if len(self._ops) == self._ops.maxlen:
+            self._bytes -= len(self._ops[0].payload)  # deque will drop it
+        self._ops.append(op)
+        self._bytes += len(op.payload)
+        # Byte ceiling: retain at least the newest op (a caught-up
+        # follower needs it; one op over budget beats an empty log).
+        while self._bytes > self._max_bytes and len(self._ops) > 1:
+            self._bytes -= len(self._ops.popleft().payload)
+
+    def append(self, kind: str, snapshot_id: str, payload: bytes,
+               base_id: str = "") -> int:
+        with self._lock:
+            self._seq += 1
+            op = pb.ReplicationOp(
+                seq=self._seq, kind=kind, snapshot_id=snapshot_id,
+                base_id=base_id, payload=payload,
+            )
+            self._push_locked(op)
+            self.appended += 1
+            return self._seq
+
+    def mirror(self, op: pb.ReplicationOp) -> None:
+        """Record a leader op on a standby, preserving its seq."""
+        with self._lock:
+            self._seq = max(self._seq, int(op.seq))
+            self._push_locked(op)
+            self.mirrored += 1
+
+    def since(self, from_seq: int, max_ops: int = 64):
+        """(ops, end_seq, stale): retained ops with seq >= from_seq,
+        oldest first, capped at max_ops. stale=True means from_seq
+        predates retention — the caller must serve a full rebase."""
+        from_seq = max(int(from_seq), 1)
+        with self._lock:
+            end = self._seq
+            if from_seq > end + 1:
+                # The caller is AHEAD of this log: it followed a
+                # timeline (the old leader's tail) this replica never
+                # saw, so after a LAGGING standby's promotion the seq
+                # spaces fork. Undetected, the follower would report
+                # lag 0 forever while frozen on dead state; forcing the
+                # rebase path drops the fork and adopts this leader's
+                # newest store, resuming from end_seq + 1.
+                return [], end, True
+            if not self._ops:
+                # Nothing retained. A follower asking for history the
+                # log once held (from_seq <= end) is stale; asking for
+                # the future is simply caught up.
+                return [], end, from_seq <= end
+            if from_seq < int(self._ops[0].seq):
+                return [], end, True
+            out = [op for op in self._ops if int(op.seq) >= from_seq]
+            return out[:max_ops], end, False
+
+
+class StandbyFollower:
+    """The standby's replication loop: poll the leader's Replicate rpc
+    and apply ops into `svc` (a SchedulerService constructed with
+    role="standby"). Owns its client; the thread exits when stopped or
+    when the service is promoted out of "standby" (takeover)."""
+
+    def __init__(self, svc, addresses, poll_s: float = POLL_S,
+                 follower_id: str = "", timeout: float = 10.0):
+        from tpusched.rpc.client import RetryPolicy, SchedulerClient
+
+        self.svc = svc
+        self.poll_s = float(poll_s)
+        self.follower_id = follower_id or f"standby-{id(svc):x}"
+        self.applied_seq = 0     # newest op seq applied locally
+        self.known_end = 0       # leader end_seq at the last good poll
+        self.polls = 0
+        self.failed_polls = 0
+        self.rebase_count = 0
+        self._consec_failures = 0
+        self._stop = threading.Event()
+        # NO_RETRY + explicit failover below: a dead leader must not
+        # burn a backoff ladder inside every poll tick — the loop IS
+        # the retry, and rotating endpoints finds a promoted leader.
+        self._client = SchedulerClient(
+            addresses, timeout=timeout, retry=RetryPolicy(max_attempts=1)
+        )
+        self._thread = threading.Thread(
+            target=self._run, name=f"tpusched-replica-{self.follower_id}",
+            daemon=True,
+        )
+
+    def start(self) -> "StandbyFollower":
+        self._thread.start()
+        return self
+
+    def lag(self) -> int:
+        return max(0, self.known_end - self.applied_seq)
+
+    def _run(self) -> None:
+        import grpc
+
+        while not self._stop.is_set() and self.svc.role == "standby":
+            try:
+                self.svc._faults.fire("replica.stream")
+                with self.svc._trace.span(
+                    "replica.stream", cat="replica",
+                    follower=self.follower_id, from_seq=self.applied_seq + 1,
+                ) as sp:
+                    resp = self._client.replicate(
+                        self.applied_seq + 1, follower_id=self.follower_id
+                    )
+                    self.polls += 1
+                    if resp.resync and resp.ops:
+                        # Fell behind retention: rebase onto the
+                        # leader's newest store, resume from log end.
+                        self.svc.replica_rebase(resp.ops[0])
+                        self.applied_seq = int(resp.end_seq)
+                        self.rebase_count += 1
+                    else:
+                        for op in resp.ops:
+                            if self.svc.role != "standby":
+                                # Promoted mid-batch (a client request
+                                # won the role lock): the remaining
+                                # old-leader ops are refused anyway —
+                                # stop applying, the loop exits next
+                                # time around.
+                                break
+                            try:
+                                self.svc.replica_apply(op)
+                            except Exception:
+                                # A deterministically-bad op (unknown
+                                # kind, corrupt payload) must not wedge
+                                # the stream: skip PAST it — same
+                                # correctness floor as a missing base,
+                                # the failed-over client heals through
+                                # FAILED_PRECONDITION + full resync.
+                                self.svc.replication_skipped += 1
+                                import logging
+                                import traceback
+
+                                logging.getLogger(
+                                    "tpusched.replicate"
+                                ).warning(
+                                    "skipping unappliable replication "
+                                    "op seq=%s kind=%s:\n%s", op.seq,
+                                    op.kind,
+                                    traceback.format_exc(limit=2),
+                                )
+                            self.applied_seq = int(op.seq)
+                    self.known_end = max(int(resp.end_seq),
+                                         self.applied_seq)
+                    sp.attrs.update(ops=len(resp.ops),
+                                    lag=self.lag(), resync=resp.resync)
+                self.svc.replication_lag = self.lag()
+                self._consec_failures = 0
+                if resp.role != "leader" and len(self._client.addresses) > 1:
+                    # A peer STANDBY answered (we rotated onto it during
+                    # a leader blip). Its mirrored log is valid — the
+                    # ops above were applied — but following a follower
+                    # adds a lag hop and its end_seq underreports ours,
+                    # so keep rotating until a leader answers.
+                    self._client.failover()
+            except grpc.RpcError as e:
+                self.failed_polls += 1
+                self._consec_failures += 1
+                # A DEAD or restarting leader answers UNAVAILABLE:
+                # rotate immediately (a promoted standby answers at the
+                # next endpoint). A HUNG one answers DEADLINE_EXCEEDED
+                # (or a crashed handler UNKNOWN) — rotate after a few
+                # consecutive failures of any kind, so a wedged peer
+                # cannot hold the replication stream hostage.
+                if len(self._client.addresses) > 1 and (
+                        e.code() == grpc.StatusCode.UNAVAILABLE
+                        or self._consec_failures >= 3):
+                    self._client.failover()
+                    self._consec_failures = 0
+            except FaultError:
+                # An injected replica.stream shot — the scenario's
+                # deterministic failed poll: count it quietly (plans
+                # fire these every tick) and keep the loop alive;
+                # replication lag is the observable consequence.
+                self.failed_polls += 1
+            except Exception:
+                # A real bug in the poll/apply path must not degrade
+                # into silent, permanent lag: count AND log it.
+                self.failed_polls += 1
+                import logging
+                import traceback
+
+                logging.getLogger("tpusched.replicate").warning(
+                    "replication poll failed (follower %s):\n%s",
+                    self.follower_id, traceback.format_exc(limit=3),
+                )
+            self._stop.wait(self.poll_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=10.0)
+        self._client.close()
+
+
+class ReplicaSet:
+    """An in-process fleet of N sidecar replicas on one host: replica 0
+    leads, replicas 1..N-1 run StandbyFollowers against the ordered
+    endpoint list. The chaos harness, the replicate tests, and the sim
+    driver's replicated gRPC backend all build on this; production
+    deployments run the same roles as separate processes."""
+
+    def __init__(self, n: int = 2, poll_s: float = POLL_S,
+                 follower_timeout: float = 10.0, **make_kw):
+        from tpusched.rpc.server import make_server
+
+        if n < 1:
+            raise ValueError(f"replica count must be >= 1, got {n}")
+        self._make_kw = dict(make_kw)
+        self._poll_s = poll_s
+        self._follower_timeout = follower_timeout
+        self.servers: list = []
+        self.ports: list[int] = []
+        self.services: list = []
+        self.followers: list = [None] * n
+        for i in range(n):
+            server, port, svc = make_server(
+                "127.0.0.1:0", role="leader" if i == 0 else "standby",
+                **make_kw,
+            )
+            server.start()
+            self.servers.append(server)
+            self.ports.append(port)
+            self.services.append(svc)
+        for i in range(1, n):
+            self.followers[i] = StandbyFollower(
+                self.services[i], self._peer_addresses(i),
+                poll_s=poll_s, follower_id=f"replica-{i}",
+                timeout=follower_timeout,
+            ).start()
+        self._dead: set[int] = set()
+
+    def _peer_addresses(self, i: int) -> list[str]:
+        """Every replica's address except i's own, leader-most first."""
+        return [f"127.0.0.1:{p}" for j, p in enumerate(self.ports)
+                if j != i]
+
+    def addresses(self) -> list[str]:
+        """Client-facing ordered endpoint list (replica 0 first)."""
+        return [f"127.0.0.1:{p}" for p in self.ports]
+
+    def leader_index(self) -> int:
+        """The live replica currently reporting role=leader (first
+        match in replica order; -1 if none — mid-failover window)."""
+        for i, svc in enumerate(self.services):
+            if i not in self._dead and svc.role == "leader":
+                return i
+        return -1
+
+    def wait_caught_up(self, timeout: float = 10.0) -> bool:
+        """Block until every live standby's applied seq reaches the
+        current leader's log end (True) or timeout (False). Chaos runs
+        call this before a kill so 'warm standby' is a property the
+        harness controls, not a race it hopes to win."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            li = self.leader_index()
+            if li < 0:
+                return False
+            end = self.services[li]._replog.end_seq
+            lagging = [
+                f for i, f in enumerate(self.followers)
+                if f is not None and i not in self._dead
+                and self.services[i].role == "standby"
+                and f.applied_seq < end
+            ]
+            if not lagging:
+                return True
+            time.sleep(min(self._poll_s / 2, 0.05))
+        return False
+
+    def kill(self, i: int) -> None:
+        """Stop replica i's server + service (its follower too). The
+        port is remembered so restart() can resurrect it in place."""
+        if i in self._dead:
+            return
+        self._dead.add(i)
+        if self.followers[i] is not None:
+            self.followers[i].stop()
+            self.followers[i] = None
+        self.servers[i].stop(0)
+        self.services[i].close()
+
+    def kill_leader(self) -> int:
+        """The canonical fault: kill the current leader; returns its
+        index (-1 when no live leader exists)."""
+        li = self.leader_index()
+        if li >= 0:
+            self.kill(li)
+        return li
+
+    def restart(self, i: int, role: str = "standby") -> None:
+        """Resurrect a killed replica on its original port — as a
+        STANDBY by default: a crashed ex-leader rejoins the fleet
+        following whoever leads now, it does not reclaim leadership."""
+        from tpusched.rpc.server import make_server
+
+        if i not in self._dead:
+            raise RuntimeError(f"replica {i} is not dead")
+        server, port, svc = make_server(
+            f"127.0.0.1:{self.ports[i]}", role=role, **self._make_kw
+        )
+        if port != self.ports[i]:
+            raise RuntimeError(f"could not rebind port {self.ports[i]}")
+        server.start()
+        self.servers[i] = server
+        self.services[i] = svc
+        self._dead.discard(i)
+        if role == "standby":
+            self.followers[i] = StandbyFollower(
+                svc, self._peer_addresses(i), poll_s=self._poll_s,
+                follower_id=f"replica-{i}", timeout=self._follower_timeout,
+            ).start()
+
+    def takeovers(self) -> int:
+        return sum(svc.takeovers for svc in self.services)
+
+    def close(self) -> None:
+        for i in range(len(self.servers)):
+            self.kill(i)

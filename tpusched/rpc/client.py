@@ -21,12 +21,21 @@ taxonomy documented in rpc/server.py):
 Retry-safety: every delta is stamped with (lineage_id, seq); a retry
 whose first attempt was applied-but-unacked is deduped server-side and
 the cached response replayed (SnapshotDelta proto comment).
+
+Replica failover (round 11, ISSUE 6): SchedulerClient accepts an
+ORDERED endpoint list; UNAVAILABLE rotates to the next replica before
+the retry re-sends (both the blocking _call loop and the pipelines'
+_join_entry re-issues). A warm standby answers the retried delta from
+its replicated stores under the same snapshot_ids; a cold one answers
+FAILED_PRECONDITION and the resync machinery above takes over — so
+failover composes with, rather than replaces, the ISSUE 3 contract.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import random
+import threading
 import time
 import uuid
 
@@ -82,6 +91,27 @@ class RetryPolicy:
 
 # Retries disabled: surface the first error (tests pin exact statuses).
 NO_RETRY = RetryPolicy(max_attempts=1)
+
+
+class _MethodRef:
+    """Stable handle for one rpc method that resolves the CURRENT
+    channel's stub at call time, so a failover mid-retry-loop (the
+    channel and its stubs are rebuilt) transparently redirects every
+    holder — retry loops, pipelines re-issuing futures — without them
+    re-reading client attributes."""
+
+    __slots__ = ("_client", "_name")
+
+    def __init__(self, client: "SchedulerClient", name: str):
+        self._client = client
+        self._name = name
+
+    def __call__(self, request, timeout=None):
+        return self._client._stubs[self._name](request, timeout=timeout)
+
+    def future(self, request, timeout=None):
+        return self._client._stubs[self._name].future(
+            request, timeout=timeout)
 
 
 def score_response_arrays(resp: pb.ScoreResponse):
@@ -150,10 +180,19 @@ def assign_response_arrays(resp: pb.AssignResponse):
 
 
 class SchedulerClient:
-    def __init__(self, address: str, timeout: float = 120.0,
+    def __init__(self, address, timeout: float = 120.0,
                  retry: RetryPolicy | None = None,
                  retry_seed: int | None = None):
-        """timeout: per-RPC deadline budget (seconds) — retries spend
+        """address: one endpoint, or an ORDERED list of replica
+        endpoints (round 11, ISSUE 6) — the client talks to the first
+        and FAILS OVER to the next on UNAVAILABLE (a dead/restarting
+        sidecar), wrapping around; the promoted standby serves the
+        failed-over client's deltas from its replicated stores.
+        RESOURCE_EXHAUSTED deliberately does NOT rotate: an overloaded
+        leader is alive, and stampeding its standby would promote it
+        into a split brain.
+
+        timeout: per-RPC deadline budget (seconds) — retries spend
         the SAME budget, they don't extend it. retry: RetryPolicy for
         RETRYABLE statuses (None = defaults; pass NO_RETRY to surface
         first errors). retry_seed pins the backoff jitter for
@@ -161,32 +200,111 @@ class SchedulerClient:
         self.timeout = timeout
         self.retry = retry if retry is not None else RetryPolicy()
         self.retries = 0          # observability: attempts beyond the first
+        self.failovers = 0        # endpoint rotations (UNAVAILABLE)
         self._retry_rng = random.Random(retry_seed)
         # Trace stitching (round 9, ISSUE 4): every Score/Assign request
         # is stamped with a trace id (request_id) + the caller's active
         # span (parent_span); the sidecar roots its stage spans there,
         # so the client and server rings merge into one causal trace.
         self.tracer = tracing.DEFAULT
+        self.addresses = ([address] if isinstance(address, str)
+                          else list(address))
+        if not self.addresses:
+            raise ValueError("SchedulerClient needs at least one address")
+        self._endpoint_idx = 0
+        self._channel = None
+        self._stubs: dict = {}
+        self._parked: list = []   # pre-failover channels, closed in close()
+        # Endpoint GENERATION: bumped on every failover. Callers capture
+        # it at issue time and pass it to _maybe_failover so a failure
+        # observed on an already-abandoned channel (a pipeline sibling
+        # future issued pre-rotation) cannot rotate the client BACK onto
+        # the dead endpoint it just left.
+        self._gen = 0
+        self._failover_lock = threading.Lock()
+        self._connect()
+        self._score = _MethodRef(self, "ScoreBatch")
+        self._assign = _MethodRef(self, "Assign")
+        self._health = _MethodRef(self, "Health")
+        self._metrics = _MethodRef(self, "Metrics")
+        self._debugz = _MethodRef(self, "Debugz")
+        self._replicate = _MethodRef(self, "Replicate")
+
+    _RPCS = (
+        ("ScoreBatch", pb.ScoreRequest, pb.ScoreResponse),
+        ("Assign", pb.AssignRequest, pb.AssignResponse),
+        ("Health", pb.HealthRequest, pb.HealthResponse),
+        ("Metrics", pb.MetricsRequest, pb.MetricsResponse),
+        ("Debugz", pb.DebugzRequest, pb.DebugzResponse),
+        ("Replicate", pb.ReplicateRequest, pb.ReplicateResponse),
+    )
+
+    def _connect(self) -> None:
+        """(Re)build the channel + raw stubs against the current
+        endpoint; the _MethodRef handles callers hold resolve through
+        self._stubs, so they all pick up the new channel."""
         self._channel = grpc.insecure_channel(
-            address,
+            self.addresses[self._endpoint_idx],
             options=[
                 ("grpc.max_receive_message_length", -1),
                 ("grpc.max_send_message_length", -1),
             ],
         )
-
-        def method(name, req_cls, resp_cls):
-            return self._channel.unary_unary(
+        self._stubs = {
+            name: self._channel.unary_unary(
                 f"/{SERVICE}/{name}",
                 request_serializer=req_cls.SerializeToString,
                 response_deserializer=resp_cls.FromString,
             )
+            for name, req_cls, resp_cls in self._RPCS
+        }
 
-        self._score = method("ScoreBatch", pb.ScoreRequest, pb.ScoreResponse)
-        self._assign = method("Assign", pb.AssignRequest, pb.AssignResponse)
-        self._health = method("Health", pb.HealthRequest, pb.HealthResponse)
-        self._metrics = method("Metrics", pb.MetricsRequest, pb.MetricsResponse)
-        self._debugz = method("Debugz", pb.DebugzRequest, pb.DebugzResponse)
+    def endpoint(self) -> str:
+        """The endpoint this client currently targets."""
+        return self.addresses[self._endpoint_idx]
+
+    def failover(self) -> str:
+        """Rotate to the next endpoint in the ordered list (wrapping)
+        and rebuild the channel; returns the new endpoint. The old
+        channel is NOT closed here — closing would CANCEL a pipeline's
+        other in-flight futures (fatal), where letting them fail
+        against the dead server yields UNAVAILABLE (retryable, and the
+        retry re-issues on the new channel). Parked channels are closed
+        by close()."""
+        self._parked.append(self._channel)
+        # Bound the park lot: a long-lived client on a flapping fleet
+        # must not accumulate channels forever. Only the last few
+        # generations can still carry live in-flight futures (pipeline
+        # joins are FIFO and re-issue promptly on the current channel);
+        # closing the oldest beyond that is safe.
+        while len(self._parked) > 8:
+            self._parked.pop(0).close()
+        self._endpoint_idx = (self._endpoint_idx + 1) % len(self.addresses)
+        self._gen += 1
+        self._connect()
+        self.failovers += 1
+        self.tracer.record("client.failover", cat="client",
+                           to=self.endpoint())
+        return self.endpoint()
+
+    def _maybe_failover(self, code, gen: int | None = None) -> bool:
+        """Failover trigger (round 11): UNAVAILABLE means the endpoint
+        is dead or restarting — with more than one endpoint configured,
+        rotate before the retry re-sends. Other retryable statuses stay
+        put (see __init__).
+
+        gen: the endpoint generation captured when the failed call was
+        ISSUED. If another failure already rotated us off that endpoint
+        (gen is stale), stay put — rotating again would point the
+        client back at the dead replica and burn retry attempts
+        ping-ponging between the corpse and the live standby."""
+        if code != grpc.StatusCode.UNAVAILABLE or len(self.addresses) < 2:
+            return False
+        with self._failover_lock:
+            if gen is not None and gen != self._gen:
+                return False
+            self.failover()
+        return True
 
     def _stamp(self, request, request_id: str = "") -> str:
         """Stamp a Score/Assign request with its trace identity; keeps
@@ -226,6 +344,7 @@ class SchedulerClient:
         attempt = 0
         while True:
             remaining = deadline - time.monotonic()
+            gen = self._gen
             try:
                 if not rid:
                     return method(request, timeout=max(remaining, 1e-3))
@@ -243,6 +362,10 @@ class SchedulerClient:
                 if deadline - time.monotonic() <= delay:
                     raise
                 self.retries += 1
+                # Replica failover (round 11): a dead endpoint rotates
+                # BEFORE the backoff, so the retry re-sends against the
+                # next replica in the ordered list.
+                self._maybe_failover(e.code(), gen)
                 time.sleep(delay)
                 if rid:
                     # The backoff wait, as a span: retries are visible
@@ -255,6 +378,16 @@ class SchedulerClient:
 
     def health(self) -> pb.HealthResponse:
         return self._call(self._health, pb.HealthRequest())
+
+    def replicate(self, from_seq: int,
+                  follower_id: str = "") -> pb.ReplicateResponse:
+        """Fetch replication ops from the current endpoint (round 11;
+        StandbyFollower's poll — see tpusched/replicate.py)."""
+        return self._call(
+            self._replicate,
+            pb.ReplicateRequest(from_seq=int(from_seq),
+                                follower_id=follower_id),
+        )
 
     def score_batch(self, snapshot: pb.ClusterSnapshot, *,
                     packed_ok: bool = False,
@@ -358,6 +491,9 @@ class SchedulerClient:
 
     def close(self):
         self._channel.close()
+        for ch in self._parked:
+            ch.close()
+        self._parked = []
 
     def __enter__(self):
         return self
@@ -616,6 +752,14 @@ class _BasePipeline:
                 if code in policy.codes and attempt < policy.max_attempts - 1:
                     delay = policy.backoff_s(attempt, self.client._retry_rng)
                     if deadline - time.monotonic() > delay:
+                        # Same failover trigger as _call: rotate off a
+                        # dead endpoint, then re-issue the SAME delta
+                        # (same lineage/seq) against the new replica —
+                        # its replicated stores hold the pinned base.
+                        # The entry's issue-time generation keeps a
+                        # SIBLING future's failure (issued pre-rotation
+                        # on the dead channel) from rotating us back.
+                        self.client._maybe_failover(code, entry.get("gen"))
                         time.sleep(delay)
                         attempt += 1
                         self.retried += 1
@@ -628,6 +772,7 @@ class _BasePipeline:
                         entry["fut"] = self._send_delta_future(
                             entry["delta"], entry["packed_ok"], rid
                         )
+                        entry["gen"] = self.client._gen
                         continue
                 if code in RESYNC_CODES:
                     return self._resync_entry(entry, e)
@@ -707,6 +852,7 @@ class _BasePipeline:
         self._inflight.append(dict(
             fut=self._send_delta_future(delta, packed_ok, rid),
             delta=delta, packed_ok=packed_ok, rid=rid,
+            gen=self.client._gen,
         ))
         self.delta_sends += 1
         done = []

@@ -78,6 +78,23 @@ the ladder promotes one rung on probation — one failure at the
 restored rung demotes immediately, a success keeps it. Health reports
 the rung and counters; Metrics exports them.
 
+Round 11 (ISSUE 6) makes the sidecar a FLEET MEMBER instead of a
+single point of failure. Every store registration (full send or delta)
+is appended to a ReplicationLog (tpusched/replicate.py) as an op that
+carries the SAME snapshot_id handed to the client; the Replicate rpc
+serves that log to standby replicas, whose StandbyFollower applies the
+ops into their own byte stores (and warms DeviceSessions), so a
+failed-over client's delta against a leader-era base_id resolves on
+the standby without a resync storm. Roles: a "leader" serves and
+appends; a "standby" follows and serves only Health/Metrics/Debugz/
+Replicate until its first Assign/ScoreBatch arrives — which PROMOTES
+it (takeover: one trace event + flight dump carrying the hand-off
+causal chain; the "replica.takeover" fault site can refuse it with
+UNAVAILABLE — the split-brain-attempt guard scenario). Replication is
+async: an op in flight when the leader dies is lost SAFELY — the
+failed-over client gets FAILED_PRECONDITION and the ISSUE 3 resync
+machinery re-sends the full snapshot.
+
 Round 9 (ISSUE 4) makes the whole pipeline OBSERVABLE:
 
   * every handler roots a trace (tpusched.trace) at the request's
@@ -642,6 +659,8 @@ class SchedulerService:
         ladder: DegradationLadder | None = None,
         tracer: "tracing.TraceCollector | None" = None,
         flight: FlightRecorder | None = None,
+        role: str = "leader",
+        replication_log: "ReplicationLog | None" = None,
     ):
         """audit_stream: optional file-like; when set, every Assign
         emits one JSON record PER POD (pod, node, score, commit_key —
@@ -665,7 +684,13 @@ class SchedulerService:
 
         tracer: span collector (default: the process-wide
         tpusched.trace.DEFAULT, so in-process clients and the sidecar
-        share one stitched ring). flight: injectable FlightRecorder."""
+        share one stitched ring). flight: injectable FlightRecorder.
+
+        role: "leader" (serves + appends every store registration to
+        its replication log) or "standby" (follows a leader's log via
+        StandbyFollower; the first Assign/ScoreBatch promotes it —
+        module docstring, round 11). replication_log: injectable
+        ReplicationLog (tests pin capacity to force the rebase path)."""
         from tpusched.faults import NO_FAULTS
 
         self.config = config or EngineConfig()
@@ -693,6 +718,16 @@ class SchedulerService:
         self._store_lock = threading.Lock()
         self._stores: dict[str, SnapshotStore] = {}  # LRU by insertion
         self._next_store = 0
+        # Mint EPOCH (round 11): sids carry a per-instance nonce so a
+        # promoted standby's own mints can NEVER alias a sid the dead
+        # leader handed a client inside the async-replication loss
+        # window — an aliased base would silently resolve a failed-over
+        # delta against the wrong bytes instead of triggering the
+        # FAILED_PRECONDITION -> resync heal path.
+        import uuid as _uuid
+
+        self._mint_nonce = _uuid.uuid4().hex[:8]
+        self._last_minted: str | None = None  # newest REGISTERED sid
         # Dispatch admission (round 7, replaces the `_dispatch_lane`
         # mutex): handlers still decode OUTSIDE the serialized section
         # and build responses while the engine's ordered fetch worker
@@ -739,15 +774,200 @@ class SchedulerService:
         self.flight = flight if flight is not None else FlightRecorder()
         self._resync_storm = StormDetector(n=4, window_s=5.0)
         self._closed = False
+        # Replication (round 11, ISSUE 6): role, the op log, and the
+        # takeover/lag surface Health + Metrics export. Appending is
+        # unconditional — a standby promoted to leader keeps the same
+        # log, whose mirrored ops already carry the old leader's seqs,
+        # so a surviving second standby re-follows without a rebase.
+        if role not in ("leader", "standby"):
+            raise ValueError(f"role={role!r}: want leader|standby")
+        # Imported here, not at module top: replicate.py speaks the
+        # same pb module, and the rpc package init imports this file.
+        from tpusched.replicate import ReplicationLog
 
-    def _register_store(self, store: SnapshotStore) -> str:
+        self.role = role
+        self._role_lock = threading.Lock()
+        self._replog = (replication_log if replication_log is not None
+                        else ReplicationLog())
+        self.takeovers = 0
+        self.replication_lag = 0      # updated by StandbyFollower
+        self.replication_applied = 0  # ops applied as a standby
+        self.replication_skipped = 0  # delta ops whose base was gone
+
+    def _store_put_locked(self, sid: str, store: SnapshotStore) -> None:
+        """Insert + evict under _store_lock (caller holds it). The ONE
+        place retention policy lives: the leader's mint path and the
+        replication apply path must evict identically or leader/standby
+        store retention drifts and the byte-identity contract breaks."""
+        self._stores.pop(sid, None)
+        self._stores[sid] = store
+        self._last_minted = sid
+        while len(self._stores) > STORE_CAP:
+            self._stores.pop(next(iter(self._stores)))
+
+    def _register_store(self, store: SnapshotStore, op_kind: str = "",
+                        payload: bytes = b"", base_id: str = "") -> str:
+        """Mint + register; when op_kind is set, the replication-log
+        append happens INSIDE the same critical section — op order must
+        equal registration order, or the standby's replayed insertion
+        (= eviction) order diverges from the leader's under concurrent
+        handlers and the two replicas evict different stores."""
         with self._store_lock:
-            sid = f"snap-{self._next_store}"
+            sid = f"snap-{self._mint_nonce}-{self._next_store}"
             self._next_store += 1
-            self._stores[sid] = store
-            while len(self._stores) > STORE_CAP:
-                self._stores.pop(next(iter(self._stores)))
+            self._store_put_locked(sid, store)
+            if op_kind:
+                self._replog.append(op_kind, sid, payload,
+                                    base_id=base_id)
         return sid
+
+    def _register_store_as(self, sid: str, store: SnapshotStore) -> None:
+        """Register under a LEADER-minted snapshot_id (replication
+        apply path). No mint-collision handling needed: local mints
+        carry this instance's nonce, so a replicated (other-nonce) sid
+        can never alias one we hand out post-takeover."""
+        with self._store_lock:
+            self._store_put_locked(sid, store)
+
+    # -- replication (round 11) ---------------------------------------------
+
+    def replica_apply(self, op: "pb.ReplicationOp") -> bool:
+        """Apply one leader op on a standby: register the op's store
+        under the leader's snapshot_id, warm the device session for
+        delta lineages, and mirror the op into our own log. Returns
+        False (skipped) for a delta op whose base this replica no
+        longer holds — safe: the failed-over client heals through
+        FAILED_PRECONDITION + full-snapshot resync.
+
+        Runs under _role_lock with a role RE-CHECK: a takeover promotes
+        under the same lock, so an apply in flight when a client's
+        request promotes us finishes first and every later op is
+        refused — an old-leader op delivered post-promotion can never
+        overwrite a store the new leader registered. The O(cluster)
+        device-session warm-up runs OUTSIDE the lock: a failed-over
+        client's promoting request must not wait behind a session
+        seed/compile (promotion latency IS failover recovery time;
+        warmth is only an optimization)."""
+        with self._role_lock:
+            if self.role != "standby":
+                return False
+            applied, warm = self._replica_apply_locked(op)
+        if warm is not None:
+            self._replica_warm_session(*warm)
+        return applied
+
+    def _replica_apply_locked(self, op: "pb.ReplicationOp"):
+        """(applied, warm-args-or-None); caller holds _role_lock."""
+        with self._trace.span("replica.apply", cat="replica",
+                              kind=op.kind, sid=op.snapshot_id) as sp:
+            if op.kind == "full":
+                msg = pb.ClusterSnapshot.FromString(op.payload)
+                store = SnapshotStore()
+                store.set_full_bytes(msg)
+                self._register_store_as(op.snapshot_id, store)
+                warm = None
+            elif op.kind == "delta":
+                with self._store_lock:
+                    base = self._stores.get(op.base_id)
+                    if base is not None:
+                        # Mirror the serving path's true-LRU hit-touch
+                        # of the delta's base: without it, leader and
+                        # standby eviction orders diverge past
+                        # STORE_CAP and the standby drops exactly the
+                        # hot bases a failed-over client will name.
+                        self._stores.pop(op.base_id)
+                        self._stores[op.base_id] = base
+                if base is None:
+                    self.replication_skipped += 1
+                    self._replog.mirror(op)
+                    sp.attrs["skipped"] = True
+                    return False, None
+                delta = pb.SnapshotDelta.FromString(op.payload)
+                store = base.copy()
+                store.apply_delta(delta)
+                self._register_store_as(op.snapshot_id, store)
+                warm = (op.base_id, delta, op.snapshot_id, base)
+            else:
+                raise ValueError(f"unknown replication op kind {op.kind!r}")
+            self._replog.mirror(op)
+            self.replication_applied += 1
+            return True, warm
+
+    def replica_rebase(self, op: "pb.ReplicationOp") -> None:
+        """Full rebase after falling behind log retention: drop every
+        store and session (they chain from history we no longer have)
+        and start over from the leader's newest store. Same _role_lock
+        discipline as replica_apply — a post-promotion rebase must not
+        wipe the new leader's stores."""
+        with self._role_lock:
+            if self.role != "standby":
+                return
+            with self._store_lock:
+                self._stores.clear()
+                self._sessions.clear()
+            self._replica_apply_locked(op)  # "full" op: no warm-up args
+
+    def _replica_warm_session(self, base_id: str, delta, sid: str,
+                              base: SnapshotStore) -> None:
+        """Best-effort device-session warm-up on the standby, mirroring
+        the leader's lazy-seed-then-apply discipline so a takeover
+        starts with the lineage's cluster already ON device. Failures
+        drop the warm state silently — the post-takeover decode path is
+        always the correctness floor, and a standby must not burn
+        ladder demerits for an optimization."""
+        if self._session_cap <= 0 or self._ladder.level() != "delta":
+            return
+        session = None
+        try:
+            with self._store_lock:
+                session = self._sessions.get(base_id)
+            if session is None:
+                with self._trace.span("session.seed", cat="replica",
+                                      base_id=base_id):
+                    session = DeviceSession.from_base_store(
+                        base, base_id, self.config, self.buckets
+                    )
+                    session.device.tracer = self._trace
+                self.session_seeds += 1
+            with session.lock:
+                session.apply_delta(base_id, delta, sid)
+            self._session_put(session)
+        except Exception:
+            import logging
+            import traceback
+
+            logging.getLogger("tpusched.rpc.server").warning(
+                "standby session warm-up failed; takeover will serve "
+                "via decode:\n%s", traceback.format_exc(limit=3),
+            )
+            if session is not None:
+                self._drop_session(session)
+
+    def _maybe_takeover(self, rpc: str) -> None:
+        """First serving request on a standby: promote to leader. The
+        'replica.takeover' fault site can refuse it (split-brain-
+        attempt guard) — the caller sees UNAVAILABLE and fails over to
+        the next endpoint. The promotion is the failover event, so it
+        snapshots the trace ring: the flight dump carries the hand-off
+        causal chain (last replication polls + the promoting request)."""
+        with self._role_lock:
+            if self.role != "standby":
+                return
+            try:
+                self._faults.fire("replica.takeover")
+            except FaultError as e:
+                raise _Abort(
+                    grpc.StatusCode.UNAVAILABLE,
+                    f"standby refused takeover (split-brain guard): {e}",
+                ) from e
+            self.role = "leader"
+            self.takeovers += 1
+            lag = self.replication_lag
+            self.replication_lag = 0
+        self._trace.record("replica.takeover", cat="replica", rpc=rpc,
+                           lag_at_takeover=lag)
+        self.flight.record("replica_takeover", self._trace,
+                           rpc=rpc, lag_at_takeover=lag)
 
     @staticmethod
     def _check_delta_upserts(delta) -> None:
@@ -970,7 +1190,13 @@ class SchedulerService:
                 )
             store = base.copy()
             store.apply_delta(request.delta)
-            sid = self._register_store(store)
+            # Replication op (round 11): ship the delta verbatim; the
+            # standby re-applies it against its own copy of base_id and
+            # registers the result under this very sid.
+            sid = self._register_store(
+                store, "delta", request.delta.SerializeToString(),
+                base_id=base_id,
+            )
             t0 = time.perf_counter()
             seeding = False
             session = None
@@ -1091,7 +1317,7 @@ class SchedulerService:
         # later delta cycle serializes only its churn (apply_delta) and
         # composes by concatenation.
         store.set_full_bytes(msg)
-        sid = self._register_store(store)
+        sid = self._register_store(store, "full", msg.SerializeToString())
         snap, meta, decode_s = self._decode(msg)
         return snap, meta, sid, decode_s, None
 
@@ -1202,6 +1428,9 @@ class SchedulerService:
                 self.metrics.count_request(rpc, "OK")
                 return replay
             try:
+                # A serving request reaching a standby IS the failover
+                # signal: promote (or refuse — split-brain guard site).
+                self._maybe_takeover(rpc)
                 resp = inner(request, context)
             except _Abort as e:
                 self._count_abort(rpc, e.code, root)
@@ -1486,7 +1715,8 @@ class SchedulerService:
     def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
         """Liveness + the failure-domain surface a sidecar watchdog
         (liveness probe, chaos harness, operator) reads: which ladder
-        rung is serving and the trip/demotion/recovery/replay counters."""
+        rung is serving, the trip/demotion/recovery/replay counters,
+        and (round 11) the replication role / lag / takeover count."""
         import jax
 
         lad = self._ladder.snapshot()
@@ -1498,7 +1728,41 @@ class SchedulerService:
             ladder_demotions=lad["demotions"],
             ladder_recoveries=lad["recoveries"],
             replayed_requests=self.replayed_requests,
+            role=self.role,
+            replication_lag_seq=self.replication_lag,
+            takeovers=self.takeovers,
         )
+
+    def Replicate(self, request: pb.ReplicateRequest,
+                  context) -> pb.ReplicateResponse:
+        """Serve the op log to a follower (round 11). A from_seq that
+        predates retention gets resync=true + ONE full-rebase op built
+        from the newest registered store (the follower drops its state
+        and resumes from end_seq + 1); a caught-up follower gets an
+        empty ops list and the current end_seq as its lag reference."""
+        ops, end, stale = self._replog.since(int(request.from_seq))
+        resp = pb.ReplicateResponse(end_seq=end, resync=stale,
+                                    role=self.role)
+        if stale:
+            with self._store_lock:
+                # Newest REGISTERED store — not dict order: the delta
+                # serving path's true-LRU hit-touch moves old bases to
+                # the end of _stores, and a rebase op built from one of
+                # those but stamped seq=end would leave the follower
+                # "caught up" on stale state.
+                newest = (self._last_minted
+                          if self._last_minted in self._stores
+                          else next(reversed(self._stores), None))
+                store = self._stores.get(newest) if newest else None
+            if store is not None:
+                op = resp.ops.add()
+                op.seq = end
+                op.kind = "full"
+                op.snapshot_id = newest
+                op.payload = store.compose_bytes()
+        else:
+            resp.ops.extend(ops)
+        return resp
 
     def Metrics(self, request: pb.MetricsRequest, context) -> pb.MetricsResponse:
         lad = self._ladder.snapshot()
@@ -1536,6 +1800,22 @@ class SchedulerService:
             f"{self._coalescer.fused_requests}",
             "# TYPE scheduler_flight_dumps_total counter",
             f"scheduler_flight_dumps_total {self.flight.trips}",
+            # Replication surface (round 11, ISSUE 6): role as a
+            # labeled gauge (value 1 on the current role), lag in ops,
+            # takeovers, and the op-log flow counters.
+            "# TYPE scheduler_replica_role gauge",
+            f'scheduler_replica_role{{role="{self.role}"}} 1',
+            "# TYPE scheduler_replication_lag_seq gauge",
+            f"scheduler_replication_lag_seq {self.replication_lag}",
+            "# TYPE scheduler_replica_takeovers_total counter",
+            f"scheduler_replica_takeovers_total {self.takeovers}",
+            "# TYPE scheduler_replication_ops_total counter",
+            f'scheduler_replication_ops_total{{op="appended"}} '
+            f"{self._replog.appended}",
+            f'scheduler_replication_ops_total{{op="applied"}} '
+            f"{self.replication_applied}",
+            f'scheduler_replication_ops_total{{op="skipped"}} '
+            f"{self.replication_skipped}",
         ]
         return pb.MetricsResponse(
             prometheus_text=self.metrics.render() + "\n".join(extra) + "\n"
@@ -1576,6 +1856,8 @@ def make_server(
     ladder: DegradationLadder | None = None,
     tracer=None,
     flight: FlightRecorder | None = None,
+    role: str = "leader",
+    replication_log: "ReplicationLog | None" = None,
 ):
     """Build (grpc.Server, bound_port, service). Unlimited message size:
     a 10k-pod snapshot exceeds the 4 MB default. max_workers default 8:
@@ -1584,12 +1866,15 @@ def make_server(
     serialization point. Call svc.close() after server.stop() to drain
     the engine's fetch worker and drop device-resident sessions.
     faults/watchdog_s/ladder: failure-domain knobs; tracer/flight:
-    observability knobs (SchedulerService)."""
+    observability knobs; role/replication_log: fleet knobs
+    (SchedulerService; tpusched/replicate.py ReplicaSet wires a
+    standby's follower loop)."""
     svc = SchedulerService(config, buckets, log_stream=log_stream,
                            audit_stream=audit_stream,
                            device_sessions=device_sessions,
                            faults=faults, watchdog_s=watchdog_s,
-                           ladder=ladder, tracer=tracer, flight=flight)
+                           ladder=ladder, tracer=tracer, flight=flight,
+                           role=role, replication_log=replication_log)
 
     def handler(fn, req_cls):
         return grpc.unary_unary_rpc_method_handler(
@@ -1604,6 +1889,7 @@ def make_server(
         "Health": handler(svc.Health, pb.HealthRequest),
         "Metrics": handler(svc.Metrics, pb.MetricsRequest),
         "Debugz": handler(svc.Debugz, pb.DebugzRequest),
+        "Replicate": handler(svc.Replicate, pb.ReplicateRequest),
     }
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),

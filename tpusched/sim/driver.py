@@ -365,12 +365,18 @@ def run_scenario(
     engine=None,
     faults=None,
     tracer=None,
+    replicas: int = 1,
 ) -> SimResult:
     """One sim run. backend="grpc" spins an in-process sidecar and
     drives the full host -> gRPC path (AssignPipeline transport);
     "inprocess" solves through a local Engine (pass `engine` to share
-    one jit cache across runs of the SAME config)."""
+    one jit cache across runs of the SAME config). replicas > 1 (grpc
+    only) serves from a tpusched.replicate.ReplicaSet — warm-standby
+    replication behind the same pipeline transport, so long simulated
+    horizons ride the failover machinery the chaos harness pins."""
     if backend == "inprocess":
+        if replicas != 1:
+            raise ValueError("replicas > 1 needs backend='grpc'")
         return SimDriver(scenario, seed, config=config, sim=sim,
                          engine=engine, faults=faults, tracer=tracer).run()
     if backend != "grpc":
@@ -379,6 +385,17 @@ def run_scenario(
     from tpusched.rpc.server import make_server
 
     cfg = effective_config(scenario, config)
+    if replicas > 1:
+        from tpusched.replicate import ReplicaSet
+
+        fleet = ReplicaSet(replicas, config=cfg, faults=faults)
+        client = SchedulerClient(fleet.addresses())
+        try:
+            return SimDriver(scenario, seed, config=cfg, sim=sim,
+                             client=client, tracer=tracer).run()
+        finally:
+            client.close()
+            fleet.close()
     server, port, svc = make_server("127.0.0.1:0", config=cfg,
                                     faults=faults)
     server.start()
