@@ -371,6 +371,94 @@ def bench_preemption(args):
                              and mode == "fast"))
 
 
+def bench_explain(args):
+    """Decision provenance overhead (round 12, ISSUE 8 acceptance).
+    explain=off is the SAME serving program as before — one boolean
+    check per Assign — so the regular serving/headline benches are the
+    off-arm evidence that disabled provenance costs nothing. Here the
+    ON-arm is priced: (1) engine-level, explained solve (solve program
+    with observer arrays + the score/filter probe, two fetches) vs the
+    plain solve on a preemption cluster; (2) wire-level, one Assign
+    off vs on including record building."""
+    from tpusched import Engine, EngineConfig
+    from tpusched.synth import config5_preemption
+
+    pods = min(args.pods, 2000)
+    nodes = min(args.nodes, 1000)
+    rng = np.random.default_rng(45)
+    snap, _ = _build(config5_preemption, rng, n_pods=pods, n_nodes=nodes)
+    cfg = EngineConfig(mode="fast", preemption=True)
+    engine = Engine(cfg)
+    log(f"[explain] solve@{pods}x{nodes} plain vs explained (fast)")
+    fn_off = _prep(engine, snap, "solve")
+    iters = max(10, args.iters // 10)
+    stats_off = bench_fn(fn_off, iters, label="explain-off")
+    dsnap = engine.put(snap)
+
+    def fn_on():
+        p_solve, p_probe = engine.solve_explained_async(dsnap, k=3)
+        p_solve.result()
+        p_probe.result()
+        return ()
+
+    t0 = time.perf_counter()
+    fn_on()
+    log(f"  explained compile+first-run {time.perf_counter() - t0:.1f}s")
+    stats_on = bench_fn(fn_on, iters, label="explain-on")
+    overhead = (stats_on["p50"] - stats_off["p50"]) / max(
+        stats_off["p50"], 1e-9)
+    emit(f"solve_explained_p99_latency_{pods}x{nodes}_fast", stats_on,
+         {"mode": "fast",
+          "explain_overhead_frac_p50": round(overhead, 4),
+          "plain_p50_ms": round(stats_off["p50"] * 1e3, 3)})
+    log(f"  explain overhead p50: {overhead * 100:.1f}% "
+        f"(plain {stats_off['p50'] * 1e3:.1f}ms -> explained "
+        f"{stats_on['p50'] * 1e3:.1f}ms)")
+    engine.close()
+
+    # Wire arm: the full Assign path incl. record building + counters.
+    from tpusched.rpc.client import SchedulerClient
+    from tpusched.rpc.codec import snapshot_to_proto
+    from tpusched.rpc.server import make_server
+
+    wn, wp, wm = 64, 256, 128
+    rngw = np.random.default_rng(7)
+    nodes_r = [dict(name=f"n{j}",
+                    allocatable={"cpu": 8000.0,
+                                 "memory": float(32 << 30)})
+               for j in range(wn)]
+    running_r = [dict(name=f"v{j}", node=f"n{j % wn}",
+                      requests={"cpu": 6000.0, "memory": float(1 << 30)},
+                      priority=10.0,
+                      slack=float(rngw.uniform(0.0, 0.4)))
+                 for j in range(wm)]
+    pods_r = [dict(name=f"p{j}",
+                   requests={"cpu": float(rngw.integers(500, 4000)),
+                             "memory": float(1 << 30)},
+                   priority=float(rngw.integers(0, 100)),
+                   slo_target=0.9,
+                   observed_avail=float(rngw.uniform(0.3, 1.0)))
+              for j in range(wp)]
+    msg = snapshot_to_proto(nodes_r, pods_r, running_r)
+    for arm in ("off", "on"):
+        server, port, svc = make_server("127.0.0.1:0", config=cfg,
+                                        explain=(arm == "on"))
+        server.start()
+        try:
+            with SchedulerClient(f"127.0.0.1:{port}",
+                                 timeout=300.0) as c:
+                stats = bench_fn(
+                    lambda: c.assign(msg, packed_ok=True),
+                    max(8, iters // 2), warmup=2,
+                    label=f"wire-explain-{arm}",
+                )
+        finally:
+            server.stop(0)
+            svc.close()
+        emit(f"wire_assign_ms_{wp}x{wn}_explain_{arm}", stats,
+             {"explain": arm})
+
+
 def bench_pipeline(args):
     """SURVEY.md §2.3 PP analogue: decode of batch k+1 overlapped with
     device solve of batch k over a stream of independent snapshots."""
@@ -1258,6 +1346,7 @@ BENCHES = {
     "serving": bench_serving,
     "robustness": bench_robustness,
     "sim": bench_sim,
+    "explain": bench_explain,
     # headline runs last so the final stdout line is the headline metric
     # (parity mode last within it — the stock-semantics north-star claim)
     "headline": bench_headline,

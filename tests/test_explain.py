@@ -1,0 +1,413 @@
+"""Decision provenance (round 12, ISSUE 8): explained solves, the
+DecisionRecord store, the Explainz rpc, flight-dump decisions, and the
+sim's miss attribution.
+
+Test hygiene (ISSUE 8 satellite): the engine tests ride ONE module-
+scoped solved-once fixture (one compile of the explained programs per
+mode); the full-horizon sim-attribution case is marked `slow` — tier-1
+keeps a tiny-scenario smoke."""
+
+import json
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from tpusched import Engine, EngineConfig
+from tpusched import explain as ex
+from tpusched.kernels.assign import EXPLAIN_AUCTION_STATS
+from tpusched.kernels.explain import FILTER_REASONS, SCORE_TERMS
+from tpusched.snapshot import SnapshotBuilder
+
+CFG = EngineConfig(mode="fast", preemption=True)
+
+
+def _cluster(cfg):
+    """Two full nodes (one cheap victim, one expensive), a pressured
+    preemptor, an unschedulable giant, a placeable small pod, and a
+    2-member gang that can never reach its min_member=3 quorum."""
+    b = SnapshotBuilder(cfg)
+    b.add_node("n0", {"cpu": 4000, "memory": 64 << 30})
+    b.add_running_pod("n0", {"cpu": 4000, "memory": 1 << 30},
+                      priority=10, slack=0.3)
+    b.add_node("n1", {"cpu": 4000, "memory": 64 << 30})
+    b.add_running_pod("n1", {"cpu": 4000, "memory": 1 << 30},
+                      priority=10, slack=0.05)
+    b.add_pod("p-preempt", {"cpu": 2000, "memory": 1 << 30},
+              priority=200, slo_target=0.99, observed_avail=0.2)
+    b.add_pod("p-giant", {"cpu": 90000, "memory": 1 << 30}, priority=5)
+    b.add_pod("p-small", {"cpu": 100, "memory": 1 << 30}, priority=1)
+    b.add_pod("g-a", {"cpu": 100, "memory": 1 << 30},
+              pod_group="g", pod_group_min_member=3)
+    b.add_pod("g-b", {"cpu": 100, "memory": 1 << 30},
+              pod_group="g", pod_group_min_member=3)
+    return b.build()
+
+
+@pytest.fixture(scope="module")
+def solved():
+    """Module-scoped solved-once engine fixture: ONE explained solve
+    (plus the plain twin for the identical-placements pin) shared by
+    every test below."""
+    snap, meta = _cluster(CFG)
+    eng = Engine(CFG)
+    plain = eng.solve(snap)
+    res, exd, probe = eng.solve_explained(snap, k=3)
+    rec = ex.build_record(CFG, meta, res, exd, probe, rid="rid-test",
+                          snapshot_id="snap-t", rpc="solve")
+    yield SimpleNamespace(snap=snap, meta=meta, eng=eng, plain=plain,
+                          res=res, exd=exd, probe=probe, rec=rec)
+    eng.close()
+
+
+def _idx(meta, name):
+    return meta.pod_names.index(name)
+
+
+def test_explained_solve_is_pure_observer(solved):
+    """explain=True must not change a single decision."""
+    np.testing.assert_array_equal(solved.res.assignment,
+                                  solved.plain.assignment)
+    np.testing.assert_array_equal(solved.res.evicted, solved.plain.evicted)
+    np.testing.assert_array_equal(solved.res.commit_key,
+                                  solved.plain.commit_key)
+    assert solved.res.rounds == solved.plain.rounds
+
+
+def test_victim_chain_complete(solved):
+    """Acceptance: a complete decision chain for an evicted pod —
+    evictor + round recorded for EVERY victim, auction rows account
+    for every eviction, and the evictor really sits on the victim's
+    node."""
+    res, exd = solved.res, solved.exd
+    assert res.evicted.any()
+    for m in np.flatnonzero(res.evicted):
+        p = int(exd.evictor[m])
+        assert p >= 0, f"victim {m} has no recorded evictor"
+        assert exd.evict_round[m] >= 0
+        # The preemptor was assigned the node the victim ran on.
+        victim_node = int(solved.snap.running.node_idx[m])
+        assert int(res.assignment[p]) == victim_node
+    # Un-evicted running pods carry no chain.
+    for m in np.flatnonzero(~res.evicted[:solved.meta.n_running]):
+        assert exd.evictor[m] == -1 and exd.evict_round[m] == -1
+    # Auction rows sum to the eviction count and name every column.
+    astats = exd.auction_stats
+    col = EXPLAIN_AUCTION_STATS.index("evictions")
+    assert astats[:, col].sum() == res.evicted.sum()
+    assert astats.shape[1] == len(EXPLAIN_AUCTION_STATS)
+
+
+def test_term_breakdown_sums_to_total(solved):
+    """Acceptance: the score-term decomposition sums to the reported
+    candidate score (f32 regrouping => allclose, not bit equality)."""
+    probe = solved.probe
+    got = probe.topk_terms.sum(axis=-1)
+    assert np.allclose(got, probe.topk_score, atol=1e-3)
+    # Slots without a candidate are fully zeroed.
+    empty = probe.topk_idx < 0
+    assert np.all(probe.topk_score[empty] == 0.0)
+    assert np.all(probe.topk_terms[empty] == 0.0)
+    assert probe.topk_terms.shape[-1] == len(SCORE_TERMS)
+
+
+def test_filter_tallies_partition_nodes(solved):
+    """Feasible + per-reason eliminations partition the valid-node axis
+    exactly, for every real pod."""
+    probe, meta = solved.probe, solved.meta
+    nP = meta.n_pods
+    total = probe.feasible_nodes[:nP] + probe.filter_counts[:nP].sum(1)
+    assert (total == meta.n_nodes).all()
+    assert probe.filter_counts.shape[1] == len(FILTER_REASONS)
+    # The giant pod is eliminated everywhere by resources.
+    gi = _idx(meta, "p-giant")
+    r = FILTER_REASONS.index("resources")
+    assert probe.feasible_nodes[gi] == 0
+    assert probe.filter_counts[gi, r] == meta.n_nodes
+
+
+def test_outcome_classification(solved):
+    rec, meta = solved.rec, solved.meta
+    by_name = {n: ex.OUTCOMES[int(rec.outcome[i])]
+               for i, n in enumerate(rec.pod_names)}
+    assert by_name["p-preempt"] == ex.OUTCOME_PREEMPTOR
+    assert by_name["p-giant"] == ex.OUTCOME_PENDING
+    assert by_name["p-small"] == ex.OUTCOME_PLACED
+    assert by_name["g-a"] == ex.OUTCOME_GANG_HELD
+    assert by_name["g-b"] == ex.OUTCOME_GANG_HELD
+    counts = ex.outcome_counts(rec)
+    assert sum(counts.values()) == meta.n_pods
+    assert ex.pending_reasons(rec) == {"no_feasible:resources": 1}
+
+
+def test_collector_queries_and_ring(solved):
+    col = ex.ExplainCollector(capacity=2, enabled=True)
+    assert col.record(solved.rec) == 1
+    why = col.why("p-giant")
+    assert why["outcome"] == ex.OUTCOME_PENDING
+    assert why["pending_reason"] == "no_feasible:resources"
+    assert why["rid"] == "rid-test"
+    vic = rec_victim = None
+    for m in np.flatnonzero(solved.rec.evicted):
+        rec_victim = solved.rec.running_names[int(m)]
+        vic = col.who_evicted(rec_victim)
+    assert vic is not None
+    assert vic["evictor"] == "p-preempt"
+    assert vic["round"] >= 0
+    assert vic["evictor_decision"]["outcome"] == ex.OUTCOME_PREEMPTOR
+    # Candidate decomposition in the query view also sums to its total.
+    for c in col.why("p-small")["candidates"]:
+        assert abs(sum(c["terms"].values()) - c["total"]) < 1e-2
+    # Ring cap: oldest falls out.
+    for _ in range(3):
+        col.record(solved.rec)
+    assert len(col.records()) == 2
+    # Disabled collector drops records and mints nothing.
+    off = ex.ExplainCollector()
+    assert not off.enabled
+    assert off.record(solved.rec) == 0
+    assert off.records() == []
+    # The whole record renders to JSON.
+    json.dumps(ex.record_dict(solved.rec, pods=["p-giant"]))
+    # Priority decomposition: base + qos_boost == effective (display).
+    w = col.why("p-preempt")
+    assert abs(w["priority_base"] + w["qos_boost"] - w["priority"]) < 1e-3
+    assert w["qos_boost"] > 0
+
+
+def test_collector_byte_budget(solved):
+    """Records scale with batch shape, so the ring is byte-bounded too
+    (a count-only cap would pin ~500 MB at the headline shape); the
+    newest record always survives."""
+    nb = ex.record_nbytes(solved.rec)
+    assert nb > 0
+    col = ex.ExplainCollector(capacity=100, enabled=True,
+                              max_bytes=int(2.5 * nb))
+    for _ in range(5):
+        col.record(solved.rec)
+    assert len(col.records()) == 2
+    assert col.retained_bytes <= 2.5 * nb
+    # A single over-budget record is kept, not dropped.
+    tiny = ex.ExplainCollector(capacity=8, enabled=True, max_bytes=1)
+    tiny.record(solved.rec)
+    assert len(tiny.records()) == 1
+
+
+def test_host_falls_back_to_default_collector(solved):
+    """HostScheduler(explain=None) records into explain.DEFAULT when
+    the process switch is on (mirrors trace.set_enabled)."""
+    from tpusched.host import FakeApiServer, HostScheduler
+
+    api = FakeApiServer()
+    api.add_node("n0", allocatable={"cpu": 4000.0,
+                                    "memory": float(16 << 30)})
+    api.add_pod("p0", requests={"cpu": 100.0, "memory": float(1 << 30)})
+    host = HostScheduler(api, CFG, engine=solved.eng)
+    assert host.explain is ex.DEFAULT
+    before = len(ex.DEFAULT.records())
+    ex.set_enabled(True)
+    try:
+        host.cycle()
+    finally:
+        ex.set_enabled(False)
+        host.close()
+    recs = ex.DEFAULT.records()
+    assert len(recs) == before + 1
+    assert recs[-1].rpc == "host.cycle"
+    ex.DEFAULT.clear()
+
+
+def test_parity_mode_chain():
+    """Parity (sequential) mode records the same chain semantics:
+    evictor/round set exactly for evicted victims, placements
+    unchanged vs the plain parity solve."""
+    cfg = EngineConfig(mode="parity", preemption=True)
+    snap, meta = _cluster(cfg)
+    eng = Engine(cfg)
+    try:
+        plain = eng.solve(snap)
+        res, exd, probe = eng.solve_explained(snap, k=2)
+    finally:
+        eng.close()
+    np.testing.assert_array_equal(res.assignment, plain.assignment)
+    np.testing.assert_array_equal(res.evicted, plain.evicted)
+    assert res.evicted.any()
+    for m in np.flatnonzero(res.evicted):
+        assert exd.evictor[m] >= 0 and exd.evict_round[m] >= 0
+    for m in np.flatnonzero(~res.evicted[:meta.n_running]):
+        assert exd.evictor[m] == -1
+    # No auction in parity mode: the stats table is all-zero.
+    assert not exd.auction_stats.any()
+
+
+# ---------------------------------------------------------------------------
+# Wire surface: Explainz rpc, metrics counters, flight-dump decisions.
+# ---------------------------------------------------------------------------
+
+
+def _wire_snapshot():
+    from tpusched.rpc.codec import snapshot_to_proto
+
+    nodes = [dict(name=f"n{j}",
+                  allocatable={"cpu": 4000.0, "memory": float(16 << 30)})
+             for j in range(2)]
+    running = [dict(name=f"v{j}", node=f"n{j}",
+                    requests={"cpu": 4000.0, "memory": float(1 << 30)},
+                    priority=10.0, slack=0.3 - 0.25 * j)
+               for j in range(2)]
+    pods = [dict(name="p-preempt",
+                 requests={"cpu": 2000.0, "memory": float(1 << 30)},
+                 priority=500.0),
+            dict(name="p-giant",
+                 requests={"cpu": 90000.0, "memory": float(1 << 30)},
+                 priority=5.0)]
+    return snapshot_to_proto(nodes, pods, running)
+
+
+def test_explainz_rpc_end_to_end(thread_leak_check):
+    from tpusched.rpc.client import SchedulerClient
+    from tpusched.rpc.server import make_server
+
+    server, port, svc = make_server("127.0.0.1:0", config=CFG,
+                                    explain=True)
+    server.start()
+    try:
+        with SchedulerClient(f"127.0.0.1:{port}", timeout=300.0) as c:
+            resp = c.assign(_wire_snapshot(), packed_ok=True)
+            assert list(resp.evicted) == ["v0"]
+            ez = c.explainz(pod="p-giant", victim="v0",
+                            max_records=4, include_auction=True)
+            payload = json.loads(ez.explain_json)
+            mt = c.metrics_text()
+    finally:
+        server.stop(0)
+        svc.close()
+    assert payload["enabled"] and len(payload["records"]) == 1
+    rec = payload["records"][0]
+    assert rec["outcomes"]["preemptor"] == 1
+    assert rec["rid"], "record must carry the wire request id"
+    assert payload["why"]["pending_reason"] == "no_feasible:resources"
+    who = payload["who_evicted"]
+    assert who["evictor"] == "p-preempt" and who["round"] >= 0
+    assert who["auction"], "auction chain rides the victim answer"
+    # The trace ring carries the decision link under the SAME rid.
+    from tpusched import trace as tracing
+
+    dec_spans = [s for s in tracing.DEFAULT.spans()
+                 if s.name == "decision" and s.trace_id == rec["rid"]]
+    assert dec_spans and dec_spans[-1].attrs["decision"] == rec["cycle"]
+    # Decision-outcome counters + device-bytes gauge in the exposition.
+    assert 'scheduler_decisions_total{outcome="preemptor"} 1' in mt
+    assert ('scheduler_pending_pods_total'
+            '{reason="no_feasible:resources"} 1') in mt
+    assert 'scheduler_device_bytes{kind="byte_stores"}' in mt
+
+
+def test_flight_dump_carries_decisions(solved):
+    from tpusched import trace as tracing
+    from tpusched.trace import FlightRecorder
+
+    col = ex.ExplainCollector(enabled=True)
+    col.record(solved.rec)
+    fr = FlightRecorder()
+    fr.decisions = col
+    dump = fr.record("test_trip", tracing.TraceCollector(enabled=True))
+    assert [d["cycle"] for d in dump["decisions"]] == [solved.rec.cycle]
+    json.dumps(dump["decisions"])
+    # Without an attached (or with a disabled) collector: no key.
+    fr2 = FlightRecorder()
+    assert "decisions" not in fr2.record(
+        "t", tracing.TraceCollector(enabled=True))
+
+
+# ---------------------------------------------------------------------------
+# Sim integration: miss attribution.
+# ---------------------------------------------------------------------------
+
+# Tiny 2-node scenario: one short-lived class fits, one class of
+# permanently-oversized pods never schedules — every miss must
+# attribute to unschedulable:resources.
+def _tiny_scenario():
+    from tpusched.sim.workloads import Scenario
+
+    return Scenario(
+        name="tiny_explain", n_nodes=2, horizon_s=20.0, rate=0.4,
+        mix=(
+            (0.5, 0.9, (2.0, 4.0), (0, 50), (500.0, 900.0)),
+            (0.5, 0.9, (2.0, 4.0), (0, 50), (90000.0, 95000.0)),
+        ),
+    )
+
+
+def _check_attribution_consistency(att, records, res):
+    """The acceptance contract: per-pod causes are consistent with the
+    recorded decisions."""
+    from tpusched.sim import report as sim_report
+
+    victims = set()
+    unsched = set()
+    outranked = set()
+    for rec in records:
+        for m, vn in enumerate(rec.running_names):
+            if rec.evicted[m]:
+                victims.add(vn)
+        pend = ex.OUTCOMES.index(ex.OUTCOME_PENDING)
+        for i, pn in enumerate(rec.pod_names):
+            if int(rec.outcome[i]) == pend:
+                if int(rec.feasible_nodes[i]) == 0:
+                    unsched.add(pn)
+                else:
+                    outranked.add(pn)
+    evcount = {p.name: p.evictions for p in res.pods}
+    for name, d in att["pods"].items():
+        cause = d["cause"]
+        if cause == sim_report.CAUSE_PREEMPTED:
+            assert name in victims or evcount.get(name, 0) > 0
+        elif cause.startswith(sim_report.CAUSE_UNSCHED):
+            assert name in unsched
+        elif cause == sim_report.CAUSE_OUTRANKED:
+            assert name in outranked and name not in unsched
+    assert sum(att["causes"].values()) == att["misses"]
+
+
+def test_sim_miss_attribution_smoke():
+    """Tier-1: a tiny explained sim run joins every missed-SLO pod to
+    its recorded decisions."""
+    from tpusched.sim import report as sim_report
+    from tpusched.sim.driver import run_scenario
+
+    col = ex.ExplainCollector(capacity=1024, enabled=True)
+    res = run_scenario(_tiny_scenario(), seed=0, explain=col)
+    records = col.records()
+    assert records, "explained sim run must record decisions"
+    assert all(r.rpc == "host.cycle" for r in records)
+    att = sim_report.miss_attribution(res, records)
+    assert att["misses"] > 0
+    assert any(c.startswith("unschedulable:resources")
+               for c in att["causes"])
+    _check_attribution_consistency(att, records, res)
+    # Renders without error.
+    assert "top miss causes" in sim_report.render_attribution(att)
+
+
+@pytest.mark.slow
+def test_sim_twin_attribution_full_horizon():
+    """Full-horizon explained TWIN on pressure_skew: both arms carry a
+    miss_attribution whose per-pod causes are consistent with their
+    recorded decisions (ISSUE 8 acceptance, sim side)."""
+    from tpusched.sim import report as sim_report
+    from tpusched.sim.driver import run_scenario, twin_run
+    from tpusched.sim.workloads import SCENARIOS
+
+    sc = SCENARIOS["pressure_skew"]
+    twin = twin_run(sc, seed=0, explain=True)
+    for arm in ("qos", "static"):
+        att = twin[arm]["miss_attribution"]
+        assert att["misses"] + twin[arm]["slo_attained"] \
+            == twin[arm]["slo_pods"]
+    # Consistency re-checked with a captured collector on one arm.
+    col = ex.ExplainCollector(capacity=65536, enabled=True)
+    res = run_scenario(sc, seed=0, explain=col)
+    att = sim_report.miss_attribution(res, col.records())
+    _check_attribution_consistency(att, col.records(), res)
+    assert "top miss causes" in sim_report.render_twin(twin)

@@ -157,6 +157,71 @@ def test_duration_buckets_cover_long_solves():
     assert pm.BYTE_BUCKETS[-1] >= 1 << 30
 
 
+def test_callback_gauge_renders_live_samples():
+    """Round 12: CallbackGauge samples are computed at render time —
+    live state (device bytes) without a mutation hook — and a failing
+    callback must not take the scrape down."""
+    r = pm.Registry()
+    state = {"x": 1}
+    pm.CallbackGauge(
+        "t_live_bytes", "live", ("kind",),
+        callback=lambda: {("x",): state["x"], ("y",): 2}, registry=r)
+    text = r.render()
+    assert check_prometheus(text)["t_live_bytes"] == "gauge"
+    assert 't_live_bytes{kind="x"} 1' in text
+    state["x"] = 7
+    assert 't_live_bytes{kind="x"} 7' in r.render()
+    # Label-less scalar form.
+    r2 = pm.Registry()
+    pm.CallbackGauge("t_scalar", "s", callback=lambda: 3.5, registry=r2)
+    assert "t_scalar 3.5" in r2.render()
+    check_prometheus(r2.render())
+    # Erroring callback: the family renders with no samples.
+    r3 = pm.Registry()
+    pm.CallbackGauge("t_boom", "b", ("k",), callback=lambda: 1 / 0,
+                     registry=r3)
+    assert "# TYPE t_boom gauge" in r3.render()
+    check_prometheus(r3.render())
+
+
+def test_device_bytes_gauge_exposition():
+    """ISSUE 8 satellite: scheduler_device_bytes{kind} reports the
+    registered byte stores and, once a delta lineage seeds a device
+    session, the device-resident DeviceSnapshot arrays."""
+    import re as _re
+
+    from tpusched.rpc import tpusched_pb2 as pb
+    from tpusched.rpc.codec import snapshot_to_proto
+    from tpusched.rpc.server import SchedulerService
+
+    svc = SchedulerService()
+    try:
+        nodes = [dict(name="n0", allocatable={"cpu": 4000.0,
+                                              "memory": float(16 << 30)})]
+        pods = [dict(name="p0", requests={"cpu": 500.0,
+                                          "memory": float(1 << 30)})]
+        msg = snapshot_to_proto(nodes, pods, [])
+        resp = svc.Assign(
+            pb.AssignRequest(snapshot=msg, packed_ok=True), None)
+        delta = pb.SnapshotDelta(base_id=resp.snapshot_id)
+        delta.upsert_pods.append(msg.pods[0])
+        svc.Assign(pb.AssignRequest(delta=delta, packed_ok=True), None)
+        text = svc.Metrics(pb.MetricsRequest(), None).prometheus_text
+    finally:
+        svc.close()
+    check_prometheus(text)
+
+    def value(kind):
+        m = _re.search(
+            rf'scheduler_device_bytes{{kind="{kind}"}} (\d+)', text)
+        assert m, f"missing scheduler_device_bytes kind={kind}"
+        return int(m.group(1))
+
+    assert value("byte_stores") > 0
+    assert value("session_arrays") > 0, \
+        "the delta lineage's DeviceSnapshot arrays must be accounted"
+
+
 # ---------------------------------------------------------------------------
 # The sidecar's full Metrics render.
 # ---------------------------------------------------------------------------

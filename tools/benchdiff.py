@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Diff bench rounds: trend table + regression flags (round 12).
+
+The driver snapshots each round's ``python bench.py`` output into
+``BENCH_r<NN>.json`` ({"n": round, "tail": last-lines, ...}); every
+metric bench.py emits is one JSON object line inside that tail
+({"metric": ..., "value": ..., "unit": ...}). This tool extracts those
+lines across two or more snapshot files, renders the per-metric trend,
+and flags the newest round's regressions beyond a noise threshold —
+so "did this PR cost us serving latency" is one command instead of
+eyeballing tails.
+
+Direction is inferred from the unit/name: ms/s metrics (latencies)
+regress UP; qps / placements / fractions / counts regress DOWN.
+Override per run with --worse-up / --worse-down globs if a metric is
+misclassified.
+
+Usage:
+  python tools/benchdiff.py BENCH_r*.json             # full trend table
+  python tools/benchdiff.py BENCH_r04.json BENCH_r05.json --threshold 0.15
+  python tools/benchdiff.py BENCH_r*.json --strict    # exit 1 on regression
+  python tools/benchdiff.py BENCH_r*.json --metric 'serve_qps*'
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import re
+import sys
+from pathlib import Path
+
+# Units where LOWER is better; everything else is higher-better unless
+# the metric name matches a latency-ish pattern.
+_LOWER_BETTER_UNITS = {"ms", "s", "seconds", "bytes"}
+_LOWER_BETTER_NAME = re.compile(
+    r"(_ms($|_)|_s($|_)|latency|recovery|cycle_ms|_p\d+($|_))"
+)
+
+
+def round_key(path: Path) -> str:
+    m = re.search(r"r(\d+)", path.stem)
+    return f"r{int(m.group(1)):02d}" if m else path.stem
+
+
+def round_sort_key(path: Path):
+    """NUMERIC round order (string-sorting the labels would put r100
+    before r99 and flip the newest-vs-previous regression delta)."""
+    m = re.search(r"r(\d+)", path.stem)
+    return (0, int(m.group(1))) if m else (1, path.stem)
+
+
+def extract_metrics(path: Path) -> dict:
+    """{metric: {"value": float, "unit": str}} from one snapshot's
+    tail (last JSON line per metric wins)."""
+    doc = json.loads(path.read_text())
+    out: dict = {}
+    for line in str(doc.get("tail", "")).splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "metric" in rec and "value" in rec:
+            out[rec["metric"]] = dict(
+                value=float(rec["value"]), unit=str(rec.get("unit", ""))
+            )
+    return out
+
+
+def lower_is_better(metric: str, unit: str) -> bool:
+    return (unit in _LOWER_BETTER_UNITS
+            or bool(_LOWER_BETTER_NAME.search(metric)))
+
+
+def diff_rounds(files: "list[Path]", threshold: float,
+                metric_glob: "str | None" = None,
+                worse_up=(), worse_down=()) -> dict:
+    """{"rounds": [...], "metrics": {name: {"values": {round: v},
+    "unit": u, "delta_frac": f|None, "regressed": bool}}} — delta is
+    newest vs the PREVIOUS round that has the metric."""
+    rounds = []
+    per_round = {}
+    for f in sorted(files, key=round_sort_key):
+        r = round_key(f)
+        rounds.append(r)
+        per_round[r] = extract_metrics(f)
+    names: list = []
+    for r in rounds:
+        for name in per_round[r]:
+            if name not in names:
+                names.append(name)
+    if metric_glob:
+        names = [n for n in names if fnmatch.fnmatch(n, metric_glob)]
+    metrics = {}
+    for name in names:
+        values = {r: per_round[r][name]["value"]
+                  for r in rounds if name in per_round[r]}
+        unit = next(per_round[r][name]["unit"]
+                    for r in rounds if name in per_round[r])
+        lower = lower_is_better(name, unit)
+        if any(fnmatch.fnmatch(name, g) for g in worse_up):
+            lower = True
+        if any(fnmatch.fnmatch(name, g) for g in worse_down):
+            lower = False
+        delta = None
+        regressed = False
+        have = [r for r in rounds if r in values]
+        if len(have) >= 2:
+            prev, cur = values[have[-2]], values[have[-1]]
+            if prev != 0:
+                delta = (cur - prev) / abs(prev)
+                worse = delta > 0 if lower else delta < 0
+                regressed = worse and abs(delta) > threshold
+        metrics[name] = dict(values=values, unit=unit,
+                             lower_is_better=lower,
+                             delta_frac=delta, regressed=regressed)
+    return dict(rounds=rounds, metrics=metrics)
+
+
+def render(diff: dict) -> str:
+    rounds = diff["rounds"]
+    name_w = max([len(n) for n in diff["metrics"]] + [8])
+    head = f"{'metric':<{name_w}}  " + "  ".join(
+        f"{r:>12}" for r in rounds) + "   delta"
+    lines = [head, "-" * len(head)]
+    for name, m in diff["metrics"].items():
+        cells = "  ".join(
+            f"{m['values'][r]:>12.3f}" if r in m["values"] else
+            f"{'-':>12}"
+            for r in rounds
+        )
+        tag = ""
+        if m["delta_frac"] is not None:
+            arrow = "+" if m["delta_frac"] >= 0 else ""
+            tag = f" {arrow}{m['delta_frac'] * 100:.1f}%"
+            if m["regressed"]:
+                tag += "  << REGRESSION"
+        lines.append(f"{name:<{name_w}}  {cells} {tag}")
+    n_reg = sum(1 for m in diff["metrics"].values() if m["regressed"])
+    lines.append(f"{n_reg} regression(s) beyond threshold "
+                 f"across {len(diff['metrics'])} metrics")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="+", help="BENCH_r*.json snapshots")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="noise threshold as a fraction (default 0.10 "
+                         "= flag >10%% moves in the worse direction)")
+    ap.add_argument("--metric", default=None,
+                    help="glob filter on metric names")
+    ap.add_argument("--worse-up", action="append", default=[],
+                    help="glob of metrics where UP is worse (override)")
+    ap.add_argument("--worse-down", action="append", default=[],
+                    help="glob of metrics where DOWN is worse (override)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any regression is flagged")
+    ap.add_argument("--json", default=None,
+                    help="also write the diff as JSON here")
+    args = ap.parse_args()
+    files = [Path(f) for f in args.files]
+    missing = [f for f in files if not f.exists()]
+    if missing:
+        print(f"missing: {missing}", file=sys.stderr)
+        return 2
+    if len(files) < 2:
+        print("need at least two snapshot files to diff",
+              file=sys.stderr)
+        return 2
+    diff = diff_rounds(files, args.threshold, args.metric,
+                       args.worse_up, args.worse_down)
+    print(render(diff))
+    if args.json:
+        Path(args.json).write_text(json.dumps(diff, indent=2))
+    if args.strict and any(m["regressed"]
+                           for m in diff["metrics"].values()):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

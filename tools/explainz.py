@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Why did the scheduler do that? — decision-provenance CLI (round 12).
+
+Answers the questions Borg-lineage operators ask first:
+
+    why is pod P pending?        -> --pod P
+    who evicted running pod V?   -> --victim V
+
+Two modes:
+
+  * ``--address host:port`` — query a LIVE sidecar's Explainz rpc
+    (serve it with ``python -m tpusched.rpc.server --explain``);
+  * ``--demo`` — spin an in-process sidecar with explain on, drive one
+    seeded Assign whose cluster forces a preemption (two full nodes, a
+    high-priority preemptor, an unschedulable giant), and render the
+    complete chains: the victim's eviction (auction rounds + evictor's
+    decision with the score-term breakdown) and the giant's pending
+    reason. The zero-infrastructure way to see a decision chain.
+
+Output is Perfetto-LINKABLE: every record carries the wire request_id
+(`rid`) its solve ran under — the same id tools/tracez.py puts in span
+args — and the server drops a "decision" event span with the record's
+cycle id into the trace ring, so a slow cycle in the Perfetto UI joins
+its decisions by either key. ``--out`` writes the raw record JSON.
+
+Usage:
+  python tools/explainz.py --demo
+  python tools/explainz.py --demo --out /tmp/decisions.json
+  python tools/explainz.py --address 127.0.0.1:50051 --pod web-42
+  python tools/explainz.py --address 127.0.0.1:50051 --victim batch-7
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def demo_snapshot():
+    """The seeded demo cluster: both nodes full, one cheap victim, one
+    expensive one; a pressured high-priority pod must preempt, a giant
+    pod can never fit, a small pod rides the freed capacity."""
+    from tpusched.rpc.codec import snapshot_to_proto
+
+    nodes = [
+        dict(name=f"node-{j}",
+             allocatable={"cpu": 4000.0, "memory": float(16 << 30),
+                          "pods": 110.0})
+        for j in range(2)
+    ]
+    running = [
+        # node-0's victim runs far ABOVE its SLO (slack 0.3): cheap.
+        dict(name="victim-cheap", node="node-0",
+             requests={"cpu": 4000.0, "memory": float(1 << 30)},
+             priority=10.0, slack=0.3),
+        # node-1's victim barely meets its SLO (slack 0.02): expensive.
+        dict(name="victim-tight", node="node-1",
+             requests={"cpu": 4000.0, "memory": float(1 << 30)},
+             priority=10.0, slack=0.02),
+    ]
+    pods = [
+        dict(name="urgent-preemptor",
+             requests={"cpu": 2000.0, "memory": float(1 << 30)},
+             priority=200.0, slo_target=0.99, observed_avail=0.2),
+        dict(name="giant-unschedulable",
+             requests={"cpu": 64000.0, "memory": float(1 << 30)},
+             priority=50.0),
+        dict(name="small-rider",
+             requests={"cpu": 500.0, "memory": float(1 << 30)},
+             priority=1.0),
+    ]
+    return snapshot_to_proto(nodes, pods, running)
+
+
+def run_demo(out_path: "str | None"):
+    from tpusched import explain as explaining
+    from tpusched.config import EngineConfig
+    from tpusched.rpc.client import SchedulerClient
+    from tpusched.rpc.server import make_server
+
+    cfg = EngineConfig(mode="fast", preemption=True)
+    server, port, svc = make_server("127.0.0.1:0", config=cfg,
+                                    explain=True)
+    server.start()
+    try:
+        with SchedulerClient(f"127.0.0.1:{port}", timeout=300.0) as c:
+            resp = c.assign(demo_snapshot(), packed_ok=True)
+            evicted = list(resp.evicted)
+            print(f"assign: evicted={evicted}\n")
+            ez = c.explainz(pod="giant-unschedulable",
+                            victim=evicted[0] if evicted else "",
+                            max_records=4, include_auction=True)
+        payload = json.loads(ez.explain_json)
+        print(explaining.render_why(payload.get("why"),
+                                    "giant-unschedulable"))
+        print()
+        if evicted:
+            print(explaining.render_victim(payload.get("who_evicted"),
+                                           evicted[0]))
+        if out_path:
+            Path(out_path).write_text(json.dumps(payload, indent=2))
+            print(f"\nwrote {out_path}: {len(payload['records'])} "
+                  "records (rids join tools/tracez.py span args)",
+                  file=sys.stderr)
+        return payload
+    finally:
+        server.stop(0)
+        svc.close()
+
+
+def query_live(address: str, pod: str, victim: str, last: int,
+               out_path: "str | None"):
+    from tpusched import explain as explaining
+    from tpusched.rpc.client import SchedulerClient
+
+    with SchedulerClient(address) as c:
+        ez = c.explainz(pod=pod, victim=victim, max_records=last,
+                        include_auction=True)
+    payload = json.loads(ez.explain_json)
+    if not payload.get("enabled"):
+        print("NOTE: the sidecar is not recording decisions — restart "
+              "it with --explain (python -m tpusched.rpc.server "
+              "--explain)", file=sys.stderr)
+    if pod:
+        print(explaining.render_why(payload.get("why"), pod))
+    if victim:
+        print(explaining.render_victim(payload.get("who_evicted"), victim))
+    if not pod and not victim:
+        for rec in payload.get("records", []):
+            print(f"cycle {rec['cycle']} rid={rec['rid'] or '-'} "
+                  f"rpc={rec['rpc']} pods={rec['pods']} "
+                  f"outcomes={rec['outcomes']} "
+                  f"evictions={len(rec['evictions'])}")
+    if out_path:
+        Path(out_path).write_text(json.dumps(payload, indent=2))
+        print(f"wrote {out_path}", file=sys.stderr)
+    return payload
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--address", help="live sidecar to query")
+    mode.add_argument("--demo", action="store_true",
+                      help="in-process preemption demo")
+    ap.add_argument("--pod", default="", help="why is this pod "
+                    "pending / why did it land where it did")
+    ap.add_argument("--victim", default="",
+                    help="who evicted this running pod")
+    ap.add_argument("--last", type=int, default=8,
+                    help="how many recent records to fetch")
+    ap.add_argument("--out", default=None,
+                    help="write the raw record JSON here")
+    args = ap.parse_args()
+    if args.demo:
+        run_demo(args.out)
+    else:
+        query_live(args.address, args.pod, args.victim, args.last,
+                   args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

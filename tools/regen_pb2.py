@@ -91,6 +91,17 @@ MESSAGE_ADDS = {
         ("resync", 3, F.TYPE_BOOL, "resync"),
         ("role", 4, F.TYPE_STRING, "role"),
     ],
+    # Round 12 (ISSUE 8): decision provenance — last-N DecisionRecords
+    # plus targeted "why is P pending" / "who evicted V" queries.
+    "ExplainzRequest": [
+        ("pod", 1, F.TYPE_STRING, "pod"),
+        ("victim", 2, F.TYPE_STRING, "victim"),
+        ("max_records", 3, F.TYPE_INT32, "maxRecords"),
+        ("include_auction", 4, F.TYPE_BOOL, "includeAuction"),
+    ],
+    "ExplainzResponse": [
+        ("explain_json", 1, F.TYPE_STRING, "explainJson"),
+    ],
 }
 
 # New unary service methods: service name -> [(method, input, output)].
@@ -99,6 +110,8 @@ METHOD_ADDS = {
         ("Debugz", ".tpusched.DebugzRequest", ".tpusched.DebugzResponse"),
         ("Replicate", ".tpusched.ReplicateRequest",
          ".tpusched.ReplicateResponse"),
+        ("Explainz", ".tpusched.ExplainzRequest",
+         ".tpusched.ExplainzResponse"),
     ],
 }
 

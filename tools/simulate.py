@@ -69,6 +69,11 @@ def main() -> int:
                     help="force preemption on regardless of scenario")
     ap.add_argument("--json", default=None,
                     help="also write the report as JSON to this path")
+    ap.add_argument("--explain", choices=["on", "off"], default=None,
+                    help="decision provenance: record every cycle's "
+                         "DecisionRecords and attribute missed SLOs to "
+                         "their decision chains (default: on for "
+                         "--twin, off otherwise)")
     args = ap.parse_args()
 
     sc = SCENARIOS[args.scenario]
@@ -98,19 +103,32 @@ def main() -> int:
         ap.error("--replicas needs --backend grpc (a fleet is a wire-"
                  "level construct; the in-process engine has no "
                  "endpoints to fail over between)")
+    explain = (args.explain == "on") if args.explain is not None \
+        else args.twin
     if args.twin:
         if args.replicas != 1:
             ap.error("--twin does not support --replicas yet: both "
                      "arms run a single sidecar so the QoS-vs-static "
                      "comparison is apples-to-apples")
         out = twin_run(sc, seed=args.seed, config=cfg, sim=sim,
-                       backend=args.backend, log=log)
+                       backend=args.backend, log=log, explain=explain)
         print(report.render_twin(out))
     else:
+        col = None
+        if explain:
+            from tpusched.explain import ExplainCollector
+
+            col = ExplainCollector(capacity=65536, enabled=True)
         res = run_scenario(sc, seed=args.seed, config=cfg, sim=sim,
-                           backend=args.backend, replicas=args.replicas)
+                           backend=args.backend, replicas=args.replicas,
+                           explain=col)
         out = report.summarize(res)
+        if col is not None:
+            out["miss_attribution"] = report.miss_attribution(
+                res, col.records())
         print(report.render_text(out))
+        if out.get("miss_attribution"):
+            print(report.render_attribution(out["miss_attribution"]))
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=2)

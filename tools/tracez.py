@@ -18,9 +18,16 @@ request shows the follower's ``coalesce.wait`` parked against the
 leader's ``dispatch``, and a client's ``client.send`` brackets the
 server's stage spans for the same request_id.
 
+Decision linkage (round 12): on an explain-enabled sidecar every
+Assign additionally emits a ``decision`` event span whose args carry
+the DecisionRecord's cycle id — so a slow cycle found here joins its
+decision chain via ``tools/explainz.py`` by cycle id, or by the shared
+request_id (records carry ``rid``). ``--demo --explain`` shows it.
+
 Usage:
   python tools/tracez.py --demo --clients 4 --cycles 6 --out /tmp/t.json
   python tools/tracez.py --demo --trip-watchdog --flight-out /tmp/f.json
+  python tools/tracez.py --demo --explain --out /tmp/t.json
   python tools/tracez.py --address 127.0.0.1:50051 --last 32 --out t.json
 """
 
@@ -49,7 +56,8 @@ def spans_from_debugz(resp) -> list:
     return out
 
 
-def run_demo(clients: int, cycles: int, trip_watchdog: bool):
+def run_demo(clients: int, cycles: int, trip_watchdog: bool,
+             explain: bool = False):
     """In-process multi-client serving demo; returns (span_dicts,
     flight_dumps). Small shapes — this is about the trace, not load."""
     import threading
@@ -70,7 +78,8 @@ def run_demo(clients: int, cycles: int, trip_watchdog: bool):
         faults = FaultPlan([FaultRule(site="engine.fetch", kind="delay",
                                       at=frozenset({2}), delay_s=2.5)])
     server, port, svc = make_server("127.0.0.1:0", faults=faults,
-                                    watchdog_s=watchdog_s)
+                                    watchdog_s=watchdog_s,
+                                    explain=explain)
     server.start()
 
     def drive(i: int):
@@ -121,11 +130,15 @@ def main() -> int:
     ap.add_argument("--trip-watchdog", action="store_true",
                     help="--demo: inject a hung fetch so the watchdog "
                          "trips and the flight recorder dumps")
+    ap.add_argument("--explain", action="store_true",
+                    help="--demo: explain-enabled sidecar — each Assign "
+                         "emits a 'decision' span linking the trace to "
+                         "its DecisionRecord (tools/explainz.py)")
     args = ap.parse_args()
 
     if args.demo:
         spans, flight = run_demo(args.clients, args.cycles,
-                                 args.trip_watchdog)
+                                 args.trip_watchdog, args.explain)
     else:
         from tpusched.rpc.client import SchedulerClient
 

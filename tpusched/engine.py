@@ -49,6 +49,21 @@ class ScoreBatchResult:
     solve_seconds: float = 0.0
 
 
+@dataclasses.dataclass
+class ExplainData:
+    """Solve-path provenance extras (round 12, decision provenance):
+    which gang placements rolled back, and for every evicted running
+    pod WHO evicted it and in which commit round. auction_stats is one
+    row per fast-mode preemption round (kernels.assign
+    EXPLAIN_AUCTION_STATS columns; all-zero rows are untrimmed here —
+    tpusched.explain trims when building records)."""
+
+    rolled: np.ndarray         # [P] bool: reverted by gang_rollback
+    evictor: np.ndarray        # [M] int32 preemptor pod index (-1)
+    evict_round: np.ndarray    # [M] int32 commit-round key (-1)
+    auction_stats: np.ndarray  # [rounds_cap, N_STATS] f32
+
+
 class _OrderedFetchWorker:
     """ONE background fetch thread with strict FIFO order — the
     replacement for the old single-worker ThreadPoolExecutor. Three
@@ -174,7 +189,8 @@ def _sat_tables(snap: ClusterSnapshot):
     return node_sat_t, member_sat_t
 
 
-def solve_core(cfg: EngineConfig, snap: ClusterSnapshot, mesh=None):
+def solve_core(cfg: EngineConfig, snap: ClusterSnapshot, mesh=None,
+               explain: bool = False):
     """Mode dispatch shared by Engine and tenants.solve_many: returns
     (assigned, chosen, used, order, commit_key, rounds, evicted) in
     either mode (parity synthesizes commit_key from pop order and
@@ -182,7 +198,12 @@ def solve_core(cfg: EngineConfig, snap: ClusterSnapshot, mesh=None):
     initial pairwise domain counts come from the blockwise ring kernel
     (sig blocks rotating over the 'p' axis via ppermute) instead of the
     dense contraction — bit-identical results, O(S/ndev x members/ndev)
-    peak memory (SURVEY.md §2.3 SP/CP row)."""
+    peak memory (SURVEY.md §2.3 SP/CP row).
+
+    explain=True (decision provenance, round 12) appends one trailing
+    tuple (rolled, evictor, evict_round, auction_stats) — see
+    solve_rounds/solve_sequential. Placements are IDENTICAL either way
+    (the provenance arrays are pure observers; test-pinned)."""
     node_sat_t, member_sat_t = _sat_tables(snap)
     init_counts = None
     if cfg.ring_counts and snap.sigs.key.shape[0]:
@@ -194,15 +215,20 @@ def solve_core(cfg: EngineConfig, snap: ClusterSnapshot, mesh=None):
         )
     if cfg.mode == "fast":
         return solve_rounds(cfg, snap, node_sat_t, member_sat_t,
-                            init_counts=init_counts)
-    a, c, u, o, ev = solve_sequential(cfg, snap, node_sat_t, member_sat_t,
-                                      init_counts=init_counts)
+                            init_counts=init_counts, explain=explain)
+    seq = solve_sequential(cfg, snap, node_sat_t, member_sat_t,
+                           init_counts=init_counts, explain=explain)
+    if explain:
+        a, c, u, o, ev, extras = seq
+    else:
+        a, c, u, o, ev = seq
     # parity commit key = position in pop order (strictly serial)
     P = a.shape[0]
     rank = jnp.zeros(P, jnp.int32).at[o].set(
         jnp.arange(P, dtype=jnp.int32)
     )
-    return a, c, u, o, rank, jnp.int32(P), ev
+    base = (a, c, u, o, rank, jnp.int32(P), ev)
+    return base + ((extras,) if explain else ())
 
 
 class Engine:
@@ -286,6 +312,11 @@ class Engine:
         self._score_top1_jit = jax.jit(_score_top1)
         self._score_fn = _score
         self._topk_jits: dict[int, Any] = {}  # k -> jitted top-k path
+        # Decision-provenance programs (round 12): compiled LAZILY on
+        # the first solve_explained call, so engines that never explain
+        # pay neither trace time nor executable memory for them.
+        self._explain_solve_jit = None
+        self._explain_probe_jits: dict[int, Any] = {}
         # ONE background fetch worker: fetch order == dispatch order,
         # which fetch-driven transports (axon tunnel) rely on — two
         # concurrent D2H reads would race for the single execution
@@ -419,6 +450,109 @@ class Engine:
             return res
 
         return PendingFetch(unpack, self._submit_fetch(buf), t0)
+
+    # -- decision provenance (round 12) -------------------------------------
+
+    def unpack_explained(self, snap: ClusterSnapshot, buf):
+        """Decode the explained solve's packed buffer: the standard
+        solve layout (Engine.unpack) followed by the provenance extras.
+        Returns (SolveResult, ExplainData)."""
+        from tpusched.kernels.assign import (_PREEMPT_MAX_ROUNDS,
+                                             EXPLAIN_AUCTION_STATS)
+
+        buf = np.asarray(buf)
+        P = snap.pods.valid.shape[0]
+        N, R = snap.nodes.used.shape
+        M = snap.running.valid.shape[0]
+        std = 4 * P + N * R + M + 1
+        res = Engine.unpack(snap, buf[:std])
+        off = std
+        rolled = buf[off:off + P] > 0
+        off += P
+        evictor = buf[off:off + M].astype(np.int32)
+        off += M
+        evict_round = buf[off:off + M].astype(np.int32)
+        off += M
+        astats = buf[off:].reshape(
+            _PREEMPT_MAX_ROUNDS, len(EXPLAIN_AUCTION_STATS)
+        )
+        return res, ExplainData(rolled=rolled, evictor=evictor,
+                                evict_round=evict_round,
+                                auction_stats=astats)
+
+    def solve_explained_async(self, snap: ClusterSnapshot, k: int = 3):
+        """Dispatch the EXPLAINED solve plus the provenance probe
+        (kernels.explain.explain_probe): returns (pending_solve,
+        pending_probe) where the first joins to (SolveResult,
+        ExplainData) and the second to a ScoreExplain. Both fetch
+        through the engine's ordered worker — no handler-thread D2H.
+        Placements are identical to solve(): the explain program only
+        ADDS observer arrays (test-pinned). Compiled lazily per shape;
+        the unexplained hot path never traces it."""
+        from tpusched.kernels import explain as kexplain
+
+        cfg = self.config
+        mesh = self.mesh
+        if self._explain_solve_jit is None:
+            def _packed_explained(s: ClusterSnapshot):
+                out = solve_core(cfg, s, mesh=mesh, explain=True)
+                a, c, u, o, ck, rounds, ev = out[:7]
+                rolled, evictor, evict_rd, astats = out[7]
+                return jnp.concatenate([
+                    a.astype(jnp.float32), c, o.astype(jnp.float32),
+                    ck.astype(jnp.float32), u.reshape(-1),
+                    ev.astype(jnp.float32),
+                    rounds.astype(jnp.float32)[None],
+                    rolled.astype(jnp.float32),
+                    evictor.astype(jnp.float32),
+                    evict_rd.astype(jnp.float32),
+                    astats.reshape(-1),
+                ])
+
+            self._explain_solve_jit = jax.jit(_packed_explained)
+        N = snap.nodes.valid.shape[0]
+        kk = int(min(max(int(k), 1), max(N, 1)))
+        probe_fn = self._explain_probe_jits.get(kk)
+        if probe_fn is None:
+            def _probe(s: ClusterSnapshot, _k=kk):
+                node_sat_t, member_sat_t = _sat_tables(s)
+                ic = None
+                if cfg.ring_counts and s.sigs.key.shape[0]:
+                    from tpusched.ring import ring_sig_counts
+
+                    ic = ring_sig_counts(
+                        s, member_sat_t,
+                        jnp.full(s.pods.valid.shape[0], -1, jnp.int32),
+                        mesh,
+                    )
+                return kexplain.explain_probe(
+                    cfg, s, node_sat_t, member_sat_t, _k, init_counts=ic
+                )
+
+            probe_fn = self._explain_probe_jits[kk] = jax.jit(_probe)
+
+        t0 = time.perf_counter()
+        solve_buf = self._explain_solve_jit(snap)   # async dispatch
+        probe_buf = probe_fn(snap)                  # async dispatch
+
+        def unpack_solve(raw, seconds):
+            res, exd = self.unpack_explained(snap, raw)
+            res.solve_seconds = seconds
+            return res, exd
+
+        def unpack_probe(raw, _seconds):
+            return kexplain.unpack_probe(snap, raw, kk)
+
+        return (
+            PendingFetch(unpack_solve, self._submit_fetch(solve_buf), t0),
+            PendingFetch(unpack_probe, self._submit_fetch(probe_buf), t0),
+        )
+
+    def solve_explained(self, snap: ClusterSnapshot, k: int = 3):
+        """Blocking form: (SolveResult, ExplainData, ScoreExplain)."""
+        p_solve, p_probe = self.solve_explained_async(snap, k)
+        res, exd = p_solve.result()
+        return res, exd, p_probe.result()
 
     def score(self, snap: ClusterSnapshot) -> ScoreBatchResult:
         """ScoreBatch: [P, N] feasibility + normalized weighted scores,

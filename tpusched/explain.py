@@ -1,0 +1,482 @@
+"""Decision provenance (round 12, ISSUE 8 tentpole).
+
+The QoS terms drive the Filter->Score loop and victim selection, but
+until this round those decisions were a black box: traces say where
+TIME went (tpusched.trace), metrics say how MUCH happened — nothing
+could answer "why did pod P land on node Y", "why is P still pending",
+or "who evicted V and what did it cost". This module is the store that
+answers them: one `DecisionRecord` per EXPLAINED solve cycle, ring-
+buffered in an `ExplainCollector` with the same design rules as
+trace.TraceCollector —
+
+  * disabled by default and O(1) when disabled (`record()` returns
+    immediately; the engine only runs the provenance programs for
+    explained cycles, so the serving hot path is untouched when off);
+  * lock-cheap when enabled (one short lock around a deque append;
+    records are immutable-after-build plain dataclasses);
+  * NEVER spawns threads (tests/conftest.py thread_leak_check);
+  * linked to traces: each record carries the wire request_id (`rid`)
+    of the request whose solve it explains, so a slow cycle found in
+    Perfetto joins its decisions by id (tools/tracez.py args carry the
+    same rid; the server also drops a "decision" event span with the
+    record's cycle id into the trace ring).
+
+A record captures, per cycle: every pod's OUTCOME (placed / preemptor
+/ pending / gang-held), its top-k candidate nodes with the score
+decomposed into plugin terms and the QoS inputs (pressure, effective
+priority), filter-elimination tallies by reason (an exact partition of
+the node axis — kernels/explain.py), and the preemption side: per-
+victim evictor + commit round + slack/cost, plus the auction's per-
+round stats table (bids, claims, keeps, PDB budget spent, cap hits).
+
+Query surface: `why(pod)` and `who_evicted(victim)` walk the ring
+newest-first; `record_dict` renders JSON for the Explainz rpc and
+tools/explainz.py; sim/report.py joins records to missed-SLO pods for
+the twin-run miss-attribution table.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpusched.kernels.assign import EXPLAIN_AUCTION_STATS
+from tpusched.kernels.explain import FILTER_REASONS, SCORE_TERMS
+
+OUTCOME_PLACED = "placed"
+OUTCOME_PREEMPTOR = "preemptor"      # placed by evicting victims
+OUTCOME_PENDING = "pending"
+OUTCOME_GANG_HELD = "gang_held"      # rolled back below gang quorum
+OUTCOMES = (OUTCOME_PLACED, OUTCOME_PREEMPTOR, OUTCOME_PENDING,
+            OUTCOME_GANG_HELD)
+
+# Pending-cause labels (decision-outcome counters by reason and the
+# sim's miss attribution share these):
+REASON_OUTRANKED = "outranked"       # feasible nodes existed; capacity
+#                                      went to higher-priority pods
+_NO_FEASIBLE = "no_feasible:"        # prefix + dominant filter reason
+
+
+@dataclass
+class DecisionRecord:
+    """One explained solve cycle. Arrays are sliced to the REAL record
+    counts (no bucket padding); names index them."""
+
+    rid: str                 # wire request_id ("" = unwired solve)
+    ts: float
+    rpc: str                 # "Assign" | "host.cycle" | "solve"
+    snapshot_id: str
+    mode: str
+    rounds: int
+    cap_hit: bool            # auction hit _PREEMPT_MAX_ROUNDS
+    pod_names: list
+    node_names: list
+    running_names: list
+    outcome: np.ndarray      # [P] int8 index into OUTCOMES
+    assignment: np.ndarray   # [P] int32 node index or -1
+    chosen_score: np.ndarray  # [P] f32 (0 where unscored)
+    commit_key: np.ndarray   # [P] int32 (-1 unplaced)
+    pressure: np.ndarray     # [P] f32
+    priority: np.ndarray     # [P] f32 effective priority
+    topk_idx: np.ndarray     # [P, k] int32 (-1 pad)
+    topk_score: np.ndarray   # [P, k] f32
+    topk_terms: np.ndarray   # [P, k, T] f32
+    filter_counts: np.ndarray   # [P, NR] int32
+    feasible_nodes: np.ndarray  # [P] int32
+    evicted: np.ndarray      # [M] bool
+    evictor: np.ndarray      # [M] int32 pod index (-1)
+    evict_round: np.ndarray  # [M] int32 commit-round key (-1)
+    victim_priority: np.ndarray  # [M] f32
+    victim_slack: np.ndarray     # [M] f32
+    evict_cost: np.ndarray       # [M] f32 (auction's shifted cost)
+    qos_gain: float = 0.0    # config.qos.qos_gain at solve time
+    auction: list = field(default_factory=list)  # per-round stat dicts
+    cycle: int = 0           # collector-minted on record()
+    nbytes: int = 0          # retained-size estimate (collector budget)
+
+
+def build_record(config, meta, res, exd, probe, rid: str = "",
+                 snapshot_id: str = "", rpc: str = "solve",
+                 ts: "float | None" = None) -> DecisionRecord:
+    """Assemble one DecisionRecord from a solve_explained triple. meta:
+    SnapshotMeta (slices bucket-padded arrays to real counts); res/exd:
+    (SolveResult, ExplainData); probe: ScoreExplain."""
+    nP = int(meta.n_pods)
+    nM = int(meta.n_running)
+    running = list(meta.running_names or [])[:nM]
+    if len(running) < nM:
+        # Builder-level metas don't track running names (only the gRPC
+        # codec and host shim do); synthesize stable placeholders so
+        # victim views still index.
+        running += [f"running-{i}" for i in range(len(running), nM)]
+    a = np.asarray(res.assignment[:nP], dtype=np.int32)
+    sc = np.asarray(res.chosen_score[:nP], dtype=np.float32).copy()
+    sc[~np.isfinite(sc)] = 0.0
+    ck = (np.asarray(res.commit_key[:nP], dtype=np.int32)
+          if res.commit_key is not None else np.full(nP, -1, np.int32))
+    rolled = np.asarray(exd.rolled[:nP], dtype=bool)
+    evictor = np.asarray(exd.evictor[:nM], dtype=np.int32)
+    evicted = (np.asarray(res.evicted[:nM], dtype=bool)
+               if res.evicted is not None else np.zeros(nM, bool))
+    # Outcome codes: gang-held beats everything (its assignment is -1
+    # already); a placed pod that evicted someone is a preemptor.
+    is_preemptor = np.isin(
+        np.arange(nP, dtype=np.int32), evictor[evictor >= 0]
+    )
+    outcome = np.full(nP, OUTCOMES.index(OUTCOME_PENDING), np.int8)
+    outcome[a >= 0] = OUTCOMES.index(OUTCOME_PLACED)
+    outcome[(a >= 0) & is_preemptor] = OUTCOMES.index(OUTCOME_PREEMPTOR)
+    outcome[rolled] = OUTCOMES.index(OUTCOME_GANG_HELD)
+    # Auction table: keep rows up to the last one with any activity.
+    astats = np.asarray(exd.auction_stats, dtype=np.float32)
+    nz = np.flatnonzero(np.any(astats != 0.0, axis=1))
+    n_rows = int(nz[-1]) + 1 if nz.size else 0
+    auction = [
+        dict(round=i, **{
+            name: float(astats[i, j])
+            for j, name in enumerate(EXPLAIN_AUCTION_STATS)
+        })
+        for i in range(n_rows)
+    ]
+    return DecisionRecord(
+        rid=rid, ts=time.time() if ts is None else float(ts), rpc=rpc,
+        snapshot_id=snapshot_id, mode=config.mode, rounds=int(res.rounds),
+        cap_hit=n_rows >= astats.shape[0],
+        pod_names=list(meta.pod_names)[:nP],
+        node_names=list(meta.node_names)[:int(meta.n_nodes)],
+        running_names=running,
+        outcome=outcome, assignment=a, chosen_score=sc, commit_key=ck,
+        pressure=np.asarray(probe.pressure[:nP], np.float32),
+        priority=np.asarray(probe.priority[:nP], np.float32),
+        topk_idx=np.asarray(probe.topk_idx[:nP], np.int32),
+        topk_score=np.asarray(probe.topk_score[:nP], np.float32),
+        topk_terms=np.asarray(probe.topk_terms[:nP], np.float32),
+        filter_counts=np.asarray(probe.filter_counts[:nP], np.int32),
+        feasible_nodes=np.asarray(probe.feasible_nodes[:nP], np.int32),
+        evicted=evicted, evictor=evictor,
+        evict_round=np.asarray(exd.evict_round[:nM], np.int32),
+        victim_priority=np.asarray(probe.victim_priority[:nM], np.float32),
+        victim_slack=np.asarray(probe.victim_slack[:nM], np.float32),
+        evict_cost=np.asarray(probe.evict_cost[:nM], np.float32),
+        qos_gain=float(config.qos.qos_gain),
+        auction=auction,
+    )
+
+
+_ARRAY_FIELDS = (
+    "outcome", "assignment", "chosen_score", "commit_key", "pressure",
+    "priority", "topk_idx", "topk_score", "topk_terms", "filter_counts",
+    "feasible_nodes", "evicted", "evictor", "evict_round",
+    "victim_priority", "victim_slack", "evict_cost",
+)
+
+
+def record_nbytes(rec: DecisionRecord) -> int:
+    """Retained-size estimate of one record (array nbytes + a rough
+    per-string overhead): the collector's byte budget counts these —
+    at the 10k x 5k headline shape one record holds ~2 MB, so a
+    count-only ring would quietly pin hundreds of MB."""
+    n = sum(int(getattr(rec, f).nbytes) for f in _ARRAY_FIELDS)
+    for names in (rec.pod_names, rec.node_names, rec.running_names):
+        n += sum(len(s) + 56 for s in names)
+    return n + 240 * len(rec.auction) + 512
+
+
+# ---------------------------------------------------------------------------
+# Per-record views (JSON-safe plain dicts).
+# ---------------------------------------------------------------------------
+
+
+def pod_decision(rec: DecisionRecord, i: int) -> dict:
+    """One pod's decision: outcome, QoS inputs, candidate nodes with
+    the score decomposed into terms, and the filter tallies."""
+    boost = float(rec.qos_gain) * float(rec.pressure[i])
+    d = dict(
+        pod=rec.pod_names[i],
+        outcome=OUTCOMES[int(rec.outcome[i])],
+        pressure=round(float(rec.pressure[i]), 6),
+        priority=round(float(rec.priority[i]), 6),
+        # qos.priority_terms inverted through the record's qos_gain:
+        # base + qos_boost == the effective priority the queue sorted
+        # by (f32 round-trip, so display-exact, not bit-exact).
+        priority_base=round(float(rec.priority[i]) - boost, 6),
+        qos_boost=round(boost, 6),
+        feasible_nodes=int(rec.feasible_nodes[i]),
+        filter_eliminated={
+            FILTER_REASONS[j]: int(c)
+            for j, c in enumerate(rec.filter_counts[i]) if c
+        },
+    )
+    n = int(rec.assignment[i])
+    if n >= 0:
+        d["node"] = rec.node_names[n]
+        d["score"] = round(float(rec.chosen_score[i]), 4)
+        d["commit_key"] = int(rec.commit_key[i])
+    cands = []
+    for s in range(rec.topk_idx.shape[1]):
+        ni = int(rec.topk_idx[i, s])
+        if ni < 0:
+            continue
+        cands.append(dict(
+            node=rec.node_names[ni],
+            total=round(float(rec.topk_score[i, s]), 4),
+            terms={
+                SCORE_TERMS[t]: round(float(rec.topk_terms[i, s, t]), 4)
+                for t in range(len(SCORE_TERMS))
+            },
+        ))
+    d["candidates"] = cands
+    if d["outcome"] == OUTCOME_PENDING:
+        d["pending_reason"] = _pending_reason(rec, i)
+    return d
+
+
+def victim_decision(rec: DecisionRecord, m: int) -> dict:
+    """One running pod's eviction verdict (evicted or spared) with the
+    auction-side numbers that drove it."""
+    ev = int(rec.evictor[m])
+    d = dict(
+        victim=rec.running_names[m],
+        evicted=bool(rec.evicted[m]),
+        victim_priority=round(float(rec.victim_priority[m]), 6),
+        victim_slack=round(float(rec.victim_slack[m]), 6),
+        evict_cost=round(float(rec.evict_cost[m]), 6),
+    )
+    if rec.evicted[m]:
+        d["round"] = int(rec.evict_round[m])
+        if 0 <= ev < len(rec.pod_names):
+            d["evictor"] = rec.pod_names[ev]
+    return d
+
+
+def _pending_reason(rec: DecisionRecord, i: int) -> str:
+    if int(rec.feasible_nodes[i]) > 0:
+        return REASON_OUTRANKED
+    counts = rec.filter_counts[i]
+    if not counts.any():
+        return _NO_FEASIBLE + "none"
+    return _NO_FEASIBLE + FILTER_REASONS[int(np.argmax(counts))]
+
+
+def outcome_counts(rec: DecisionRecord) -> dict:
+    """{outcome: pods} for one record (decision-outcome counters)."""
+    return {
+        name: int(np.sum(rec.outcome == code))
+        for code, name in enumerate(OUTCOMES)
+    }
+
+
+def pending_reasons(rec: DecisionRecord) -> dict:
+    """{pending-cause label: pods} for one record."""
+    out: dict = {}
+    pend = OUTCOMES.index(OUTCOME_PENDING)
+    for i in np.flatnonzero(rec.outcome == pend):
+        r = _pending_reason(rec, int(i))
+        out[r] = out.get(r, 0) + 1
+    return out
+
+
+def record_dict(rec: DecisionRecord, pods: "list[str] | None" = None,
+                include_auction: bool = True,
+                max_victims: int = 64) -> dict:
+    """JSON-safe summary of one record: counts + victims (+ auction);
+    full per-pod decisions only for the requested `pods`, so Explainz
+    responses stay bounded at 10k-pod batches."""
+    d = dict(
+        cycle=rec.cycle, rid=rec.rid, ts=rec.ts, rpc=rec.rpc,
+        snapshot_id=rec.snapshot_id, mode=rec.mode, rounds=rec.rounds,
+        cap_hit=rec.cap_hit,
+        pods=len(rec.pod_names), nodes=len(rec.node_names),
+        running=len(rec.running_names),
+        outcomes=outcome_counts(rec),
+        pending_reasons=pending_reasons(rec),
+        evictions=[
+            victim_decision(rec, int(m))
+            for m in np.flatnonzero(rec.evicted)[:max_victims]
+        ],
+    )
+    if include_auction:
+        d["auction"] = rec.auction
+    if pods:
+        want = set(pods)
+        d["decisions"] = {
+            name: pod_decision(rec, i)
+            for i, name in enumerate(rec.pod_names) if name in want
+        }
+    return d
+
+
+# ---------------------------------------------------------------------------
+# The collector.
+# ---------------------------------------------------------------------------
+
+
+class ExplainCollector:
+    """Ring-buffered DecisionRecord store (module docstring). `topk` is
+    the candidate depth explained cycles request from the engine. The
+    ring is bounded by BOTH a record count and a byte budget
+    (`max_bytes`, default 128 MB): records scale with the batch shape
+    (~2 MB each at 10k pods x 5k running), so a count-only cap would
+    let an --explain sidecar quietly pin hundreds of MB of host RSS.
+    The newest record always survives even if it alone exceeds the
+    budget."""
+
+    def __init__(self, capacity: int = 256, enabled: bool = False,
+                 topk: int = 3, max_bytes: int = 128 << 20):
+        self._lock = threading.Lock()
+        self._ring: deque = deque()
+        self._mint = itertools.count(1)
+        self.capacity = int(capacity)
+        self.max_bytes = int(max_bytes)
+        self.enabled = bool(enabled)
+        self.topk = int(topk)
+        self.recorded = 0
+        self.retained_bytes = 0
+
+    def record(self, rec: DecisionRecord) -> int:
+        """Append; returns the record's minted cycle id (0 = dropped
+        because disabled)."""
+        if not self.enabled:
+            return 0
+        rec.cycle = next(self._mint)
+        rec.nbytes = record_nbytes(rec)
+        with self._lock:
+            self._ring.append(rec)
+            self.retained_bytes += rec.nbytes
+            self.recorded += 1
+            while len(self._ring) > 1 and (
+                len(self._ring) > self.capacity
+                or self.retained_bytes > self.max_bytes
+            ):
+                self.retained_bytes -= self._ring.popleft().nbytes
+        return rec.cycle
+
+    def records(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    def last(self, n: int) -> list:
+        if int(n) <= 0:
+            return []
+        with self._lock:
+            out = list(self._ring)
+        return out[-int(n):]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.retained_bytes = 0
+
+    # -- queries -------------------------------------------------------------
+
+    def why(self, pod: str) -> "dict | None":
+        """Most recent decision for `pod` (newest record wins): the
+        operator's "why is P pending / why did P land on Y"."""
+        for rec in reversed(self.records()):
+            try:
+                i = rec.pod_names.index(pod)
+            except ValueError:
+                continue
+            d = pod_decision(rec, i)
+            d.update(cycle=rec.cycle, rid=rec.rid, ts=rec.ts)
+            return d
+        return None
+
+    def who_evicted(self, victim: str) -> "dict | None":
+        """Most recent record in which `victim` was an eviction victim:
+        the full chain — who bid, what it cost, which auction round —
+        plus the evictor's own decision."""
+        for rec in reversed(self.records()):
+            try:
+                m = rec.running_names.index(victim)
+            except ValueError:
+                continue
+            if not rec.evicted[m]:
+                continue
+            d = victim_decision(rec, m)
+            d.update(cycle=rec.cycle, rid=rec.rid, ts=rec.ts,
+                     auction=rec.auction, cap_hit=rec.cap_hit)
+            ev = int(rec.evictor[m])
+            if 0 <= ev < len(rec.pod_names):
+                d["evictor_decision"] = pod_decision(rec, ev)
+            return d
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Text rendering (tools/explainz.py).
+# ---------------------------------------------------------------------------
+
+
+def render_why(d: "dict | None", pod: str) -> str:
+    if d is None:
+        return f"{pod}: no decision recorded"
+    head = f"{pod}: {d['outcome']}"
+    if d.get("cycle") is not None:
+        head += f" (cycle {d['cycle']}, rid {d.get('rid') or '-'})"
+    lines = [head]
+    lines.append(
+        f"  qos: pressure={d['pressure']} effective_priority="
+        f"{d['priority']} (base {d.get('priority_base')} + qos_boost "
+        f"{d.get('qos_boost')})"
+    )
+    if "node" in d:
+        lines.append(f"  placed on {d['node']} score={d['score']} "
+                     f"commit_key={d['commit_key']}")
+    if d.get("pending_reason"):
+        lines.append(f"  pending because: {d['pending_reason']}")
+    if d["filter_eliminated"]:
+        elim = ", ".join(f"{k}={v}" for k, v in d["filter_eliminated"].items())
+        lines.append(f"  filter eliminated ({elim}); "
+                     f"{d['feasible_nodes']} nodes feasible")
+    for c in d["candidates"]:
+        terms = " ".join(f"{k}={v}" for k, v in c["terms"].items() if v)
+        lines.append(f"  candidate {c['node']}: total={c['total']} ({terms})")
+    return "\n".join(lines)
+
+
+def render_victim(d: "dict | None", victim: str) -> str:
+    if d is None:
+        return f"{victim}: never evicted in the recorded window"
+    lines = [f"{victim}: evicted in auction round {d.get('round')} of "
+             f"cycle {d.get('cycle')} (rid {d.get('rid') or '-'})"]
+    lines.append(
+        f"  victim terms: priority={d['victim_priority']} "
+        f"slack={d['victim_slack']} evict_cost={d['evict_cost']}"
+    )
+    if "evictor" in d:
+        lines.append(f"  evicted by {d['evictor']}")
+    ed = d.get("evictor_decision")
+    if ed:
+        lines.append("  evictor decision:")
+        lines.extend("  " + ln for ln in
+                     render_why(ed, ed["pod"]).splitlines())
+    for row in d.get("auction", []):
+        lines.append(
+            "  auction r{round}: considered={considered:.0f} "
+            "bids={bids:.0f} claimed={claimed:.0f} "
+            "kept_evict={kept_evict:.0f} evictions={evictions:.0f} "
+            "pdb_spent={pdb_spent:.0f}".format(**row)
+        )
+    if d.get("cap_hit"):
+        lines.append("  NOTE: auction round cap hit — later bidders "
+                     "deferred to the next cycle")
+    return "\n".join(lines)
+
+
+# Process default (mirrors trace.DEFAULT): IN-PROCESS HostSchedulers
+# fall back to this store when not handed their own, so
+# set_enabled(True) turns on cycle recording process-wide. The sidecar
+# always constructs its own collector (make_server(explain=...)) — its
+# Explainz surface is per-server. Disabled by default: the engine only
+# runs provenance programs for explained cycles.
+DEFAULT = ExplainCollector()
+
+
+def set_enabled(on: bool) -> None:
+    DEFAULT.enabled = bool(on)

@@ -25,6 +25,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from tpusched import explain as explaining
 from tpusched.config import (
     DEFAULT_OBSERVED_AVAIL,
     DEFAULT_SLO_TARGET,
@@ -278,7 +279,18 @@ class HostScheduler:
         clock=None,
         use_delta: bool = True,
         transport: str = "delta",
+        explain=None,
     ):
+        """explain (round 12, ISSUE 8): optional
+        tpusched.explain.ExplainCollector; None falls back to the
+        process default (tpusched.explain.DEFAULT — disabled unless
+        explain.set_enabled(True), mirroring trace.DEFAULT). When the
+        collector is enabled, the IN-PROCESS engine path runs every
+        cycle explained and appends one DecisionRecord per cycle (the
+        sim's miss-attribution input; `ts` rides this host's clock, so
+        virtual-time drivers get virtual timestamps). gRPC transports
+        ignore it — server-side explain (make_server(explain=...))
+        owns provenance there."""
         self.api = api
         self.config = config or EngineConfig()
         # Transport config accepts ADDRESSES, not just a built client
@@ -358,6 +370,8 @@ class HostScheduler:
         self._m_failed_cycles = pm.Counter(
             "tpusched_host_failed_cycles_total",
             "scheduling cycles re-driven after a transient rpc failure")
+        self.explain = explain if explain is not None \
+            else explaining.DEFAULT
 
     def _io(self) -> ThreadPoolExecutor:
         """Lazy pool for concurrent API-server writes (binds/deletes)."""
@@ -539,8 +553,20 @@ class HostScheduler:
             # cannot pipeline, since cycle k's binds feed cycle k+1's
             # snapshot), and the engine's ordered fetch worker drives
             # the device either way.
-            pending_solve = self._engine.solve_async(snap)
-            res = pending_solve.result()
+            ex_col = self.explain
+            explain_on = ex_col.enabled
+            if explain_on:
+                p_solve, p_probe = self._engine.solve_explained_async(
+                    snap, ex_col.topk)
+                res, exd = p_solve.result()
+                probe = p_probe.result()
+                ex_col.record(explaining.build_record(
+                    self.config, meta, res, exd, probe,
+                    rpc="host.cycle", ts=self._clock(),
+                ))
+            else:
+                pending_solve = self._engine.solve_async(snap)
+                res = pending_solve.result()
             assignments = [
                 (meta.pod_names[i], meta.node_names[int(n)])
                 for i, n in enumerate(res.assignment[: meta.n_pods])

@@ -280,11 +280,17 @@ def _preempt_branch(cfg: EngineConfig, snap: ClusterSnapshot, static,
 
 
 def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
-                     node_sat_t, member_sat_t, init_counts=None):
+                     node_sat_t, member_sat_t, init_counts=None,
+                     explain: bool = False):
     """Exact sequential commit: stock scheduleOne semantics on device,
     including inline PostFilter preemption (cfg.preemption) at the exact
     point upstream runs it — immediately after a pod fails Filter.
-    Returns (assigned, chosen, used, order, evicted)."""
+    Returns (assigned, chosen, used, order, evicted); with explain=True
+    an extra trailing tuple (rolled, evictor, evict_round, zeros-shaped
+    auction table) — in parity mode "evict_round" is the pop-order step
+    at which the eviction committed, and the auction table is all-zero
+    (there is no auction; the shape is kept so the engine's packed
+    explain layout is mode-independent)."""
     static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
     P = snap.pods.valid.shape[0]
     M = snap.running.valid.shape[0]
@@ -298,8 +304,13 @@ def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
             snap.pods.observed_avail,
         )
 
-    def body(carry, p):
-        used, assigned, st, evicted = carry
+    def body(carry, x):
+        if explain:
+            p, pos = x
+            used, assigned, st, evicted, evictor, evict_rd = carry
+        else:
+            p = x
+            used, assigned, st, evicted = carry
         feasible, score, allowed = pod_cycle(cfg, snap, static, p, used, st)
         masked = jnp.where(feasible, score, NEG_INF)
         n = pick_node(cfg, masked, p)
@@ -311,6 +322,7 @@ def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
             # Gang members never preempt: their placement is provisional
             # until quorum (gang_rollback), and evicting real workloads
             # for a provisional placement would strand the victims.
+            prev_evicted = evicted
             used, st, evicted, pn = jax.lax.cond(
                 ~commit & snap.pods.valid[p] & (snap.pods.group[p] < 0),
                 lambda ops: _preempt_branch(
@@ -320,25 +332,42 @@ def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
                 (used, st, evicted),
             )
             a_p = jnp.where(commit, a_p, pn)
+            if explain:
+                new_ev = evicted & ~prev_evicted
+                evictor = jnp.where(new_ev, p, evictor)
+                evict_rd = jnp.where(new_ev, pos, evict_rd)
         assigned = assigned.at[p].set(a_p)
+        out = (used, assigned, st, evicted)
+        if explain:
+            out = out + (evictor, evict_rd)
         # Preempted placements carry no score (upstream nominates without
         # rescoring); chosen stays -inf for them, as in the oracle.
-        return (used, assigned, st, evicted), jnp.where(commit, masked[n], NEG_INF)
+        return out, jnp.where(commit, masked[n], NEG_INF)
 
     init = (
         snap.nodes.used, jnp.full(P, -1, jnp.int32), st0,
         jnp.zeros(M, bool),
     )
+    xs = order
+    if explain:
+        init = init + (jnp.full(M, -1, jnp.int32),
+                       jnp.full(M, -1, jnp.int32))
+        xs = (order, jnp.arange(P, dtype=jnp.int32))
     # unroll=4: purely an XLA loop-overhead optimization (4 pod steps
     # per while iteration, same sequential dataflow — placements are
     # bit-identical); ~15% off the 10k-pod scan on v5e.
-    (used, assigned, st, evicted), chosen_in_order = jax.lax.scan(
-        body, init, order, unroll=4
-    )
+    final, chosen_in_order = jax.lax.scan(body, init, xs, unroll=4)
+    used, assigned, st, evicted = final[:4]
     chosen = jnp.full(P, NEG_INF, jnp.float32).at[order].set(chosen_in_order)
-    used, assigned, chosen, _, _ = gang_rollback(
+    used, assigned, chosen, _, rolled = gang_rollback(
         snap, used, assigned, chosen, st, static.sig_match
     )
+    if explain:
+        astats = jnp.zeros(
+            (_PREEMPT_MAX_ROUNDS, len(EXPLAIN_AUCTION_STATS)), jnp.float32
+        )
+        return (assigned, chosen, used, order, evicted,
+                (rolled, final[4], final[5], astats))
     return assigned, chosen, used, order, evicted
 
 
@@ -795,6 +824,23 @@ _PREEMPT_MAX_ROUNDS = int(
 # workload (config 5 runs 8 victims/node).
 _PREEMPT_VICTIM_CAP = 16
 
+# Per-round auction provenance columns (decision provenance, round 12):
+# with explain=True the preemption loop accumulates one row per auction
+# round into a [_PREEMPT_MAX_ROUNDS, len(...)] f32 table. Column order
+# is the layout contract with tpusched/explain.py — append only.
+EXPLAIN_AUCTION_STATS = (
+    "considered",      # pods examined this round
+    "plain_feasible",  # of those, feasible without any eviction
+    "bids",            # entered the victim auction
+    "claimed",         # auction claims surviving exact validation
+    "kept_evict",      # eviction bids kept past the PDB budget gate
+    "kept_plain",      # plain placements kept (claim scan + capacity)
+    "drained",         # plain-drain placements (S == 0 pre-pass)
+    "evictions",       # victims newly evicted this round
+    "pdb_spent",       # PDB budget consumed by kept eviction bids
+    "no_bid",          # pods retired spent (no placement or prefix)
+)
+
 
 def _spread_excess_mask(snap: ClusterSnapshot, static: StaticCtx, rank,
                         choice, kept_v, st_v):
@@ -878,7 +924,7 @@ def _spread_excess_mask(snap: ClusterSnapshot, static: StaticCtx, rank,
 def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
                     static: StaticCtx, rank, order, base_rounds,
                     used, assigned, st, evicted, round_of, chosen,
-                    has_pair=None):
+                    has_pair=None, explain: bool = False):
     """Fast-mode PostFilter as BATCHED AUCTION ROUNDS (round-4; replaces
     a sequential per-pod scan that cost ~3 ms per preemptor — 9.6 s for
     2.7k preemptors at 10k x 5k). Each round:
@@ -938,11 +984,20 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         has_pair = jnp.zeros(P, bool)
 
     def cond(carry):
-        return carry[-2] & (carry[-1] < _PREEMPT_MAX_ROUNDS)
+        # Explicit indices: with explain=True the provenance tuple rides
+        # at the END of the carry, so -2/-1 would land on it.
+        return carry[7] & (carry[8] < _PREEMPT_MAX_ROUNDS)
 
     def body(carry):
-        used, assigned, st, evicted, round_of, chosen, tried, _, r = carry
+        if explain:
+            (used, assigned, st, evicted, round_of, chosen, tried, _, r,
+             exp) = carry
+            evictor, evict_rd, astats = exp
+        else:
+            used, assigned, st, evicted, round_of, chosen, tried, _, r = \
+                carry
         drained = jnp.array(False)
+        drained_n = jnp.float32(0.0)
         if S == 0:
             # Plain drain (round 5): one dealing round over the top
             # _RESIDUAL_CAP pending pods absorbs everything that FITS
@@ -986,6 +1041,8 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
                 jnp.where(hit_d, base_rounds + r, round_of[dsel])
             )
             drained = jnp.any(hit_d)
+            if explain:
+                drained_n = jnp.sum(hit_d.astype(jnp.float32))
         # Like the sequential pass, each pod gets ONE bid (tried); a bid
         # deferred by the conflict scan is NOT tried — it re-bids
         # against the updated state next round.
@@ -1216,15 +1273,58 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             jnp.any(keep_all) | drained, jnp.zeros_like(tried2), tried2
         )
         progress = jnp.any(keep_all) | jnp.any(newly_tried) | drained
-        return (used2, assigned2, st2, evicted2, round_of2, chosen2,
-                tried2, progress, r + 1)
+        out_state = (used2, assigned2, st2, evicted2, round_of2, chosen2,
+                     tried2, progress, r + 1)
+        if explain:
+            # Provenance accumulation (round 12) — traced ONLY under
+            # explain=True, so the unexplained program is unchanged.
+            # Victim attribution: keep_evict is pre-validation (a
+            # reverted keep's victims stay evicted — see above), which
+            # is exactly the evicted2 scatter's mask, so evictor /
+            # evict_rd cover the evicted set bit-for-bit. Each victim
+            # is evicted at most once, so .max over a -1 init is a
+            # masked set.
+            f32 = jnp.float32
+            if M_run:
+                vclip = jnp.clip(vidx_t, 0, M_run - 1)
+                vmask = keep_evict[:, None] & (vidx_t < M_run)
+                evictor = evictor.at[vclip].max(
+                    jnp.where(vmask, sel[:, None], -1))
+                evict_rd = evict_rd.at[vclip].max(
+                    jnp.where(vmask, base_rounds + r, -1))
+            if GP:
+                pdb_spent = jnp.sum(
+                    jnp.where(keep_evict[:, None], usage, 0.0))
+            else:
+                pdb_spent = f32(0.0)
+            row = jnp.stack([
+                jnp.sum(real.astype(f32)),
+                jnp.sum((real & can_plain).astype(f32)),
+                jnp.sum(pre_active.astype(f32)),
+                jnp.sum(claimed.astype(f32)),
+                jnp.sum(keep_evict.astype(f32)),
+                jnp.sum((keep_all & ~takes_evict).astype(f32)),
+                drained_n,
+                jnp.sum(ev_round.astype(f32)),
+                pdb_spent.astype(f32),
+                jnp.sum((real & ~could_bid).astype(f32)),
+            ])
+            astats = astats.at[jnp.clip(r, 0, astats.shape[0] - 1)].set(row)
+            out_state = out_state + ((evictor, evict_rd, astats),)
+        return out_state
 
-    out = jax.lax.while_loop(
-        cond, body,
-        (used, assigned, st, evicted, round_of, chosen,
-         jnp.zeros(P, bool), jnp.array(True), jnp.int32(0)),
-    )
-    return out[:6] + (out[-1],)
+    init = (used, assigned, st, evicted, round_of, chosen,
+            jnp.zeros(P, bool), jnp.array(True), jnp.int32(0))
+    if explain:
+        init = init + ((
+            jnp.full(M_run, -1, jnp.int32),
+            jnp.full(M_run, -1, jnp.int32),
+            jnp.zeros((_PREEMPT_MAX_ROUNDS, len(EXPLAIN_AUCTION_STATS)),
+                      jnp.float32),
+        ),)
+    out = jax.lax.while_loop(cond, body, init)
+    base = out[:6] + (out[8],)
+    return base + ((out[9],) if explain else ())
 
 
 def _cycle_nosig(alloc, used, req, mask, sscore, w_lr, w_ba, w_ts, rw):
@@ -1423,9 +1523,16 @@ def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
 
 
 def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
-                 node_sat_t, member_sat_t, init_counts=None):
+                 node_sat_t, member_sat_t, init_counts=None,
+                 explain: bool = False):
     """Fast mode: optimistic batched rounds with validate-and-rollback.
-    Returns (assigned, chosen, used, order, rounds)."""
+    Returns (assigned, chosen, used, order, round_of, rounds, evicted);
+    with explain=True (decision provenance, round 12) an extra trailing
+    tuple (rolled, evictor, evict_round, auction_stats) — gang-rollback
+    mask [P], per-victim preemptor pod index / commit-round [M] (-1 =
+    not evicted), and the [_PREEMPT_MAX_ROUNDS, EXPLAIN_AUCTION_STATS]
+    per-round auction table. The explain accumulation is traced only
+    when requested, so the default program is unchanged."""
     static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
     pods, nodes = snap.pods, snap.nodes
     P = pods.valid.shape[0]
@@ -1666,13 +1773,23 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         )
     M = snap.running.valid.shape[0]
     evicted = jnp.zeros(M, bool)
+    evictor = evict_rd = astats = None
+    if explain:
+        evictor = jnp.full(M, -1, jnp.int32)
+        evict_rd = jnp.full(M, -1, jnp.int32)
+        astats = jnp.zeros(
+            (_PREEMPT_MAX_ROUNDS, len(EXPLAIN_AUCTION_STATS)), jnp.float32
+        )
     if cfg.preemption and M > 0:
-        (used, assigned, st_f, evicted, round_of, chosen,
-         preempt_r) = _preempt_rounds(
+        pr_out = _preempt_rounds(
             cfg, snap, static, rank, order, rounds,
             used, assigned, st_f, evicted, round_of, chosen,
-            has_pair=has_pair,
+            has_pair=has_pair, explain=explain,
         )
+        (used, assigned, st_f, evicted, round_of, chosen,
+         preempt_r) = pr_out[:7]
+        if explain:
+            evictor, evict_rd, astats = pr_out[7]
         # Total commit rounds surfaces the preemption drain too (the
         # bench and host logs read SolveResult.rounds).
         rounds = rounds + preempt_r
@@ -1683,4 +1800,7 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
     # Commit key for external validity audits: pods committed in earlier
     # rounds precede later ones; within a round all commits share a key
     # (the engine validated them against end-of-round state).
-    return assigned, chosen, used, order, round_of, rounds, evicted
+    base = (assigned, chosen, used, order, round_of, rounds, evicted)
+    if explain:
+        return base + ((rolled, evictor, evict_rd, astats),)
+    return base

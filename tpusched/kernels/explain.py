@@ -1,0 +1,242 @@
+"""Decision-provenance probe (round 12, ISSUE 8 tentpole).
+
+The solve kernels answer "WHAT was decided"; this module answers "WHY"
+for one snapshot, evaluated against SNAPSHOT-START state:
+
+  * per-pod filter-elimination tallies by reason — every (valid pod,
+    valid node) pair is attributed to its FIRST failing predicate in
+    the fixed FILTER_REASONS order, so for every valid pod
+    ``feasible_nodes + sum(filter_counts) == number of valid nodes``
+    is an exact partition (test-pinned);
+  * the top-k candidate nodes by total score with the score DECOMPOSED
+    into its plugin terms (SCORE_TERMS order, urgency-reweighted per
+    pod exactly like the solve's StaticCtx weights) — the per-term
+    columns sum to the reported candidate total (f32: same terms,
+    different summation grouping than batched_cycle, so use allclose,
+    not bit equality, against the solve's chosen score);
+  * the QoS inputs the paper's loop runs on: per-pod pressure and
+    effective priority, per-victim effective priority / slack /
+    shifted-positive eviction cost (the same cost_s the preemption
+    auction ranks by, kernels/preempt.precompute).
+
+Everything is packed into ONE flat f32 buffer (one D2H fetch through
+the engine's ordered worker) and is computed ONLY for explained cycles
+— the serving hot path never traces this program (Engine lazily jits
+it on first solve_explained call).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusched.config import EngineConfig
+from tpusched.kernels import filter as kfilter
+from tpusched.kernels import pairwise as kpair
+from tpusched.kernels import score as kscore
+from tpusched.kernels.assign import precompute_static
+from tpusched.qos import (
+    effective_weights,
+    evict_cost_raw,
+    pressure_of,
+    priority_terms,
+    victim_effective_priority,
+)
+from tpusched.snapshot import ClusterSnapshot
+
+# First-failing-predicate attribution order (the order the serving
+# filters conceptually run): cordon, taints, node affinity, resources,
+# then the pairwise constraints. Invalid (bucket-padding) node slots
+# are excluded from the universe, so for every valid pod
+# feasible + sum(tallies) == number of VALID nodes.
+FILTER_REASONS = (
+    "cordoned",
+    "taint",
+    "node_affinity",
+    "resources",
+    "spread",
+    "interpod_affinity",
+)
+
+# Score decomposition columns; matches qos._PLUGINS order.
+SCORE_TERMS = (
+    "least_requested",
+    "balanced_allocation",
+    "node_affinity",
+    "taint_toleration",
+    "topology_spread",
+    "interpod_affinity",
+)
+
+
+@dataclasses.dataclass
+class ScoreExplain:
+    """Host-side decode of one explain probe (arrays carry the full
+    bucketed axes; tpusched.explain.build_record slices to the real
+    record counts via SnapshotMeta)."""
+
+    k: int
+    topk_idx: np.ndarray       # [P, k] int32 node index, -1 = no candidate
+    topk_score: np.ndarray     # [P, k] f32 total score (0 at -1 slots)
+    topk_terms: np.ndarray     # [P, k, T] f32 per-term contributions
+    filter_counts: np.ndarray  # [P, NR] int32 eliminated nodes by reason
+    feasible_nodes: np.ndarray  # [P] int32
+    pressure: np.ndarray       # [P] f32 QoS pressure
+    priority: np.ndarray       # [P] f32 effective (dynamic) priority
+    victim_priority: np.ndarray  # [M] f32 victim effective priority
+    victim_slack: np.ndarray   # [M] f32
+    evict_cost: np.ndarray     # [M] f32 shifted-positive auction cost
+
+
+def explain_probe(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
+                  member_sat_t, k: int, init_counts=None):
+    """One flat f32 buffer of the provenance arrays (module docstring).
+    `k` is a trace-time constant clipped to [1, N] by the caller."""
+    static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
+    st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts)
+    nodes, pods = snap.nodes, snap.pods
+    P = pods.valid.shape[0]
+    N = nodes.valid.shape[0]
+    S = snap.sigs.key.shape[0]
+
+    cordon_ok = nodes.schedulable[None, :] | pods.tolerates_unsched[:, None]
+    taint_ok = kfilter.taint_mask(
+        nodes.taint_ids, snap.taint_effect, pods.tolerated
+    )
+    res_ok = kfilter.resource_fit(
+        nodes.allocatable, nodes.used, pods.requests
+    )
+    if S:
+        spread_ok, spread_pen, ia_ok, ia_raw = kpair.pairwise_from_counts(
+            snap, st0, static.aff_ok, static.sig_match, None
+        )
+    else:
+        spread_ok = ia_ok = jnp.ones((P, N), bool)
+        spread_pen = ia_raw = None
+
+    # Hierarchical tallies: `alive` shrinks predicate by predicate, so
+    # each pair lands in exactly one reason column and what survives is
+    # EXACTLY batched_cycle's feasibility (same predicate set; the
+    # valid-node/valid-pod pre-mask is the universe, not a reason).
+    alive = pods.valid[:, None] & nodes.valid[None, :]
+    fails = (
+        ~cordon_ok,
+        ~taint_ok,
+        ~static.aff_ok,
+        ~res_ok,
+        ~spread_ok,
+        ~ia_ok,
+    )
+    tallies = []
+    for fail in fails:
+        hit = alive & fail
+        tallies.append(jnp.sum(hit, axis=1).astype(jnp.float32))
+        alive = alive & ~hit
+    feasible = alive
+
+    # Per-term score columns with the solve's effective (urgency-
+    # reweighted) weights — static.w_* ARE these weights; node-affinity
+    # and taint-toleration are recomputed unsummed (StaticCtx folds
+    # them into one static score).
+    w = effective_weights(
+        cfg, pressure_of(pods.slo_target, pods.observed_avail)
+    )
+    lr = static.w_lr[:, None] * kscore.least_requested(
+        nodes.allocatable, nodes.used, pods.requests, static.rw
+    )
+    ba = static.w_ba[:, None] * kscore.balanced_allocation(
+        nodes.allocatable, nodes.used, pods.requests, static.rw
+    )
+    na = w["node_affinity"][:, None] * kscore.node_affinity_score(
+        node_sat_t, pods.pref_term_atoms, pods.pref_term_valid,
+        pods.pref_weight, nodes.valid,
+    )
+    tt = w["taint_toleration"][:, None] * kscore.taint_toleration_score(
+        nodes.taint_ids, snap.taint_effect, pods.tolerated, nodes.valid
+    )
+    if S:
+        ts = static.w_ts[:, None] * kscore.inverse_normalize(
+            spread_pen, nodes.valid
+        )
+        ia = static.w_ia[:, None] * kscore.minmax_normalize(
+            ia_raw, nodes.valid
+        )
+    else:
+        # No pairwise constraints: spread score is the constant 100
+        # (batched_cycle's trace-time shortcut), inter-pod raw is 0 and
+        # minmax-normalizes to 0.
+        ts = jnp.broadcast_to(static.w_ts[:, None] * 100.0, (P, N))
+        ia = jnp.zeros((P, N), jnp.float32)
+    terms = jnp.stack([lr, ba, na, tt, ts, ia], axis=-1).astype(jnp.float32)
+    total = jnp.sum(terms, axis=-1)
+    masked = jnp.where(feasible, total, -jnp.inf)
+    v, i = jax.lax.top_k(masked, k)
+    okk = jnp.isfinite(v)
+    idx = jnp.where(okk, i, -1)
+    val = jnp.where(okk, v, 0.0)
+    term_k = jnp.take_along_axis(
+        terms, jnp.clip(i, 0, N - 1)[..., None], axis=1
+    )
+    term_k = jnp.where(okk[..., None], term_k, 0.0)
+
+    pt = priority_terms(
+        cfg, pods.base_priority, pods.slo_target, pods.observed_avail
+    )
+    press = pt["pressure"]
+    prio = pt["effective"]
+    run = snap.running
+    vprio = victim_effective_priority(cfg, run.priority, run.slack)
+    raw = evict_cost_raw(cfg, run.priority, run.slack).astype(jnp.float32)
+    # Same positive shift as kernels/preempt.precompute, so reported
+    # costs are the very numbers the auction's prefix sums rank by.
+    mn = jnp.min(jnp.where(run.valid, raw, jnp.inf))
+    mn = jnp.where(jnp.isfinite(mn), mn, 0.0)
+    cost = raw - mn + 1.0
+
+    f32 = jnp.float32
+    return jnp.concatenate([
+        idx.astype(f32).ravel(),
+        val.astype(f32).ravel(),
+        term_k.reshape(-1),
+        jnp.stack(tallies, axis=1).ravel(),
+        jnp.sum(feasible, axis=1).astype(f32),
+        press.astype(f32),
+        prio.astype(f32),
+        vprio.astype(f32),
+        run.slack.astype(f32),
+        cost.astype(f32),
+    ])
+
+
+def unpack_probe(snap: ClusterSnapshot, buf, k: int) -> ScoreExplain:
+    """Decode explain_probe's flat buffer (the single layout authority
+    — Engine fetches through here)."""
+    buf = np.asarray(buf)
+    P = snap.pods.valid.shape[0]
+    M = snap.running.valid.shape[0]
+    T = len(SCORE_TERMS)
+    NR = len(FILTER_REASONS)
+    off = 0
+
+    def take(n, shape=None):
+        nonlocal off
+        out = buf[off:off + n]
+        off += n
+        return out.reshape(shape) if shape is not None else out
+
+    return ScoreExplain(
+        k=k,
+        topk_idx=take(P * k, (P, k)).astype(np.int32),
+        topk_score=take(P * k, (P, k)).astype(np.float32),
+        topk_terms=take(P * k * T, (P, k, T)).astype(np.float32),
+        filter_counts=take(P * NR, (P, NR)).astype(np.int32),
+        feasible_nodes=take(P).astype(np.int32),
+        pressure=take(P).astype(np.float32),
+        priority=take(P).astype(np.float32),
+        victim_priority=take(M).astype(np.float32),
+        victim_slack=take(M).astype(np.float32),
+        evict_cost=take(M).astype(np.float32),
+    )

@@ -355,6 +355,61 @@ class Histogram(_Metric):
         return lines
 
 
+class CallbackGauge(_Metric):
+    """Gauge whose samples are computed at RENDER time from a callback
+    (round 12: `scheduler_device_bytes{kind}` reads the live device-
+    resident session/store sizes) — live state without a mutation hook
+    on every change. The callback returns either a scalar (label-less
+    gauge) or a mapping {label-values-tuple: value}. A callback error
+    renders as NO samples for this family (a scrape must never take
+    the server down); the TYPE line still renders so the family stays
+    discoverable."""
+
+    kind = "gauge"
+
+    def __new__(cls, name, help="", labelnames=(), callback=None,
+                registry=None):
+        registry = registry if registry is not None else DEFAULT
+
+        def make():
+            m = super(CallbackGauge, cls).__new__(cls)
+            _Metric.__init__(m, name, help, tuple(labelnames))
+            m.callback = callback
+            return m
+
+        return registry._get_or_register(
+            name, make, "gauge", tuple(labelnames),
+        )
+
+    def __init__(self, name, help="", labelnames=(), callback=None,
+                 registry=None):
+        # Built by the __new__ factory (see Counter). Re-registration
+        # with a fresh callback re-points the family (the latest owner
+        # of the live state wins — mirrors get-or-create semantics).
+        if callback is not None:
+            self.callback = callback
+
+    def render_lines(self) -> list:
+        lines = [f"# TYPE {self.name} gauge"]
+        cb = self.callback
+        if cb is None:
+            return lines
+        try:
+            samples = cb()
+        except Exception:
+            return lines
+        if not isinstance(samples, dict):
+            samples = {(): samples}
+        for key, v in samples.items():
+            key = tuple(str(k) for k in (
+                key if isinstance(key, tuple) else (key,)
+            )) if self.labelnames else ()
+            lines.append(
+                f"{self.name}{self._label_str(key)} {format_value(v)}"
+            )
+        return lines
+
+
 # Process-default registry: host-side components (kube informer,
 # HostScheduler) register here so one process-wide render_default()
 # exposes them; the sidecar's _Metrics uses its OWN Registry (its

@@ -63,6 +63,24 @@ def effective_priority(cfg: EngineConfig, base_priority, slo_target, observed_av
     return base_priority + cfg.qos.qos_gain * pressure_of(slo_target, observed_avail)
 
 
+def priority_terms(cfg: EngineConfig, base_priority, slo_target,
+                   observed_avail) -> dict:
+    """Decompose the dynamic priority into its provenance terms (round
+    12, decision provenance): base + qos_boost == effective_priority
+    exactly (same formula, same op order). Works on scalars and arrays;
+    kernels/explain.py's probe packs the pressure/effective pair from
+    this decomposition, and tpusched.explain.pod_decision re-derives
+    base/qos_boost per pod (via the record's qos_gain) so "why did P
+    pop first" is answerable from the record alone."""
+    p = pressure_of(slo_target, observed_avail)
+    return {
+        "base": base_priority,
+        "pressure": p,
+        "qos_boost": cfg.qos.qos_gain * p,
+        "effective": base_priority + cfg.qos.qos_gain * p,
+    }
+
+
 def slack_of(slo_target, observed_avail):
     return observed_avail - slo_target
 

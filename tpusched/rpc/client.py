@@ -229,6 +229,7 @@ class SchedulerClient:
         self._metrics = _MethodRef(self, "Metrics")
         self._debugz = _MethodRef(self, "Debugz")
         self._replicate = _MethodRef(self, "Replicate")
+        self._explainz = _MethodRef(self, "Explainz")
 
     _RPCS = (
         ("ScoreBatch", pb.ScoreRequest, pb.ScoreResponse),
@@ -237,6 +238,7 @@ class SchedulerClient:
         ("Metrics", pb.MetricsRequest, pb.MetricsResponse),
         ("Debugz", pb.DebugzRequest, pb.DebugzResponse),
         ("Replicate", pb.ReplicateRequest, pb.ReplicateResponse),
+        ("Explainz", pb.ExplainzRequest, pb.ExplainzResponse),
     )
 
     def _connect(self) -> None:
@@ -487,6 +489,20 @@ class SchedulerClient:
             self._debugz,
             pb.DebugzRequest(max_traces=max_traces,
                              include_flight=include_flight),
+        )
+
+    def explainz(self, pod: str = "", victim: str = "",
+                 max_records: int = 8,
+                 include_auction: bool = False) -> pb.ExplainzResponse:
+        """Decision provenance (round 12): last-N DecisionRecord
+        summaries plus "why is `pod` pending/placed" and "who evicted
+        `victim`" — see SchedulerService.Explainz and
+        tools/explainz.py."""
+        return self._call(
+            self._explainz,
+            pb.ExplainzRequest(pod=pod, victim=victim,
+                               max_records=max_records,
+                               include_auction=include_auction),
         )
 
     def close(self):

@@ -126,6 +126,7 @@ import numpy as np
 
 import grpc
 
+from tpusched import explain as explaining
 from tpusched import trace as tracing
 from tpusched.config import Buckets, EngineConfig
 from tpusched.device_state import DeviceSnapshot
@@ -236,6 +237,18 @@ class _Metrics:
             "scheduler_coalesced_fuse_size",
             "callers sharing one coalesced ScoreBatch dispatch",
             buckets=(1, 2, 3, 4, 6, 8, 12, 16), registry=r)
+        # Decision provenance (round 12): outcome counts and pending
+        # causes, incremented per EXPLAINED cycle only (explain=off
+        # cycles don't classify — the counters say so in the help).
+        self.decisions = pm.Counter(
+            "scheduler_decisions_total",
+            "pod decision outcomes on explained cycles", ("outcome",),
+            registry=r)
+        self.pending_reasons = pm.Counter(
+            "scheduler_pending_pods_total",
+            "pending-pod causes on explained cycles (dominant filter "
+            "reason, or outranked when feasible nodes existed)",
+            ("reason",), registry=r)
 
     def observe(self, n_pods: int, n_placed: int, n_evicted: int,
                 dur: float, rpc: str = "Assign"):
@@ -661,6 +674,8 @@ class SchedulerService:
         flight: FlightRecorder | None = None,
         role: str = "leader",
         replication_log: "ReplicationLog | None" = None,
+        explain=False,
+        explain_k: int = 3,
     ):
         """audit_stream: optional file-like; when set, every Assign
         emits one JSON record PER POD (pod, node, score, commit_key —
@@ -690,7 +705,17 @@ class SchedulerService:
         its replication log) or "standby" (follows a leader's log via
         StandbyFollower; the first Assign/ScoreBatch promotes it —
         module docstring, round 11). replication_log: injectable
-        ReplicationLog (tests pin capacity to force the rebase path)."""
+        ReplicationLog (tests pin capacity to force the rebase path).
+
+        explain (round 12, ISSUE 8): decision provenance. True (or an
+        injected tpusched.explain.ExplainCollector) makes every Assign
+        an EXPLAINED cycle — the engine additionally runs the lazily-
+        compiled provenance programs (per-pod outcome + top-k score
+        decomposition + filter tallies + victim chains) and one
+        DecisionRecord lands in the collector, served by the Explainz
+        rpc and carried in flight-recorder dumps. Off (default) the
+        serving path is byte-identical to round 11: one enabled-check
+        per Assign. explain_k: candidate depth per pod."""
         from tpusched.faults import NO_FAULTS
 
         self.config = config or EngineConfig()
@@ -772,6 +797,30 @@ class SchedulerService:
             self._engine.tracer = tracer
             self._faults.tracer = tracer
         self.flight = flight if flight is not None else FlightRecorder()
+        # Decision provenance (round 12, ISSUE 8): collector + the
+        # flight-recorder attachment (dumps carry last-N decisions).
+        # ONE source for the candidate depth: an injected collector's
+        # topk wins (host-side wiring honors the same field); explain_k
+        # only applies when the server builds its own collector.
+        if isinstance(explain, explaining.ExplainCollector):
+            self.explain = explain
+        else:
+            self.explain = explaining.ExplainCollector(
+                enabled=bool(explain), topk=int(explain_k))
+        self._explain_k = int(self.explain.topk)
+        self.flight.decisions = self.explain
+        # Live device/store memory surface (ROADMAP item 1 feeds on
+        # this): rendered at scrape time from the authoritative maps.
+        from tpusched import metrics as pm
+
+        pm.CallbackGauge(
+            "scheduler_device_bytes",
+            "live device-resident and host-retained bytes by kind "
+            "(session_arrays: per-lineage DeviceSnapshot arrays on "
+            "device; byte_stores: registered snapshot byte stores, "
+            "shared records counted once per store)",
+            ("kind",), callback=self._device_bytes_by_kind,
+            registry=self.metrics.registry)
         self._resync_storm = StormDetector(n=4, window_s=5.0)
         self._closed = False
         # Replication (round 11, ISSUE 6): role, the op log, and the
@@ -1022,6 +1071,30 @@ class SchedulerService:
     def _drop_session(self, session) -> None:
         with self._store_lock:
             self._drop_session_locked(session)
+
+    def _device_bytes_by_kind(self) -> dict:
+        """Samples for the scheduler_device_bytes gauge (round 12):
+        distinct device-resident sessions' array bytes (a session
+        registered under two keys counts once) and the registered byte
+        stores' retained payload. Only the REFERENCE snapshot happens
+        under _store_lock — nbytes() walks O(records) per store, and a
+        scrape must not stall the Assign registration path behind that
+        walk. Array nbytes is metadata, no D2H."""
+        with self._store_lock:
+            distinct = []
+            for s in self._sessions.values():
+                if s not in distinct:
+                    distinct.append(s)
+            stores = list(self._stores.values())
+        store_bytes = sum(st.nbytes() for st in stores)
+        dev_bytes = 0
+        for s in distinct:
+            try:
+                dev_bytes += int(s.device.full_bytes)
+            except Exception:  # noqa: BLE001 — a scrape must not abort
+                continue
+        return {("session_arrays",): dev_bytes,
+                ("byte_stores",): store_bytes}
 
     # -- failure-domain helpers (round 8) -----------------------------------
 
@@ -1635,11 +1708,22 @@ class SchedulerService:
         # drives the device and fetches the packed buffer. The gate
         # (round 7) additionally keeps concurrent clients' dispatches
         # round-robin fair instead of lock-race ordered.
+        explain_on = self.explain.enabled
+        pending_probe = None
         t_q = time.perf_counter()
         with self._gate.slot(self._peer(context)):
             self._stage_done("gate.wait", t_q)
-            with self._trace.span("dispatch", cat="server"):
-                pending = self._engine.solve_async(snap)
+            with self._trace.span("dispatch", cat="server",
+                                  explained=explain_on):
+                if explain_on:
+                    # Explained cycle (round 12): the solve carries the
+                    # provenance extras and a second program decomposes
+                    # scores/filters — both fetch on the ordered worker.
+                    pending, pending_probe = (
+                        self._engine.solve_explained_async(
+                            snap, self._explain_k))
+                else:
+                    pending = self._engine.solve_async(snap)
         resp = pb.AssignResponse(snapshot_id=sid)
         P = meta.n_pods
         if request.packed_ok:
@@ -1652,7 +1736,11 @@ class SchedulerService:
                 # (sorted) node order, not the request's wire order —
                 # ship the table.
                 resp.node_names.extend(meta.node_names)
-        res = self._join_guarded(pending, "Assign solve")
+        exd = None
+        if explain_on:
+            res, exd = self._join_guarded(pending, "Assign solve")
+        else:
+            res = self._join_guarded(pending, "Assign solve")
         t_p = time.perf_counter()
         with self._trace.span("reply.pack", cat="server"):
             ni = np.asarray(res.assignment[:P], dtype=np.int32)
@@ -1704,6 +1792,44 @@ class SchedulerService:
                 with self._audit_lock:
                     self._audit.write("\n".join(lines) + "\n")
                     self._audit.flush()
+        if explain_on:
+            # BEST-EFFORT: the reply is already complete — a failed or
+            # wedged provenance probe must not fail a served placement
+            # (no _join_guarded here: a trip would also demote the
+            # ladder and abandon the fetch worker for an observability-
+            # only program). The plain result(timeout=) converts a hang
+            # into a skipped record instead.
+            try:
+                probe = pending_probe.result(timeout=self.watchdog_s)
+            except Exception:  # noqa: BLE001 — observability best-effort
+                import logging
+                import traceback
+
+                logging.getLogger("tpusched.rpc.server").warning(
+                    "explain probe failed; skipping the decision "
+                    "record:\n%s", traceback.format_exc(limit=3),
+                )
+                probe = None
+            if probe is not None:
+                ctx = self._trace.current()
+                rec = explaining.build_record(
+                    self.config, meta, res, exd, probe,
+                    rid=ctx[0] if ctx else "", snapshot_id=sid,
+                    rpc="Assign",
+                )
+                cyc = self.explain.record(rec)
+                # One "decision" event span under the request root: the
+                # Perfetto export's args then link the slow cycle to its
+                # DecisionRecord by cycle id (tools/tracez.py satellite).
+                self._trace.record("decision", cat="explain",
+                                   decision=cyc, pods=meta.n_pods,
+                                   evictions=n_evicted)
+                for oc, n in explaining.outcome_counts(rec).items():
+                    if n:
+                        self.metrics.decisions.labels(oc).inc(n)
+                for reason, n in explaining.pending_reasons(rec).items():
+                    if n:
+                        self.metrics.pending_reasons.labels(reason).inc(n)
         resp.rounds = res.rounds
         resp.solve_seconds = res.solve_seconds
         self._log_batch("Assign", meta, decode_s, res.solve_seconds,
@@ -1842,6 +1968,34 @@ class SchedulerService:
             trace_json=json.dumps({"traces": traces}), flight_json=flight
         )
 
+    def Explainz(self, request: pb.ExplainzRequest,
+                 context) -> pb.ExplainzResponse:
+        """Decision provenance (round 12): last-N DecisionRecords as
+        JSON summaries plus targeted queries — `pod` answers "why is P
+        pending / why did P land there" (full per-pod decision with the
+        score-term breakdown), `victim` answers "who evicted V" (victim
+        terms + evictor's decision + the auction round chain). Like
+        Debugz, a debug surface: JSON follows tpusched.explain
+        record_dict, not a stable API. Record summaries stay bounded
+        (per-pod decisions ship only for the requested pod)."""
+        col = self.explain
+        n = int(request.max_records)
+        n = 8 if n <= 0 else min(n, 64)
+        payload: dict = dict(
+            enabled=col.enabled,
+            recorded=col.recorded,
+            records=[
+                explaining.record_dict(
+                    r, include_auction=bool(request.include_auction))
+                for r in col.last(n)
+            ],
+        )
+        if request.pod:
+            payload["why"] = col.why(request.pod)
+        if request.victim:
+            payload["who_evicted"] = col.who_evicted(request.victim)
+        return pb.ExplainzResponse(explain_json=json.dumps(payload))
+
 
 def make_server(
     address: str = "127.0.0.1:0",
@@ -1858,6 +2012,8 @@ def make_server(
     flight: FlightRecorder | None = None,
     role: str = "leader",
     replication_log: "ReplicationLog | None" = None,
+    explain=False,
+    explain_k: int = 3,
 ):
     """Build (grpc.Server, bound_port, service). Unlimited message size:
     a 10k-pod snapshot exceeds the 4 MB default. max_workers default 8:
@@ -1868,13 +2024,16 @@ def make_server(
     faults/watchdog_s/ladder: failure-domain knobs; tracer/flight:
     observability knobs; role/replication_log: fleet knobs
     (SchedulerService; tpusched/replicate.py ReplicaSet wires a
-    standby's follower loop)."""
+    standby's follower loop); explain/explain_k: decision provenance
+    (round 12 — True or an ExplainCollector makes every Assign an
+    explained cycle, served by the Explainz rpc)."""
     svc = SchedulerService(config, buckets, log_stream=log_stream,
                            audit_stream=audit_stream,
                            device_sessions=device_sessions,
                            faults=faults, watchdog_s=watchdog_s,
                            ladder=ladder, tracer=tracer, flight=flight,
-                           role=role, replication_log=replication_log)
+                           role=role, replication_log=replication_log,
+                           explain=explain, explain_k=explain_k)
 
     def handler(fn, req_cls):
         return grpc.unary_unary_rpc_method_handler(
@@ -1890,6 +2049,7 @@ def make_server(
         "Metrics": handler(svc.Metrics, pb.MetricsRequest),
         "Debugz": handler(svc.Debugz, pb.DebugzRequest),
         "Replicate": handler(svc.Replicate, pb.ReplicateRequest),
+        "Explainz": handler(svc.Explainz, pb.ExplainzRequest),
     }
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
@@ -1906,11 +2066,12 @@ def make_server(
 
 
 def serve(address: str = "127.0.0.1:50051", config: EngineConfig | None = None,
-          audit_path: str | None = None, watchdog_s: float = WATCHDOG_S):
+          audit_path: str | None = None, watchdog_s: float = WATCHDOG_S,
+          explain: bool = False):
     """Blocking entry point: python -m tpusched.rpc.server"""
     audit = open(audit_path, "a") if audit_path else None
     server, port, svc = make_server(address, config, audit_stream=audit,
-                                    watchdog_s=watchdog_s)
+                                    watchdog_s=watchdog_s, explain=explain)
     server.start()
     print(f"tpusched sidecar listening on port {port}", file=sys.stderr)
     try:
@@ -1930,6 +2091,9 @@ if __name__ == "__main__":
     ap.add_argument("--watchdog-s", type=float, default=WATCHDOG_S,
                     help="per-dispatch result-join budget before a hung "
                          "solve is aborted as DEADLINE_EXCEEDED")
+    ap.add_argument("--explain", action="store_true",
+                    help="record decision provenance for every Assign "
+                         "(served by the Explainz rpc / tools/explainz.py)")
     args = ap.parse_args()
     cfg = None
     if args.config:
@@ -1937,4 +2101,4 @@ if __name__ == "__main__":
 
         cfg = load_config(args.config)
     serve(args.address, cfg, audit_path=args.audit,
-          watchdog_s=args.watchdog_s)
+          watchdog_s=args.watchdog_s, explain=args.explain)

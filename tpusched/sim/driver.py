@@ -122,7 +122,13 @@ class SimDriver:
         engine=None,
         faults=None,
         tracer=None,
+        explain=None,
     ):
+        """explain (round 12): optional ExplainCollector threaded into
+        the in-process HostScheduler — every cycle records a
+        DecisionRecord on VIRTUAL time, the input report.py's
+        miss-attribution join consumes. gRPC runs record server-side
+        instead (run_scenario wires the collector into make_server)."""
         self.sc = scenario
         self.seed = int(seed)
         self.cfg = effective_config(scenario, config)
@@ -149,6 +155,7 @@ class SimDriver:
             backoff_initial=self.sim.backoff_initial_s,
             backoff_max=self.sim.backoff_max_s,
             transport="pipeline" if client is not None else "delta",
+            explain=explain,
         )
         self.backend = "grpc" if client is not None else "inprocess"
 
@@ -366,6 +373,7 @@ def run_scenario(
     faults=None,
     tracer=None,
     replicas: int = 1,
+    explain=None,
 ) -> SimResult:
     """One sim run. backend="grpc" spins an in-process sidecar and
     drives the full host -> gRPC path (AssignPipeline transport);
@@ -373,12 +381,17 @@ def run_scenario(
     one jit cache across runs of the SAME config). replicas > 1 (grpc
     only) serves from a tpusched.replicate.ReplicaSet — warm-standby
     replication behind the same pipeline transport, so long simulated
-    horizons ride the failover machinery the chaos harness pins."""
+    horizons ride the failover machinery the chaos harness pins.
+    explain: optional ExplainCollector — in-process it rides the host,
+    on grpc it is handed to make_server so the sidecar records every
+    Assign (same collector object either way; replicas > 1 records on
+    the initial leader only)."""
     if backend == "inprocess":
         if replicas != 1:
             raise ValueError("replicas > 1 needs backend='grpc'")
         return SimDriver(scenario, seed, config=config, sim=sim,
-                         engine=engine, faults=faults, tracer=tracer).run()
+                         engine=engine, faults=faults, tracer=tracer,
+                         explain=explain).run()
     if backend != "grpc":
         raise ValueError(f"backend={backend!r}: want inprocess|grpc")
     from tpusched.rpc.client import SchedulerClient
@@ -388,7 +401,8 @@ def run_scenario(
     if replicas > 1:
         from tpusched.replicate import ReplicaSet
 
-        fleet = ReplicaSet(replicas, config=cfg, faults=faults)
+        fleet = ReplicaSet(replicas, config=cfg, faults=faults,
+                           explain=explain)
         client = SchedulerClient(fleet.addresses())
         try:
             return SimDriver(scenario, seed, config=cfg, sim=sim,
@@ -397,7 +411,7 @@ def run_scenario(
             client.close()
             fleet.close()
     server, port, svc = make_server("127.0.0.1:0", config=cfg,
-                                    faults=faults)
+                                    faults=faults, explain=explain)
     server.start()
     client = SchedulerClient(f"127.0.0.1:{port}")
     try:
@@ -430,12 +444,20 @@ def twin_run(
     sim: "SimConfig | None" = None,
     backend: str = "inprocess",
     log=None,
+    explain: bool = False,
 ) -> dict:
     """The headline experiment: same scenario, same seed, QoS-driven vs
     static-priority baseline. Returns both summaries plus
     attainment_gain_vs_static (fraction of SLO-carrying pods attaining
     their target, QoS minus static) — the reference paper's central
-    claim as a repeatable bench number."""
+    claim as a repeatable bench number.
+
+    explain=True (round 12) runs each arm with a per-arm
+    ExplainCollector and attaches `miss_attribution` to its summary:
+    every missed-SLO pod joined to its recorded decision chain, rolled
+    up into a "top miss causes" table (report.miss_attribution) — the
+    twin then says not just THAT static lost but WHY its misses
+    happened (preempted vs unschedulable vs outranked)."""
     from tpusched.sim import report
 
     cfg = effective_config(scenario, config)
@@ -450,9 +472,19 @@ def twin_run(
         if log:
             log(f"[sim] twin-run arm={arm} scenario={scenario.name} "
                 f"seed={seed} qos_gain={arm_cfg.qos.qos_gain}")
+        col = None
+        if explain:
+            from tpusched.explain import ExplainCollector
+
+            # Capacity covers a full horizon of per-tick cycles, so the
+            # attribution join sees every decision, not a recent window.
+            col = ExplainCollector(capacity=65536, enabled=True)
         res = run_scenario(scenario, seed, config=arm_cfg, sim=sim,
-                           backend=backend)
+                           backend=backend, explain=col)
         results[arm] = report.summarize(res)
+        if col is not None:
+            results[arm]["miss_attribution"] = report.miss_attribution(
+                res, col.records())
         if log:
             s = results[arm]
             log(f"[sim]   attainment={s['slo_attainment_frac']} "

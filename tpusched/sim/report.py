@@ -108,7 +108,8 @@ def render_text(summary: dict) -> str:
 
 
 def render_twin(twin: dict) -> str:
-    """Twin-run comparison block."""
+    """Twin-run comparison block (+ per-arm miss attribution when the
+    twin ran explained)."""
     q, s = twin["qos"], twin["static"]
     lines = [
         f"twin-run scenario={twin['scenario']} seed={twin['seed']} "
@@ -120,4 +121,139 @@ def render_twin(twin: dict) -> str:
         f"  attainment_gain_vs_static = "
         f"{twin['attainment_gain_vs_static']}",
     ]
+    for arm in ("qos", "static"):
+        att = twin[arm].get("miss_attribution")
+        if att:
+            lines.append(render_attribution(att, label=arm))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Miss attribution (round 12, ISSUE 8): join missed-SLO pods to their
+# recorded decision chains.
+# ---------------------------------------------------------------------------
+
+# Cause labels, most to least actionable. A pod can match several over
+# its lifetime (evicted AND later unschedulable); the FIRST matching
+# cause in this order wins — eviction explains a miss better than the
+# requeue-era pending states it produces.
+CAUSE_PREEMPTED = "preempted"
+CAUSE_UNSCHED = "unschedulable"      # rendered with dominant reason
+CAUSE_OUTRANKED = "outranked"        # feasible nodes existed; capacity
+#                                      went to higher-priority pods
+CAUSE_GANG_HELD = "gang_held"
+CAUSE_PLACED_LATE = "placed_below_slo"  # placed whenever seen; the SLO
+#                                      was lost to queueing before/after
+#                                      the recorded window
+CAUSE_NO_RECORD = "no_decision_recorded"
+
+
+def miss_attribution(res, records) -> dict:
+    """Join every missed-SLO pod of a SimResult to its decision chain
+    across the run's DecisionRecords (tpusched.explain) and roll the
+    per-pod causes into a "top miss causes" table.
+
+    Per missed pod, the recorded evidence is summarized as:
+      * preempted    — it shows up as an eviction victim (the record
+                       names the evictor and auction round);
+      * unschedulable:<reason> — some cycle left it pending with ZERO
+                       feasible nodes; <reason> is the dominant
+                       filter-elimination reason at the LAST such cycle;
+      * outranked    — pending cycles always had feasible nodes; the
+                       capacity went to higher-priority pods;
+      * gang_held    — held below gang quorum;
+      * placed_below_slo — every recorded sighting was a placement; the
+                       availability was lost outside scheduling;
+      * no_decision_recorded — never in an explained batch (ring
+                       overflow or arrival after the last cycle).
+
+    Returns {"misses": n, "causes": {label: count}, "pods": {name:
+    {cause, evidence...}}} — json-friendly; render_attribution prints
+    the table. Consistency contract (test-pinned): every "preempted"
+    pod IS an eviction victim in some record; every "unschedulable"
+    pod has a recorded zero-feasible pending cycle."""
+    from tpusched.explain import (_NO_FEASIBLE, OUTCOME_GANG_HELD,
+                                  OUTCOME_PENDING, OUTCOMES,
+                                  _pending_reason)
+
+    pend_code = OUTCOMES.index(OUTCOME_PENDING)
+    gang_code = OUTCOMES.index(OUTCOME_GANG_HELD)
+    # Pod -> accumulated evidence over the record stream (records are
+    # oldest-first; later sightings overwrite "last_*" fields).
+    seen: dict[str, dict] = {}
+    for rec in records:
+        for i, name in enumerate(rec.pod_names):
+            ev = seen.setdefault(name, {})
+            code = int(rec.outcome[i])
+            if code == pend_code:
+                if int(rec.feasible_nodes[i]) == 0:
+                    ev["unsched_reason"] = _pending_reason(rec, i)
+                    ev["unsched_cycle"] = rec.cycle
+                else:
+                    ev["outranked_cycles"] = ev.get("outranked_cycles", 0) + 1
+            elif code == gang_code:
+                ev["gang_held"] = True
+            else:
+                ev["placed_cycles"] = ev.get("placed_cycles", 0) + 1
+        for m, vname in enumerate(rec.running_names):
+            if rec.evicted[m]:
+                evictor = int(rec.evictor[m])
+                seen.setdefault(vname, {})["evicted"] = dict(
+                    cycle=rec.cycle,
+                    by=(rec.pod_names[evictor]
+                        if 0 <= evictor < len(rec.pod_names) else None),
+                    round=int(rec.evict_round[m]),
+                )
+    causes: dict[str, int] = {}
+    pods: dict[str, dict] = {}
+    n_miss = 0
+    for p in res.pods:
+        if p.attained is not False:
+            continue  # attained, or SLO-less (None)
+        n_miss += 1
+        ev = seen.get(p.name, {})
+        if "evicted" in ev or p.evictions > 0:
+            cause = CAUSE_PREEMPTED
+            detail = ev.get("evicted", {})
+        elif "unsched_reason" in ev:
+            reason = ev["unsched_reason"]
+            if reason.startswith(_NO_FEASIBLE):
+                reason = reason[len(_NO_FEASIBLE):]
+            cause = f"{CAUSE_UNSCHED}:{reason}"
+            detail = dict(last_cycle=ev.get("unsched_cycle"))
+        elif ev.get("outranked_cycles"):
+            cause = CAUSE_OUTRANKED
+            detail = dict(pending_cycles=ev["outranked_cycles"])
+        elif ev.get("gang_held"):
+            cause = CAUSE_GANG_HELD
+            detail = {}
+        elif ev.get("placed_cycles"):
+            cause = CAUSE_PLACED_LATE
+            detail = dict(placed_cycles=ev["placed_cycles"])
+        else:
+            cause = CAUSE_NO_RECORD
+            detail = {}
+        causes[cause] = causes.get(cause, 0) + 1
+        pods[p.name] = dict(cause=cause, final_avail=p.final_avail,
+                            slo=p.slo, **detail)
+    return dict(misses=n_miss, causes=causes, pods=pods)
+
+
+def render_attribution(att: dict, label: str = "") -> str:
+    """The "top miss causes" table, most frequent first, with one
+    example pod per cause."""
+    tag = f" ({label})" if label else ""
+    lines = [f"  top miss causes{tag}: {att['misses']} missed-SLO pods"]
+    by_cause: dict[str, list] = {}
+    for name, d in att["pods"].items():
+        by_cause.setdefault(d["cause"], []).append((name, d))
+    for cause, n in sorted(att["causes"].items(),
+                           key=lambda kv: (-kv[1], kv[0])):
+        ex_name, ex = by_cause[cause][0]
+        extra = ""
+        if cause == CAUSE_PREEMPTED and ex.get("by"):
+            extra = f" (e.g. {ex_name} evicted by {ex['by']})"
+        elif ex:
+            extra = f" (e.g. {ex_name})"
+        lines.append(f"    {cause:<34} {n:>5}{extra}")
     return "\n".join(lines)

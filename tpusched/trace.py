@@ -285,6 +285,12 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._dumps: deque = deque(maxlen=int(capacity))
         self.trips = 0
+        # Optional tpusched.explain.ExplainCollector (round 12): when
+        # attached AND enabled, every dump also carries the last-N
+        # decision records, so a watchdog trip / ladder demotion ships
+        # the DECISIONS in flight alongside the causal trace.
+        self.decisions = None
+        self.decisions_last = 4
 
     def record(self, reason: str, collector: TraceCollector,
                **extra) -> dict:
@@ -292,6 +298,14 @@ class FlightRecorder:
             ts=time.time(), reason=reason, extra=extra,
             spans=[span_dict(s) for s in collector.spans()],
         )
+        dec = self.decisions
+        if dec is not None and getattr(dec, "enabled", False):
+            from tpusched import explain as _explain
+
+            dump["decisions"] = [
+                _explain.record_dict(r, include_auction=True)
+                for r in dec.last(self.decisions_last)
+            ]
         with self._lock:
             self._dumps.append(dump)
             self.trips += 1
