@@ -1285,7 +1285,7 @@ def bench_robustness(args):
 
 
 def bench_sim(args):
-    """SLO attainment via the virtual-time simulator (ISSUE 5): the
+    """SLO attainment via the virtual-time simulator (ISSUE 5, 9): the
     twin run — same scenario, same seed, QoS-driven vs static-priority
     baseline (qos_gain=0) — reproducing the reference paper's central
     claim as bench numbers:
@@ -1296,6 +1296,14 @@ def bench_sim(args):
       attainment_gain_vs_static   that fraction minus the static
                                   baseline's, on an identical timeline
 
+    --sim-scenario all (ISSUE 9) runs the MATRIX instead: twin runs
+    across workloads.MATRIX_SCENARIOS (>= 6 Borg/Azure-shaped
+    scenarios incl. autoscale + gang pressure), emitting per scenario
+    slo_attainment_frac_<sc> / attainment_gain_vs_static_<sc> /
+    preemption_churn[_static]_<sc>, each line carrying an explicit
+    "direction" annotation so tools/benchdiff.py flags regressions the
+    right way (attainment higher-better, churn lower-better).
+
     Deterministic: the emitted event-log hashes pin both arms' full
     causal chains (arrivals, binds, evictions, completions) for the
     seed, so regressions show as hash changes, not metric wobble.
@@ -1303,8 +1311,42 @@ def bench_sim(args):
     import dataclasses as _dc
 
     from tpusched.sim import report as sim_report
-    from tpusched.sim.driver import twin_run
+    from tpusched.sim.driver import matrix_run, twin_run
     from tpusched.sim.workloads import SCENARIOS
+
+    if args.sim_scenario == "all":
+        matrix = matrix_run(seed=args.sim_seed,
+                            horizon_s=args.sim_horizon, log=log)
+        log(sim_report.render_matrix(matrix))
+        for row in matrix["rows"]:
+            name = row["scenario"]
+            common = dict(
+                scenario=name, seed=args.sim_seed,
+                slo_pods=row["slo_pods"],
+                hash_qos=row["hash_qos"], hash_static=row["hash_static"],
+            )
+            for metric, value, direction in (
+                (f"slo_attainment_frac_{name}",
+                 row["slo_attainment_frac"], "higher"),
+                (f"slo_attainment_frac_static_{name}",
+                 row["slo_attainment_frac_static"], "higher"),
+                (f"attainment_gain_vs_static_{name}",
+                 row["attainment_gain_vs_static"], "higher"),
+                (f"preemption_churn_{name}",
+                 row["preemption_churn"], "lower"),
+                (f"preemption_churn_static_{name}",
+                 row["preemption_churn_static"], "lower"),
+            ):
+                line = {"metric": metric, "value": value, "unit": "frac",
+                        "vs_baseline": None, "direction": direction}
+                line.update(common)
+                print(json.dumps(line), flush=True)
+            log(f"slo_attainment_frac_{name}: "
+                f"{row['slo_attainment_frac']} "
+                f"(static {row['slo_attainment_frac_static']}, churn "
+                f"{row['preemption_churn']}/"
+                f"{row['preemption_churn_static']})")
+        return
 
     sc = SCENARIOS[args.sim_scenario]
     if args.sim_horizon is not None:
@@ -1327,7 +1369,7 @@ def bench_sim(args):
         ("attainment_gain_vs_static", twin["attainment_gain_vs_static"]),
     ):
         line = {"metric": metric, "value": value, "unit": "frac",
-                "vs_baseline": None}
+                "vs_baseline": None, "direction": "higher"}
         if TRANSPORT:
             line["rtt_ms"] = TRANSPORT["rtt_ms"]
         line.update(common)
@@ -1397,7 +1439,8 @@ def main():
                          "--only sim)")
     ap.add_argument("--sim-scenario", default="pressure_skew",
                     help="sim bench scenario (tpusched.sim.workloads."
-                         "SCENARIOS)")
+                         "SCENARIOS), or 'all' for the twin-run "
+                         "matrix across MATRIX_SCENARIOS")
     ap.add_argument("--sim-seed", type=int, default=0)
     ap.add_argument("--sim-horizon", type=float, default=None,
                     help="override the scenario's virtual horizon (s)")

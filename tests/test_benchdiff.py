@@ -1,0 +1,79 @@
+"""tools/benchdiff.py direction handling (ISSUE 9 satellite): the
+sim-matrix metrics regress in the right direction — explicit
+"direction" annotations on bench lines win, and the name fallbacks
+classify attainment (higher-better) and churn (lower-better)."""
+
+import importlib.util
+import json
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "benchdiff",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "tools", "benchdiff.py"),
+)
+benchdiff = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(benchdiff)
+
+
+def _snap(tmp_path, n, metrics):
+    p = tmp_path / f"BENCH_r{n:02d}.json"
+    tail = "\n".join(json.dumps(m) for m in metrics)
+    p.write_text(json.dumps({"n": n, "tail": tail}))
+    return p
+
+
+def test_direction_annotation_wins_and_name_fallbacks(tmp_path):
+    a = _snap(tmp_path, 1, [
+        dict(metric="slo_attainment_frac_pressure_skew", value=0.7,
+             unit="frac", direction="higher"),
+        dict(metric="preemption_churn_pressure_skew", value=0.1,
+             unit="frac", direction="lower"),
+        # No annotation: name fallbacks must classify these.
+        dict(metric="slo_attainment_frac_steady_state", value=0.9,
+             unit="frac"),
+        dict(metric="preemption_churn_static_burst", value=0.1,
+             unit="frac"),
+        # An annotation that CONTRADICTS the unit inference must win.
+        dict(metric="warmup_cost_ms", value=100.0, unit="ms",
+             direction="higher"),
+    ])
+    b = _snap(tmp_path, 2, [
+        dict(metric="slo_attainment_frac_pressure_skew", value=0.4,
+             unit="frac", direction="higher"),       # down = regression
+        dict(metric="preemption_churn_pressure_skew", value=0.5,
+             unit="frac", direction="lower"),        # up = regression
+        dict(metric="slo_attainment_frac_steady_state", value=0.5,
+             unit="frac"),                           # down = regression
+        dict(metric="preemption_churn_static_burst", value=0.5,
+             unit="frac"),                           # up = regression
+        dict(metric="warmup_cost_ms", value=50.0, unit="ms",
+             direction="higher"),                    # down = regression
+    ])
+    diff = benchdiff.diff_rounds([a, b], threshold=0.10)
+    m = diff["metrics"]
+    assert not m["slo_attainment_frac_pressure_skew"]["lower_is_better"]
+    assert m["preemption_churn_pressure_skew"]["lower_is_better"]
+    assert not m["slo_attainment_frac_steady_state"]["lower_is_better"]
+    assert m["preemption_churn_static_burst"]["lower_is_better"]
+    assert not m["warmup_cost_ms"]["lower_is_better"], \
+        "an explicit direction beats the ms-unit inference"
+    assert all(mm["regressed"] for mm in m.values()), \
+        {k: v["regressed"] for k, v in m.items()}
+
+
+def test_improvements_do_not_flag(tmp_path):
+    a = _snap(tmp_path, 4, [
+        dict(metric="slo_attainment_frac_gang_pressure", value=0.4,
+             unit="frac", direction="higher"),
+        dict(metric="preemption_churn_gang_pressure", value=0.5,
+             unit="frac", direction="lower"),
+    ])
+    b = _snap(tmp_path, 5, [
+        dict(metric="slo_attainment_frac_gang_pressure", value=0.8,
+             unit="frac", direction="higher"),
+        dict(metric="preemption_churn_gang_pressure", value=0.1,
+             unit="frac", direction="lower"),
+    ])
+    diff = benchdiff.diff_rounds([a, b], threshold=0.10)
+    assert not any(m["regressed"] for m in diff["metrics"].values())

@@ -324,6 +324,194 @@ def test_sim_grpc_end_to_end_smoke(thread_leak_check):
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 9: autoscale + heterogeneous pools, gang arrivals under
+# pressure, and the soak composition.
+# ---------------------------------------------------------------------------
+
+
+def test_autoscale_drives_device_rebuilds_grpc(thread_leak_check):
+    """Acceptance (ISSUE 9): mid-horizon autoscale events measurably
+    exercise the device-resident growth paths — the tainted pool's
+    first grow is a brand-new taint vocabulary entry (new_taint
+    rebuild) and the staged +1 grow bursts the 8-row node bucket
+    (row_bucket rebuild); the session's node bucket provably grew.
+    pipeline_refresh_frac pins the delta path so growth arrives as
+    session applies, not churn-triggered full-send reseeds."""
+    from tpusched.rpc.client import SchedulerClient
+    from tpusched.rpc.server import make_server
+
+    sc = dataclasses.replace(
+        workloads.SCENARIOS["autoscale_stress"], horizon_s=45.0,
+        autoscale=((10.0, "grow", 1, 2), (20.0, "grow", 0, 1),
+                   (22.0, "grow", 0, 3), (35.0, "shrink", 0, 2)),
+    )
+    cfg = effective_config(sc, None)
+    server, port, svc = make_server("127.0.0.1:0", config=cfg)
+    server.start()
+    client = SchedulerClient(f"127.0.0.1:{port}")
+    try:
+        d = SimDriver(sc, seed=0, config=cfg, client=client,
+                      sim=SimConfig(pipeline_refresh_frac=10.0))
+        res = d.run()
+        # Capture BEFORE close(): svc.close() drops the sessions.
+        sessions = list({id(s): s for s in svc._sessions.values()}
+                        .values())
+        rebuilds = sum(s.device.rebuilds for s in sessions)
+        reasons = {r for s in sessions
+                   for r in s.device.rebuild_reasons}
+        node_bucket = max(s.device.meta.buckets.nodes for s in sessions)
+    finally:
+        client.close()
+        server.stop(0)
+        svc.close()
+    assert res.autoscale_events == 8, "2+1+3 grows + 2 shrinks applied"
+    assert rebuilds > 0, "autoscale must exercise the rebuild path"
+    assert "new_taint" in reasons, \
+        f"tainted pool growth must force the vocab rebuild: {reasons}"
+    assert "row_bucket" in reasons, \
+        f"+1 past the 8-row bucket must force bucket growth: {reasons}"
+    assert node_bucket >= 16, "the node bucket provably grew"
+    assert res.placed > 0 and res.completions > 0
+    s = sim_report.summarize(res)
+    assert s["autoscale_events"] == 8
+
+
+def test_autoscale_scale_down_requeues_with_history():
+    """Scale-down interrupts running pods like a real node drain: the
+    victim re-queues with banked run credit (availability keeps
+    decaying from where it was, not from 1.0)."""
+    sc = workloads.Scenario(
+        name="scale_down_tiny", horizon_s=30.0,
+        pools=((2, 1),),
+        autoscale=((10.0, "shrink", 0, 1),),
+        arrival="poisson", rate=0.0, prefill=6,
+        prefill_duration_s=(25.0, 28.0),
+        mix=((1.0, 0.0, (25.0, 28.0), (50, 51), (1800.0, 2000.0)),),
+    )
+    res = run_scenario(sc, seed=0)
+    assert res.autoscale_events == 1
+    assert res.requeues >= 1, "the drained node's pods re-queued"
+    interrupted = [p for p in res.pods if p.evictions > 0]
+    assert interrupted, "scale-down interrupted running pods"
+    assert all(p.ran_s > 0 for p in interrupted), \
+        "run credit survives the autoscale_down requeue"
+
+
+def test_gang_under_pressure_held_not_partially_bound():
+    """ISSUE 9 satellite: a gang that cannot fully place (4 members x
+    1500 cpu on one 4000-cpu node) is HELD — no member is ever bound,
+    no capacity leaks — and miss_attribution classifies every member
+    gang_held (group-propagated past the members that merely read
+    'pending' in the rollback cycle)."""
+    from tpusched.explain import ExplainCollector
+
+    sc = workloads.Scenario(
+        name="gang_held_tiny", n_nodes=1, node_class=0, horizon_s=30.0,
+        arrival="poisson", rate=0.05,
+        gang_frac=1.0, gang_size=4,
+        mix=((1.0, 0.9, (10.0, 10.0), (50, 51), (1500.0, 1600.0)),),
+    )
+    col = ExplainCollector(capacity=4096, enabled=True)
+    res = run_scenario(sc, seed=0, explain=col)   # seed 0: ONE gang
+    assert len(res.pods) == 4 and res.placed == 0
+    assert all(p.ran_s == 0.0 and p.evictions == 0 for p in res.pods), \
+        "held means NEVER partially bound"
+    assert all(p.gang for p in res.pods)
+    att = sim_report.miss_attribution(res, col.records())
+    assert att["misses"] == 4
+    assert att["causes"] == {"gang_held": 4}, att["causes"]
+    for d in att["pods"].values():
+        assert d["cause"] == "gang_held"
+
+
+def test_interrupted_gang_reforms_quorum_together():
+    """A gang member losing its node pulls the WHOLE gang back to
+    pending (gang_reform): the solver's minMember quorum is
+    batch-local, so a lone requeued member would be held forever.
+    Deterministic interrupt via autoscale shrink: 2 nodes, a 2-member
+    gang split one-per-node, scale down one node at t=15, grow it back
+    at t=25 — the gang re-forms quorum in one batch and completes."""
+    sc = workloads.Scenario(
+        name="gang_reform_tiny", horizon_s=70.0,
+        pools=((2, 0),),                      # 2 x 4000 cpu
+        autoscale=((15.0, "shrink", 0, 1), (25.0, "grow", 0, 1)),
+        arrival="poisson", rate=0.012,  # seed 34: ONE gang, at t=0.5
+        gang_frac=1.0, gang_size=2,
+        mix=((1.0, 0.0, (20.0, 20.0), (50, 51), (2500.0, 2600.0)),),
+    )
+    d = SimDriver(sc, seed=34)
+    res = d.run()
+    members = [p for p in res.pods if p.gang]
+    assert len(members) == 2
+    # Both members were interrupted (one by the shrink, one pulled
+    # along by gang_reform) and both re-placed and completed.
+    assert all(p.evictions >= 1 for p in members), \
+        [p.evictions for p in members]
+    assert res.requeues >= 2
+    assert all(p.completed for p in members), \
+        "the gang re-formed quorum and finished (no lone-member " \
+        "livelock)"
+    kinds = [e["kind"] for e in d.q.log]
+    assert "gang_reform" in kinds
+    # All-or-nothing held throughout: bind events for the two members
+    # come in pairs (same note timestamp), never a lone member bound.
+    binds = [e for e in d.q.log if e["kind"] == "bind"
+             and e["pod"] in {p.name for p in members}]
+    by_t: dict = {}
+    for b in binds:
+        by_t.setdefault(b["t"], []).append(b["pod"])
+    assert all(len(v) == 2 for v in by_t.values()), by_t
+
+
+def test_colocated_gang_interrupt_counts_once():
+    """Gang members CO-LOCATED on the removed node: the first victim's
+    gang_reform propagation re-queues the sibling before the victims
+    loop reaches it — the second pass must be a no-op, not a second
+    banked eviction (evictions [1,1], requeues 2, not [1,2]/3)."""
+    sc = workloads.Scenario(
+        name="gang_colo_tiny", horizon_s=70.0,
+        # ONE node, so the shrink is guaranteed to hit the gang's node
+        # (shrink removes the pool's highest-numbered = only node).
+        pools=((1, 0),),
+        autoscale=((15.0, "shrink", 0, 1), (25.0, "grow", 0, 1)),
+        arrival="poisson", rate=0.012,  # seed 34: one gang at t=0.5
+        gang_frac=1.0, gang_size=2,
+        # 1700 cpu each: BOTH members fit the one 4000-cpu node.
+        mix=((1.0, 0.0, (20.0, 20.0), (50, 51), (1700.0, 1750.0)),),
+    )
+    res = SimDriver(sc, seed=34).run()
+    members = [p for p in res.pods if p.gang]
+    assert len(members) == 2
+    assert all(p.evictions == 1 for p in members), \
+        [p.evictions for p in members]
+    assert res.requeues == 2, res.requeues
+    assert all(p.completed for p in members), \
+        "gang re-placed together after the node grew back"
+
+
+def test_soak_smoke_composes_faults_with_sim_clock():
+    """Bounded tier-1 form of the long-horizon soak (ISSUE 9):
+    diurnal load + node flaps + autoscale + gangs + a seeded
+    engine-fault plan on one timeline. Injected engine.fetch errors
+    drop cycles (counted + logged as cycle_failed — part of the
+    deterministic hash), and the run still completes work."""
+    from tpusched.sim import generators
+
+    sc = generators.soak_smoke(45.0)
+    d = SimDriver(sc, seed=0,
+                  faults=generators.soak_fault_plan(0, cycles=45))
+    res = d.run()
+    assert res.failed_cycles >= 1, "the fault plan actually fired"
+    assert res.completions > 0 and res.placed > 0
+    assert res.autoscale_events > 0 and res.node_failures > 0
+    s = sim_report.summarize(res)
+    assert s["failed_cycles"] == res.failed_cycles
+    # The drops are IN the hash-covered applied log, so the fault
+    # schedule is part of the deterministic timeline.
+    assert any(e["kind"] == "cycle_failed" for e in d.q.log)
+
+
+# ---------------------------------------------------------------------------
 # Long scenarios (full horizons): excluded from tier-1.
 # ---------------------------------------------------------------------------
 
@@ -347,3 +535,59 @@ def test_burst_twin_full_horizon():
     assert twin["qos"]["slo_attainment_frac"] >= \
         twin["static"]["slo_attainment_frac"], \
         "QoS must not LOSE to static under bursts"
+
+
+@pytest.mark.slow
+def test_soak_storm_full_horizon_deterministic():
+    """The 600-virtual-second soak (ISSUE 9): diurnal + flaps +
+    autoscale + gangs + lognormal tails + injected faults, twice on
+    one seed — byte-identical event logs, faults fired both times."""
+    from tpusched.sim import generators
+
+    sc = workloads.SCENARIOS["soak_storm"]
+    a = run_scenario(sc, seed=0,
+                     faults=generators.soak_fault_plan(0, cycles=600))
+    b = run_scenario(sc, seed=0,
+                     faults=generators.soak_fault_plan(0, cycles=600))
+    assert a.event_log_hash == b.event_log_hash
+    assert a.failed_cycles >= 1 and a.failed_cycles == b.failed_cycles
+    assert a.node_failures > 0 and a.autoscale_events > 0
+    assert a.completions > 0
+    s = sim_report.summarize(a)
+    assert 0.0 <= s["slo_attainment_frac"] <= 1.0
+
+
+@pytest.mark.slow
+def test_soak_twin_with_faults_factory():
+    """twin_run(faults_factory=...): both arms get a FRESH seeded
+    FaultPlan (plans carry invocation counters), so a faulted soak
+    twins deterministically — the same shots drop cycles in each arm."""
+    from tpusched.sim import generators
+
+    sc = generators.soak_smoke(60.0)
+    twin = twin_run(
+        sc, seed=0,
+        faults_factory=lambda: generators.soak_fault_plan(0, cycles=60),
+    )
+    assert twin["qos"]["failed_cycles"] >= 1
+    assert twin["static"]["failed_cycles"] >= 1
+    assert twin["qos"]["slo_pods"] == twin["static"]["slo_pods"] > 0
+
+
+@pytest.mark.slow
+def test_matrix_run_covers_scenarios():
+    """matrix_run (the bench.py --sim-scenario all surface) produces a
+    row per scenario with both arms' attainment + churn + hashes."""
+    from tpusched.sim.driver import matrix_run
+
+    out = matrix_run(scenario_names=["steady_state", "gang_pressure"],
+                     seed=0, horizon_s=40.0)
+    assert [r["scenario"] for r in out["rows"]] == \
+        ["steady_state", "gang_pressure"]
+    for r in out["rows"]:
+        assert 0.0 <= r["slo_attainment_frac"] <= 1.0
+        assert 0.0 <= r["slo_attainment_frac_static"] <= 1.0
+        assert r["preemption_churn"] >= 0.0
+        assert r["hash_qos"] and r["hash_static"]
+    text = sim_report.render_matrix(out)
+    assert "gang_pressure" in text and "churn" in text

@@ -88,3 +88,31 @@ def test_tenant_axis_sharded_over_mesh():
         np.nan_to_num(c, neginf=-1.0), np.nan_to_num(c2, neginf=-1.0),
         rtol=1e-5,
     )
+
+
+def test_zipf_weights_is_the_shared_tenant_skew_definition():
+    """ISSUE 9 satellite: tenants.zipf_weights is THE Zipf tenant-skew
+    definition — the sim's workload generators draw from it (no local
+    re-derivation), skew 0 is uniform, higher skew concentrates the
+    head, and weights always normalize."""
+    from tpusched.sim import workloads
+    from tpusched.tenants import zipf_weights
+
+    # The sim sources the definition from tenants.py, not a local copy.
+    assert workloads.zipf_weights is zipf_weights
+
+    w0 = zipf_weights(4, 0.0)
+    np.testing.assert_allclose(w0, np.full(4, 0.25))
+    for skew in (0.5, 1.0, 1.4):
+        w = zipf_weights(6, skew)
+        assert w.sum() == pytest.approx(1.0)
+        assert (np.diff(w) < 0).all(), "monotone head-heavy"
+    # Higher skew => heavier head.
+    assert zipf_weights(6, 1.4)[0] > zipf_weights(6, 0.5)[0]
+    # Exact Zipf form: w_r proportional to 1/r^s.
+    w = zipf_weights(3, 1.0)
+    np.testing.assert_allclose(w / w[0], [1.0, 0.5, 1.0 / 3.0])
+    # Negative skew clamps to uniform; n must be positive.
+    np.testing.assert_allclose(zipf_weights(3, -2.0), np.full(3, 1 / 3))
+    with pytest.raises(ValueError):
+        zipf_weights(0, 1.0)

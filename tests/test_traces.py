@@ -1,0 +1,188 @@
+"""Trace-driven workloads (ISSUE 9): the on-disk trace format's
+round-trip lint (generate -> write -> load -> replay must reproduce the
+in-memory run's event-log hash BYTE-identically), the schema validator's
+error surface, and the generator axes (gangs, pools, autoscale,
+lognormal durations) surviving serialization."""
+
+import dataclasses
+import json
+
+import pytest
+
+from tpusched.config import EngineConfig
+from tpusched.sim import generators, traces, workloads
+from tpusched.sim.driver import effective_config, run_scenario
+from tpusched.sim.traces import TraceError
+
+
+def _events(setup):
+    return [(e.time, e.kind, sorted(e.data.items()))
+            for e in setup.queue.events()]
+
+
+def test_trace_round_trip_setup_equality(tmp_path):
+    """write -> load reproduces the generated SimSetup exactly: nodes
+    (order + content), specs, meta, and the full event timeline —
+    including autoscale node_add specs and gang pod_groups."""
+    for name in ("borg_longtail", "autoscale_stress", "gang_pressure"):
+        sc = workloads.SCENARIOS[name]
+        setup = workloads.generate(sc, seed=4)
+        path = str(tmp_path / f"{name}.jsonl")
+        traces.write_trace(setup, path)
+        loaded = traces.load_trace(path)
+        assert loaded.nodes == setup.nodes, name
+        assert loaded.specs == setup.specs, name
+        assert loaded.meta == setup.meta, name
+        assert _events(loaded) == _events(setup), name
+        assert loaded.seed == setup.seed
+        assert loaded.scenario.horizon_s == sc.horizon_s
+        assert loaded.scenario.preemption == sc.preemption
+
+
+def test_trace_replay_hash_byte_identical(tmp_path):
+    """ISSUE 9 acceptance: replaying a written trace through SimDriver
+    yields the SAME event-log hash as the in-memory run of the
+    generated workload — the trace ingestion path and the generator
+    path are one code path."""
+    from tpusched.engine import Engine
+
+    sc = dataclasses.replace(workloads.SCENARIOS["steady_state"],
+                             horizon_s=40.0)
+    cfg = effective_config(sc, None)
+    path = str(tmp_path / "steady.jsonl")
+    traces.write_trace(workloads.generate(sc, 0), path)
+    eng = Engine(cfg)
+    try:
+        mem = run_scenario(sc, seed=0, config=cfg, engine=eng)
+        rep = run_scenario(setup=traces.load_trace(path), config=cfg,
+                           engine=eng)
+    finally:
+        eng.close()
+    assert mem.event_log_hash == rep.event_log_hash, \
+        "trace replay must be byte-identical to the in-memory run"
+    assert rep.backend == "inprocess" and rep.completions == mem.completions
+
+
+def test_trace_validator_errors(tmp_path):
+    """traces.validate (wired into load_trace) fails LOUDLY with the
+    offending line on every schema/version/field mismatch."""
+    sc = dataclasses.replace(workloads.SCENARIOS["steady_state"],
+                             horizon_s=20.0)
+    path = str(tmp_path / "t.jsonl")
+    traces.write_trace(workloads.generate(sc, 0), path)
+    lines = open(path).read().splitlines()
+
+    def rewrite(xform):
+        p = str(tmp_path / "bad.jsonl")
+        with open(p, "w") as f:
+            f.write("\n".join(xform(list(lines))) + "\n")
+        return p
+
+    # Wrong version: a clear "this build reads version N" error.
+    hdr = json.loads(lines[0])
+    hdr["version"] = 99
+    with pytest.raises(TraceError, match="version 99 unsupported"):
+        traces.load_trace(rewrite(lambda ls: [json.dumps(hdr)] + ls[1:]))
+    # Wrong schema marker.
+    hdr2 = dict(json.loads(lines[0]), schema="something-else")
+    with pytest.raises(TraceError, match="schema"):
+        traces.load_trace(rewrite(lambda ls: [json.dumps(hdr2)] + ls[1:]))
+    # Missing required pod-spec field, with the line number named.
+    bad_pod = None
+    for i, ln in enumerate(lines):
+        rec = json.loads(ln)
+        if rec.get("kind") == "pod":
+            del rec["spec"]["slo_target"]
+            bad_pod = (i, json.dumps(rec))
+            break
+    i, ln = bad_pod
+    with pytest.raises(TraceError, match=rf"line {i + 1}.*slo_target"):
+        traces.load_trace(rewrite(lambda ls: ls[:i] + [ln] + ls[i + 1:]))
+    # Unknown event kind = version skew, not a silent skip.
+    evt = json.dumps(dict(kind="event", t=1.0, etype="teleport",
+                          data={"pod": "x"}))
+    with pytest.raises(TraceError, match="teleport"):
+        traces.load_trace(rewrite(lambda ls: ls + [evt]))
+    # Arrival for an undefined pod.
+    evt2 = json.dumps(dict(kind="event", t=1.0, etype="arrival",
+                           data={"pod": "ghost"}))
+    with pytest.raises(TraceError, match="ghost"):
+        traces.load_trace(rewrite(lambda ls: ls + [evt2]))
+    # Truncation: header counts no longer match the body.
+    with pytest.raises(TraceError, match="counts"):
+        traces.load_trace(rewrite(lambda ls: ls[:-1]))
+    # Not JSON at all.
+    with pytest.raises(TraceError, match="not JSON"):
+        traces.load_trace(rewrite(lambda ls: ls + ["{nope"]))
+    # Empty file.
+    with pytest.raises(TraceError, match="empty"):
+        traces.load_trace(rewrite(lambda ls: [""]))
+    # The original file still loads (the rewrites didn't mutate it).
+    assert len(traces.load_trace(path).specs) > 0
+
+
+def test_generate_trace_helper(tmp_path):
+    """generators.generate_trace = generate + write, validated on
+    load; gang members carry pod_group/minMember through the file."""
+    sc = dataclasses.replace(workloads.SCENARIOS["gang_pressure"],
+                             horizon_s=40.0)
+    path = generators.generate_trace(sc, 2, str(tmp_path / "g.jsonl"))
+    setup = traces.load_trace(path)
+    gang_specs = [s for s in setup.specs.values() if "pod_group" in s]
+    assert gang_specs, "gang_pressure must emit gang members"
+    assert all(s["pod_group_min_member"] == sc.gang_size
+               for s in gang_specs), "all-or-nothing minMember"
+    gang_meta = [m for m in setup.meta.values() if "gang" in m]
+    assert len(gang_meta) == len(gang_specs)
+
+
+def test_scenario_registry_and_matrix():
+    """The scenario library carries the Borg/Azure shapes with
+    one-line descriptions, and the bench matrix names >= 6 of them
+    (ISSUE 9 acceptance: the matrix is the default judging surface)."""
+    for name, sc in workloads.SCENARIOS.items():
+        assert sc.name == name
+        assert sc.description, f"{name} needs a --list description"
+    assert len(workloads.MATRIX_SCENARIOS) >= 6
+    assert set(workloads.MATRIX_SCENARIOS) <= set(workloads.SCENARIOS)
+    # The matrix covers the new axes: autoscale, gangs, lognormal.
+    axes = [workloads.SCENARIOS[n] for n in workloads.MATRIX_SCENARIOS]
+    assert any(sc.autoscale for sc in axes)
+    assert any(sc.gang_frac > 0 for sc in axes)
+    assert any(sc.duration_dist == "lognormal" for sc in axes)
+    assert any(len(sc.pools) >= 2 for sc in axes), \
+        "heterogeneous pools in the matrix"
+
+
+def test_autoscale_generation_validation():
+    sc = workloads.SCENARIOS["autoscale_stress"]
+    with pytest.raises(ValueError, match="grow|shrink"):
+        workloads.generate(
+            dataclasses.replace(sc, autoscale=((1.0, "explode", 0, 1),)), 0)
+    with pytest.raises(ValueError, match="no pool"):
+        workloads.generate(
+            dataclasses.replace(sc, autoscale=((1.0, "grow", 9, 1),)), 0)
+    with pytest.raises(ValueError, match="only"):
+        workloads.generate(
+            dataclasses.replace(sc, autoscale=((1.0, "shrink", 1, 5),)), 0)
+    with pytest.raises(ValueError, match="duration_dist"):
+        workloads.generate(
+            dataclasses.replace(sc, duration_dist="pareto"), 0)
+
+
+def test_lognormal_durations_are_long_tailed():
+    """The lognormal axis actually produces a heavy tail: median near
+    d_lo, a tail beyond d_hi, never non-positive."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    xs = [workloads._sample_duration(rng, "lognormal", 20.0, 300.0)
+          for _ in range(4000)]
+    xs = np.asarray(xs)
+    assert (xs > 0).all()
+    assert 15.0 < np.median(xs) < 27.0, "median pinned near d_lo"
+    assert (xs > 300.0).mean() < 0.05, "d_hi sits near the p99"
+    assert xs.max() > 300.0, "the tail extends past d_hi"
+    uni = [workloads._sample_duration(rng, "uniform", 20.0, 300.0)
+           for _ in range(100)]
+    assert all(20.0 <= u <= 300.0 for u in uni)

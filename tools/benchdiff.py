@@ -10,10 +10,14 @@ and flags the newest round's regressions beyond a noise threshold —
 so "did this PR cost us serving latency" is one command instead of
 eyeballing tails.
 
-Direction is inferred from the unit/name: ms/s metrics (latencies)
-regress UP; qps / placements / fractions / counts regress DOWN.
-Override per run with --worse-up / --worse-down globs if a metric is
-misclassified.
+Direction resolution, most authoritative first (round 13): an explicit
+``"direction": "higher"|"lower"`` field on the metric line (bench.py
+annotates the sim-matrix metrics — slo_attainment_frac_<scenario> is
+higher-better, preemption_churn_<scenario> lower-better — so the
+matrix regresses in the right direction by construction); else
+inferred from the unit/name: ms/s/churn metrics regress UP, qps /
+placements / fractions / counts regress DOWN. Override per run with
+--worse-up / --worse-down globs if a metric is misclassified.
 
 Usage:
   python tools/benchdiff.py BENCH_r*.json             # full trend table
@@ -32,11 +36,15 @@ import sys
 from pathlib import Path
 
 # Units where LOWER is better; everything else is higher-better unless
-# the metric name matches a latency-ish pattern.
+# the metric name matches a latency-ish (or churn) pattern.
 _LOWER_BETTER_UNITS = {"ms", "s", "seconds", "bytes"}
 _LOWER_BETTER_NAME = re.compile(
-    r"(_ms($|_)|_s($|_)|latency|recovery|cycle_ms|_p\d+($|_))"
+    r"(_ms($|_)|_s($|_)|latency|recovery|cycle_ms|_p\d+($|_)|churn)"
 )
+# Names that are ALWAYS higher-better regardless of what the latency
+# pattern accidentally matches (scenario suffixes like *_p99_s_qos or a
+# future *_s-suffixed scenario name must not flip attainment metrics).
+_HIGHER_BETTER_NAME = re.compile(r"(attainment|goodput|qps)")
 
 
 def round_key(path: Path) -> str:
@@ -66,12 +74,22 @@ def extract_metrics(path: Path) -> dict:
             continue
         if "metric" in rec and "value" in rec:
             out[rec["metric"]] = dict(
-                value=float(rec["value"]), unit=str(rec.get("unit", ""))
+                value=float(rec["value"]), unit=str(rec.get("unit", "")),
+                direction=(rec["direction"]
+                           if rec.get("direction") in ("higher", "lower")
+                           else None),
             )
     return out
 
 
-def lower_is_better(metric: str, unit: str) -> bool:
+def lower_is_better(metric: str, unit: str,
+                    direction: "str | None" = None) -> bool:
+    """direction (an explicit bench-line annotation) wins; then the
+    always-higher-better names; then unit/name inference."""
+    if direction is not None:
+        return direction == "lower"
+    if _HIGHER_BETTER_NAME.search(metric):
+        return False
     return (unit in _LOWER_BETTER_UNITS
             or bool(_LOWER_BETTER_NAME.search(metric)))
 
@@ -101,7 +119,15 @@ def diff_rounds(files: "list[Path]", threshold: float,
                   for r in rounds if name in per_round[r]}
         unit = next(per_round[r][name]["unit"]
                     for r in rounds if name in per_round[r])
-        lower = lower_is_better(name, unit)
+        # Newest round's explicit annotation wins (older snapshots
+        # predate the direction field).
+        direction = next(
+            (per_round[r][name]["direction"] for r in reversed(rounds)
+             if name in per_round[r]
+             and per_round[r][name]["direction"] is not None),
+            None,
+        )
+        lower = lower_is_better(name, unit, direction)
         if any(fnmatch.fnmatch(name, g) for g in worse_up):
             lower = True
         if any(fnmatch.fnmatch(name, g) for g in worse_down):

@@ -1,11 +1,25 @@
 #!/usr/bin/env python
-"""Virtual-time cluster simulator CLI (ISSUE 5).
+"""Virtual-time cluster simulator CLI (ISSUE 5, 9).
 
-Run one scenario, or the headline TWIN run (QoS-driven vs static
-priority on the same seed and timeline):
+Run one scenario, the headline TWIN run (QoS-driven vs static priority
+on the same seed and timeline), the full scenario MATRIX, or a
+trace-file replay:
 
     # the paper's central claim as one number
     python tools/simulate.py --scenario pressure_skew --twin
+
+    # the scenario library, one line each
+    python tools/simulate.py --list
+
+    # the whole matrix: twin runs across >= 6 Borg/Azure-shaped
+    # scenarios, attainment + preemption churn per arm
+    python tools/simulate.py --scenario all
+
+    # trace-driven workloads: generate -> write -> replay
+    python tools/simulate.py --scenario borg_longtail --seed 3 \
+        --write-trace /tmp/borg.jsonl
+    python tools/simulate.py --trace /tmp/borg.jsonl
+    python tools/simulate.py --trace /tmp/borg.jsonl --twin
 
     # a single arm, full report
     python tools/simulate.py --scenario failure_storm --seed 3
@@ -31,19 +45,36 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> int:
     from tpusched.config import EngineConfig, SimConfig
-    from tpusched.sim import report
-    from tpusched.sim.driver import run_scenario, twin_run
-    from tpusched.sim.workloads import SCENARIOS
+    from tpusched.sim import report, traces
+    from tpusched.sim.driver import matrix_run, run_scenario, twin_run
+    from tpusched.sim.workloads import MATRIX_SCENARIOS, SCENARIOS
 
     ap = argparse.ArgumentParser(
         description="Discrete-event virtual-clock cluster simulator"
     )
-    ap.add_argument("--scenario", choices=sorted(SCENARIOS),
-                    default="pressure_skew")
-    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS) + ["all"],
+                    default=None,
+                    help="scenario name (default pressure_skew), or "
+                         "'all' for the twin-run matrix across "
+                         "MATRIX_SCENARIOS")
+    ap.add_argument("--list", action="store_true",
+                    help="print the scenario library (one line each) "
+                         "and exit")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="generation seed (default 0); does not "
+                         "compose with --trace (a trace file IS its "
+                         "timeline)")
     ap.add_argument("--twin", action="store_true",
                     help="twin run: QoS-driven vs static-priority "
                          "baseline on the same seed")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="replay a trace file (tpusched.sim.traces) "
+                         "instead of generating --scenario; composes "
+                         "with --twin (each arm loads the file fresh)")
+    ap.add_argument("--write-trace", default=None, metavar="PATH",
+                    help="generate --scenario at --seed, write it as "
+                         "a trace file, and exit (replay it with "
+                         "--trace)")
     ap.add_argument("--backend", choices=["inprocess", "grpc"],
                     default="inprocess",
                     help="grpc = spin an in-process sidecar and drive "
@@ -53,7 +84,8 @@ def main() -> int:
                          "standby fleet (tpusched.replicate.ReplicaSet)"
                          " instead of one sidecar")
     ap.add_argument("--horizon", type=float, default=None,
-                    help="override the scenario's virtual horizon (s)")
+                    help="override the scenario's virtual horizon (s); "
+                         "in matrix mode, CAP every scenario's horizon")
     ap.add_argument("--rate", type=float, default=None,
                     help="override the scenario's arrival rate (pods/s)")
     ap.add_argument("--nodes", type=int, default=None,
@@ -76,6 +108,75 @@ def main() -> int:
                          "--twin, off otherwise)")
     args = ap.parse_args()
 
+    def log(msg):
+        print(msg, file=sys.stderr, flush=True)
+
+    if args.list:
+        width = max(len(n) for n in SCENARIOS)
+        for name in sorted(SCENARIOS):
+            sc = SCENARIOS[name]
+            star = "*" if name in MATRIX_SCENARIOS else " "
+            print(f"{star} {name:<{width}}  {sc.description}")
+        print("(* = in the bench/--scenario all matrix; soak_storm is "
+              "long-horizon — run it alone)")
+        return 0
+
+    cfg = EngineConfig(mode=args.mode)
+    if args.qos_gain is not None:
+        cfg = dataclasses.replace(
+            cfg, qos=dataclasses.replace(cfg.qos, qos_gain=args.qos_gain)
+        )
+    sim = SimConfig(tick_s=args.tick, resolve_every=args.resolve_every)
+
+    if args.replicas != 1 and args.backend != "grpc":
+        ap.error("--replicas needs --backend grpc (a fleet is a wire-"
+                 "level construct; the in-process engine has no "
+                 "endpoints to fail over between)")
+
+    # Non-composing flag pairs fail LOUDLY (a silently-dropped mode is
+    # a measurement you think you took).
+    if args.trace and args.scenario is not None:
+        ap.error("--trace replays the file's recorded workload; it "
+                 "does not compose with --scenario")
+    if args.trace and args.seed is not None:
+        ap.error("--trace replays the file's recorded timeline; "
+                 "--seed does not apply (a seed sweep over one trace "
+                 "would be N identical runs)")
+    if args.seed is None:
+        args.seed = 0
+    if args.trace and args.write_trace:
+        ap.error("--write-trace generates and writes, --trace replays "
+                 "a file: pick one")
+    if args.write_trace and (args.twin or args.backend != "inprocess"
+                             or args.replicas != 1):
+        ap.error("--write-trace only generates + validates the file "
+                 "(no run): --twin/--backend/--replicas do not apply "
+                 "— replay the file with --trace instead")
+    if args.scenario is None:
+        args.scenario = "pressure_skew"
+    if args.scenario == "all" and args.write_trace:
+        ap.error("--scenario all (matrix) does not compose with "
+                 "--write-trace: a matrix is a library sweep")
+    if args.scenario == "all":
+        if args.backend != "inprocess" or args.replicas != 1:
+            ap.error("matrix mode runs in-process (2 arms x >= 6 "
+                     "scenarios; use a single --scenario for grpc)")
+        if (args.rate is not None or args.nodes is not None
+                or args.preemption):
+            ap.error("matrix mode sweeps the scenario library as "
+                     "defined; per-scenario --rate/--nodes/"
+                     "--preemption overrides do not apply (only "
+                     "--horizon, as a cap)")
+        out = matrix_run(seed=args.seed, config=cfg, sim=sim,
+                         horizon_s=args.horizon, log=log,
+                         explain=(args.explain == "on"))
+        print(report.render_matrix(out))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(out, f, indent=2)
+            log(f"wrote {args.json}")
+        return 0
+
     sc = SCENARIOS[args.scenario]
     overrides = {}
     if args.horizon is not None:
@@ -89,20 +190,27 @@ def main() -> int:
     if overrides:
         sc = dataclasses.replace(sc, **overrides)
 
-    cfg = EngineConfig(mode=args.mode)
-    if args.qos_gain is not None:
-        cfg = dataclasses.replace(
-            cfg, qos=dataclasses.replace(cfg.qos, qos_gain=args.qos_gain)
-        )
-    sim = SimConfig(tick_s=args.tick, resolve_every=args.resolve_every)
+    if args.write_trace:
+        from tpusched.sim.workloads import generate
 
-    def log(msg):
-        print(msg, file=sys.stderr, flush=True)
+        path = traces.write_trace(generate(sc, args.seed),
+                                  args.write_trace)
+        # Immediate load-back: the file is validated before the tool
+        # reports success, so a schema bug can't produce a dead trace.
+        setup = traces.load_trace(path)
+        log(f"wrote {path}: {len(setup.specs)} pods, "
+            f"{len(setup.nodes)} nodes, {len(setup.queue)} events "
+            f"(replay with --trace {path})")
+        return 0
 
-    if args.replicas != 1 and args.backend != "grpc":
-        ap.error("--replicas needs --backend grpc (a fleet is a wire-"
-                 "level construct; the in-process engine has no "
-                 "endpoints to fail over between)")
+    setup_factory = None
+    if args.trace:
+        if overrides:
+            ap.error("--trace replays the recorded timeline; horizon/"
+                     "rate/node overrides only apply to generation")
+        setup_factory = lambda: traces.load_trace(args.trace)  # noqa: E731
+        sc = None
+
     explain = (args.explain == "on") if args.explain is not None \
         else args.twin
     if args.twin:
@@ -111,7 +219,8 @@ def main() -> int:
                      "arms run a single sidecar so the QoS-vs-static "
                      "comparison is apples-to-apples")
         out = twin_run(sc, seed=args.seed, config=cfg, sim=sim,
-                       backend=args.backend, log=log, explain=explain)
+                       backend=args.backend, log=log, explain=explain,
+                       setup_factory=setup_factory)
         print(report.render_twin(out))
     else:
         col = None
@@ -119,9 +228,11 @@ def main() -> int:
             from tpusched.explain import ExplainCollector
 
             col = ExplainCollector(capacity=65536, enabled=True)
-        res = run_scenario(sc, seed=args.seed, config=cfg, sim=sim,
-                           backend=args.backend, replicas=args.replicas,
-                           explain=col)
+        res = run_scenario(
+            sc, seed=args.seed, config=cfg, sim=sim,
+            backend=args.backend, replicas=args.replicas, explain=col,
+            setup=(setup_factory() if setup_factory else None),
+        )
         out = report.summarize(res)
         if col is not None:
             out["miss_attribution"] = report.miss_attribution(

@@ -237,6 +237,13 @@ class SimConfig:
     # cycle. Set >0 to model backoff-queue semantics instead.
     backoff_initial_s: float = 0.0
     backoff_max_s: float = 0.0
+    # gRPC-mode transport: AssignPipeline's pin-refresh threshold
+    # (fraction of records whose cumulative churn triggers a full-send
+    # pin refresh). None = the client default (0.25). Raising it >= 1
+    # keeps a drifting sim workload on the DELTA path — the knob the
+    # autoscale rebuild tests use to pin the device-resident
+    # bucket-growth path instead of a churn-triggered reseed.
+    pipeline_refresh_frac: "float | None" = None
 
     def __post_init__(self):
         if self.tick_s <= 0:

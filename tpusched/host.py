@@ -280,6 +280,7 @@ class HostScheduler:
         use_delta: bool = True,
         transport: str = "delta",
         explain=None,
+        refresh_frac: "float | None" = None,
     ):
         """explain (round 12, ISSUE 8): optional
         tpusched.explain.ExplainCollector; None falls back to the
@@ -342,7 +343,13 @@ class HostScheduler:
         elif client is not None and transport == "pipeline":
             from tpusched.rpc.client import AssignPipeline
 
-            self._pipeline = AssignPipeline(client, depth=1)
+            # refresh_frac: pin-refresh churn threshold passthrough
+            # (None keeps the client default). The simulator threads
+            # SimConfig.pipeline_refresh_frac here so long drifting
+            # runs can stay on the delta path deliberately.
+            kw = {} if refresh_frac is None else dict(
+                refresh_frac=refresh_frac)
+            self._pipeline = AssignPipeline(client, depth=1, **kw)
         self.cycles: list[CycleStats] = []
         # Queue semantics (SURVEY.md §1.2 L5: activeQ/backoffQ): a pod
         # that fails to place enters backoff with exponentially growing

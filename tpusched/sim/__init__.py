@@ -7,11 +7,15 @@ host.py can import `lifecycle` without dragging in the driver stack):
 
   clock      VirtualClock — zero-real-sleep virtual time
   lifecycle  per-pod availability accounting (the closed loop's state)
-  events     seeded event queue + arrival/failure processes
-  workloads  scenario library (steady_state / burst / pressure_skew /
-             failure_storm)
-  driver     SimDriver + run_scenario + twin_run (QoS vs static)
-  report     SLO-attainment summaries, CDFs, text rendering
+  events     seeded event queue + arrival/failure/autoscale processes
+  workloads  Scenario + generate(): THE workload-synthesis path and the
+             scenario registry (presets + the Borg/Azure shapes)
+  generators Borg/Azure-shaped presets, soak composition, trace
+             emission (ISSUE 9)
+  traces     versioned seed-free on-disk trace format: validate /
+             write_trace / load_trace / replay (ISSUE 9)
+  driver     SimDriver + run_scenario + twin_run + matrix_run
+  report     SLO-attainment summaries, CDFs, matrix/text rendering
 """
 
 from tpusched.sim.clock import VirtualClock  # noqa: F401
@@ -25,12 +29,16 @@ def __getattr__(name):
     # Lazy: driver/report import host/engine/rpc layers; workloads pulls
     # synth. Loading them only on demand keeps `import tpusched.sim`
     # cheap for the host's lifecycle import.
-    if name in ("SimDriver", "run_scenario", "twin_run"):
+    if name in ("SimDriver", "run_scenario", "twin_run", "matrix_run"):
         from tpusched.sim import driver
 
         return getattr(driver, name)
-    if name in ("Scenario", "SCENARIOS", "generate"):
+    if name in ("Scenario", "SCENARIOS", "MATRIX_SCENARIOS", "generate"):
         from tpusched.sim import workloads
 
         return getattr(workloads, name)
+    if name in ("write_trace", "load_trace", "replay"):
+        from tpusched.sim import traces
+
+        return getattr(traces, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -37,6 +37,7 @@ from tpusched import metrics as pm
 from tpusched import qos
 from tpusched.config import (DEFAULT_OBSERVED_AVAIL, DEFAULT_SLO_TARGET,
                              EngineConfig, QoSConfig, SimConfig)
+from tpusched.faults import FaultError
 from tpusched.host import FakeApiServer, HostScheduler
 from tpusched.sim.clock import VirtualClock
 from tpusched.sim.lifecycle import LifecycleTracker
@@ -88,6 +89,7 @@ class PodOutcome:
     evictions: int
     final_avail: float
     attained: "bool | None"    # None for SLO-less pods (slo == 0)
+    gang: "str | None" = None  # pod_group id for gang members
 
 
 @dataclasses.dataclass
@@ -105,6 +107,8 @@ class SimResult:
     completions: int
     requeues: int
     node_failures: int
+    autoscale_events: int
+    failed_cycles: int
     pods: list          # [PodOutcome]
     pressure_samples: list   # (t, n_pending, mean_pressure, max_pressure)
     event_log_hash: str
@@ -114,7 +118,7 @@ class SimResult:
 class SimDriver:
     def __init__(
         self,
-        scenario: Scenario,
+        scenario: "Scenario | None" = None,
         seed: int = 0,
         config: "EngineConfig | None" = None,
         sim: "SimConfig | None" = None,
@@ -123,12 +127,29 @@ class SimDriver:
         faults=None,
         tracer=None,
         explain=None,
+        setup: "SimSetup | None" = None,
     ):
         """explain (round 12): optional ExplainCollector threaded into
         the in-process HostScheduler — every cycle records a
         DecisionRecord on VIRTUAL time, the input report.py's
         miss-attribution join consumes. gRPC runs record server-side
-        instead (run_scenario wires the collector into make_server)."""
+        instead (run_scenario wires the collector into make_server).
+
+        setup (round 13, ISSUE 9): a prebuilt SimSetup — the trace
+        REPLAY input (traces.load_trace) or a generate() result the
+        caller wants to inspect/serialize first. When given, no
+        generation happens here; the scenario rides in on the setup
+        (pass scenario=None). Note a setup's event queue is consumed
+        by the run — build/load a fresh one per run."""
+        if setup is not None:
+            if scenario is not None and scenario is not setup.scenario:
+                raise ValueError(
+                    "pass scenario OR setup, not a conflicting pair"
+                )
+            scenario = setup.scenario
+            seed = setup.seed
+        elif scenario is None:
+            raise ValueError("SimDriver needs a scenario or a setup")
         self.sc = scenario
         self.seed = int(seed)
         self.cfg = effective_config(scenario, config)
@@ -136,7 +157,8 @@ class SimDriver:
         self.tracer = tracer
         self.clock = VirtualClock()
         self.api = FakeApiServer(clock=self.clock)
-        self.setup: SimSetup = generate(scenario, self.seed)
+        self.setup: SimSetup = (setup if setup is not None
+                                else generate(scenario, self.seed))
         for n in self.setup.nodes:
             self.api.add_node(**n)
         self._node_specs = {n["name"]: n for n in self.setup.nodes}
@@ -156,6 +178,7 @@ class SimDriver:
             backoff_max=self.sim.backoff_max_s,
             transport="pipeline" if client is not None else "delta",
             explain=explain,
+            refresh_frac=self.sim.pipeline_refresh_frac,
         )
         self.backend = "grpc" if client is not None else "inprocess"
 
@@ -168,6 +191,8 @@ class SimDriver:
         self.completions = 0
         self.requeues = 0
         self.node_failures = 0
+        self.autoscale_events = 0
+        self.failed_cycles = 0
         self.pressure_samples: list[tuple] = []
 
     # -- event application --------------------------------------------------
@@ -216,11 +241,44 @@ class SimDriver:
                         victims=victims)
         elif ev.kind == "node_recover":
             node = ev.data["node"]
-            if node not in self._down:
+            if node not in self._down or node not in self._node_specs:
                 return
             self._down.discard(node)
             self.api.add_node(**self._node_specs[node])
             self.q.note(ev.time, "node_recover", node=node)
+        elif ev.kind == "node_add":
+            # Autoscale-up: the node's full spec rides in the event
+            # (generate/_schedule_autoscale put it there; a trace
+            # serializes it with the timeline), so the driver needs no
+            # side channel to learn grown shapes.
+            node = ev.data["node"]
+            if node in self._node_specs and node not in self._down:
+                return
+            self._node_specs[node] = ev.data["spec"]
+            self._down.discard(node)
+            self.api.add_node(**ev.data["spec"])
+            self.autoscale_events += 1
+            self.q.note(ev.time, "node_add", node=node)
+        elif ev.kind == "node_remove":
+            # Autoscale-down: permanent removal (unlike node_fail there
+            # is no pending recovery). Running pods are interrupted and
+            # re-queued with lifecycle history — a real scale-down
+            # eviction, and the availability hit is attributed to it.
+            node = ev.data["node"]
+            if node not in self._node_specs:
+                return
+            victims = sorted(
+                p["name"] for p in self.api.bound_pods()
+                if p.get("node") == node
+            )
+            for name in victims:
+                self._interrupt(name, now, reason="autoscale_down")
+            self.api.delete_node(node)
+            del self._node_specs[node]
+            self._down.discard(node)
+            self.autoscale_events += 1
+            self.q.note(ev.time, "node_remove", node=node,
+                        victims=victims)
         else:
             raise ValueError(f"unknown sim event kind {ev.kind!r}")
         self.events_applied += 1
@@ -230,7 +288,27 @@ class SimDriver:
         bank its run credit, shorten the remaining duration by what it
         already ran, bump its completion generation (pending completion
         events become stale), and re-queue it with lifecycle history so
-        availability keeps decaying from where it was."""
+        availability keeps decaying from where it was.
+
+        GANG members propagate (ISSUE 9): the solver's minMember
+        quorum is batch-local — running members do not count toward
+        it — so a lone requeued member could NEVER re-place (held
+        below quorum forever, silently dragging attainment). All-or-
+        nothing semantics cut the other way too: losing any member
+        interrupts the whole gang, and the group re-forms quorum in
+        one pending batch.
+
+        Idempotent per instant: gang propagation can race the caller's
+        victims snapshot (co-located siblings get re-queued by the
+        first victim's propagation before the loop reaches them) — a
+        pod that is already back to Pending with no live run was
+        interrupted this instant and must not bank a second eviction.
+        The host-preempted path (api record already deleted) still has
+        bound_at set and passes through."""
+        pod = self.api.get_pod(name)
+        if (self.life.pods[name].bound_at is None and pod is not None
+                and pod.get("phase") == "Pending"):
+            return
         ran = self.life.on_unbind(name, now, evicted=True)
         self._remaining[name] = max(
             self._remaining.get(name, 0.0) - ran, _MIN_REMAINING_S
@@ -244,12 +322,40 @@ class SimDriver:
         )
         self.requeues += 1
         _M_REQUEUES.labels(reason).inc()
+        gang = self.setup.meta[name].get("gang")
+        if gang and reason != "gang_reform":
+            siblings = sorted(
+                p["name"] for p in self.api.bound_pods()
+                if self.setup.meta.get(p["name"], {}).get("gang") == gang
+            )
+            for member in siblings:
+                self._interrupt(member, now, reason="gang_reform")
+            if siblings:
+                self.q.note(now, "gang_reform", gang=gang,
+                            members=siblings)
 
     # -- scheduling cycle ---------------------------------------------------
 
     def _cycle(self, now: float) -> None:
         bound_prev = {p["name"] for p in self.api.bound_pods()}
-        self.host.cycle()
+        try:
+            self.host.cycle()
+        except BaseException as e:
+            # Soak composition (ISSUE 9): an injected engine fault
+            # (FaultError via engine.fetch) or a transient sidecar rpc
+            # failure drops THIS cycle the way the host's
+            # run_until_idle tolerates a flaky scheduler backend — the
+            # failed cycle mutated nothing (binds happen after a
+            # successful solve; cycle()'s unwind restored the change
+            # hints), so the next tick re-reads truth. Counted AND
+            # noted in the event log: the fault schedule is part of
+            # the deterministic timeline the hash pins.
+            if not (isinstance(e, FaultError)
+                    or HostScheduler._transient_rpc_error(e)):
+                raise
+            self.failed_cycles += 1
+            self.q.note(now, "cycle_failed", n=self.failed_cycles)
+            return
         bound_now = {p["name"]: p.get("node") for p in self.api.bound_pods()}
 
         for name in sorted(set(bound_now) - bound_prev):
@@ -343,6 +449,7 @@ class SimDriver:
                 waited_s=max(end - life.submitted - ran, 0.0),
                 evictions=life.evictions, final_avail=avail,
                 attained=(avail + 1e-9 >= slo) if slo > 0 else None,
+                gang=meta.get("gang"),
             ))
         placed = sum(c.placed for c in self.host.cycles)
         evicted = sum(c.evicted for c in self.host.cycles)
@@ -353,6 +460,8 @@ class SimDriver:
             events_applied=self.events_applied, placed=placed,
             evicted=evicted, completions=self.completions,
             requeues=self.requeues, node_failures=self.node_failures,
+            autoscale_events=self.autoscale_events,
+            failed_cycles=self.failed_cycles,
             pods=outcomes, pressure_samples=self.pressure_samples,
             event_log_hash=self.q.log_hash(), wall_seconds=wall_s,
         )
@@ -364,7 +473,7 @@ class SimDriver:
 
 
 def run_scenario(
-    scenario: Scenario,
+    scenario: "Scenario | None" = None,
     seed: int = 0,
     config: "EngineConfig | None" = None,
     sim: "SimConfig | None" = None,
@@ -374,6 +483,7 @@ def run_scenario(
     tracer=None,
     replicas: int = 1,
     explain=None,
+    setup: "SimSetup | None" = None,
 ) -> SimResult:
     """One sim run. backend="grpc" spins an in-process sidecar and
     drives the full host -> gRPC path (AssignPipeline transport);
@@ -385,13 +495,19 @@ def run_scenario(
     explain: optional ExplainCollector — in-process it rides the host,
     on grpc it is handed to make_server so the sidecar records every
     Assign (same collector object either way; replicas > 1 records on
-    the initial leader only)."""
+    the initial leader only).
+    setup (ISSUE 9): a prebuilt SimSetup (trace replay via
+    traces.load_trace, or a pre-generated workload) instead of
+    `scenario` — generated and ingested workloads ride this one path."""
+    if setup is not None:
+        scenario = setup.scenario
+        seed = setup.seed
     if backend == "inprocess":
         if replicas != 1:
             raise ValueError("replicas > 1 needs backend='grpc'")
         return SimDriver(scenario, seed, config=config, sim=sim,
                          engine=engine, faults=faults, tracer=tracer,
-                         explain=explain).run()
+                         explain=explain, setup=setup).run()
     if backend != "grpc":
         raise ValueError(f"backend={backend!r}: want inprocess|grpc")
     from tpusched.rpc.client import SchedulerClient
@@ -406,7 +522,8 @@ def run_scenario(
         client = SchedulerClient(fleet.addresses())
         try:
             return SimDriver(scenario, seed, config=cfg, sim=sim,
-                             client=client, tracer=tracer).run()
+                             client=client, tracer=tracer,
+                             setup=setup).run()
         finally:
             client.close()
             fleet.close()
@@ -416,7 +533,7 @@ def run_scenario(
     client = SchedulerClient(f"127.0.0.1:{port}")
     try:
         return SimDriver(scenario, seed, config=cfg, sim=sim,
-                         client=client, tracer=tracer).run()
+                         client=client, tracer=tracer, setup=setup).run()
     finally:
         client.close()
         server.stop(0)
@@ -438,13 +555,15 @@ def static_baseline(config: "EngineConfig | None" = None) -> EngineConfig:
 
 
 def twin_run(
-    scenario: Scenario,
+    scenario: "Scenario | None" = None,
     seed: int = 0,
     config: "EngineConfig | None" = None,
     sim: "SimConfig | None" = None,
     backend: str = "inprocess",
     log=None,
     explain: bool = False,
+    setup_factory=None,
+    faults_factory=None,
 ) -> dict:
     """The headline experiment: same scenario, same seed, QoS-driven vs
     static-priority baseline. Returns both summaries plus
@@ -457,9 +576,23 @@ def twin_run(
     every missed-SLO pod joined to its recorded decision chain, rolled
     up into a "top miss causes" table (report.miss_attribution) — the
     twin then says not just THAT static lost but WHY its misses
-    happened (preempted vs unschedulable vs outranked)."""
+    happened (preempted vs unschedulable vs outranked).
+
+    setup_factory (ISSUE 9): zero-arg callable returning a FRESH
+    SimSetup per arm (a run consumes its event queue) — the trace-twin
+    entry: `lambda: traces.load_trace(path)` twins an INGESTED
+    workload; scenario may then be None. faults_factory likewise
+    builds a fresh FaultPlan per arm (plans carry invocation counters),
+    so soak compositions twin deterministically."""
     from tpusched.sim import report
 
+    # When the scenario rides in on the factory (trace twins), keep the
+    # setup we peeked at for the FIRST arm — a large ingested trace
+    # should parse once per arm, not an extra time for the header.
+    pending_setup = None
+    if setup_factory is not None and scenario is None:
+        pending_setup = setup_factory()
+        scenario = pending_setup.scenario
     cfg = effective_config(scenario, config)
     if cfg.qos.qos_gain <= 0:
         raise ValueError(
@@ -479,8 +612,18 @@ def twin_run(
             # Capacity covers a full horizon of per-tick cycles, so the
             # attribution join sees every decision, not a recent window.
             col = ExplainCollector(capacity=65536, enabled=True)
-        res = run_scenario(scenario, seed, config=arm_cfg, sim=sim,
-                           backend=backend, explain=col)
+        if pending_setup is not None:
+            arm_setup, pending_setup = pending_setup, None
+        elif setup_factory is not None:
+            arm_setup = setup_factory()
+        else:
+            arm_setup = None
+        res = run_scenario(
+            scenario, seed, config=arm_cfg, sim=sim, backend=backend,
+            explain=col, setup=arm_setup,
+            faults=(faults_factory() if faults_factory is not None
+                    else None),
+        )
         results[arm] = report.summarize(res)
         if col is not None:
             results[arm]["miss_attribution"] = report.miss_attribution(
@@ -498,3 +641,63 @@ def twin_run(
         slo_attainment_frac=results["qos"]["slo_attainment_frac"],
         attainment_gain_vs_static=round(gain, 6),
     )
+
+
+def matrix_run(
+    scenario_names=None,
+    seed: int = 0,
+    config: "EngineConfig | None" = None,
+    sim: "SimConfig | None" = None,
+    backend: str = "inprocess",
+    horizon_s: "float | None" = None,
+    log=None,
+    explain: bool = False,
+) -> dict:
+    """The scenario-matrix bench (ISSUE 9): twin_run every scenario in
+    `scenario_names` (default workloads.MATRIX_SCENARIOS, >= 6
+    Borg/Azure-shaped shapes) and tabulate slo_attainment_frac +
+    preemption churn per scenario x {QoS, static}, with both arms'
+    event-log hashes — so every future PR's QoS-vs-static gain is
+    judged across the matrix instead of one hand-picked corner.
+    horizon_s caps (never extends) each scenario's virtual horizon —
+    the bench-budget knob."""
+    from tpusched.sim.workloads import MATRIX_SCENARIOS, SCENARIOS
+
+    names = list(scenario_names if scenario_names is not None
+                 else MATRIX_SCENARIOS)
+    rows = []
+    for name in names:
+        sc = SCENARIOS[name]
+        if horizon_s is not None:
+            sc = dataclasses.replace(
+                sc, horizon_s=min(sc.horizon_s, float(horizon_s))
+            )
+        twin = twin_run(sc, seed=seed, config=config, sim=sim,
+                        backend=backend, log=log, explain=explain)
+        q, s = twin["qos"], twin["static"]
+        extra = {}
+        if explain:
+            extra = dict(
+                miss_causes=q.get("miss_attribution", {}).get("causes"),
+                miss_causes_static=s.get("miss_attribution",
+                                         {}).get("causes"),
+            )
+        rows.append(dict(
+            **extra,
+            scenario=name,
+            slo_attainment_frac=q["slo_attainment_frac"],
+            slo_attainment_frac_static=s["slo_attainment_frac"],
+            attainment_gain_vs_static=twin["attainment_gain_vs_static"],
+            preemption_churn=q["preemption_churn"],
+            preemption_churn_static=s["preemption_churn"],
+            slo_pods=q["slo_pods"],
+            evictions=q["evicted"], evictions_static=s["evicted"],
+            autoscale_events=q["autoscale_events"],
+            hash_qos=q["event_log_hash"], hash_static=s["event_log_hash"],
+        ))
+        if log:
+            r = rows[-1]
+            log(f"[sim] matrix {name}: qos={r['slo_attainment_frac']} "
+                f"static={r['slo_attainment_frac_static']} "
+                f"gain={r['attainment_gain_vs_static']}")
+    return dict(seed=seed, backend=backend, scenarios=names, rows=rows)

@@ -65,6 +65,13 @@ class EventQueue:
     def next_time(self) -> "float | None":
         return self._heap[0][0] if self._heap else None
 
+    def events(self) -> list[Event]:
+        """Non-destructive (time, push-order) listing of every PENDING
+        event — the trace writer's view of the full timeline. The heap
+        is untouched, so a setup can be serialized and then run."""
+        return [entry[2] for entry in
+                sorted(self._heap, key=lambda entry: (entry[0], entry[1]))]
+
     def __len__(self) -> int:
         return len(self._heap)
 

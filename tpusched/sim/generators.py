@@ -1,0 +1,249 @@
+"""Borg/Azure-shaped workload generators (ISSUE 9 tentpole part 2).
+
+The methodology lineage of this scheduler family is TRACE-DRIVEN
+evaluation — Borg ("Large-scale cluster management at Google with
+Borg", EuroSys'15) and Azure's Resource Central trace analysis
+(SOSP'17) — not hand-picked synthetic corners. This module shapes
+tpusched.sim.workloads.Scenario values after the published
+characteristics of those traces:
+
+  * long-tail LOGNORMAL durations (most jobs short, a heavy tail of
+    long-runners that outlive several arrival cycles);
+  * DIURNAL arrival cycles (events.diurnal_times thinning — the
+    day/night swing every production trace shows);
+  * Zipf TENANT skew (tenants.zipf_weights, the one shared
+    definition: a few subscriptions/users dominate submission volume);
+  * a prefill/decode-flavored CLASS MIX for the serving-shaped preset:
+    short interactive bursts (prefill-like) next to long-lived
+    SLO-carrying servers (decode-like) over batch filler;
+  * GANG arrivals (Borg jobs are sets of identical tasks; the sim's
+    gang members carry pod_group/minMember with test_gangs.py
+    all-or-nothing semantics);
+  * AUTOSCALE + heterogeneous pools (clusters are not static: node
+    pools grow and shrink mid-horizon, which on the gRPC path drives
+    the device-resident state's real bucket-growth / taint-vocab
+    rebuild machinery in device_state.py);
+  * a long-horizon SOAK composing node flaps, autoscale, gangs, and a
+    seeded tpusched.faults plan with the virtual clock.
+
+Everything here EMITS TRACES in the one-code-path sense: a preset is
+an ordinary Scenario fed through workloads.generate(), and
+generate_trace()/write_trace serialize that SimSetup with
+tpusched.sim.traces — so a Borg-shaped workload, a hand-written trace,
+and a replayed file all drive SimDriver identically.
+
+This module is imported by workloads.py at its BOTTOM (after Scenario
+and generate are defined) to merge SCENARIOS into the one registry;
+import workloads, not this module, to enumerate scenarios.
+"""
+
+from __future__ import annotations
+
+from tpusched.sim.workloads import Scenario
+
+# A PreferNoSchedule taint on the scale-out pool: it never filters a
+# pod (the cluster stays schedulable for tolerance-less sim pods) but
+# its FIRST appearance mid-horizon is a brand-new taint vocabulary
+# entry — the [P, VT] tolerated-matrix growth that forces the
+# device-resident state's "new_taint" full rebuild (device_state.py),
+# exactly the path an autoscale scenario exists to exercise.
+SCALEOUT_TAINT = ("tpusched.io/scaleout", "true", "PreferNoSchedule")
+
+
+SCENARIOS: dict[str, Scenario] = {
+    # Borg-shaped: lognormal long-tail durations, heavy batch tier at
+    # HIGH base priority (Borg's production/batch split inverted into
+    # the adversarial pressure-skew form), Zipf'd tenants, a slice of
+    # gang jobs. The long tail is the point: a handful of prefilled
+    # long-runners pin capacity while the short majority churns.
+    "borg_longtail": Scenario(
+        name="borg_longtail", n_nodes=8, horizon_s=150.0,
+        description="Borg-shaped: lognormal long-tail durations, "
+                    "Zipf tenants, gang jobs, batch tier at high "
+                    "priority over low-priority SLO servers",
+        arrival="poisson", rate=1.0, prefill=20,
+        prefill_duration_s=(20.0, 200.0),
+        duration_dist="lognormal",
+        mix=(
+            # batch filler: no SLO, HIGH priority, median 20s, p99 ~5min
+            (0.35, 0.0, (20.0, 300.0), (60, 100), (1700.0, 2300.0)),
+            # prod serving: SLO 0.8, LOW base priority
+            (0.40, 0.8, (15.0, 120.0), (0, 30), (1700.0, 2300.0)),
+            # prod tier-2: tight SLO
+            (0.25, 0.95, (10.0, 60.0), (0, 30), (1700.0, 2300.0)),
+        ),
+        gang_frac=0.15, gang_size=3,
+        tenants=8, tenant_skew=1.2,
+    ),
+    # Azure-shaped: diurnal arrival cycle, VM-like duration mix — many
+    # short interactive instances (prefill-like), long-lived SLO
+    # servers (decode-like), and long batch VMs — with strong
+    # subscription (tenant) skew.
+    "azure_diurnal": Scenario(
+        name="azure_diurnal", n_nodes=6, horizon_s=180.0,
+        description="Azure-shaped: diurnal arrivals, prefill/decode "
+                    "class mix (short interactive vs long-lived SLO "
+                    "servers), strong subscription skew",
+        arrival="diurnal", rate=0.75,
+        diurnal_period_s=120.0, diurnal_amplitude=0.9,
+        prefill=16, prefill_duration_s=(30.0, 150.0),
+        duration_dist="lognormal",
+        mix=(
+            # batch VMs: no SLO, high priority, very long tail
+            (0.30, 0.0, (30.0, 600.0), (50, 100), (1800.0, 2400.0)),
+            # interactive (prefill-like): short-lived, SLO-carrying
+            (0.40, 0.75, (8.0, 40.0), (0, 40), (1500.0, 2000.0)),
+            # servers (decode-like): long-lived, tight SLO
+            (0.30, 0.9, (20.0, 90.0), (0, 40), (1800.0, 2400.0)),
+        ),
+        tenants=8, tenant_skew=1.4,
+    ),
+    # Cluster dynamics: a tight 6-node pool rides out an overload wave
+    # by growing a TAINTED heterogeneous scale-out pool (first grow =
+    # new taint vocab; second grow bursts the 8-node row bucket), then
+    # shrinks back — scale-down interrupts running pods, which requeue
+    # with lifecycle history. On the gRPC path the two grows force both
+    # device-resident rebuild flavors (new_taint, row_bucket).
+    "autoscale_stress": Scenario(
+        name="autoscale_stress", horizon_s=140.0,
+        description="mid-horizon autoscale: tainted heterogeneous "
+                    "pool grows past the node bucket (drives "
+                    "device-state rebuilds), then shrinks back",
+        pools=((6, 1), (0, 2, SCALEOUT_TAINT)),
+        autoscale=(
+            (40.0, "grow", 1, 2),    # within the 8-row bucket: new_taint
+            # Staged grow: +1 bursts the 8-row node bucket as a SMALL
+            # delta (the row_bucket rebuild path, not a pipeline
+            # full-send), then the rest of the wave lands.
+            (60.0, "grow", 0, 1),    # 9 > 8 rows: row_bucket growth
+            (62.0, "grow", 0, 3),    # -> 12 nodes at the grown bucket
+            (100.0, "shrink", 0, 4),  # scale-down evicts + requeues
+        ),
+        arrival="poisson", rate=0.6, prefill=18,
+        prefill_duration_s=(15.0, 100.0),
+        mix=(
+            (0.5, 0.0, (40.0, 90.0), (60, 100), (1800.0, 2400.0)),
+            (0.5, 0.85, (20.0, 45.0), (0, 20), (1800.0, 2400.0)),
+        ),
+        tenants=4, tenant_skew=1.0,
+    ),
+    # Gang arrivals under pressure: gangs of 4 near-node-sized members
+    # compete with a standing filler backlog. A gang that cannot fully
+    # place is HELD (all-or-nothing rollback, test_gangs.py semantics)
+    # — never partially bound — and its held members show up in
+    # report.miss_attribution as gang_held.
+    "gang_pressure": Scenario(
+        name="gang_pressure", n_nodes=6, horizon_s=150.0,
+        description="gang arrivals under filler pressure: sub-quorum "
+                    "gangs hold all-or-nothing instead of partially "
+                    "binding",
+        arrival="poisson", rate=0.28, prefill=16,
+        prefill_duration_s=(15.0, 110.0),
+        gang_frac=0.35, gang_size=4,
+        mix=(
+            (0.5, 0.0, (40.0, 80.0), (60, 100), (1800.0, 2400.0)),
+            (0.5, 0.85, (20.0, 40.0), (0, 20), (1700.0, 2100.0)),
+        ),
+        tenants=4, tenant_skew=1.0,
+    ),
+    # Long-horizon soak: diurnal load + node flaps + autoscale + gangs
+    # + lognormal tails over 600 virtual seconds, normally composed
+    # with soak_fault_plan() so injected engine faults land mid-run
+    # (the driver tolerates and logs them as cycle_failed events).
+    # Full horizon is marked slow in tests; the tier-1 smoke runs a
+    # shortened horizon (see soak_smoke()).
+    "soak_storm": Scenario(
+        name="soak_storm", horizon_s=600.0,
+        description="long-horizon soak: diurnal load + node flaps + "
+                    "autoscale + gangs + injected faults (slow; "
+                    "tier-1 runs the bounded smoke)",
+        pools=((8, 1), (0, 2, SCALEOUT_TAINT)),
+        autoscale=(
+            (150.0, "grow", 1, 3),
+            (300.0, "shrink", 1, 2),
+            (450.0, "grow", 0, 2),
+        ),
+        arrival="diurnal", rate=0.30,
+        diurnal_period_s=200.0, diurnal_amplitude=0.8,
+        prefill=20, prefill_duration_s=(20.0, 180.0),
+        duration_dist="lognormal",
+        gang_frac=0.10, gang_size=3,
+        mix=(
+            (0.35, 0.0, (25.0, 400.0), (50, 100), (1700.0, 2300.0)),
+            (0.40, 0.8, (15.0, 90.0), (0, 30), (1700.0, 2300.0)),
+            (0.25, 0.9, (15.0, 60.0), (0, 30), (1700.0, 2300.0)),
+        ),
+        tenants=8, tenant_skew=1.2,
+        node_mtbf_s=150.0, node_mttr_s=20.0,
+    ),
+}
+
+
+def soak_fault_plan(seed: int, cycles: int = 300):
+    """The soak scenario's fault composition: a fresh, seeded
+    tpusched.faults.FaultPlan whose engine.fetch error shots land at
+    deterministic solve indices spread over roughly `cycles` scheduling
+    cycles. The sim driver tolerates these the way the host's
+    run_until_idle tolerates a flaky sidecar — the cycle is dropped,
+    counted (SimResult.failed_cycles), and noted in the event log
+    ("cycle_failed"), so the fault schedule is part of the pinned
+    deterministic timeline. Build a FRESH plan per run: plans carry
+    invocation counters.
+
+    The shot window is cycles//4: idle ticks (empty pending queue) run
+    no solve, so actual engine.fetch invocations trail the tick count —
+    a window at the full cycle count could land every shot past the end
+    of the run (a silent no-op soak)."""
+    from tpusched.faults import FaultPlan
+
+    return FaultPlan.seeded(seed, {
+        "engine.fetch": dict(kind="error", n=3,
+                             window=max(cycles // 4, 6)),
+    })
+
+
+def soak_smoke(horizon_s: float = 60.0) -> Scenario:
+    """The bounded tier-1 form of soak_storm: same composition, short
+    horizon, autoscale/flap times rescaled into the window."""
+    import dataclasses
+
+    base = SCENARIOS["soak_storm"]
+    scale = horizon_s / base.horizon_s
+    return dataclasses.replace(
+        base,
+        name="soak_smoke",
+        description="bounded tier-1 soak smoke (rescaled soak_storm)",
+        horizon_s=horizon_s,
+        diurnal_period_s=base.diurnal_period_s * scale,
+        prefill_duration_s=(5.0, 40.0),
+        node_mtbf_s=base.node_mtbf_s * scale,
+        node_mttr_s=base.node_mttr_s * scale,
+        autoscale=tuple(
+            (round(t * scale, 6), op, pi, count)
+            for (t, op, pi, count) in base.autoscale
+        ),
+        mix=tuple(
+            (w, slo, (d_lo * scale, d_hi * scale), prio, cpu)
+            for (w, slo, (d_lo, d_hi), prio, cpu) in base.mix
+        ),
+    )
+
+
+def generate_trace(scenario: Scenario, seed: int, path: str) -> str:
+    """Generate a workload and write it as an on-disk trace: the
+    generate -> write half of the trace round trip (load_trace +
+    SimDriver(setup=...) is the other half). Returns `path`."""
+    from tpusched.sim import traces
+    from tpusched.sim.workloads import generate
+
+    return traces.write_trace(generate(scenario, seed), path)
+
+
+# Merge these presets into THE scenario registry. Down here (after
+# SCENARIOS exists) the merge is safe in either import order: importing
+# workloads first runs this module to completion from workloads'
+# bottom bare-import; importing this module first pulls workloads in
+# fully via the top-of-module Scenario import before reaching here.
+from tpusched.sim import workloads as _workloads  # noqa: E402
+
+_workloads.SCENARIOS.update(SCENARIOS)

@@ -58,6 +58,13 @@ def summarize(res) -> dict:
         completions=res.completions,
         placed=res.placed, evicted=res.evicted,
         requeues=res.requeues, node_failures=res.node_failures,
+        autoscale_events=getattr(res, "autoscale_events", 0),
+        failed_cycles=getattr(res, "failed_cycles", 0),
+        # Preemption churn (ISSUE 9 matrix metric): evictions per
+        # placement — the fraction of placements the policy later
+        # undid. Lower is better; a policy can buy attainment with
+        # churn, and the matrix reports both so the trade is visible.
+        preemption_churn=round(res.evicted / max(res.placed, 1), 6),
         slo_pods=len(slo_pods),
         slo_attained=len(attained),
         slo_attainment_frac=(
@@ -128,6 +135,40 @@ def render_twin(twin: dict) -> str:
     return "\n".join(lines)
 
 
+def render_matrix(matrix: dict) -> str:
+    """The scenario-matrix table (driver.matrix_run output): one row
+    per scenario, QoS vs static attainment + preemption churn, gain,
+    and both arms' hash prefixes (the determinism pin)."""
+    head = (f"{'scenario':<18} {'qos':>7} {'static':>7} {'gain':>8} "
+            f"{'churn_q':>8} {'churn_s':>8} {'slo_pods':>8}  hashes")
+    lines = [f"scenario matrix: seed={matrix['seed']} "
+             f"backend={matrix['backend']}", head, "-" * len(head)]
+    for r in matrix["rows"]:
+        lines.append(
+            f"{r['scenario']:<18} {r['slo_attainment_frac']:>7.3f} "
+            f"{r['slo_attainment_frac_static']:>7.3f} "
+            f"{r['attainment_gain_vs_static']:>+8.3f} "
+            f"{r['preemption_churn']:>8.3f} "
+            f"{r['preemption_churn_static']:>8.3f} "
+            f"{r['slo_pods']:>8} "
+            f" {r['hash_qos'][:8]}/{r['hash_static'][:8]}"
+        )
+        for arm in ("miss_causes", "miss_causes_static"):
+            if r.get(arm):
+                tag = "static" if arm.endswith("static") else "qos"
+                causes = ", ".join(f"{k}={v}" for k, v in
+                                   sorted(r[arm].items(),
+                                          key=lambda kv: -kv[1]))
+                lines.append(f"{'':<18}   misses ({tag}): {causes}")
+    gains = [r["attainment_gain_vs_static"] for r in matrix["rows"]]
+    if gains:
+        lines.append(
+            f"mean attainment_gain_vs_static over {len(gains)} "
+            f"scenarios: {sum(gains) / len(gains):+.3f}"
+        )
+    return "\n".join(lines)
+
+
 # ---------------------------------------------------------------------------
 # Miss attribution (round 12, ISSUE 8): join missed-SLO pods to their
 # recorded decision chains.
@@ -136,7 +177,12 @@ def render_twin(twin: dict) -> str:
 # Cause labels, most to least actionable. A pod can match several over
 # its lifetime (evicted AND later unschedulable); the FIRST matching
 # cause in this order wins — eviction explains a miss better than the
-# requeue-era pending states it produces.
+# requeue-era pending states it produces. gang_held ranks ABOVE
+# outranked and is GROUP-propagated (ISSUE 9): in a held gang only the
+# members that placed-then-rolled-back carry the gang_held outcome
+# code, while quorum-missing members read as ordinary pending — but
+# their "outranked" cycles are an artifact of the hold, so any member's
+# hold classifies the whole group.
 CAUSE_PREEMPTED = "preempted"
 CAUSE_UNSCHED = "unschedulable"      # rendered with dominant reason
 CAUSE_OUTRANKED = "outranked"        # feasible nodes existed; capacity
@@ -204,6 +250,12 @@ def miss_attribution(res, records) -> dict:
                         if 0 <= evictor < len(rec.pod_names) else None),
                     round=int(rec.evict_round[m]),
                 )
+    # Gangs with a recorded hold: any member's gang_held outcome marks
+    # the GROUP held (see the cause-order comment above).
+    held_groups = {
+        p.gang for p in res.pods
+        if getattr(p, "gang", None) and seen.get(p.name, {}).get("gang_held")
+    }
     causes: dict[str, int] = {}
     pods: dict[str, dict] = {}
     n_miss = 0
@@ -212,6 +264,7 @@ def miss_attribution(res, records) -> dict:
             continue  # attained, or SLO-less (None)
         n_miss += 1
         ev = seen.get(p.name, {})
+        gang = getattr(p, "gang", None)
         if "evicted" in ev or p.evictions > 0:
             cause = CAUSE_PREEMPTED
             detail = ev.get("evicted", {})
@@ -221,12 +274,12 @@ def miss_attribution(res, records) -> dict:
                 reason = reason[len(_NO_FEASIBLE):]
             cause = f"{CAUSE_UNSCHED}:{reason}"
             detail = dict(last_cycle=ev.get("unsched_cycle"))
+        elif ev.get("gang_held") or (gang and gang in held_groups):
+            cause = CAUSE_GANG_HELD
+            detail = dict(gang=gang) if gang else {}
         elif ev.get("outranked_cycles"):
             cause = CAUSE_OUTRANKED
             detail = dict(pending_cycles=ev["outranked_cycles"])
-        elif ev.get("gang_held"):
-            cause = CAUSE_GANG_HELD
-            detail = {}
         elif ev.get("placed_cycles"):
             cause = CAUSE_PLACED_LATE
             detail = dict(placed_cycles=ev["placed_cycles"])

@@ -1,0 +1,225 @@
+"""On-disk workload traces (ISSUE 9 tentpole part 1).
+
+A versioned, SEED-FREE trace format: everything a sim run consumes —
+the initial cluster, every pod's spec + accounting meta (submit time,
+duration, resource shape, SLO target, tenant, optional gang id), and
+the full pre-drawn event timeline (arrivals, node fail/recover flaps,
+autoscale node add/remove, each add carrying its node shape) — written
+out as JSON lines. Replaying a trace needs NO generator and NO rng:
+`load_trace` rebuilds the exact SimSetup `workloads.generate` produced,
+and a SimDriver run over it yields a BYTE-IDENTICAL event-log hash to
+the in-memory run that wrote it (the tier-1 round-trip lint pins this).
+Python floats survive the trip exactly: json emits repr-quality
+decimal strings and parses them back to the same IEEE-754 value.
+
+File layout (one JSON object per line):
+
+    {"schema": "tpusched-sim-trace", "version": 1, "scenario": {...},
+     "seed": 0, "counts": {"nodes": N, "pods": P, "events": E}}
+    {"kind": "node", "spec": {...}}            x N  (initial cluster)
+    {"kind": "pod", "name": ..., "spec": {...}, "meta": {...}}   x P
+    {"kind": "event", "t": ..., "etype": ..., "data": {...}}     x E
+
+The header's `scenario` carries only what REPLAY reads (name,
+horizon_s, preemption) plus free-form `generator` provenance — a trace
+is self-contained, not a recipe: ingesting someone else's trace works
+without their generator config. `validate()` runs on every load and
+fails loudly on version or field mismatches (the CI lint surface).
+"""
+
+from __future__ import annotations
+
+import json
+
+from tpusched.sim import events as ev
+from tpusched.sim.workloads import Scenario, SimSetup
+
+SCHEMA = "tpusched-sim-trace"
+VERSION = 1
+
+# Event kinds the driver understands; anything else in a file is a
+# version-skew error, not a silent skip.
+EVENT_KINDS = ("arrival", "node_fail", "node_recover",
+               "node_add", "node_remove")
+
+_POD_SPEC_REQUIRED = ("requests", "priority", "slo_target")
+_META_REQUIRED = ("duration_s", "slo", "tenant", "priority")
+
+
+class TraceError(ValueError):
+    """A malformed/incompatible trace file; the message says which
+    line and what is wrong."""
+
+
+def _err(lineno: "int | None", msg: str) -> TraceError:
+    where = f"line {lineno}: " if lineno is not None else ""
+    return TraceError(f"trace: {where}{msg}")
+
+
+def _require(rec: dict, keys, lineno: int, what: str) -> None:
+    missing = [k for k in keys if k not in rec]
+    if missing:
+        raise _err(lineno, f"{what} record missing fields {missing} "
+                           f"(have {sorted(rec)})")
+
+
+def validate(records: "list[tuple[int, dict]]") -> dict:
+    """Validate a parsed trace ((lineno, record) pairs, header first).
+    Returns the header. Raises TraceError with the offending line on
+    any schema/version/field mismatch — wired into load_trace so a bad
+    file cannot half-load into a run."""
+    if not records:
+        raise _err(None, "empty file (want a header line first)")
+    ln0, header = records[0]
+    if header.get("schema") != SCHEMA:
+        raise _err(ln0, f"schema {header.get('schema')!r} is not "
+                        f"{SCHEMA!r} (is this a trace file?)")
+    version = header.get("version")
+    if version != VERSION:
+        raise _err(ln0, f"version {version!r} unsupported (this build "
+                        f"reads version {VERSION})")
+    _require(header, ("scenario", "counts"), ln0, "header")
+    _require(header["scenario"], ("name", "horizon_s", "preemption"),
+             ln0, "header scenario")
+    counts = header["counts"]
+    _require(counts, ("nodes", "pods", "events"), ln0, "header counts")
+
+    n_nodes = n_pods = n_events = 0
+    node_names: set = set()
+    pod_names: set = set()
+    for lineno, rec in records[1:]:
+        kind = rec.get("kind")
+        if kind == "node":
+            _require(rec, ("spec",), lineno, "node")
+            spec = rec["spec"]
+            _require(spec, ("name", "allocatable"), lineno, "node spec")
+            if spec["name"] in node_names:
+                raise _err(lineno, f"duplicate node {spec['name']!r}")
+            node_names.add(spec["name"])
+            n_nodes += 1
+        elif kind == "pod":
+            _require(rec, ("name", "spec", "meta"), lineno, "pod")
+            _require(rec["spec"], _POD_SPEC_REQUIRED, lineno, "pod spec")
+            _require(rec["meta"], _META_REQUIRED, lineno, "pod meta")
+            if rec["name"] in pod_names:
+                raise _err(lineno, f"duplicate pod {rec['name']!r}")
+            pod_names.add(rec["name"])
+            n_pods += 1
+        elif kind == "event":
+            _require(rec, ("t", "etype", "data"), lineno, "event")
+            etype = rec["etype"]
+            if etype not in EVENT_KINDS:
+                raise _err(lineno, f"unknown event kind {etype!r} "
+                                   f"(this build knows {EVENT_KINDS})")
+            data = rec["data"]
+            if etype == "arrival":
+                if data.get("pod") not in pod_names:
+                    raise _err(lineno, "arrival references undefined "
+                                       f"pod {data.get('pod')!r} (pods "
+                                       "must precede events)")
+            elif etype == "node_add":
+                _require(data, ("node", "spec"), lineno, "node_add")
+            elif "node" not in data:
+                raise _err(lineno, f"{etype} record missing 'node'")
+            n_events += 1
+        else:
+            raise _err(lineno, f"unknown record kind {kind!r}")
+    got = dict(nodes=n_nodes, pods=n_pods, events=n_events)
+    if {k: counts[k] for k in got} != got:
+        raise _err(None, f"header counts {counts} != body {got} "
+                         "(truncated or spliced file)")
+    return header
+
+
+def write_trace(setup: SimSetup, path: str) -> str:
+    """Serialize a SimSetup (workloads.generate output) to `path`.
+    Non-destructive: the setup's event queue is listed, not drained,
+    so the same object can still be run. Returns `path`."""
+    sc = setup.scenario
+    events = setup.queue.events()
+    header = dict(
+        schema=SCHEMA, version=VERSION,
+        scenario=dict(name=sc.name, horizon_s=sc.horizon_s,
+                      preemption=sc.preemption),
+        seed=setup.seed,
+        generator=dict(description=sc.description,
+                       arrival=sc.arrival,
+                       duration_dist=sc.duration_dist),
+        counts=dict(nodes=len(setup.nodes), pods=len(setup.specs),
+                    events=len(events)),
+    )
+    with open(path, "w") as f:
+        f.write(json.dumps(header) + "\n")
+        for spec in setup.nodes:
+            f.write(json.dumps(dict(kind="node", spec=spec)) + "\n")
+        for name, spec in setup.specs.items():
+            f.write(json.dumps(dict(kind="pod", name=name, spec=spec,
+                                    meta=setup.meta[name])) + "\n")
+        for e in events:
+            f.write(json.dumps(dict(kind="event", t=e.time, etype=e.kind,
+                                    data=e.data)) + "\n")
+    return path
+
+
+def _detuple_taints(spec: dict) -> dict:
+    """JSON turned taint tuples into lists; restore tuples so loaded
+    node specs compare equal to generated ones (and hash the same way
+    through the snapshot builder)."""
+    if spec.get("taints"):
+        spec = dict(spec, taints=[tuple(t) for t in spec["taints"]])
+    return spec
+
+
+def load_trace(path: str) -> SimSetup:
+    """Parse + validate a trace file into a runnable SimSetup.
+
+    The reconstructed Scenario carries only the replay-relevant fields
+    (name, horizon_s, preemption); the timeline and every spec come
+    from the file, so SimDriver(setup=load_trace(p)) replays the
+    recorded run — byte-identical event-log hash to the in-memory run
+    that produced the file."""
+    records: list[tuple[int, dict]] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append((lineno, json.loads(line)))
+            except json.JSONDecodeError as e:
+                raise _err(lineno, f"not JSON: {e}") from None
+    header = validate(records)
+    hs = header["scenario"]
+    scenario = Scenario(
+        name=str(hs["name"]),
+        description="ingested trace",
+        horizon_s=float(hs["horizon_s"]),
+        preemption=bool(hs["preemption"]),
+    )
+    nodes: list = []
+    specs: dict = {}
+    meta: dict = {}
+    q = ev.EventQueue()
+    for _, rec in records[1:]:
+        kind = rec["kind"]
+        if kind == "node":
+            nodes.append(_detuple_taints(rec["spec"]))
+        elif kind == "pod":
+            specs[rec["name"]] = rec["spec"]
+            meta[rec["name"]] = rec["meta"]
+        else:
+            data = rec["data"]
+            if rec["etype"] == "node_add":
+                data = dict(data, spec=_detuple_taints(data["spec"]))
+            q.push(rec["t"], rec["etype"], **data)
+    return SimSetup(scenario=scenario, seed=int(header.get("seed", 0)),
+                    nodes=nodes, specs=specs, meta=meta, queue=q)
+
+
+def replay(path: str, **run_kwargs):
+    """Load a trace and run it through the real stack: load_trace +
+    driver.run_scenario(setup=...). run_kwargs pass through (config,
+    sim, backend, engine, faults, explain, ...)."""
+    from tpusched.sim.driver import run_scenario
+
+    return run_scenario(setup=load_trace(path), **run_kwargs)

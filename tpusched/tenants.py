@@ -30,6 +30,24 @@ from tpusched.engine import solve_core
 from tpusched.snapshot import ClusterSnapshot
 
 
+def zipf_weights(n: int, skew: float) -> np.ndarray:
+    """Normalized Zipf weights over n tenants: w_r ∝ 1 / rank^skew.
+
+    THE tenant-skew definition shared across the codebase — the sim's
+    workload generators (tpusched/sim/workloads.py draws each pod's
+    tenant from these weights) and any serving-path tenant-fairness
+    weighting must read it from here, so "tenant 0 gets X% of traffic"
+    means the same thing in a trace-driven sim run and on the serving
+    path. skew=0 is uniform; the Borg/Azure trace analyses this
+    reproduces (Resource Central, SOSP'17) put subscription skew around
+    1.0-1.4."""
+    if n < 1:
+        raise ValueError(f"zipf_weights: n={n} must be >= 1")
+    w = 1.0 / np.power(np.arange(1, n + 1, dtype=np.float64),
+                       max(float(skew), 0.0))
+    return w / w.sum()
+
+
 def stack_snapshots(snaps: list[ClusterSnapshot]) -> ClusterSnapshot:
     """Stack bucket-aligned snapshots along a new leading tenant axis.
     Raises if any leaf shapes disagree (different buckets)."""
