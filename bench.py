@@ -485,6 +485,7 @@ def bench_pipeline(args):
         "metric": f"pipeline_overlap_speedup_{pods}x{nodes}",
         "value": round(stats["speedup"], 3),
         "unit": "x",
+        "direction": "higher",
         "vs_baseline": round(stats["speedup"], 3),
         "sequential_s": round(stats["sequential_s"], 3),
         "pipelined_s": round(stats["pipelined_s"], 3),
@@ -760,7 +761,8 @@ def bench_wire(args):
                 outs = [[], []]
                 t0 = time.perf_counter()
                 threads = [
-                    threading.Thread(target=drive, args=(i, outs[i]))
+                    threading.Thread(target=drive, args=(i, outs[i]),
+                                     name=f"tpusched-bench-wire-{i}")
                     for i in range(2)
                 ]
                 for t in threads:
@@ -825,7 +827,7 @@ def _stage_breakdown() -> dict:
     from tpusched import trace as _tr
 
     by: dict[str, list] = {}
-    for s in _tr.DEFAULT.spans():
+    for s in _tr.DEFAULT.spans():  # tpl: disable=TPL009(bench deliberately reads the process-default ring its --trace knob enables)
         if s.cat in ("server", "engine"):
             by.setdefault(s.name, []).append(s.dur_s)
     return {
@@ -894,7 +896,8 @@ def _serve_score_phase(svc, clients, msgs, rngs, pods, churn, shape,
     for _ in range(cycles):
         d = score_delta()
         sink = []
-        threads = [threading.Thread(target=fire, args=(i, d, sink))
+        threads = [threading.Thread(target=fire, args=(i, d, sink),
+                                    name=f"tpusched-bench-coalesce-{i}")
                    for i in range(K)]
         for t in threads:
             t.start()
@@ -1020,7 +1023,7 @@ def bench_serving(args):
         # exactly this phase.
         from tpusched import trace as _tr
 
-        _tr.DEFAULT.clear()
+        _tr.DEFAULT.clear()  # tpl: disable=TPL009(bench deliberately scopes the process-default ring to this phase)
         lat: list[list[float]] = [[] for _ in range(K)]
 
         def drive(i):
@@ -1030,7 +1033,8 @@ def bench_serving(args):
                 lat[i].append(time.perf_counter() - t0)
 
         t0 = time.perf_counter()
-        threads = [threading.Thread(target=drive, args=(i,))
+        threads = [threading.Thread(target=drive, args=(i,),
+                                    name=f"tpusched-bench-serve-{i}")
                    for i in range(K)]
         for t in threads:
             t.start()
@@ -1084,7 +1088,8 @@ def bench_serving(args):
                 one_cycle(i)
                 open_lat[i].append(time.perf_counter() - arrivals[req])
 
-        threads = [threading.Thread(target=drive_open, args=(i,))
+        threads = [threading.Thread(target=drive_open, args=(i,),
+                                    name=f"tpusched-bench-open-{i}")
                    for i in range(K)]
         for t in threads:
             t.start()
@@ -1157,6 +1162,7 @@ def bench_divergence(args):
             "value": row["identical_rate"],
             "unit": "identical_rate",
             "vs_baseline": None,
+            "direction": "higher",
         }
         if TRANSPORT:
             line["rtt_ms"] = TRANSPORT["rtt_ms"]
@@ -1210,16 +1216,16 @@ def bench_robustness(args):
         failed_cycle_attempts=report["chaos"]["failed_cycle_attempts"],
         faults_fired=len(report["injected"]["fired"]),
     )
-    for metric, value, unit, extra in (
-        ("chaos_recovery_ms", round(worst * 1e3, 1), "ms",
+    for metric, value, unit, direction, extra in (
+        ("chaos_recovery_ms", round(worst * 1e3, 1), "ms", "lower",
          {"recovery_ms": {k: round(v * 1e3, 1) for k, v in rec.items()}}),
         ("chaos_goodput_frac", report["goodput_frac"],
-         "frac_of_fault_free",
+         "frac_of_fault_free", "higher",
          {"fault_free_pps": report["baseline"]["goodput_pps"],
           "chaos_pps": report["chaos"]["goodput_pps"]}),
     ):
         line = {"metric": metric, "value": value, "unit": unit,
-                "vs_baseline": None}
+                "vs_baseline": None, "direction": direction}
         if TRANSPORT:
             line["rtt_ms"] = TRANSPORT["rtt_ms"]
         line.update(common)

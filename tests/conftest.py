@@ -42,6 +42,36 @@ def rng():
     return np.random.default_rng(0)
 
 
+# TPL005 runtime backstop (round 15, ISSUE 10): the static rule proves
+# literal/f-string Thread names carry the "tpusched-" prefix, but a
+# dynamically-named (or third-party-wrapped) construction slips it.
+# An UNNAMED thread gets Python's default "Thread-<N> (target)" name —
+# invisible to the name-keyed leak matcher below, so a leak of one
+# would silently pass. Known third-party default-named threads (we
+# can't name what we don't construct) are exempted by their target
+# suffix; grpc's poller shows up as "Thread-1 (_serve)".
+_THIRD_PARTY_THREAD_SUFFIXES = (
+    "(_serve)",                  # grpc server poller
+    "(channel_spin)",            # grpc channel watcher
+    "(process_request_thread)",  # stdlib ThreadingHTTPServer worker
+    "(serve_forever)",           # stdlib test HTTP servers
+)
+
+
+def _unnamed_stray_threads():
+    import re
+    import threading
+
+    out = []
+    for t in threading.enumerate():
+        if not t.is_alive() or not re.match(r"^Thread-\d+", t.name):
+            continue
+        if t.name.endswith(_THIRD_PARTY_THREAD_SUFFIXES):
+            continue
+        out.append(t.name)
+    return out
+
+
 @pytest.fixture
 def thread_leak_check():
     """Multi-client/concurrency tests opt in: asserts every NEW
@@ -63,6 +93,17 @@ def thread_leak_check():
     — a regression here would put a leakable thread on every traced
     hot path."""
     import threading
+
+    # Setup assertion (round 15): every thread alive when the leak
+    # check arms must satisfy TPL005 — a default-named stray that
+    # predates the test would be exempt from the leak match below AND
+    # invisible to it if re-leaked, so it fails LOUDLY here instead.
+    strays = _unnamed_stray_threads()
+    assert strays == [], (
+        f"unnamed (TPL005-violating) threads alive at leak-check "
+        f"setup: {strays} — name them tpusched-* or exempt a known "
+        f"third-party target in _THIRD_PARTY_THREAD_SUFFIXES"
+    )
 
     # Keyed by Thread OBJECT, not ident: the OS recycles idents, and a
     # leaked worker created with a recycled ident would otherwise be

@@ -75,12 +75,15 @@ def test_store_delta_roundtrip():
     s1, m1 = snapshot_from_proto(composed, cfg)
     s2, m2 = snapshot_from_proto(new, cfg)
     eng = Engine(cfg)
-    r1, r2 = eng.solve(s1), eng.solve(s2)
-    by_name_1 = {m1.pod_names[i]: (m1.node_names[int(n)] if n >= 0 else None)
-                 for i, n in enumerate(r1.assignment[: m1.n_pods])}
-    by_name_2 = {m2.pod_names[i]: (m2.node_names[int(n)] if n >= 0 else None)
-                 for i, n in enumerate(r2.assignment[: m2.n_pods])}
-    assert by_name_1 == by_name_2
+    try:
+        r1, r2 = eng.solve(s1), eng.solve(s2)
+        by_name_1 = {m1.pod_names[i]: (m1.node_names[int(n)] if n >= 0 else None)
+                     for i, n in enumerate(r1.assignment[: m1.n_pods])}
+        by_name_2 = {m2.pod_names[i]: (m2.node_names[int(n)] if n >= 0 else None)
+                     for i, n in enumerate(r2.assignment[: m2.n_pods])}
+        assert by_name_1 == by_name_2
+    finally:
+        eng.close()
 
 
 @pytest.fixture

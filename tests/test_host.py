@@ -30,36 +30,42 @@ def test_e2e_100x10_single_batch_matches_oracle():
     api = _cluster()
     cfg = EngineConfig()  # parity mode
     host = HostScheduler(api, cfg)
-    # capture the wire snapshot the host will solve, for the oracle
-    msg = host._wire_snapshot(api.pending_pods())
-    snap, meta = snapshot_from_proto(msg, cfg)
-    ora = Oracle(snap, cfg).solve()
+    try:
+        # capture the wire snapshot the host will solve, for the oracle
+        msg = host._wire_snapshot(api.pending_pods())
+        snap, meta = snapshot_from_proto(msg, cfg)
+        ora = Oracle(snap, cfg).solve()
 
-    stats = host.cycle()
-    assert stats.batch_size == 100
-    bound = {p["name"]: p["node"] for p in api.bound_pods()}
-    for i, name in enumerate(meta.pod_names):
-        if ora.assignment[i] >= 0:
-            assert bound[name] == meta.node_names[ora.assignment[i]]
-        else:
-            assert name not in bound
-    assert stats.placed == int((ora.assignment >= 0).sum())
-    assert not api.pending_pods() or stats.placed < 100
+        stats = host.cycle()
+        assert stats.batch_size == 100
+        bound = {p["name"]: p["node"] for p in api.bound_pods()}
+        for i, name in enumerate(meta.pod_names):
+            if ora.assignment[i] >= 0:
+                assert bound[name] == meta.node_names[ora.assignment[i]]
+            else:
+                assert name not in bound
+        assert stats.placed == int((ora.assignment >= 0).sum())
+        assert not api.pending_pods() or stats.placed < 100
+    finally:
+        host.close()
 
 
 def test_e2e_multi_batch_drains_queue():
     api = _cluster(n_pods=60, n_nodes=8, seed=3)
     host = HostScheduler(api, EngineConfig(mode="fast"), batch_size=16)
-    cycles = host.run_until_idle()
-    assert cycles >= 4  # 60 pods / 16 per batch
-    assert api.pending_pods() == []
-    # later batches saw earlier binds as running pods (capacity respected)
-    per_node: dict[str, float] = {}
-    for p in api.bound_pods():
-        per_node.setdefault(p["node"], 0.0)
-        per_node[p["node"]] += p["requests"]["cpu"]
-    for n in api.list_nodes():
-        assert per_node.get(n["name"], 0.0) <= n["allocatable"]["cpu"] + 1e-6
+    try:
+        cycles = host.run_until_idle()
+        assert cycles >= 4  # 60 pods / 16 per batch
+        assert api.pending_pods() == []
+        # later batches saw earlier binds as running pods (capacity respected)
+        per_node: dict[str, float] = {}
+        for p in api.bound_pods():
+            per_node.setdefault(p["node"], 0.0)
+            per_node[p["node"]] += p["requests"]["cpu"]
+        for n in api.list_nodes():
+            assert per_node.get(n["name"], 0.0) <= n["allocatable"]["cpu"] + 1e-6
+    finally:
+        host.close()
 
 
 def test_e2e_through_grpc_sidecar():
@@ -73,9 +79,12 @@ def test_e2e_through_grpc_sidecar():
         with SchedulerClient(f"127.0.0.1:{port}") as client:
             api = _cluster(n_pods=40, n_nodes=6, seed=5)
             host = HostScheduler(api, cfg, client=client)
-            host.run_until_idle()
-            assert api.pending_pods() == []
-            assert api.bind_count == 40
+            try:
+                host.run_until_idle()
+                assert api.pending_pods() == []
+                assert api.bind_count == 40
+            finally:
+                host.close()
     finally:
         server.stop(0)
 
@@ -96,25 +105,31 @@ def test_crash_replay_no_duplicate_binds():
     api = _cluster(n_pods=30, n_nodes=6, seed=7)
     cfg = EngineConfig(mode="fast")
     host1 = HostScheduler(api, cfg, batch_size=30)
-    # First host "crashes" after solving but before binding everything:
-    pending = api.pending_pods()
-    msg = host1._wire_snapshot(pending)
-    snap, meta = snapshot_from_proto(msg, cfg)
-    res = host1._engine.solve(snap)
-    # bind only the first 10 assignments, then "crash"
-    done = 0
-    for i, n in enumerate(res.assignment[: meta.n_pods]):
-        if n >= 0 and done < 10:
-            api.bind(meta.pod_names[i], meta.node_names[int(n)])
-            done += 1
-    binds_before = api.bind_count
-    # Fresh host replays from cluster truth:
-    host2 = HostScheduler(api, cfg, batch_size=30)
-    host2.run_until_idle()
-    assert api.pending_pods() == []
-    # every pod bound exactly once overall
-    assert api.bind_count == 30
-    assert api.bind_count - binds_before == 20
+    host2 = None
+    try:
+        # First host "crashes" after solving but before binding everything:
+        pending = api.pending_pods()
+        msg = host1._wire_snapshot(pending)
+        snap, meta = snapshot_from_proto(msg, cfg)
+        res = host1._engine.solve(snap)
+        # bind only the first 10 assignments, then "crash"
+        done = 0
+        for i, n in enumerate(res.assignment[: meta.n_pods]):
+            if n >= 0 and done < 10:
+                api.bind(meta.pod_names[i], meta.node_names[int(n)])
+                done += 1
+        binds_before = api.bind_count
+        # Fresh host replays from cluster truth:
+        host2 = HostScheduler(api, cfg, batch_size=30)
+        host2.run_until_idle()
+        assert api.pending_pods() == []
+        # every pod bound exactly once overall
+        assert api.bind_count == 30
+        assert api.bind_count - binds_before == 20
+    finally:
+        host1.close()
+        if host2 is not None:
+            host2.close()
 
 
 def test_preemption_deletes_then_binds():
@@ -126,11 +141,14 @@ def test_preemption_deletes_then_binds():
                 priority=500.0, observed_avail=1.0)
     cfg = EngineConfig(preemption=True)
     host = HostScheduler(api, cfg)
-    stats = host.cycle()
-    assert stats.evicted == 1 and stats.placed == 1
-    assert api.delete_count == 1
-    bound = {p["name"]: p["node"] for p in api.bound_pods()}
-    assert bound == {"urgent": "n0"}  # victim gone, preemptor in place
+    try:
+        stats = host.cycle()
+        assert stats.evicted == 1 and stats.placed == 1
+        assert api.delete_count == 1
+        bound = {p["name"]: p["node"] for p in api.bound_pods()}
+        assert bound == {"urgent": "n0"}  # victim gone, preemptor in place
+    finally:
+        host.close()
 
 
 def test_gang_pods_all_or_nothing_e2e():
@@ -141,9 +159,12 @@ def test_gang_pods_all_or_nothing_e2e():
                     pod_group="g", pod_group_min_member=4,
                     observed_avail=1.0)
     host = HostScheduler(api, EngineConfig())
-    host.run_until_idle(max_cycles=3)
-    assert api.bound_pods() == []  # quorum impossible: nothing binds
-    assert len(api.pending_pods()) == 4
+    try:
+        host.run_until_idle(max_cycles=3)
+        assert api.bound_pods() == []  # quorum impossible: nothing binds
+        assert len(api.pending_pods()) == 4
+    finally:
+        host.close()
 
 
 def test_failure_after_drain_restores_hints():
@@ -170,11 +191,14 @@ def test_failure_after_drain_restores_hints():
 
     host = HostScheduler(api, EngineConfig(mode="fast"),
                          client=_NeverCalled())
-    assert api.drain_changed() is None  # consume the no-baseline drain
-    api.add_pod("late-pod", requests={"cpu": 10.0, "memory": 1e6})
-    api.fail_next = True
-    with pytest.raises(RuntimeError):
-        host.cycle()
-    assert api.drain_changed() == {"late-pod"}, (
-        "hints drained by the failed cycle were not restored"
-    )
+    try:
+        assert api.drain_changed() is None  # consume the no-baseline drain
+        api.add_pod("late-pod", requests={"cpu": 10.0, "memory": 1e6})
+        api.fail_next = True
+        with pytest.raises(RuntimeError):
+            host.cycle()
+        assert api.drain_changed() == {"late-pod"}, (
+            "hints drained by the failed cycle were not restored"
+        )
+    finally:
+        host.close()

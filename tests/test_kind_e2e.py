@@ -83,6 +83,7 @@ def test_kind_host_schedules_all_pods(kind_cluster):
     informer = KubeInformer(
         KubeApiClient(kubeconfig=kind_cluster), poll_timeout=5.0
     ).start()
+    host = None
     try:
         host = HostScheduler(informer, EngineConfig(mode="fast"))
         deadline = time.monotonic() + 120.0
@@ -98,6 +99,8 @@ def test_kind_host_schedules_all_pods(kind_cluster):
             time.sleep(1.0)
         assert len(bound) == N_PODS, f"only {len(bound)}/{N_PODS} bound"
     finally:
+        if host is not None:
+            host.close()
         informer.stop()
         subprocess.run(
             [kubectl, "delete", "pod", "-l", "app=tpusched-e2e",

@@ -438,6 +438,7 @@ def test_host_e2e_over_rest_with_informer_and_delta(fake_kube):
     informer = KubeInformer(KubeApiClient(base_url=url),
                             poll_timeout=2.0).start()
     client = SchedulerClient(f"127.0.0.1:{port}")
+    host = None
     try:
         host = HostScheduler(informer, cfg, client=client)
         host.run_until_idle()
@@ -469,6 +470,8 @@ def test_host_e2e_over_rest_with_informer_and_delta(fake_kube):
             "delta transport must beat full resends on the wire"
         )
     finally:
+        if host is not None:
+            host.close()
         informer.stop()
         client.close()
         server.stop(0)

@@ -1,0 +1,336 @@
+"""tpuschedlint suite tests (round 15, ISSUE 10).
+
+Per-rule positive/negative fixture twins (each rule must fire on its
+bad snippet and stay silent on the good one), the suppression grammar
+(reason mandatory), the baseline round trip, and — the point of the
+whole exercise — the tier-1 gate: the REAL tree lints clean with an
+EMPTY baseline, so every invariant the repo has paid review passes for
+is now enforced, not remembered.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpusched.lint import (
+    Finding,
+    LintContext,
+    LintEngine,
+    RULES,
+    load_baseline,
+    parse_suppressions,
+    write_baseline,
+)
+from tpusched.lint.engine import BAD_SUPPRESSION, apply_baseline
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def lint(src: str, relpath: str, **ctx_kw) -> "list[Finding]":
+    ctx = LintContext(root=REPO_ROOT, **ctx_kw)
+    return LintEngine(ctx=ctx).lint_text(src, relpath)
+
+
+def rules_of(findings) -> "set[str]":
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# Fixture twins: (rule, relpath, bad snippet, good snippet). Each bad
+# snippet must yield EXACTLY its rule (no collateral findings — keeps
+# the fixtures honest about what fires), each good twin nothing.
+# ---------------------------------------------------------------------------
+
+FIXTURES = [
+    (
+        "TPL001", "tpusched/foo.py",
+        "def f():\n    from tpusched import trace\n    return trace\n",
+        "from tpusched import trace\n\n\ndef f():\n    return trace\n",
+    ),
+    (
+        # allowlisted optional dep: function-level grpc is legal
+        None, "tpusched/foo.py",
+        None,
+        "def f():\n    import grpc\n    return grpc\n",
+    ),
+    (
+        "TPL002", "tpusched/sim/foo.py",
+        "import random\n\n\ndef f():\n    return random.random()\n",
+        "import random\n\n\ndef f():\n    return random.Random(0).random()\n",
+    ),
+    (
+        "TPL002", "tpusched/kernels/foo.py",
+        "import numpy as np\n\n\ndef f():\n    return np.random.rand(3)\n",
+        "import numpy as np\n\n\ndef f():\n"
+        "    return np.random.default_rng(7).random(3)\n",
+    ),
+    (
+        "TPL002", "tpusched/faults.py",
+        "import time\n\n\ndef f():\n    return time.time()\n",
+        "import time\n\n\ndef f(clock):\n"
+        "    return (clock.now(), time.monotonic())\n",
+    ),
+    (
+        "TPL002", "tpusched/sim/foo.py",
+        "import numpy as np\n\n\ndef f():\n    return np.random.default_rng()\n",
+        "import numpy as np\n\n\ndef f(seed):\n"
+        "    return np.random.default_rng(seed)\n",
+    ),
+    (
+        # an explicit None seed is still OS entropy
+        "TPL002", "tpusched/sim/foo.py",
+        "import numpy as np\n\n\ndef f():\n"
+        "    return np.random.default_rng(None)\n",
+        "import numpy as np\n\n\ndef f():\n"
+        "    return np.random.default_rng(seed=0)\n",
+    ),
+    (
+        "TPL003", "tpusched/foo.py",
+        "def f(self):\n    with self._lock:\n"
+        "        return self._fut.result()\n",
+        "def f(self):\n    with self._lock:\n        fut = self._fut\n"
+        "    return fut.result()\n",
+    ),
+    (
+        # defining a function under the lock is free
+        None, "tpusched/foo.py",
+        None,
+        "def f(self):\n    with self._lock:\n"
+        "        def g():\n            return self._fut.result()\n"
+        "    return g\n",
+    ),
+    (
+        "TPL004", "tpusched/foo.py",
+        "def f(v):\n    return min(max(v, 0.0), 1.0)\n",
+        "from tpusched.config import clamp01\n\n\ndef f(v):\n"
+        "    return clamp01(v)\n",
+    ),
+    (
+        # a non-unit range clamp is NOT the clamp01 bug class
+        None, "tpusched/foo.py",
+        None,
+        "def f(k, n):\n    return max(1, min(k, n))\n",
+    ),
+    (
+        "TPL005", "tpusched/foo.py",
+        "import threading\n\n\ndef f():\n"
+        "    return threading.Thread(target=f)\n",
+        "import threading\n\n\ndef f():\n"
+        "    return threading.Thread(target=f, name='tpusched-foo')\n",
+    ),
+    (
+        "TPL005", "tools/foo.py",
+        "import threading\n\n\ndef f(i):\n"
+        "    return threading.Thread(target=f, name=f'worker-{i}')\n",
+        "import threading\n\n\ndef f(i):\n"
+        "    return threading.Thread(target=f, name=f'tpusched-w-{i}')\n",
+    ),
+    (
+        "TPL006", "bench.py",
+        'import json\n\n\ndef f(v):\n    print(json.dumps({\n'
+        '        "metric": "mystery_frac", "value": v, "unit": "frac"}))\n',
+        'import json\n\n\ndef f(v):\n    print(json.dumps({\n'
+        '        "metric": "mystery_frac", "value": v, "unit": "frac",\n'
+        '        "direction": "higher"}))\n',
+    ),
+    (
+        # lower-better unit and qps-pattern names resolve without help
+        None, "bench.py",
+        None,
+        'import json\n\n\ndef f(v, shape):\n'
+        '    print(json.dumps({"metric": "solve_ms", "value": v,'
+        ' "unit": "ms"}))\n'
+        '    print(json.dumps({"metric": f"serve_qps_{shape}",'
+        ' "value": v, "unit": "qps"}))\n',
+    ),
+    (
+        "TPL007", "tpusched/foo.py",
+        "def f(d):\n    return next(reversed(d), None)\n",
+        "def f(d, newest):\n    return d.get(newest)\n",
+    ),
+    (
+        "TPL008", "tools/foo.py",
+        "def f(rounds):\n    return sorted(rounds)\n",
+        "def f(rounds):\n    return sorted(rounds, key=int)\n",
+    ),
+    (
+        # name without round/seq tokens: not this bug class
+        None, "tools/foo.py",
+        None,
+        "def f(node_names):\n    node_names.sort()\n"
+        "    return sorted(node_names)\n",
+    ),
+    (
+        "TPL009", "tpusched/foo.py",
+        "from tpusched import trace as tracing\n\n\ndef f():\n"
+        "    tracing.DEFAULT.record('x')\n",
+        "from tpusched import trace as tracing\n\n\ndef f(tracer):\n"
+        "    (tracer or tracing.DEFAULT).record('x')\n",
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "rule,relpath,bad,good",
+    FIXTURES,
+    ids=[f"{r or 'neg'}-{i}" for i, (r, _, _, _) in enumerate(FIXTURES)],
+)
+def test_rule_fires_on_bad_and_not_on_good(rule, relpath, bad, good):
+    if bad is not None:
+        got = rules_of(lint(bad, relpath))
+        assert got == {rule}, f"bad twin: expected {{{rule}}}, got {got}"
+    assert lint(good, relpath) == [], "good twin must lint clean"
+
+
+def test_tpl010_fires_and_clears_on_close():
+    bad = (
+        "def test_leaks():\n"
+        "    eng = Engine(cfg)\n"
+        "    assert eng.solve(snap)\n"
+    )
+    closed = (
+        "def test_closes():\n"
+        "    eng = Engine(cfg)\n"
+        "    try:\n"
+        "        assert eng.solve(snap)\n"
+        "    finally:\n"
+        "        eng.close()\n"
+    )
+    handed_off = (
+        "def test_hands_off():\n"
+        "    eng = Engine(cfg)\n"
+        "    host = HostScheduler(api, cfg, engine=eng)\n"
+        "    host.close()\n"
+    )
+    kw = dict(closeable_classes={"Engine", "HostScheduler"})
+    assert rules_of(lint(bad, "tests/test_x.py", **kw)) == {"TPL010"}
+    assert lint(closed, "tests/test_x.py", **kw) == []
+    assert lint(handed_off, "tests/test_x.py", **kw) == []
+    # rule is tests-only: the same code in product scope is silent
+    assert lint(bad, "tpusched/foo.py", **kw) == []
+
+
+def test_closeable_scan_finds_the_real_classes():
+    ctx = LintContext(root=REPO_ROOT)
+    assert {"Engine", "HostScheduler", "SchedulerClient",
+            "SchedulerService", "ReplicaSet"} <= ctx.closeable_classes
+
+
+# ---------------------------------------------------------------------------
+# Suppressions.
+# ---------------------------------------------------------------------------
+
+BAD_TPL007 = "def f(d):\n    return next(reversed(d), None){}\n"
+
+
+def test_suppression_with_reason_silences_the_rule():
+    src = BAD_TPL007.format(
+        "  # tpl: disable=TPL007(any element is acceptable here)"
+    )
+    assert lint(src, "tpusched/foo.py") == []
+
+
+def test_suppression_without_reason_is_its_own_finding():
+    for marker in ("  # tpl: disable=TPL007",
+                   "  # tpl: disable=TPL007()"):
+        got = lint(BAD_TPL007.format(marker), "tpusched/foo.py")
+        assert rules_of(got) == {BAD_SUPPRESSION, "TPL007"}, (
+            "a reasonless suppression must not suppress, and must "
+            "flag itself"
+        )
+
+
+def test_suppression_only_covers_its_own_line_and_rule():
+    src = (
+        "def f(d):\n"
+        "    x = next(reversed(d))  # tpl: disable=TPL001(wrong rule)\n"
+        "    y = next(reversed(d))\n"
+        "    return x, y\n"
+    )
+    got = lint(src, "tpusched/foo.py")
+    assert [f.line for f in got] == [2, 3]
+    assert rules_of(got) == {"TPL007"}
+
+
+def test_suppression_marker_inside_string_literal_is_ignored():
+    src = 'MSG = "write # tpl: disable=TPL007(reason) on the line"\n'
+    assert lint(src, "tpusched/foo.py") == []
+
+
+# ---------------------------------------------------------------------------
+# Baseline round trip.
+# ---------------------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    src = "def f(d):\n    return next(reversed(d), None)\n"
+    findings = lint(src, "tpusched/foo.py")
+    assert rules_of(findings) == {"TPL007"}
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(bl_path, findings)
+    baseline = load_baseline(bl_path)
+    assert apply_baseline(findings, baseline) == []
+    # a NEW finding (different line) is not covered
+    moved = [Finding(f.path, f.line + 10, f.rule, f.message)
+             for f in findings]
+    assert apply_baseline(moved, baseline) == moved
+    # the checked-in JSON stays list-shaped
+    assert isinstance(json.loads(bl_path.read_text()), list)
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    assert load_baseline(tmp_path / "nope.json") == set()
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 gate.
+# ---------------------------------------------------------------------------
+
+def test_rule_table_is_complete():
+    ids = [cls.rule_id for cls in RULES]
+    assert len(ids) == len(set(ids)) == 10
+    for cls in RULES:
+        assert cls.incident, f"{cls.rule_id} must cite its incident"
+        assert cls.title, f"{cls.rule_id} must carry a title"
+
+
+def test_tree_is_clean():
+    """THE gate (acceptance criterion): the full repo — product code
+    AND tests — lints clean against the checked-in baseline, which is
+    EMPTY. A finding here is a real invariant violation: fix it or
+    suppress it on-line with a reason, do not baseline it."""
+    baseline_path = REPO_ROOT / "tools" / "lint_baseline.json"
+    baseline = load_baseline(baseline_path)
+    assert baseline == set(), (
+        "tools/lint_baseline.json must stay EMPTY at HEAD — baselines "
+        "grandfather a new rule in, they are not a suppression pool"
+    )
+    engine = LintEngine(ctx=LintContext(root=REPO_ROOT))
+    findings = engine.lint_paths([
+        REPO_ROOT / "tpusched",
+        REPO_ROOT / "tools",
+        REPO_ROOT / "bench.py",
+        REPO_ROOT / "tests",
+    ])
+    findings = apply_baseline(findings, baseline)
+    assert findings == [], (
+        "tpuschedlint findings at HEAD:\n"
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+def test_suppressions_in_tree_all_carry_reasons():
+    """Every suppression in the real tree parses with a reason (the
+    gate would fail on TPL000 otherwise, but this pins the grammar
+    end-to-end over the live files)."""
+    n_suppressions = 0
+    for path in sorted((REPO_ROOT / "tpusched").rglob("*.py")):
+        by_line, errors = parse_suppressions(path.read_text())
+        assert errors == [], f"{path}: {errors}"
+        n_suppressions += sum(len(v) for v in by_line.values())
+    assert n_suppressions >= 5, (
+        "the tree documents its deliberate exceptions via reasoned "
+        "suppressions; losing them all suggests the parser broke"
+    )

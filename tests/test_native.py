@@ -69,8 +69,11 @@ def test_host_cluster_roundtrip():
     api = FakeApiServer()
     build_synthetic_cluster(api, rng, 40, 8)
     host = HostScheduler(api, EngineConfig())
-    msg = host._wire_snapshot(api.pending_pods())
-    _roundtrip(msg)
+    try:
+        msg = host._wire_snapshot(api.pending_pods())
+        _roundtrip(msg)
+    finally:
+        host.close()
 
 
 def _rich_records(rng, n_pods=24, n_nodes=8, n_running=10):

@@ -70,12 +70,15 @@ def test_solve_async_matches_sync():
     rng = np.random.default_rng(9)
     snap, _ = make_cluster(rng, 40, 8)
     eng = Engine(EngineConfig(mode="fast"))
-    snap = eng.put(snap)
-    sync = eng.solve(snap)
-    pending = eng.solve_async(snap)
-    # The caller's thread is free here — that window is the feature.
-    async_res = pending.result()
-    np.testing.assert_array_equal(sync.assignment, async_res.assignment)
-    np.testing.assert_array_equal(sync.commit_key, async_res.commit_key)
-    np.testing.assert_allclose(sync.final_used, async_res.final_used)
-    assert async_res.solve_seconds > 0
+    try:
+        snap = eng.put(snap)
+        sync = eng.solve(snap)
+        pending = eng.solve_async(snap)
+        # The caller's thread is free here — that window is the feature.
+        async_res = pending.result()
+        np.testing.assert_array_equal(sync.assignment, async_res.assignment)
+        np.testing.assert_array_equal(sync.commit_key, async_res.commit_key)
+        np.testing.assert_allclose(sync.final_used, async_res.final_used)
+        assert async_res.solve_seconds > 0
+    finally:
+        eng.close()

@@ -34,20 +34,23 @@ def test_unschedulable_pod_backs_off_and_retries():
     clock = FakeClock()
     host = HostScheduler(api, EngineConfig(mode="fast"), clock=clock,
                          backoff_initial=1.0, backoff_max=10.0)
-    stats = host.cycle()
-    assert stats.placed == 1            # "fits" binds, "huge" does not
-    assert host.backlogged() == 1
-    # Within the backoff window the active queue is empty.
-    clock.t = 0.5
-    assert host.cycle() is None
-    # Window expires -> the pod is retried (still unschedulable, so its
-    # backoff doubles: attempts 1 -> 2).
-    clock.t = 1.5
-    stats = host.cycle()
-    assert stats is not None and stats.batch_size == 1 and stats.placed == 0
-    retry_at, attempts = host._backoff["pod\x00huge"]
-    assert attempts == 2
-    assert retry_at == clock.t + 2.0    # 1.0 * 2^1
+    try:
+        stats = host.cycle()
+        assert stats.placed == 1            # "fits" binds, "huge" does not
+        assert host.backlogged() == 1
+        # Within the backoff window the active queue is empty.
+        clock.t = 0.5
+        assert host.cycle() is None
+        # Window expires -> the pod is retried (still unschedulable, so its
+        # backoff doubles: attempts 1 -> 2).
+        clock.t = 1.5
+        stats = host.cycle()
+        assert stats is not None and stats.batch_size == 1 and stats.placed == 0
+        retry_at, attempts = host._backoff["pod\x00huge"]
+        assert attempts == 2
+        assert retry_at == clock.t + 2.0    # 1.0 * 2^1
+    finally:
+        host.close()
 
 
 def test_backoff_caps():
@@ -56,11 +59,14 @@ def test_backoff_caps():
     clock = FakeClock()
     host = HostScheduler(api, EngineConfig(mode="fast"), clock=clock,
                          backoff_initial=1.0, backoff_max=4.0)
-    for _ in range(6):
-        host.cycle()
-        clock.t = host._backoff["pod\x00huge"][0]  # jump to retry time
-    retry_at, attempts = host._backoff["pod\x00huge"]
-    assert retry_at - clock.t <= 4.0 + 1e-9, "delay must cap at backoff_max"
+    try:
+        for _ in range(6):
+            host.cycle()
+            clock.t = host._backoff["pod\x00huge"][0]  # jump to retry time
+        retry_at, attempts = host._backoff["pod\x00huge"]
+        assert retry_at - clock.t <= 4.0 + 1e-9, "delay must cap at backoff_max"
+    finally:
+        host.close()
 
 
 def test_success_clears_backoff():
@@ -69,15 +75,18 @@ def test_success_clears_backoff():
     api.add_pod("p", requests={"cpu": 2000.0, "memory": float(1 << 30)})
     clock = FakeClock()
     host = HostScheduler(api, EngineConfig(mode="fast"), clock=clock)
-    host.cycle()
-    assert "pod\x00p" in host._backoff
-    # Capacity appears (new node); after the window the pod places and
-    # leaves the backoff book.
-    api.add_node("n1", allocatable={"cpu": 4000.0, "memory": float(4 << 30)})
-    clock.t = 10.0
-    stats = host.cycle()
-    assert stats.placed == 1
-    assert "pod\x00p" not in host._backoff
+    try:
+        host.cycle()
+        assert "pod\x00p" in host._backoff
+        # Capacity appears (new node); after the window the pod places and
+        # leaves the backoff book.
+        api.add_node("n1", allocatable={"cpu": 4000.0, "memory": float(4 << 30)})
+        clock.t = 10.0
+        stats = host.cycle()
+        assert stats.placed == 1
+        assert "pod\x00p" not in host._backoff
+    finally:
+        host.close()
 
 
 def test_run_until_idle_stops_with_backlog():
@@ -85,10 +94,13 @@ def test_run_until_idle_stops_with_backlog():
     _small_cluster(api)
     clock = FakeClock()
     host = HostScheduler(api, EngineConfig(mode="fast"), clock=clock)
-    n = host.run_until_idle()
-    assert n <= 3
-    assert host.backlogged() == 1
-    assert api.bind_count == 1
+    try:
+        n = host.run_until_idle()
+        assert n <= 3
+        assert host.backlogged() == 1
+        assert api.bind_count == 1
+    finally:
+        host.close()
 
 
 def test_gang_members_share_one_backoff_window():
@@ -103,17 +115,20 @@ def test_gang_members_share_one_backoff_window():
     clock = FakeClock()
     host = HostScheduler(api, EngineConfig(mode="fast"), clock=clock,
                          backoff_initial=1.0)
-    host.cycle()
-    assert api.bind_count == 0
-    assert list(host._backoff) == ["gang\x00gang"]
-    # Capacity appears; the whole gang returns together and places.
-    for i in range(2):
-        api.add_node(f"extra-{i}",
-                     allocatable={"cpu": 1000.0, "memory": float(64 << 30)})
-    clock.t = 2.0
-    stats = host.cycle()
-    assert stats.batch_size == 3 and stats.placed == 3
-    assert host._backoff == {}
+    try:
+        host.cycle()
+        assert api.bind_count == 0
+        assert list(host._backoff) == ["gang\x00gang"]
+        # Capacity appears; the whole gang returns together and places.
+        for i in range(2):
+            api.add_node(f"extra-{i}",
+                         allocatable={"cpu": 1000.0, "memory": float(64 << 30)})
+        clock.t = 2.0
+        stats = host.cycle()
+        assert stats.batch_size == 3 and stats.placed == 3
+        assert host._backoff == {}
+    finally:
+        host.close()
 
 
 def test_backoff_pruned_for_vanished_pods():
@@ -121,12 +136,15 @@ def test_backoff_pruned_for_vanished_pods():
     _small_cluster(api)
     clock = FakeClock()
     host = HostScheduler(api, EngineConfig(mode="fast"), clock=clock)
-    host.cycle()
-    assert host._backoff
-    api.delete_pod("huge")
-    clock.t = 100.0
-    host.cycle()
-    assert host._backoff == {}, "entries for deleted pods must be pruned"
+    try:
+        host.cycle()
+        assert host._backoff
+        api.delete_pod("huge")
+        clock.t = 100.0
+        host.cycle()
+        assert host._backoff == {}, "entries for deleted pods must be pruned"
+    finally:
+        host.close()
 
 
 def test_audit_records():
@@ -136,18 +154,21 @@ def test_audit_records():
         EngineConfig(mode="fast", preemption=True),
         log_stream=io.StringIO(), audit_stream=io.StringIO(),
     )
-    nodes = [dict(name="n0", allocatable={"cpu": 4000.0, "memory": float(64 << 30)})]
-    running = [dict(name="victim", node="n0",
-                    requests={"cpu": 4000.0, "memory": float(1 << 30)},
-                    priority=1.0, slack=0.4)]
-    pods = [dict(name="p", requests={"cpu": 2000.0, "memory": float(1 << 30)},
-                 priority=500.0, observed_avail=1.0)]
-    req = pb.AssignRequest(snapshot=snapshot_to_proto(nodes, pods, running))
-    resp = svc.Assign(req, None)
-    records = [json.loads(l) for l in svc._audit.getvalue().splitlines()]
-    placements = [r for r in records if r["kind"] == "placement"]
-    evictions = [r for r in records if r["kind"] == "eviction"]
-    assert len(placements) == 1
-    assert placements[0]["pod"] == "p" and placements[0]["node"] == "n0"
-    assert placements[0]["snapshot_id"] == resp.snapshot_id
-    assert [e["pod"] for e in evictions] == ["victim"]
+    try:
+        nodes = [dict(name="n0", allocatable={"cpu": 4000.0, "memory": float(64 << 30)})]
+        running = [dict(name="victim", node="n0",
+                        requests={"cpu": 4000.0, "memory": float(1 << 30)},
+                        priority=1.0, slack=0.4)]
+        pods = [dict(name="p", requests={"cpu": 2000.0, "memory": float(1 << 30)},
+                     priority=500.0, observed_avail=1.0)]
+        req = pb.AssignRequest(snapshot=snapshot_to_proto(nodes, pods, running))
+        resp = svc.Assign(req, None)
+        records = [json.loads(l) for l in svc._audit.getvalue().splitlines()]
+        placements = [r for r in records if r["kind"] == "placement"]
+        evictions = [r for r in records if r["kind"] == "eviction"]
+        assert len(placements) == 1
+        assert placements[0]["pod"] == "p" and placements[0]["node"] == "n0"
+        assert placements[0]["snapshot_id"] == resp.snapshot_id
+        assert [e["pod"] for e in evictions] == ["victim"]
+    finally:
+        svc.close()

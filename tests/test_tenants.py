@@ -43,17 +43,20 @@ def test_batched_matches_individual(mode):
         np.asarray, solve_many_jit(cfg)(stacked)
     )
     eng = Engine(cfg)
-    for b, (snap, meta) in enumerate(tenants):
-        solo = eng.solve(snap)
-        np.testing.assert_array_equal(a[b], solo.assignment, f"tenant {b}")
-        np.testing.assert_array_equal(u[b], solo.final_used)
-        np.testing.assert_array_equal(o[b], solo.order)
-        np.testing.assert_array_equal(ev[b], solo.evicted)
-        assert int(rounds[b]) == solo.rounds
-        np.testing.assert_allclose(
-            np.nan_to_num(c[b], neginf=-1.0),
-            np.nan_to_num(solo.chosen_score, neginf=-1.0), rtol=1e-6,
-        )
+    try:
+        for b, (snap, meta) in enumerate(tenants):
+            solo = eng.solve(snap)
+            np.testing.assert_array_equal(a[b], solo.assignment, f"tenant {b}")
+            np.testing.assert_array_equal(u[b], solo.final_used)
+            np.testing.assert_array_equal(o[b], solo.order)
+            np.testing.assert_array_equal(ev[b], solo.evicted)
+            assert int(rounds[b]) == solo.rounds
+            np.testing.assert_allclose(
+                np.nan_to_num(c[b], neginf=-1.0),
+                np.nan_to_num(solo.chosen_score, neginf=-1.0), rtol=1e-6,
+            )
+    finally:
+        eng.close()
 
 
 def test_mismatched_buckets_rejected():

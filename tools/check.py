@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""The pre-PR gate: one entry point for every static check (round 15).
+
+Chains, in order:
+
+  regen    tools/regen_pb2.py --check   (generated pb2 in sync with
+           the descriptor splice recipe)
+  lint     tools/lint.py over tpusched/ tools/ bench.py tests/
+           (the tpuschedlint invariant suite, empty baseline)
+  syntax   byte-compile every tracked .py (pyflakes when the image
+           has it; stdlib compile() otherwise — this image must not
+           grow dependencies)
+  mypy     mypy --strict over the typed beachhead (mypy.ini scopes
+           it: config.py, qos.py, metrics.py); SKIPPED gracefully
+           when mypy is not installed
+
+Prints a per-stage summary and exits non-zero if any stage fails.
+Documented in tools/README.md as the thing to run before mailing a PR.
+"""
+
+from __future__ import annotations
+
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+LINT_PATHS = ("tpusched", "tools", "bench.py", "tests")
+SYNTAX_ROOTS = ("tpusched", "tools", "tests", "bench.py")
+MYPY_TARGETS = ("tpusched/config.py", "tpusched/qos.py",
+                "tpusched/metrics.py")
+
+
+def _run(cmd: "list[str]") -> "tuple[int, str]":
+    proc = subprocess.run(
+        cmd, cwd=REPO_ROOT, capture_output=True, text=True
+    )
+    return proc.returncode, (proc.stdout + proc.stderr).strip()
+
+
+def stage_regen() -> "tuple[str, str]":
+    rc, out = _run([sys.executable, "tools/regen_pb2.py", "--check"])
+    return ("ok" if rc == 0 else "FAIL"), out
+
+
+def stage_lint() -> "tuple[str, str]":
+    rc, out = _run([sys.executable, "tools/lint.py", *LINT_PATHS])
+    return ("ok" if rc == 0 else "FAIL"), out
+
+
+def _py_files() -> "list[Path]":
+    out = []
+    for root in SYNTAX_ROOTS:
+        p = REPO_ROOT / root
+        if p.is_file():
+            out.append(p)
+        elif p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+    return out
+
+
+def stage_syntax() -> "tuple[str, str]":
+    """pyflakes when available, else a stdlib byte-compile pass (catches
+    syntax errors; pyflakes additionally catches undefined names)."""
+    files = _py_files()
+    try:
+        import pyflakes  # noqa: F401
+    except ImportError:
+        errors = []
+        for f in files:
+            try:
+                compile(f.read_text(), str(f), "exec")
+            except SyntaxError as e:
+                errors.append(f"{f}:{e.lineno}: {e.msg}")
+        tag = f"compiled {len(files)} files (pyflakes unavailable)"
+        if errors:
+            return "FAIL", "\n".join(errors)
+        return "ok", tag
+    rc, out = _run([sys.executable, "-m", "pyflakes",
+                    *[str(f) for f in files]])
+    return ("ok" if rc == 0 else "FAIL"), out or f"pyflakes over {len(files)} files"
+
+
+def stage_mypy() -> "tuple[str, str]":
+    try:
+        import mypy  # noqa: F401
+    except ImportError:
+        return "skip", "mypy not installed on this image"
+    rc, out = _run([sys.executable, "-m", "mypy",
+                    "--config-file", "mypy.ini", *MYPY_TARGETS])
+    return ("ok" if rc == 0 else "FAIL"), out
+
+
+STAGES = (
+    ("regen", stage_regen),
+    ("lint", stage_lint),
+    ("syntax", stage_syntax),
+    ("mypy", stage_mypy),
+)
+
+
+def main() -> int:
+    results = []
+    for name, fn in STAGES:
+        try:
+            status, detail = fn()
+        except Exception as e:  # a broken checker must not pass silently
+            status, detail = "FAIL", f"stage crashed: {e!r}"
+        results.append((name, status, detail))
+        marker = {"ok": "+", "skip": "~", "FAIL": "!"}[status]
+        print(f"[{marker}] {name:<7} {status}")
+        if status == "FAIL" and detail:
+            print("\n".join(f"      {ln}" for ln in detail.splitlines()[:40]))
+        elif detail and status != "ok":
+            print(f"      {detail.splitlines()[0]}")
+    failed = [n for n, s, _ in results if s == "FAIL"]
+    print("check:", "FAILED " + ", ".join(failed) if failed else
+          "all stages passed"
+          + (" (mypy skipped)" if any(s == "skip" for _, s, _ in results)
+             else ""))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

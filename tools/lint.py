@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""tpuschedlint CLI: enforce the repo's review-pass invariants (round 15).
+
+Runs the AST rule suite in tpusched/lint/ over the given paths and
+fails on any finding not covered by the checked-in baseline. The
+tier-1 gate (tests/test_lint.py::test_tree_is_clean) runs exactly:
+
+  python tools/lint.py tpusched tools bench.py tests
+
+Suppress a legitimate exception per line, reason mandatory:
+
+  expr  # tpl: disable=TPL003(why this line is exempt)
+
+Baseline workflow (for landing a NEW rule against an old tree):
+
+  python tools/lint.py --write-baseline tpusched tools bench.py tests
+  ... fix findings, shrinking tools/lint_baseline.json to [] ...
+
+The baseline at HEAD is kept EMPTY; entries are grandfathered debt,
+not a second suppression mechanism.
+
+  python tools/lint.py --list-rules     # rule table + incident lineage
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from tpusched.lint import (  # noqa: E402
+    LintContext,
+    LintEngine,
+    RULES,
+    load_baseline,
+    write_baseline,
+)
+from tpusched.lint.engine import apply_baseline  # noqa: E402
+
+DEFAULT_BASELINE = REPO_ROOT / "tools" / "lint_baseline.json"
+DEFAULT_PATHS = ("tpusched", "tools", "bench.py", "tests")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline JSON (default tools/lint_baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to the baseline and exit 0")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show every finding)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in RULES:
+            print(f"{cls.rule_id}  {cls.title}")
+            print(f"        descends from: {cls.incident}")
+        return 0
+
+    paths = [Path(p) for p in (args.paths or DEFAULT_PATHS)]
+    missing = [p for p in paths if not p.exists()]
+    if missing:
+        print(f"lint: no such path(s): {missing}", file=sys.stderr)
+        return 2
+
+    engine = LintEngine(ctx=LintContext(root=REPO_ROOT))
+    findings = engine.lint_paths(paths)
+
+    if args.write_baseline:
+        write_baseline(Path(args.baseline), findings)
+        print(f"lint: wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    if not args.no_baseline:
+        baseline = load_baseline(Path(args.baseline))
+        if baseline:
+            before = len(findings)
+            findings = apply_baseline(findings, baseline)
+            print(f"lint: {before - len(findings)} finding(s) covered "
+                  f"by baseline {args.baseline}", file=sys.stderr)
+
+    for f in findings:
+        print(f.render())
+    n = len(findings)
+    print(f"lint: {n} finding(s) across {len(RULES)} rules"
+          + ("" if n else " — clean"))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

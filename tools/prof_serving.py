@@ -104,7 +104,8 @@ def main():
                 lat[i].append(time.perf_counter() - t0)
 
         t0 = time.perf_counter()
-        threads = [threading.Thread(target=drive, args=(i,))
+        threads = [threading.Thread(target=drive, args=(i,),
+                                    name=f"tpusched-prof-serving-{i}")
                    for i in range(K)]
         for t in threads:
             t.start()

@@ -100,7 +100,8 @@ def run_demo(clients: int, cycles: int, trip_watchdog: bool,
                 except Exception as e:  # noqa: BLE001 — the tripped caller
                     print(f"client {i} cycle {k}: {e}", file=sys.stderr)
 
-    threads = [threading.Thread(target=drive, args=(i,))
+    threads = [threading.Thread(target=drive, args=(i,),
+                                name=f"tpusched-tracez-demo-{i}")
                for i in range(clients)]
     for t in threads:
         t.start()

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Mapping, Sequence
+from typing import Any, Mapping
 
 # ---------------------------------------------------------------------------
 # Resource axes.
@@ -80,7 +80,7 @@ def clamp01(v: float, default: float = 0.0) -> float:
     v = float(v)
     if not math.isfinite(v):
         return float(default)
-    return min(max(v, 0.0), 1.0)
+    return min(max(v, 0.0), 1.0)  # tpl: disable=TPL004(this IS clamp01 — the non-finite guard above makes the naive clamp safe here)
 
 
 def _next_pow2(x: int) -> int:
@@ -245,7 +245,7 @@ class SimConfig:
     # bucket-growth path instead of a churn-triggered reseed.
     pipeline_refresh_frac: "float | None" = None
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if self.tick_s <= 0:
             raise ValueError(f"tick_s={self.tick_s}: must be > 0")
         if self.resolve_every < 1:
@@ -312,10 +312,10 @@ class EngineConfig:
         return [float(self.score_resource_weights.get(r, 0.0)) for r in self.resources]
 
     @staticmethod
-    def from_dict(d: Mapping) -> "EngineConfig":
+    def from_dict(d: Mapping[str, Any]) -> "EngineConfig":
         """Build from a YAML/JSON-decoded mapping (KubeSchedulerConfiguration
         profile analogue); unknown keys rejected to catch typos."""
-        kw = {}
+        kw: dict[str, Any] = {}
         if "resources" in d:
             kw["resources"] = tuple(d["resources"])
         if "score_resource_weights" in d:
@@ -341,7 +341,7 @@ class EngineConfig:
 
 
 def load_config(path: str) -> EngineConfig:
-    import yaml
+    import yaml  # noqa: allowlisted optional dep (TPL001)
 
     with open(path) as f:
         return EngineConfig.from_dict(yaml.safe_load(f) or {})

@@ -43,12 +43,15 @@ Invariants (the delta-vs-rebuild parity tests pin these):
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
+import traceback
 from typing import Iterable, Mapping
 
 import jax
 import numpy as np
 
+from tpusched import trace as tracing
 from tpusched.config import Buckets, EngineConfig
 from tpusched.kernels.assign import permute_rows, scatter_rows
 from tpusched.snapshot import (
@@ -272,8 +275,6 @@ class DeviceSnapshot:
         # Event span (round 9): a rebuild is the expensive surprise of
         # the device-resident path — it must be visible in the trace
         # ring (and flight dumps) with its trigger, not just a counter.
-        from tpusched import trace as tracing
-
         (self.tracer or tracing.DEFAULT).record(
             "device.rebuild", dur_s=time.perf_counter() - t0, cat="device",
             reason=reason, h2d_bytes=nbytes,
@@ -353,9 +354,6 @@ class DeviceSnapshot:
         except _NeedsRebuild as e:
             return self._rebuild(e.reason)
         except Exception:  # noqa: BLE001 — heal, then let tests catch it
-            import logging
-            import traceback
-
             logging.getLogger("tpusched.device_state").warning(
                 "incremental delta apply failed; rebuilding this "
                 "lineage:\n%s", traceback.format_exc(limit=4),

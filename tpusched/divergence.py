@@ -29,7 +29,9 @@ CLI:  python -m tpusched.divergence [--preset mixed] [--seeds 10]
 
 from __future__ import annotations
 
+import argparse
 import dataclasses
+import json
 
 import numpy as np
 
@@ -144,9 +146,6 @@ def measure(
 
 
 def main(argv=None) -> None:
-    import argparse
-    import json
-
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", choices=sorted(PRESETS), default=None,
                     help="default: all presets")

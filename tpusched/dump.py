@@ -17,7 +17,9 @@ import jax
 import numpy as np
 
 from tpusched.config import Buckets
-from tpusched.snapshot import ClusterSnapshot, SnapshotMeta
+from tpusched.snapshot import (AtomTable, ClusterSnapshot, NodeArrays,
+                               PodArrays, RunningPodArrays, SigTable,
+                               SnapshotMeta)
 
 
 def _norm(path: str) -> str:
@@ -60,13 +62,6 @@ def snap_skeleton() -> ClusterSnapshot:
     defines the canonical leaf order for save/load. Structure is fixed
     by the dataclass definitions, so any snapshot flattens to the same
     treedef."""
-    from tpusched.snapshot import (
-        AtomTable,
-        NodeArrays,
-        PodArrays,
-        RunningPodArrays,
-        SigTable,
-    )
 
     def fill(cls):
         return cls(**{f.name: 0 for f in dataclasses.fields(cls)})

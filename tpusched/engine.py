@@ -9,9 +9,11 @@ changes; EngineConfig is closed over as compile-time constants.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue
 import threading
 import time
+import weakref
 from concurrent.futures import Future
 from typing import Any, Callable
 
@@ -19,10 +21,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpusched import trace as tracing
 from tpusched.config import EngineConfig
-from tpusched.kernels.assign import score_batch, solve_rounds, solve_sequential
+from tpusched.faults import NO_FAULTS
+from tpusched.kernels import explain as kexplain
+from tpusched.kernels.assign import (_PREEMPT_MAX_ROUNDS,
+                                     EXPLAIN_AUCTION_STATS, score_batch,
+                                     solve_rounds, solve_sequential)
 from tpusched.kernels.atoms import atom_sat
 from tpusched.kernels.pairwise import member_label_sat_t
+from tpusched.ring import ring_sig_counts
 from tpusched.snapshot import ClusterSnapshot
 
 
@@ -105,8 +113,6 @@ class _OrderedFetchWorker:
             if self._thread is not None and not self._thread.is_alive():
                 # The loop died on an unexpected exception (not via the
                 # shutdown sentinel — _closed is False). Respawn it.
-                import logging
-
                 logging.getLogger("tpusched.engine").warning(
                     "fetch worker %s died unexpectedly; restarting",
                     self._name,
@@ -207,8 +213,6 @@ def solve_core(cfg: EngineConfig, snap: ClusterSnapshot, mesh=None,
     node_sat_t, member_sat_t = _sat_tables(snap)
     init_counts = None
     if cfg.ring_counts and snap.sigs.key.shape[0]:
-        from tpusched.ring import ring_sig_counts
-
         P = snap.pods.valid.shape[0]
         init_counts = ring_sig_counts(
             snap, member_sat_t, jnp.full(P, -1, jnp.int32), mesh
@@ -241,8 +245,6 @@ class Engine:
         faults: optional tpusched.faults.FaultPlan; the background
         fetch fires site "engine.fetch" per fetched buffer (a delay
         rule there is a hung solve — what the sidecar watchdog hunts)."""
-        from tpusched.faults import NO_FAULTS
-
         self.config = config or EngineConfig()
         self.mesh = mesh
         self._faults = faults if faults is not None else NO_FAULTS
@@ -285,8 +287,6 @@ class Engine:
             node_sat_t, member_sat_t = _sat_tables(snap)
             ic = None
             if cfg.ring_counts and snap.sigs.key.shape[0]:
-                from tpusched.ring import ring_sig_counts
-
                 ic = ring_sig_counts(
                     snap, member_sat_t,
                     jnp.full(snap.pods.valid.shape[0], -1, jnp.int32),
@@ -330,8 +330,6 @@ class Engine:
         self._pool_lock = threading.Lock()  # pool swap vs close vs submit
         self._closing = False               # close() wins over restarts
         self._fetch_pool = _OrderedFetchWorker()
-        import weakref
-
         self._pool_finalizer = weakref.finalize(
             self, self._fetch_pool._q.put, None
         )
@@ -375,8 +373,6 @@ class Engine:
         swap buys back availability. A no-op once close() has begun:
         swapping a fresh (never-closed) worker in behind a concurrent
         close would void close's drain guarantee and leak the thread."""
-        import weakref
-
         with self._pool_lock:
             if self._closing:
                 return
@@ -408,16 +404,12 @@ class Engine:
         t0 = time.perf_counter()
         out = jax.tree.map(np.asarray, buf)
         done = time.perf_counter()
-        from tpusched import trace as tracing
-
         (self.tracer or tracing.DEFAULT).record(
             "engine.fetch", dur_s=done - t0, cat="engine", ctx=tctx)
         return out, done
 
     def _submit_fetch(self, buf):
         """Queue the D2H fetch, carrying the caller's trace context."""
-        from tpusched import trace as tracing
-
         tr = self.tracer or tracing.DEFAULT
         return self._pool().submit(self._fetch, buf, tr.current())
 
@@ -457,9 +449,6 @@ class Engine:
         """Decode the explained solve's packed buffer: the standard
         solve layout (Engine.unpack) followed by the provenance extras.
         Returns (SolveResult, ExplainData)."""
-        from tpusched.kernels.assign import (_PREEMPT_MAX_ROUNDS,
-                                             EXPLAIN_AUCTION_STATS)
-
         buf = np.asarray(buf)
         P = snap.pods.valid.shape[0]
         N, R = snap.nodes.used.shape
@@ -489,8 +478,6 @@ class Engine:
         Placements are identical to solve(): the explain program only
         ADDS observer arrays (test-pinned). Compiled lazily per shape;
         the unexplained hot path never traces it."""
-        from tpusched.kernels import explain as kexplain
-
         cfg = self.config
         mesh = self.mesh
         if self._explain_solve_jit is None:
@@ -518,8 +505,6 @@ class Engine:
                 node_sat_t, member_sat_t = _sat_tables(s)
                 ic = None
                 if cfg.ring_counts and s.sigs.key.shape[0]:
-                    from tpusched.ring import ring_sig_counts
-
                     ic = ring_sig_counts(
                         s, member_sat_t,
                         jnp.full(s.pods.valid.shape[0], -1, jnp.int32),

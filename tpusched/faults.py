@@ -52,6 +52,10 @@ import threading
 import time
 from typing import Iterable
 
+import numpy as np
+
+from tpusched import trace as tracing
+
 
 class FaultError(RuntimeError):
     """An injected failure (kind="error"). Deliberately a RuntimeError:
@@ -109,8 +113,6 @@ class FaultPlan:
         A site may also map to a LIST of such dicts. Same (seed, spec)
         always yields the same plan.
         """
-        import numpy as np
-
         rng = np.random.default_rng(seed)
         rules = []
         for site in sorted(spec):
@@ -167,8 +169,6 @@ class FaultPlan:
         # injection alongside the stages it broke. Inherits the firing
         # thread's active trace (a server.decode shot lands inside its
         # request's stitched trace); delay shots carry their duration.
-        from tpusched import trace as tracing
-
         tr = self.tracer or tracing.DEFAULT
         if hit.kind == "delay":
             time.sleep(hit.delay_s)

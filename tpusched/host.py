@@ -26,6 +26,8 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from tpusched import explain as explaining
+from tpusched import metrics as pm
+from tpusched import trace as tracing
 from tpusched.config import (
     DEFAULT_OBSERVED_AVAIL,
     DEFAULT_SLO_TARGET,
@@ -281,6 +283,7 @@ class HostScheduler:
         transport: str = "delta",
         explain=None,
         refresh_frac: "float | None" = None,
+        tracer=None,
     ):
         """explain (round 12, ISSUE 8): optional
         tpusched.explain.ExplainCollector; None falls back to the
@@ -291,8 +294,13 @@ class HostScheduler:
         sim's miss-attribution input; `ts` rides this host's clock, so
         virtual-time drivers get virtual timestamps). gRPC transports
         ignore it — server-side explain (make_server(explain=...))
-        owns provenance there."""
+        owns provenance there.
+
+        tracer: optional tpusched.trace.TraceCollector for the
+        per-cycle host.cycle span; None falls back to the process
+        default at emit time (injected-collector discipline, TPL009)."""
         self.api = api
+        self.tracer = tracer
         self.config = config or EngineConfig()
         # Transport config accepts ADDRESSES, not just a built client
         # (round 11, ISSUE 6): a str or an ordered list/tuple of
@@ -300,7 +308,7 @@ class HostScheduler:
         # owned (and closed) by this host.
         self._owns_client = False
         if isinstance(client, (str, list, tuple)):
-            from tpusched.rpc.client import SchedulerClient
+            from tpusched.rpc.client import SchedulerClient  # tpl: disable=TPL001(grpc transport is optional; the in-process host must import without grpc)
 
             client = SchedulerClient(client)
             self._owns_client = True
@@ -337,11 +345,11 @@ class HostScheduler:
         self._delta = None
         self._pipeline = None
         if client is not None and transport == "delta":
-            from tpusched.rpc.client import DeltaSession
+            from tpusched.rpc.client import DeltaSession  # tpl: disable=TPL001(grpc transport is optional; the in-process host must import without grpc)
 
             self._delta = DeltaSession(client)
         elif client is not None and transport == "pipeline":
-            from tpusched.rpc.client import AssignPipeline
+            from tpusched.rpc.client import AssignPipeline  # tpl: disable=TPL001(grpc transport is optional; the in-process host must import without grpc)
 
             # refresh_frac: pin-refresh churn threshold passthrough
             # (None keeps the client default). The simulator threads
@@ -371,8 +379,6 @@ class HostScheduler:
         # an apiserver hiccup — state is re-read, the cycle re-runs).
         # Round 9 exports the count as a Prometheus counter in the
         # process-default registry (it was in-memory-only state).
-        from tpusched import metrics as pm
-
         self.failed_cycles = 0
         self._m_failed_cycles = pm.Counter(
             "tpusched_host_failed_cycles_total",
@@ -543,7 +549,7 @@ class HostScheduler:
             # Packed parallel-array response: three frombuffer reads
             # instead of P Python proto message traversals (~30 ms per
             # 10k-pod cycle on each side of the wire).
-            from tpusched.rpc.client import assign_response_arrays
+            from tpusched.rpc.client import assign_response_arrays  # tpl: disable=TPL001(grpc transport is optional; the in-process host must import without grpc)
 
             pod_names, node_names, ni, _, _ = assign_response_arrays(resp)
             assignments = [
@@ -645,9 +651,7 @@ class HostScheduler:
         # One retroactive span per completed cycle: the host-side roof
         # over the per-request client/server traces (the rpc spans
         # carry their own request_ids; this one carries the batch).
-        from tpusched import trace as tracing
-
-        tracing.DEFAULT.record(
+        (self.tracer or tracing.DEFAULT).record(
             "host.cycle", dur_s=stats.total_seconds, cat="host",
             batch=stats.batch_size, placed=placed, evicted=len(evicted),
         )
@@ -751,8 +755,8 @@ def run_e2e_benchmark(n_pods: int = 100, n_nodes: int = 10, iters: int = 10,
     """Full-boundary E2E: fake API server -> host shim -> gRPC sidecar
     -> engine -> binds. Returns bench.py-style percentile stats of the
     complete cycle latency plus placements/sec."""
-    from tpusched.rpc.client import SchedulerClient
-    from tpusched.rpc.server import make_server
+    from tpusched.rpc.client import SchedulerClient  # tpl: disable=TPL001(grpc transport is optional; the in-process host must import without grpc)
+    from tpusched.rpc.server import make_server  # tpl: disable=TPL001(grpc transport is optional; the in-process host must import without grpc)
 
     cfg = EngineConfig(mode="fast")
     server = client = shared_engine = svc = None

@@ -27,9 +27,11 @@ informer resync semantics (SURVEY.md §5 "Failure detection").
 
 from __future__ import annotations
 
+import atexit
 import base64
 import json
 import os
+import random
 import ssl
 import sys
 import tempfile
@@ -39,6 +41,10 @@ import urllib.error
 import urllib.parse
 import urllib.request
 
+from tpusched import metrics as pm
+from tpusched import trace as tracing
+from tpusched.faults import NO_FAULTS, FaultError
+from tpusched.host import Conflict
 from tpusched.config import (
     DEFAULT_OBSERVED_AVAIL,
     DEFAULT_SLO_TARGET,
@@ -367,8 +373,6 @@ def _load_cert_chain(sslctx: ssl.SSLContext, cert: "str | bytes",
     load_cert_chain could skip the finally. Round-5 ADVICE: the old
     `delete=False`-and-forget left decoded client keys in /tmp for the
     life of the host."""
-    import atexit
-
     paths = []
     args = []
     try:
@@ -609,7 +613,6 @@ class KubeApiClient:
         """POST the Binding subresource; 404/409 -> host.Conflict (the
         idempotent-bind story, SURVEY.md §5 'Failure detection').
         pod_name is the qualified 'namespace/name' record identity."""
-        from tpusched.host import Conflict
 
         namespace, name = split_qualified(pod_name)
         body = {
@@ -746,8 +749,6 @@ class KubeInformer:
         # fires at the top of every watch-stream attempt (an error rule
         # is a flapping apiserver: the loop takes its relist/backoff
         # path, exactly like a real watch failure).
-        from tpusched.faults import NO_FAULTS
-
         self._faults = faults if faults is not None else NO_FAULTS
         # Span collector for kube.watch.reconnect events; None = the
         # process default at emit time.
@@ -792,16 +793,12 @@ class KubeInformer:
         # backoff_seed to pin the sequence.
         self.watch_backoff_initial = 0.5
         self.watch_backoff_max = 30.0
-        import random
-
         self._watch_rng = random.Random(backoff_seed)
         # Prometheus export (round 9, ISSUE 4 satellite): reconnects and
         # backoff time were in-memory-only state; now they're counters
         # in the process-default registry (tpusched.metrics.render_
         # default()) — shared across informers in one process, like
         # prometheus_client families — plus instance mirrors for tests.
-        from tpusched import metrics as pm
-
         self.watch_reconnects = 0
         self.watch_backoff_s = 0.0
         self._m_reconnects = pm.Counter(
@@ -855,7 +852,8 @@ class KubeInformer:
         for path in (self._POD_PATH, self._NODE_PATH):
             rv = self._relist(path)
             t = threading.Thread(
-                target=self._watch_loop, args=(path, rv), daemon=True
+                target=self._watch_loop, args=(path, rv), daemon=True,
+                name=f"tpusched-kube-watch-{path.rsplit('/', 1)[-1]}",
             )
             t.start()
             self._threads.append(t)
@@ -896,8 +894,6 @@ class KubeInformer:
         return base * (0.5 + 0.5 * self._watch_rng.random())
 
     def _watch_loop(self, path: str, rv: str = ""):
-        from tpusched.faults import FaultError
-
         failures = 0
         while not self._stop.is_set():
             try:
@@ -944,8 +940,6 @@ class KubeInformer:
                 delay = self._watch_backoff(failures)
                 self.watch_reconnects += 1
                 self._m_reconnects.labels(path).inc()
-                from tpusched import trace as tracing
-
                 (self.tracer or tracing.DEFAULT).record(
                     "kube.watch.reconnect", cat="kube", path=path,
                     failures=failures, backoff_s=round(delay, 3),

@@ -1,0 +1,37 @@
+"""tpuschedlint: the repo's hard-won invariants as enforced AST analysis.
+
+Every rule here descends from a defect class this codebase has already
+paid review passes for (round 15, ISSUE 10; incident lineage in
+tools/README.md "Static analysis"):
+
+    TPL001  function-level imports in tpusched/ (hot-path import cost)
+    TPL002  unseeded randomness / wall-clock in the hash-pinned sim
+    TPL003  known-cost calls lexically under a lock
+    TPL004  inline [0,1] clamps bypassing config.clamp01
+    TPL005  threading.Thread without a tpusched- name
+    TPL006  bench.py metric emitted without a resolvable direction
+    TPL007  next(reversed(...)) dict-order-dependent selection
+    TPL008  sorted() on round/seq-shaped keys without a numeric key
+    TPL009  trace.DEFAULT/explain.DEFAULT outside the fallback idiom
+    TPL010  closeable class never closed in a test function
+
+Run via ``python tools/lint.py tpusched tools bench.py tests`` (the
+tier-1 gate, tests/test_lint.py::test_tree_is_clean) or through
+``tools/check.py``. Per-line suppressions:
+
+    expr  # tpl: disable=TPL003(reason is mandatory)
+
+and a JSON baseline file (tools/lint_baseline.json) for grandfathered
+findings — kept EMPTY at HEAD; the engine reports TPL000 for a
+suppression without a reason so the escape hatch stays documented.
+"""
+
+from tpusched.lint.engine import (  # noqa: F401
+    Finding,
+    LintContext,
+    LintEngine,
+    load_baseline,
+    parse_suppressions,
+    write_baseline,
+)
+from tpusched.lint.rules import RULES, default_rules  # noqa: F401

@@ -1,0 +1,275 @@
+"""Lint engine: file walking, suppressions, baseline, rule dispatch.
+
+Design constraints (round 15, ISSUE 10):
+
+* Pure stdlib ``ast`` — this image must not grow dependencies.
+* Findings are (path, line, rule, message) and deterministic: the
+  tier-1 gate diffs them against an (empty) checked-in baseline, so
+  ordering and paths must be stable across machines — paths are
+  repo-root-relative POSIX strings.
+* Suppressions are per-line and must carry a reason:
+  ``# tpl: disable=TPL003(scrape is O(1) here)``. A reasonless
+  suppression is itself a finding (TPL000) — the escape hatch is part
+  of the documented invariant surface, not a way around it.
+* The baseline exists for grandfathering a rule in; the repo keeps it
+  empty (acceptance: ``tools/lint.py tpusched tools bench.py`` exits 0
+  with ``tools/lint_baseline.json == []``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import importlib.util
+import io
+import json
+import re
+import tokenize
+from pathlib import Path, PurePosixPath
+from typing import Iterable, Sequence
+
+#: Engine-level pseudo-rule for malformed suppression comments.
+BAD_SUPPRESSION = "TPL000"
+
+_SUPPRESS_RE = re.compile(r"#\s*tpl:\s*disable=(?P<entries>.+)$")
+_ENTRY_RE = re.compile(r"(TPL\d{3})\s*(?:\(([^)]*)\))?")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    path: str   # repo-relative POSIX path
+    line: int   # 1-indexed
+    rule: str   # "TPL001"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def key(self) -> tuple:
+        """Baseline identity (message excluded: wording may evolve
+        without re-grandfathering a finding)."""
+        return (self.path, self.line, self.rule)
+
+
+class LintContext:
+    """Cross-file project knowledge shared by all rules over one run.
+
+    ``root`` anchors relative paths; ``closeable_classes`` (TPL010) and
+    ``benchdiff`` (TPL006) are computed lazily so linting a single
+    fixture snippet never scans the tree, and both are injectable for
+    rule unit tests.
+    """
+
+    def __init__(
+        self,
+        root: "Path | None" = None,
+        closeable_classes: "set[str] | None" = None,
+        benchdiff=None,
+    ):
+        self.root = Path(root) if root is not None else _default_root()
+        self._closeable = closeable_classes
+        self._benchdiff = benchdiff
+        self._benchdiff_loaded = benchdiff is not None
+
+    @property
+    def closeable_classes(self) -> "set[str]":
+        """Public tpusched classes defining close(): the TPL010 set."""
+        if self._closeable is None:
+            self._closeable = scan_closeable_classes(self.root / "tpusched")
+        return self._closeable
+
+    @property
+    def benchdiff(self):
+        """tools/benchdiff.py as a module (direction-inference source
+        of truth for TPL006), or None when the repo doesn't carry it."""
+        if not self._benchdiff_loaded:
+            self._benchdiff_loaded = True
+            self._benchdiff = _load_benchdiff(self.root)
+        return self._benchdiff
+
+
+def _default_root() -> Path:
+    # tpusched/lint/engine.py -> tpusched/lint -> tpusched -> repo root
+    return Path(__file__).resolve().parents[2]
+
+
+def _load_benchdiff(root: Path):
+    path = root / "tools" / "benchdiff.py"
+    if not path.exists():
+        return None
+    spec = importlib.util.spec_from_file_location(
+        "tpusched_lint_benchdiff", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def scan_closeable_classes(pkg_dir: Path) -> "set[str]":
+    """Names of PUBLIC classes under ``pkg_dir`` that define close():
+    the classes a test may construct but must not leak (TPL010)."""
+    out: set[str] = set()
+    if not pkg_dir.is_dir():
+        return out
+    for path in sorted(pkg_dir.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef) or node.name.startswith("_"):
+                continue
+            for item in node.body:
+                if (isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and item.name == "close"):
+                    out.add(node.name)
+                    break
+    return out
+
+
+def parse_suppressions(src: str) -> "tuple[dict[int, set[str]], list[tuple[int, str]]]":
+    """``(line -> suppressed rule ids, [(line, error)])``.
+
+    Grammar (one comment suppresses one PHYSICAL line — put it on the
+    line the finding reports, i.e. the statement's first line):
+
+        # tpl: disable=TPL001(reason),TPL009(another reason)
+
+    The reason is mandatory; ``TPL001`` or ``TPL001()`` yields a
+    TPL000 error instead of a suppression.
+    """
+    by_line: dict[int, set[str]] = {}
+    errors: list[tuple[int, str]] = []
+    # Real COMMENT tokens only: the suppression marker inside a string
+    # literal (e.g. lint's own error messages) must not suppress.
+    try:
+        comments = [
+            (tok.start[0], tok.string)
+            for tok in tokenize.generate_tokens(io.StringIO(src).readline)
+            if tok.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        comments = []
+    for lineno, line in comments:
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        entries = m.group("entries").strip()
+        matched_any = False
+        for em in _ENTRY_RE.finditer(entries):
+            matched_any = True
+            rule, reason = em.group(1), em.group(2)
+            if not reason or not reason.strip():
+                errors.append((
+                    lineno,
+                    f"suppression of {rule} without a reason — write "
+                    f"`# tpl: disable={rule}(why this line is exempt)`",
+                ))
+                continue
+            by_line.setdefault(lineno, set()).add(rule)
+        if not matched_any:
+            errors.append((
+                lineno,
+                f"unparseable tpl suppression {entries!r} — expected "
+                "`TPLnnn(reason)` entries",
+            ))
+    return by_line, errors
+
+
+def load_baseline(path: Path) -> "set[tuple]":
+    """Baseline file: JSON list of {path, line, rule}. Missing file ==
+    empty baseline."""
+    if not Path(path).exists():
+        return set()
+    doc = json.loads(Path(path).read_text())
+    if not isinstance(doc, list):
+        raise ValueError(f"{path}: baseline must be a JSON list")
+    out = set()
+    for rec in doc:
+        out.add((str(rec["path"]), int(rec["line"]), str(rec["rule"])))
+    return out
+
+
+def write_baseline(path: Path, findings: "Sequence[Finding]") -> None:
+    recs = [
+        {"path": f.path, "line": f.line, "rule": f.rule}
+        for f in sorted(findings)
+    ]
+    Path(path).write_text(json.dumps(recs, indent=2) + "\n")
+
+
+def build_parent_map(tree: ast.AST) -> "dict[ast.AST, ast.AST]":
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+class LintEngine:
+    def __init__(self, rules=None, ctx: "LintContext | None" = None):
+        if rules is None:
+            from tpusched.lint.rules import default_rules  # tpl: disable=TPL001(rules imports Finding from engine; importing rules at module top would be a cycle)
+
+            rules = default_rules()
+        self.rules = list(rules)
+        self.ctx = ctx if ctx is not None else LintContext()
+
+    # -- single-source entry (also the fixture-test entry) -----------
+
+    def lint_text(self, src: str, relpath: str) -> "list[Finding]":
+        """Lint one source blob as if it lived at ``relpath`` (POSIX,
+        repo-relative — applicability predicates key off it)."""
+        relpath = str(PurePosixPath(relpath))
+        try:
+            tree = ast.parse(src, filename=relpath)
+        except SyntaxError as e:
+            return [Finding(relpath, int(e.lineno or 1), BAD_SUPPRESSION,
+                            f"file does not parse: {e.msg}")]
+        suppressed, sup_errors = parse_suppressions(src)
+        parents = build_parent_map(tree)
+        findings = [
+            Finding(relpath, line, BAD_SUPPRESSION, msg)
+            for line, msg in sup_errors
+        ]
+        for rule in self.rules:
+            if not rule.applies(relpath):
+                continue
+            for f in rule.check(tree, src, relpath, self.ctx, parents):
+                if rule.rule_id in suppressed.get(f.line, ()):
+                    continue
+                findings.append(f)
+        return sorted(findings)
+
+    # -- filesystem entries ------------------------------------------
+
+    def lint_file(self, path: Path) -> "list[Finding]":
+        path = Path(path).resolve()
+        try:
+            rel = path.relative_to(self.ctx.root).as_posix()
+        except ValueError:
+            # A basename fallback would fail every path-scoped
+            # applies() predicate and report the file CLEAN — a
+            # false-green gate for sibling checkouts / CI mounts.
+            raise ValueError(
+                f"{path} is outside the lint root {self.ctx.root}; "
+                "pass a LintContext(root=...) covering it"
+            ) from None
+        return self.lint_text(path.read_text(), rel)
+
+    def lint_paths(self, paths: "Iterable[Path]") -> "list[Finding]":
+        findings: list[Finding] = []
+        for path in paths:
+            path = Path(path)
+            if path.is_dir():
+                for f in sorted(path.rglob("*.py")):
+                    findings.extend(self.lint_file(f))
+            else:
+                findings.extend(self.lint_file(path))
+        return sorted(findings)
+
+
+def apply_baseline(
+    findings: "Sequence[Finding]", baseline: "set[tuple]"
+) -> "list[Finding]":
+    return [f for f in findings if f.key() not in baseline]

@@ -1,0 +1,807 @@
+"""The ten tpuschedlint rules (round 15, ISSUE 10).
+
+Each rule is a small pass over one file's AST producing Findings; the
+incident each rule descends from is catalogued in tools/README.md
+"Static analysis". Rules are HEURISTIC on purpose: they prove the
+cheap lexical property (no `.result()` token under a `with ...lock:`)
+rather than the deep semantic one, and every legitimate exception is a
+per-line suppression whose mandatory reason documents WHY the line is
+exempt — the suppression text is the living review checklist.
+
+Applicability is path-based (repo-relative POSIX paths): most rules
+cover product code (tpusched/, tools/, bench.py) and skip tests;
+TPL010 covers ONLY test files. Passing any mix of paths to the engine
+is safe — each rule selects its own territory.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpusched.lint.engine import Finding
+
+__all__ = ["RULES", "default_rules", "Rule"]
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers.
+# ---------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> "str | None":
+    """Render a Name/Attribute chain as ``a.b.c``; None for anything
+    whose base is not a plain name (calls, subscripts, ...)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def terminal_name(node: ast.AST) -> "str | None":
+    """The rightmost identifier of a call target: ``x.y.z() -> z``,
+    ``f() -> f``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def is_num(node: ast.AST, value: float) -> bool:
+    return (isinstance(node, ast.Constant)
+            and type(node.value) in (int, float)
+            and float(node.value) == value)
+
+
+def import_aliases(tree: ast.AST) -> "dict[str, str]":
+    """local name -> fully dotted module/object it refers to, from the
+    MODULE-LEVEL and function-level import statements of one file."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.level == 0 and node.module:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def normalize_call(func: ast.AST, aliases: "dict[str, str]") -> "str | None":
+    """Dotted call target with its leading alias expanded:
+    ``np.random.rand`` -> ``numpy.random.rand`` under
+    ``import numpy as np``."""
+    d = dotted_name(func)
+    if d is None:
+        return None
+    head, _, rest = d.partition(".")
+    if head in aliases:
+        d = aliases[head] + ("." + rest if rest else "")
+    return d
+
+
+def is_test_path(relpath: str) -> bool:
+    return (relpath.startswith("tests/")
+            or relpath.rsplit("/", 1)[-1].startswith("test_"))
+
+
+def product_path(relpath: str) -> bool:
+    """tpusched/, tools/, or bench.py — the non-test gate surface."""
+    if is_test_path(relpath):
+        return False
+    return (relpath.startswith("tpusched/")
+            or relpath.startswith("tools/")
+            or relpath.rsplit("/", 1)[-1] == "bench.py")
+
+
+class Rule:
+    rule_id = "TPL999"
+    title = ""
+    incident = ""  # the CHANGES.md defect class this rule encodes
+
+    def applies(self, relpath: str) -> bool:
+        return product_path(relpath)
+
+    def check(self, tree, src, relpath, ctx, parents) -> "list[Finding]":
+        raise NotImplementedError
+
+    def finding(self, relpath: str, node: ast.AST, message: str) -> Finding:
+        return Finding(relpath, getattr(node, "lineno", 1),
+                       self.rule_id, message)
+
+
+# ---------------------------------------------------------------------------
+# TPL001 — function-level imports in tpusched/.
+# ---------------------------------------------------------------------------
+
+class FunctionLevelImport(Rule):
+    """Imports belong at module top. Function-level imports put a
+    sys.modules dict probe (or worse, a first-call module init) on
+    whatever path calls the function — the exact per-record /
+    per-cycle cost PR 5 and PR 7 review passes kept hoisting. Optional
+    heavy deps (grpc, yaml: a host-only install must import without
+    them) are allowlisted; a deliberate lazy import (cycle break,
+    CLI-only dependency) takes a suppression whose reason says so.
+    """
+
+    rule_id = "TPL001"
+    title = "function-level import in tpusched/"
+    incident = ("PR 5/PR 7 review passes: per-cycle `from tpusched import "
+                "...` inside host/server hot paths")
+
+    #: Top-level modules a deployment may legitimately lack: importing
+    #: them at module top would make the whole package require them.
+    OPTIONAL_DEPS = frozenset({"grpc", "yaml"})
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("tpusched/") and not is_test_path(relpath)
+
+    def check(self, tree, src, relpath, ctx, parents):
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            if not self._inside_function(node, parents):
+                continue
+            mods = self._top_modules(node)
+            if mods and mods <= self.OPTIONAL_DEPS:
+                continue
+            findings.append(self.finding(
+                relpath, node,
+                f"function-level import of {', '.join(sorted(mods)) or '?'}"
+                " — move to module top (or suppress with the cycle/"
+                "optional-dep reason)",
+            ))
+        return findings
+
+    @staticmethod
+    def _inside_function(node, parents) -> bool:
+        p = parents.get(node)
+        while p is not None:
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return True
+            p = parents.get(p)
+        return False
+
+    @staticmethod
+    def _top_modules(node) -> "set[str]":
+        if isinstance(node, ast.Import):
+            return {a.name.split(".")[0] for a in node.names}
+        if node.module is None or node.level:  # relative import
+            return {"."}
+        return {node.module.split(".")[0]}
+
+
+# ---------------------------------------------------------------------------
+# TPL002 — unseeded randomness / wall-clock in the hash-pinned sim.
+# ---------------------------------------------------------------------------
+
+class UnseededRandomness(Rule):
+    """tpusched/sim/, tpusched/kernels/, and faults.py are under the
+    determinism contract: same seed -> byte-identical event-log hash
+    (PR 5/PR 8 twin harness). Module-level RNG draws (`random.random`,
+    `np.random.rand`), zero-arg generator constructions, and wall-clock
+    reads (`time.time`, `datetime.now`) all smuggle ambient entropy
+    into that hash. Seeded constructions (`random.Random(seed)`,
+    `np.random.default_rng(seed)`) and monotonic timers
+    (`time.monotonic`, `time.perf_counter`: measurement, not
+    timestamps) stay legal.
+    """
+
+    rule_id = "TPL002"
+    title = "unseeded randomness / wall-clock in deterministic code"
+    incident = ("PR 5/PR 8 determinism contract: the event-log hash is "
+                "the twin-run equality witness; host.py's demo "
+                "rng.uniform() leak took a PR to excise")
+
+    SCOPES = ("tpusched/sim/", "tpusched/kernels/")
+    FILES = ("tpusched/faults.py",)
+    SEEDED_CTORS = frozenset({
+        "Random", "SystemRandom", "default_rng", "RandomState",
+        "SeedSequence", "Generator", "PCG64", "Philox",
+    })
+    WALL_CLOCK = frozenset({"time.time", "time.time_ns"})
+
+    def applies(self, relpath: str) -> bool:
+        return (relpath.startswith(self.SCOPES) or relpath in self.FILES)
+
+    def check(self, tree, src, relpath, ctx, parents):
+        aliases = import_aliases(tree)
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = normalize_call(node.func, aliases)
+            if name is None:
+                continue
+            msg = self._classify(name, node)
+            if msg:
+                findings.append(self.finding(relpath, node, msg))
+        return findings
+
+    def _classify(self, name: str, call: ast.Call) -> "str | None":
+        parts = name.split(".")
+        last = parts[-1]
+        if name in self.WALL_CLOCK:
+            return (f"wall-clock read {name}() in hash-pinned code — "
+                    "use the VirtualClock / injected now")
+        if parts[0] == "datetime" and last in ("now", "utcnow", "today"):
+            return (f"wall-clock read {name}() in hash-pinned code — "
+                    "use the VirtualClock / injected now")
+        if parts[0] == "random" and len(parts) == 2:
+            if last in self.SEEDED_CTORS:
+                return self._unseeded_ctor(name, call)
+            return (f"global-RNG draw {name}() — construct a seeded "
+                    "random.Random / np.random.default_rng(seed)")
+        if name.startswith("numpy.random."):
+            if last in self.SEEDED_CTORS:
+                return self._unseeded_ctor(name, call)
+            return (f"module-level numpy RNG draw {name}() — draw from "
+                    "a seeded np.random.default_rng(seed) instance")
+        return None
+
+    @staticmethod
+    def _unseeded_ctor(name: str, call: ast.Call) -> "str | None":
+        args = list(call.args) + [k.value for k in call.keywords]
+        seedful = [a for a in args
+                   if not (isinstance(a, ast.Constant) and a.value is None)]
+        if seedful:
+            return None
+        return (f"{name}() without a seed (or with seed=None) draws OS "
+                "entropy — pass an explicit seed")
+
+
+# ---------------------------------------------------------------------------
+# TPL003 — known-cost calls lexically under a lock.
+# ---------------------------------------------------------------------------
+
+class WorkUnderLock(Rule):
+    """`with <lock>:` bodies must be O(bookkeeping). A call with known
+    cost — a fetch join (`.result()`), jit dispatch /
+    `block_until_ready`, H2D (`device_put`), byte-store composition,
+    sleeps, file/socket I/O — serializes every contender behind work
+    that never needed the lock. Lexical heuristic: the call token
+    appears inside the with-body (nested `def`/`lambda` bodies are
+    excluded — defining a function under a lock is free).
+    """
+
+    rule_id = "TPL003"
+    title = "known-cost call inside a lock body"
+    incident = ("PR 7 review: scheduler_device_bytes scrape summed "
+                "store nbytes under _store_lock, stalling Assign "
+                "registration behind every Metrics scrape")
+
+    COSTLY = frozenset({
+        "result", "block_until_ready", "device_put", "sleep",
+        "urlopen", "compose_bytes", "serve_forever", "exec_module",
+        "solve", "solve_async", "solve_explained", "score_topk",
+        "run_until_idle",
+    })
+    COSTLY_BARE = frozenset({"open", "sleep"})
+
+    def check(self, tree, src, relpath, ctx, parents):
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            lock_expr = self._lock_expr(node)
+            if lock_expr is None:
+                continue
+            for call, name in self._costly_calls(node.body):
+                findings.append(self.finding(
+                    relpath, call,
+                    f"{name}() under `with {lock_expr}:` — hoist the "
+                    "work out of the critical section",
+                ))
+        return findings
+
+    @staticmethod
+    def _lock_expr(node) -> "str | None":
+        for item in node.items:
+            for sub in ast.walk(item.context_expr):
+                t = terminal_name(sub)
+                if t and "lock" in t.lower():
+                    return dotted_name(item.context_expr) or t
+        return None
+
+    def _costly_calls(self, body) -> "Iterator[tuple[ast.Call, str]]":
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue  # defined, not executed, under the lock
+            if isinstance(node, ast.Call):
+                t = terminal_name(node.func)
+                if t and (
+                    (isinstance(node.func, ast.Attribute) and t in self.COSTLY)
+                    or (isinstance(node.func, ast.Name)
+                        and t in (self.COSTLY | self.COSTLY_BARE))
+                ):
+                    yield node, t
+            stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# TPL004 — inline [0,1] clamps.
+# ---------------------------------------------------------------------------
+
+class InlineUnitClamp(Rule):
+    """`min(max(v, 0.0), 1.0)` passes NaN straight through (Python
+    min/max return the first argument on NaN comparisons), which is
+    exactly how a garbage availability annotation once poisoned the
+    pressure math — config.clamp01 is the ONE NaN-safe unit-interval
+    clamp. Only [0,1]-bounded nestings fire; other min/max range
+    clamps (bucket caps, k clamps) are not this bug class.
+    """
+
+    rule_id = "TPL004"
+    title = "inline [0,1] clamp bypassing config.clamp01"
+    incident = ("PR 5 review: NaN slo-target annotations sailed "
+                "through naive min/max clamps in kube.py parse paths")
+
+    def check(self, tree, src, relpath, ctx, parents):
+        findings = []
+        for node in ast.walk(tree):
+            if self._is_unit_clamp(node):
+                findings.append(self.finding(
+                    relpath, node,
+                    "inline [0,1] clamp — use config.clamp01 "
+                    "(NaN-safe, one shared domain contract)",
+                ))
+        return findings
+
+    @classmethod
+    def _is_unit_clamp(cls, node) -> bool:
+        outer = cls._minmax(node)
+        if outer is None:
+            return False
+        kind, args = outer
+        outer_bound = 1.0 if kind == "min" else 0.0
+        inner_kind = "max" if kind == "min" else "min"
+        inner_bound = 0.0 if kind == "min" else 1.0
+        has_bound = any(is_num(a, outer_bound) for a in args)
+        for a in args:
+            inner = cls._minmax(a)
+            if (inner and inner[0] == inner_kind
+                    and any(is_num(ia, inner_bound) for ia in inner[1])
+                    and has_bound):
+                return True
+        return False
+
+    @staticmethod
+    def _minmax(node) -> "tuple[str, list] | None":
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in ("min", "max") and len(node.args) >= 2
+                and not node.keywords):
+            return node.func.id, node.args
+        return None
+
+
+# ---------------------------------------------------------------------------
+# TPL005 — unnamed threads.
+# ---------------------------------------------------------------------------
+
+class UnnamedThread(Rule):
+    """tests/conftest.py's thread_leak_check finds leaked workers BY
+    NAME ("tpusched" substring): a thread constructed without
+    `name="tpusched-..."` is invisible to the leak gate and shows up
+    in dumps as `Thread-17 (drive)`. Literal and f-string names must
+    prove the prefix; a fully dynamic name expression is accepted
+    (can't be proven lexically — the conftest session assertion
+    backstops it at runtime).
+    """
+
+    rule_id = "TPL005"
+    title = "threading.Thread without a tpusched- name"
+    incident = ("PR 2/PR 3 thread_leak_check matches by name; unnamed "
+                "bench/tool driver threads slipped every leak audit")
+
+    def check(self, tree, src, relpath, ctx, parents):
+        aliases = import_aliases(tree)
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = normalize_call(node.func, aliases)
+            if name not in ("threading.Thread", "Thread"):
+                continue
+            if name == "Thread" and aliases.get("Thread") != "threading.Thread":
+                continue
+            msg = self._check_name_kwarg(node)
+            if msg:
+                findings.append(self.finding(relpath, node, msg))
+        return findings
+
+    @staticmethod
+    def _check_name_kwarg(call: ast.Call) -> "str | None":
+        kw = next((k for k in call.keywords if k.arg == "name"), None)
+        if kw is None:
+            return ('threading.Thread(...) without name="tpusched-..." '
+                    "— unnamed threads are invisible to thread_leak_check")
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            if not v.value.startswith("tpusched-"):
+                return (f'thread name {v.value!r} lacks the "tpusched-" '
+                        "prefix thread_leak_check keys on")
+            return None
+        if isinstance(v, ast.JoinedStr):
+            first = v.values[0] if v.values else None
+            if (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                    and first.value.startswith("tpusched-")):
+                return None
+            return ('f-string thread name must start with a literal '
+                    '"tpusched-" prefix')
+        return None  # dynamic expression: runtime backstop applies
+
+
+# ---------------------------------------------------------------------------
+# TPL006 — bench metric direction resolution.
+# ---------------------------------------------------------------------------
+
+class BenchMetricDirection(Rule):
+    """Every JSON metric line bench.py prints must resolve to a
+    better-direction under tools/benchdiff.py's rules — explicit
+    `"direction"` key, lower-better unit, or a name pattern — or
+    benchdiff silently trends it higher-better and a regression reads
+    as an improvement. Checked at the dict-literal level (the shape
+    benchdiff parses); a dynamic metric name requires the explicit
+    direction key because no pattern can be proven against it.
+    """
+
+    rule_id = "TPL006"
+    title = "bench metric without a resolvable direction"
+    incident = ("PR 8: the *_frac/*_churn families trended as "
+                "higher-better until benchdiff grew explicit "
+                "direction annotations")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.rsplit("/", 1)[-1] == "bench.py"
+
+    def check(self, tree, src, relpath, ctx, parents):
+        bd = ctx.benchdiff
+        if bd is None:  # no benchdiff in this tree: nothing to resolve against
+            return []
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            fields = self._fields(node)
+            if fields is None:
+                continue
+            name_node, unit, direction_kw = fields
+            if direction_kw is not None:
+                if (isinstance(direction_kw, ast.Constant)
+                        and direction_kw.value not in ("higher", "lower")):
+                    findings.append(self.finding(
+                        relpath, node,
+                        f"direction {direction_kw.value!r} is not "
+                        "'higher'|'lower'",
+                    ))
+                continue
+            name = self._static_name(name_node)
+            if name is None:
+                if unit is not None and unit in bd._LOWER_BETTER_UNITS:
+                    continue
+                findings.append(self.finding(
+                    relpath, node,
+                    "dynamic metric name without an explicit "
+                    '"direction" key — benchdiff cannot infer its '
+                    "better-direction",
+                ))
+                continue
+            if unit is not None and unit in bd._LOWER_BETTER_UNITS:
+                continue
+            if (bd._HIGHER_BETTER_NAME.search(name)
+                    or bd._LOWER_BETTER_NAME.search(name)):
+                continue
+            findings.append(self.finding(
+                relpath, node,
+                f"metric {name!r} (unit {unit!r}) resolves to no "
+                "benchdiff direction — add \"direction\": "
+                "\"higher\"|\"lower\"",
+            ))
+        return findings
+
+    @staticmethod
+    def _fields(node: ast.Dict):
+        """(metric value node, static unit or None, direction value
+        node or None) for dicts carrying a "metric" key; None for
+        other dicts."""
+        name_node = unit = direction = None
+        seen_metric = False
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                continue
+            if k.value == "metric":
+                seen_metric, name_node = True, v
+            elif k.value == "unit":
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    unit = v.value
+            elif k.value == "direction":
+                direction = v
+        if not seen_metric:
+            return None
+        return name_node, unit, direction
+
+    @staticmethod
+    def _static_name(node) -> "str | None":
+        """Literal or f-string metric name, formatted values rendered
+        as '0' so shape suffixes still pattern-match."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.JoinedStr):
+            parts = []
+            for v in node.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(str(v.value))
+                else:
+                    parts.append("0")
+            return "".join(parts)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# TPL007 — dict-order-dependent selection.
+# ---------------------------------------------------------------------------
+
+class DictOrderSelection(Rule):
+    """`next(reversed(d))` reads "newest entry" but actually reads
+    "most recently INSERTED OR MOVED" — an LRU hit-touch reorders the
+    dict and the selection silently changes meaning. Select by an
+    explicit recency field instead; a genuinely-correct use (any
+    element acceptable) takes a suppression saying so.
+    """
+
+    rule_id = "TPL007"
+    title = "next(reversed(...)) dict-order selection"
+    incident = ("PR 6 review: the stale-rebase op picked "
+                "next(reversed(_stores)) = most-recently-TOUCHED "
+                "store, not the newest registered one")
+
+    def check(self, tree, src, relpath, ctx, parents):
+        findings = []
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "next" and node.args
+                    and isinstance(node.args[0], ast.Call)
+                    and isinstance(node.args[0].func, ast.Name)
+                    and node.args[0].func.id == "reversed"):
+                findings.append(self.finding(
+                    relpath, node,
+                    "next(reversed(...)) selects by dict/sequence "
+                    "order — track the intended element explicitly",
+                ))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# TPL008 — string-sorting round/seq-shaped keys.
+# ---------------------------------------------------------------------------
+
+class StringSortedRounds(Rule):
+    """String order puts r100 before r99: any sorted()/.sort() over a
+    collection whose name says round/seq/cycle must pass a numeric
+    key. Name-token heuristic — `sorted(rounds)` fires,
+    `sorted(rounds, key=round_sort_key)` and `sorted(node_names)`
+    don't.
+    """
+
+    rule_id = "TPL008"
+    title = "sorted() on round/seq-shaped keys without a numeric key"
+    incident = ("PR 7 review: benchdiff string-sorted round labels, "
+                "diffing r100 against r99's predecessor")
+
+    TOKENS = frozenset({"round", "rounds", "seq", "seqs", "rid",
+                        "rids", "cycle", "cycles"})
+
+    def check(self, tree, src, relpath, ctx, parents):
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if any(k.arg == "key" for k in node.keywords):
+                continue
+            target = None
+            if (isinstance(node.func, ast.Name) and node.func.id == "sorted"
+                    and node.args):
+                target = node.args[0]
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "sort" and not node.args):
+                target = node.func.value
+            if target is None:
+                continue
+            t = terminal_name(target)
+            if t and self.TOKENS & set(t.lower().split("_")):
+                findings.append(self.finding(
+                    relpath, node,
+                    f"sorting {t!r} without key= — string order puts "
+                    "r100 < r99; pass a numeric key",
+                ))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# TPL009 — trace.DEFAULT / explain.DEFAULT discipline.
+# ---------------------------------------------------------------------------
+
+class CollectorDefaultDiscipline(Rule):
+    """Injected-collector discipline (PR 4/PR 7 review fixes): spans
+    and decision records must land in the collector the caller
+    injected, never silently in the process-wide default. The global
+    is referenced only (a) in its owning module, (b) as the right arm
+    of the documented fallback idiom `injected or MOD.DEFAULT` /
+    `x if x is not None else MOD.DEFAULT`, or (c) in the CLI entry
+    points that deliberately drive the process default
+    (tools/tracez.py, tools/explainz.py).
+    """
+
+    rule_id = "TPL009"
+    title = "trace/explain DEFAULT outside the fallback idiom"
+    incident = ("PR 4 review: make_server(tracer=) spans landed in "
+                "trace.DEFAULT instead of the injected ring; PR 7 "
+                "mirrored the fix for explain")
+
+    OWNERS = ("tpusched/trace.py", "tpusched/explain.py")
+    ENTRY_POINTS = ("tools/tracez.py", "tools/explainz.py")
+    MODULES = ("tpusched.trace", "tpusched.explain")
+
+    def applies(self, relpath: str) -> bool:
+        return (product_path(relpath)
+                and relpath not in self.OWNERS
+                and relpath not in self.ENTRY_POINTS)
+
+    def check(self, tree, src, relpath, ctx, parents):
+        aliases = import_aliases(tree)
+        collector_aliases = {
+            local for local, full in aliases.items() if full in self.MODULES
+        }
+        findings = []
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.ImportFrom) and node.level == 0
+                    and node.module in self.MODULES
+                    and any(a.name == "DEFAULT" for a in node.names)):
+                findings.append(self.finding(
+                    relpath, node,
+                    f"importing DEFAULT from {node.module} — accept an "
+                    "injected collector and fall back with "
+                    "`injected or MOD.DEFAULT`",
+                ))
+                continue
+            if not (isinstance(node, ast.Attribute)
+                    and node.attr == "DEFAULT"):
+                continue
+            base = dotted_name(node.value)
+            if base is None:
+                continue
+            head = base.split(".")[0]
+            resolved = (base if base in self.MODULES
+                        else aliases.get(head) if base == head else None)
+            if resolved not in self.MODULES:
+                continue
+            if self._is_fallback(node, parents):
+                continue
+            mod = resolved.rsplit(".", 1)[-1]
+            findings.append(self.finding(
+                relpath, node,
+                f"direct {mod}.DEFAULT use — record into the injected "
+                "collector (fallback idiom: `injected or "
+                f"{mod}.DEFAULT`)",
+            ))
+        return findings
+
+    @staticmethod
+    def _is_fallback(node, parents) -> bool:
+        p = parents.get(node)
+        if isinstance(p, ast.BoolOp) and isinstance(p.op, ast.Or):
+            return node in p.values[1:]
+        if isinstance(p, ast.IfExp):
+            return node is p.orelse
+        return False
+
+
+# ---------------------------------------------------------------------------
+# TPL010 — closeable classes must be closed in tests.
+# ---------------------------------------------------------------------------
+
+class TestCloseDiscipline(Rule):
+    """A test that constructs a closeable tpusched object (Engine,
+    HostScheduler, SchedulerClient, ...) and drops it leaks its worker
+    threads/channels past the test — the population thread_leak_check
+    exists to catch. Heuristic: the bound variable must be close()d,
+    enter a `with`, or be handed off to another call in the same test
+    function. Tests only; direct-construction assignments only.
+    """
+
+    rule_id = "TPL010"
+    title = "closeable class never closed in test function"
+    incident = ("PR 2 conftest thread_leak_check: leaked fetch "
+                "workers from unclosed Engines were the founding "
+                "leak class")
+
+    def applies(self, relpath: str) -> bool:
+        return (relpath.startswith("tests/")
+                and relpath.rsplit("/", 1)[-1].startswith("test_"))
+
+    def check(self, tree, src, relpath, ctx, parents):
+        closeable = ctx.closeable_classes
+        if not closeable:
+            return []
+        findings = []
+        for fn in ast.walk(tree):
+            if (isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and fn.name.startswith("test_")):
+                findings.extend(self._check_fn(fn, relpath, closeable))
+        return findings
+
+    def _check_fn(self, fn, relpath, closeable):
+        candidates = []  # (varname, assign node, class name)
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                cls = terminal_name(node.value.func)
+                if cls in closeable:
+                    candidates.append((node.targets[0].id, node, cls))
+        out = []
+        for var, node, cls in candidates:
+            if not self._satisfied(fn, var):
+                out.append(self.finding(
+                    relpath, node,
+                    f"{cls}(...) bound to {var!r} is never closed in "
+                    "this test — close() it (try/finally), use a "
+                    "context manager, or hand it off",
+                ))
+        return out
+
+    @staticmethod
+    def _satisfied(fn, var: str) -> bool:
+        for node in ast.walk(fn):
+            # x.close / x.stop referenced anywhere (call, addfinalizer,
+            # ExitStack.callback, ...).
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in ("close", "stop", "shutdown")
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == var):
+                return True
+            # `with x`, `with closing(x)`, `with x.something()` ...
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Name) and sub.id == var:
+                            return True
+            # handed off as an argument: ownership transferred.
+            if isinstance(node, ast.Call):
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id == var:
+                        return True
+        return False
+
+
+RULES = (
+    FunctionLevelImport,
+    UnseededRandomness,
+    WorkUnderLock,
+    InlineUnitClamp,
+    UnnamedThread,
+    BenchMetricDirection,
+    DictOrderSelection,
+    StringSortedRounds,
+    CollectorDefaultDiscipline,
+    TestCloseDiscipline,
+)
+
+
+def default_rules() -> "list[Rule]":
+    return [cls() for cls in RULES]

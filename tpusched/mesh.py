@@ -40,8 +40,6 @@ def init_distributed(
     The reference's analogue is client-go's watch/bind HTTP plumbing —
     its only 'backend' — while compute scaling here rides XLA
     collectives; gRPC stays at the host boundary (SURVEY.md §2.3)."""
-    import jax
-
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address

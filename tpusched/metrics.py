@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import math
 import threading
+from typing import Any, Callable, Iterable, Mapping, cast
 
 
 def escape_label_value(v: str) -> str:
@@ -33,7 +34,7 @@ def escape_label_value(v: str) -> str:
             .replace("\n", "\\n"))
 
 
-def format_value(v) -> str:
+def format_value(v: Any) -> str:
     """Canonical sample value: integers render bare, floats repr-exact,
     infinities as +Inf/-Inf."""
     if isinstance(v, bool):
@@ -48,11 +49,11 @@ def format_value(v) -> str:
     return repr(f)
 
 
-def log_buckets(lo: float, hi: float, per_decade: int = 3) -> tuple:
+def log_buckets(lo: float, hi: float, per_decade: int = 3) -> "tuple[float, ...]":
     """Log-spaced upper bounds from `lo` up to and including the first
     bound >= `hi` (e.g. 1e-4 .. 600 at 3/decade: 0.0001, 0.000215,
     0.000464, 0.001, ... 464.2, 1000)."""
-    out = []
+    out: list[float] = []
     step = 10.0 ** (1.0 / per_decade)
     b = float(lo)
     while True:
@@ -63,9 +64,9 @@ def log_buckets(lo: float, hi: float, per_decade: int = 3) -> tuple:
     return tuple(out)
 
 
-def pow_buckets(lo: int, hi: int, factor: int = 4) -> tuple:
+def pow_buckets(lo: int, hi: int, factor: int = 4) -> "tuple[int, ...]":
     """Geometric integer bounds (bytes): lo, lo*factor, ... >= hi."""
-    out = []
+    out: list[int] = []
     b = int(lo)
     while True:
         out.append(b)
@@ -82,12 +83,13 @@ BYTE_BUCKETS = pow_buckets(1 << 10, 1 << 30, factor=4)
 
 
 class Registry:
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Lock()
         self._metrics: dict[str, "_Metric"] = {}  # insertion-ordered
 
-    def _get_or_register(self, name: str, factory, kind: str,
-                         labelnames: tuple):
+    def _get_or_register(self, name: str, factory: "Callable[[], _Metric]",
+                         kind: str,
+                         labelnames: "tuple[str, ...]") -> "_Metric":
         with self._lock:
             m = self._metrics.get(name)
             if m is not None:
@@ -107,7 +109,7 @@ class Registry:
         each metric family, in registration order."""
         with self._lock:
             metrics = list(self._metrics.values())
-        lines = []
+        lines: list[str] = []
         for m in metrics:
             lines.extend(m.render_lines())
         return "\n".join(lines) + ("\n" if lines else "")
@@ -116,14 +118,21 @@ class Registry:
 class _Metric:
     kind = "untyped"
 
-    def __init__(self, name: str, help: str, labelnames: tuple):
+    def __init__(self, name: str, help: str,
+                 labelnames: "tuple[str, ...]") -> None:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
-        self._children: dict[tuple, object] = {}
+        self._children: "dict[tuple[str, ...], Any]" = {}
 
-    def labels(self, *values, **kv):
+    def render_lines(self) -> "list[str]":
+        raise NotImplementedError
+
+    def _new_child(self) -> Any:
+        raise NotImplementedError
+
+    def labels(self, *values: Any, **kv: Any) -> Any:
         if kv:
             if values:
                 raise ValueError("pass label values positionally OR by name")
@@ -140,11 +149,11 @@ class _Metric:
             return child
         # (children are never removed: bounded by real label use)
 
-    def _series(self) -> "list[tuple[tuple, object]]":
+    def _series(self) -> "list[tuple[tuple[str, ...], Any]]":
         with self._lock:
             return list(self._children.items())
 
-    def _label_str(self, key: tuple, extra: str = "") -> str:
+    def _label_str(self, key: "tuple[str, ...]", extra: str = "") -> str:
         parts = [
             f'{n}="{escape_label_value(v)}"'
             for n, v in zip(self.labelnames, key)
@@ -157,11 +166,11 @@ class _Metric:
 class _CounterChild:
     __slots__ = ("_lock", "value")
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Lock()
-        self.value = 0
+        self.value: float = 0
 
-    def inc(self, n=1):
+    def inc(self, n: float = 1) -> None:
         with self._lock:
             self.value += n
 
@@ -175,38 +184,42 @@ class Counter(_Metric):
     # half-built metric (__init__ runs after __new__ returns, outside
     # the lock, so it must not be what builds the object).
 
-    def __new__(cls, name, help="", labelnames=(), registry=None):
+    def __new__(cls, name: str, help: str = "",
+                labelnames: "Iterable[str]" = (),
+                registry: "Registry | None" = None) -> "Counter":
         registry = registry if registry is not None else DEFAULT
 
-        def make():
+        def make() -> "Counter":
             m = super(Counter, cls).__new__(cls)
             _Metric.__init__(m, name, help, tuple(labelnames))
             return m
 
-        return registry._get_or_register(
+        return cast("Counter", registry._get_or_register(
             name, make, "counter", tuple(labelnames),
-        )
+        ))
 
-    def __init__(self, name, help="", labelnames=(), registry=None):
+    def __init__(self, name: str, help: str = "",
+                 labelnames: "Iterable[str]" = (),
+                 registry: "Registry | None" = None) -> None:
         pass  # built by the __new__ factory (comment above)
 
-    def _new_child(self):
+    def _new_child(self) -> _CounterChild:
         return _CounterChild()
 
-    def inc(self, n=1):
+    def inc(self, n: float = 1) -> None:
         if self.labelnames:
             raise ValueError(
                 f"{self.name} has labels {self.labelnames}; use .labels()"
             )
         self.labels().inc(n)
 
-    def value(self, *label_values) -> float:
+    def value(self, *label_values: Any) -> float:
         key = tuple(str(v) for v in label_values)
         with self._lock:
             child = self._children.get(key)
-        return child.value if child is not None else 0
+        return float(child.value) if child is not None else 0.0
 
-    def render_lines(self) -> list:
+    def render_lines(self) -> "list[str]":
         lines = [f"# TYPE {self.name} counter"]
         series = self._series()
         if not series and not self.labelnames:
@@ -222,15 +235,15 @@ class Counter(_Metric):
 class _GaugeChild:
     __slots__ = ("_lock", "value")
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Lock()
-        self.value = 0
+        self.value: float = 0
 
-    def set(self, v):
+    def set(self, v: float) -> None:
         with self._lock:
             self.value = v
 
-    def inc(self, n=1):
+    def inc(self, n: float = 1) -> None:
         with self._lock:
             self.value += n
 
@@ -238,30 +251,34 @@ class _GaugeChild:
 class Gauge(_Metric):
     kind = "gauge"
 
-    def __new__(cls, name, help="", labelnames=(), registry=None):
+    def __new__(cls, name: str, help: str = "",
+                labelnames: "Iterable[str]" = (),
+                registry: "Registry | None" = None) -> "Gauge":
         registry = registry if registry is not None else DEFAULT
 
-        def make():
+        def make() -> "Gauge":
             m = super(Gauge, cls).__new__(cls)
             _Metric.__init__(m, name, help, tuple(labelnames))
             return m
 
-        return registry._get_or_register(
+        return cast("Gauge", registry._get_or_register(
             name, make, "gauge", tuple(labelnames),
-        )
+        ))
 
-    def __init__(self, name, help="", labelnames=(), registry=None):
+    def __init__(self, name: str, help: str = "",
+                 labelnames: "Iterable[str]" = (),
+                 registry: "Registry | None" = None) -> None:
         pass  # built by the __new__ factory (see Counter)
 
-    def _new_child(self):
+    def _new_child(self) -> _GaugeChild:
         return _GaugeChild()
 
-    def set(self, v):
+    def set(self, v: float) -> None:
         if self.labelnames:
             raise ValueError(f"{self.name} has labels; use .labels().set()")
         self.labels().set(v)
 
-    def render_lines(self) -> list:
+    def render_lines(self) -> "list[str]":
         lines = [f"# TYPE {self.name} gauge"]
         series = self._series()
         if not series and not self.labelnames:
@@ -277,14 +294,14 @@ class Gauge(_Metric):
 class _HistogramChild:
     __slots__ = ("_lock", "buckets", "counts", "sum", "count")
 
-    def __init__(self, buckets):
+    def __init__(self, buckets: "tuple[float, ...]") -> None:
         self._lock = threading.Lock()
         self.buckets = buckets
         self.counts = [0] * (len(buckets) + 1)  # last = overflow (+Inf)
         self.sum = 0.0
         self.count = 0
 
-    def observe(self, v):
+    def observe(self, v: float) -> None:
         v = float(v)
         with self._lock:
             self.sum += v
@@ -299,22 +316,26 @@ class _HistogramChild:
 class Histogram(_Metric):
     kind = "histogram"
 
-    def __new__(cls, name, help="", buckets=DURATION_BUCKETS,
-                labelnames=(), registry=None):
+    def __new__(cls, name: str, help: str = "",
+                buckets: "Iterable[float]" = DURATION_BUCKETS,
+                labelnames: "Iterable[str]" = (),
+                registry: "Registry | None" = None) -> "Histogram":
         registry = registry if registry is not None else DEFAULT
 
-        def make():
+        def make() -> "Histogram":
             m = super(Histogram, cls).__new__(cls)
             _Metric.__init__(m, name, help, tuple(labelnames))
             m.buckets = tuple(float(b) for b in buckets)
             return m
 
-        return registry._get_or_register(
+        return cast("Histogram", registry._get_or_register(
             name, make, "histogram", tuple(labelnames),
-        )
+        ))
 
-    def __init__(self, name, help="", buckets=DURATION_BUCKETS,
-                 labelnames=(), registry=None):
+    def __init__(self, name: str, help: str = "",
+                 buckets: "Iterable[float]" = DURATION_BUCKETS,
+                 labelnames: "Iterable[str]" = (),
+                 registry: "Registry | None" = None) -> None:
         # Built by the __new__ factory (see Counter); only the
         # get-or-create layout check remains: a silently-different
         # bucket layout would mis-bucket this caller's observations —
@@ -325,15 +346,15 @@ class Histogram(_Metric):
                 f"{tuple(buckets)!r} but exists with {self.buckets!r}"
             )
 
-    def _new_child(self):
+    def _new_child(self) -> _HistogramChild:
         return _HistogramChild(self.buckets)
 
-    def observe(self, v):
+    def observe(self, v: float) -> None:
         if self.labelnames:
             raise ValueError(f"{self.name} has labels; use .labels().observe()")
         self.labels().observe(v)
 
-    def render_lines(self) -> list:
+    def render_lines(self) -> "list[str]":
         lines = [f"# TYPE {self.name} histogram"]
         for key, child in self._series():
             with child._lock:
@@ -367,29 +388,33 @@ class CallbackGauge(_Metric):
 
     kind = "gauge"
 
-    def __new__(cls, name, help="", labelnames=(), callback=None,
-                registry=None):
+    def __new__(cls, name: str, help: str = "",
+                labelnames: "Iterable[str]" = (),
+                callback: "Callable[[], Any] | None" = None,
+                registry: "Registry | None" = None) -> "CallbackGauge":
         registry = registry if registry is not None else DEFAULT
 
-        def make():
+        def make() -> "CallbackGauge":
             m = super(CallbackGauge, cls).__new__(cls)
             _Metric.__init__(m, name, help, tuple(labelnames))
             m.callback = callback
             return m
 
-        return registry._get_or_register(
+        return cast("CallbackGauge", registry._get_or_register(
             name, make, "gauge", tuple(labelnames),
-        )
+        ))
 
-    def __init__(self, name, help="", labelnames=(), callback=None,
-                 registry=None):
+    def __init__(self, name: str, help: str = "",
+                 labelnames: "Iterable[str]" = (),
+                 callback: "Callable[[], Any] | None" = None,
+                 registry: "Registry | None" = None) -> None:
         # Built by the __new__ factory (see Counter). Re-registration
         # with a fresh callback re-points the family (the latest owner
         # of the live state wins — mirrors get-or-create semantics).
         if callback is not None:
             self.callback = callback
 
-    def render_lines(self) -> list:
+    def render_lines(self) -> "list[str]":
         lines = [f"# TYPE {self.name} gauge"]
         cb = self.callback
         if cb is None:
@@ -398,7 +423,7 @@ class CallbackGauge(_Metric):
             samples = cb()
         except Exception:
             return lines
-        if not isinstance(samples, dict):
+        if not isinstance(samples, Mapping):
             samples = {(): samples}
         for key, v in samples.items():
             key = tuple(str(k) for k in (

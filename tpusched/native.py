@@ -21,6 +21,7 @@ ASCII literals, underscores, inf/nan (any case) all match exactly.
 from __future__ import annotations
 
 import dataclasses
+import importlib.util
 import os
 import subprocess
 import sys
@@ -85,11 +86,9 @@ def _load():
                 and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
             ):
                 _build_so()
-            import importlib.util
-
             spec = importlib.util.spec_from_file_location("_fastdecode", _SO)
             mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
+            spec.loader.exec_module(mod)  # tpl: disable=TPL003(one-time native-module load; _load_lock exists precisely to serialize this init)
             _mod = mod
             return mod
         except Exception as e:  # remember: retrying every call would be slow

@@ -20,6 +20,8 @@ urgent profile holding all weight on least_requested.
 
 from __future__ import annotations
 
+from typing import Any
+
 import numpy as np
 
 from tpusched.config import DEFAULT_OBSERVED_AVAIL, EngineConfig, clamp01
@@ -30,8 +32,10 @@ from tpusched.config import DEFAULT_OBSERVED_AVAIL, EngineConfig, clamp01
 MIN_OBSERVED_AGE_S = 1e-9
 
 
-def pressure_of(slo_target, observed_avail):
-    """Works on numpy and jax arrays alike (pure ufunc arithmetic)."""
+def pressure_of(slo_target: Any, observed_avail: Any) -> Any:
+    """Works on numpy and jax arrays alike (pure ufunc arithmetic);
+    `Any` is deliberate — the scalar/np/jnp polymorphism has no common
+    stub type on this image."""
     return (slo_target - observed_avail).clip(0.0, 1.0)
 
 
@@ -59,12 +63,13 @@ def observed_availability(
     return clamp01(run / age, default=default)
 
 
-def effective_priority(cfg: EngineConfig, base_priority, slo_target, observed_avail):
+def effective_priority(cfg: EngineConfig, base_priority: Any,
+                       slo_target: Any, observed_avail: Any) -> Any:
     return base_priority + cfg.qos.qos_gain * pressure_of(slo_target, observed_avail)
 
 
-def priority_terms(cfg: EngineConfig, base_priority, slo_target,
-                   observed_avail) -> dict:
+def priority_terms(cfg: EngineConfig, base_priority: Any, slo_target: Any,
+                   observed_avail: Any) -> dict[str, Any]:
     """Decompose the dynamic priority into its provenance terms (round
     12, decision provenance): base + qos_boost == effective_priority
     exactly (same formula, same op order). Works on scalars and arrays;
@@ -81,11 +86,12 @@ def priority_terms(cfg: EngineConfig, base_priority, slo_target,
     }
 
 
-def slack_of(slo_target, observed_avail):
+def slack_of(slo_target: Any, observed_avail: Any) -> Any:
     return observed_avail - slo_target
 
 
-def victim_effective_priority(cfg: EngineConfig, priority, slack):
+def victim_effective_priority(cfg: EngineConfig, priority: Any,
+                              slack: Any) -> Any:
     """Running pods store slack directly; a victim below its SLO
     (negative slack) gets the same qos_gain boost a pending pod would:
     pressure = clip(-slack, 0, 1)."""
@@ -93,7 +99,7 @@ def victim_effective_priority(cfg: EngineConfig, priority, slack):
     return priority + cfg.qos.qos_gain * pressure
 
 
-def evict_cost_raw(cfg: EngineConfig, priority, slack):
+def evict_cost_raw(cfg: EngineConfig, priority: Any, slack: Any) -> Any:
     """Eviction cost before the per-snapshot positive shift (see
     QoSConfig.evict_slack_weight): effective priority, discounted by how
     far ABOVE its SLO the victim runs (cheap victims have QoS to spare).
@@ -118,7 +124,7 @@ def base_weights(cfg: EngineConfig) -> dict[str, float]:
     return {p: float(getattr(cfg.weights, p)) for p in _PLUGINS}
 
 
-def effective_weights(cfg: EngineConfig, pressure) -> dict:
+def effective_weights(cfg: EngineConfig, pressure: Any) -> dict[str, Any]:
     """Per-pod plugin weights. With urgency_reweight, interpolate between
     the configured profile and an all-least-requested urgent profile by
     QoS pressure. `pressure` may be a scalar or a [P] array; weights
@@ -134,11 +140,11 @@ def effective_weights(cfg: EngineConfig, pressure) -> dict:
     }
 
 
-def _is_array(x) -> bool:
+def _is_array(x: Any) -> bool:
     return hasattr(x, "shape") and getattr(x, "shape", ()) != ()
 
 
-def tie_hash(seed: int, pod_index):
+def tie_hash(seed: int, pod_index: Any) -> Any:
     """Deterministic per-pod 32-bit mix for the "seeded" tie-break.
     Pure uint32 arithmetic so host ints (oracle) and jax uint32 (device)
     agree bit-for-bit; xxhash-style avalanche constants."""
@@ -148,7 +154,7 @@ def tie_hash(seed: int, pod_index):
         x = (x * 2246822519) & 0xFFFFFFFF
         x ^= x >> 13
         return x
-    import jax.numpy as jnp
+    import jax.numpy as jnp  # tpl: disable=TPL001(scalar host path stays jax-free; jnp is reached only with device arrays already in hand)
 
     x = jnp.uint32(seed & 0xFFFFFFFF) * jnp.uint32(2654435761) + (
         pod_index.astype(jnp.uint32) * jnp.uint32(2246822519)

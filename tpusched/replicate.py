@@ -62,8 +62,10 @@ endpoint and retries this one later).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
+import traceback
 from collections import deque
 
 from tpusched.faults import FaultError
@@ -179,7 +181,7 @@ class StandbyFollower:
 
     def __init__(self, svc, addresses, poll_s: float = POLL_S,
                  follower_id: str = "", timeout: float = 10.0):
-        from tpusched.rpc.client import RetryPolicy, SchedulerClient
+        from tpusched.rpc.client import RetryPolicy, SchedulerClient  # tpl: disable=TPL001(cycle: rpc.server imports this module at top, and client imports server back)
 
         self.svc = svc
         self.poll_s = float(poll_s)
@@ -248,9 +250,6 @@ class StandbyFollower:
                                 # the failed-over client heals through
                                 # FAILED_PRECONDITION + full resync.
                                 self.svc.replication_skipped += 1
-                                import logging
-                                import traceback
-
                                 logging.getLogger(
                                     "tpusched.replicate"
                                 ).warning(
@@ -297,9 +296,6 @@ class StandbyFollower:
                 # A real bug in the poll/apply path must not degrade
                 # into silent, permanent lag: count AND log it.
                 self.failed_polls += 1
-                import logging
-                import traceback
-
                 logging.getLogger("tpusched.replicate").warning(
                     "replication poll failed (follower %s):\n%s",
                     self.follower_id, traceback.format_exc(limit=3),
@@ -322,7 +318,7 @@ class ReplicaSet:
 
     def __init__(self, n: int = 2, poll_s: float = POLL_S,
                  follower_timeout: float = 10.0, **make_kw):
-        from tpusched.rpc.server import make_server
+        from tpusched.rpc.server import make_server  # tpl: disable=TPL001(cycle: rpc.server imports this module at top, and client imports server back)
 
         if n < 1:
             raise ValueError(f"replica count must be >= 1, got {n}")
@@ -413,7 +409,7 @@ class ReplicaSet:
         """Resurrect a killed replica on its original port — as a
         STANDBY by default: a crashed ex-leader rejoins the fleet
         following whoever leads now, it does not reclaim leadership."""
-        from tpusched.rpc.server import make_server
+        from tpusched.rpc.server import make_server  # tpl: disable=TPL001(cycle: rpc.server imports this module at top, and client imports server back)
 
         if i not in self._dead:
             raise RuntimeError(f"replica {i} is not dead")

@@ -2,12 +2,17 @@
 
 Regenerate the pb2 module after editing protos/tpusched.proto:
     protoc -Iprotos --python_out=tpusched/rpc protos/tpusched.proto
+
+The codec half (pb + snapshot_to/from_proto) is pure protobuf and
+imports eagerly; the server/client half needs grpc and loads LAZILY
+via module __getattr__ (round 15, TPL001 cleanup) so grpc stays an
+OPTIONAL dep: `tpusched.host`/`tpusched.kube`/the in-process sim all
+reach the codec through this package and must import on a grpc-free
+install — exactly the boundary the TPL001 allowlist protects.
 """
 
 from tpusched.rpc import tpusched_pb2 as pb
 from tpusched.rpc.codec import snapshot_from_proto, snapshot_to_proto
-from tpusched.rpc.server import SchedulerService, make_server
-from tpusched.rpc.client import SchedulerClient
 
 __all__ = [
     "pb",
@@ -17,3 +22,18 @@ __all__ = [
     "make_server",
     "SchedulerClient",
 ]
+
+# name -> owning module for the grpc-backed exports.
+_GRPC_EXPORTS = {
+    "SchedulerService": "tpusched.rpc.server",
+    "make_server": "tpusched.rpc.server",
+    "SchedulerClient": "tpusched.rpc.client",
+}
+
+
+def __getattr__(name):
+    if name in _GRPC_EXPORTS:
+        import importlib  # tpl: disable=TPL001(lazy public API: the grpc-backed half loads on first attribute access only)
+
+        return getattr(importlib.import_module(_GRPC_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -182,7 +182,8 @@ def assign_response_arrays(resp: pb.AssignResponse):
 class SchedulerClient:
     def __init__(self, address, timeout: float = 120.0,
                  retry: RetryPolicy | None = None,
-                 retry_seed: int | None = None):
+                 retry_seed: int | None = None,
+                 tracer=None):
         """address: one endpoint, or an ORDERED list of replica
         endpoints (round 11, ISSUE 6) — the client talks to the first
         and FAILS OVER to the next on UNAVAILABLE (a dead/restarting
@@ -206,7 +207,7 @@ class SchedulerClient:
         # is stamped with a trace id (request_id) + the caller's active
         # span (parent_span); the sidecar roots its stage spans there,
         # so the client and server rings merge into one causal trace.
-        self.tracer = tracing.DEFAULT
+        self.tracer = tracer if tracer is not None else tracing.DEFAULT
         self.addresses = ([address] if isinstance(address, str)
                           else list(address))
         if not self.addresses:

@@ -9,6 +9,10 @@ proto directly from its cache.
 
 from __future__ import annotations
 
+import logging
+import os
+import traceback
+
 from tpusched.config import (Buckets, DEFAULT_OBSERVED_AVAIL,
                              DEFAULT_SLO_TARGET, EngineConfig)
 from tpusched.rpc import tpusched_pb2 as pb
@@ -46,12 +50,10 @@ def decode_snapshot(
     is genuinely bad, Python raises the authoritative error; if it was
     a native-only limitation (e.g. exotic numeric literals), the slow
     path still serves the request."""
-    import os
-
     if prefer_native is None:
         prefer_native = os.environ.get("TPUSCHED_NO_NATIVE", "") in ("", "0")
     if prefer_native:
-        from tpusched import native
+        from tpusched import native  # tpl: disable=TPL001(the native .so is optional and may BUILD on first import; the pure-python path must not pay or risk that at module import)
 
         if native.available():
             try:
@@ -62,9 +64,6 @@ def decode_snapshot(
                 # The fallback must be LOUD: a native decode failure is
                 # either a contract bug (native.py calls it "a bug in
                 # this file") or a permanent ~8x decode slowdown.
-                import logging
-                import traceback
-
                 logging.getLogger("tpusched.native").warning(
                     "native decode failed; falling back to the Python "
                     "decoder for this request:\n%s",

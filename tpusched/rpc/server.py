@@ -114,22 +114,32 @@ Round 9 (ISSUE 4) makes the whole pipeline OBSERVABLE:
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import sys
 import threading
 import time
+import traceback
+import uuid
 from collections import deque
 from concurrent import futures
+from concurrent.futures import TimeoutError as _FutTimeout
 from contextlib import contextmanager
 
+import jax
 import numpy as np
 
 import grpc
 
 from tpusched import explain as explaining
+from tpusched import metrics as pm
 from tpusched import trace as tracing
+from tpusched.faults import NO_FAULTS
+from tpusched.mesh import make_mesh
 from tpusched.config import Buckets, EngineConfig
 from tpusched.device_state import DeviceSnapshot
+from tpusched.replicate import ReplicationLog
 from tpusched.engine import Engine
 from tpusched.faults import FaultError
 from tpusched.rpc import tpusched_pb2 as pb
@@ -196,8 +206,6 @@ class _Metrics:
     name a trace shows."""
 
     def __init__(self):
-        from tpusched import metrics as pm
-
         r = self.registry = pm.Registry()
         self.attempts = pm.Counter(
             "scheduler_schedule_attempts_total",
@@ -716,8 +724,6 @@ class SchedulerService:
         rpc and carried in flight-recorder dumps. Off (default) the
         serving path is byte-identical to round 11: one enabled-check
         per Assign. explain_k: candidate depth per pod."""
-        from tpusched.faults import NO_FAULTS
-
         self.config = config or EngineConfig()
         # Floor buckets pin compile shapes across requests (a feature
         # first appearing mid-serving would otherwise trigger a full
@@ -729,16 +735,12 @@ class SchedulerService:
         # route to the sharded/ring paths (EngineConfig.mesh_shape).
         mesh = None
         if self.config.ring_counts or tuple(self.config.mesh_shape) != (1, 1):
-            from tpusched.mesh import make_mesh
-
             shape = tuple(self.config.mesh_shape)
             mesh = make_mesh(None if shape == (1, 1) else shape)
         self._faults = faults if faults is not None else NO_FAULTS
         self._engine = Engine(self.config, mesh=mesh, faults=self._faults)
         self._log = log_stream if log_stream is not None else sys.stderr
         self._audit = audit_stream
-        import threading
-
         self._audit_lock = threading.Lock()  # handlers run on a pool
         self._store_lock = threading.Lock()
         self._stores: dict[str, SnapshotStore] = {}  # LRU by insertion
@@ -749,9 +751,7 @@ class SchedulerService:
         # window — an aliased base would silently resolve a failed-over
         # delta against the wrong bytes instead of triggering the
         # FAILED_PRECONDITION -> resync heal path.
-        import uuid as _uuid
-
-        self._mint_nonce = _uuid.uuid4().hex[:8]
+        self._mint_nonce = uuid.uuid4().hex[:8]
         self._last_minted: str | None = None  # newest REGISTERED sid
         # Dispatch admission (round 7, replaces the `_dispatch_lane`
         # mutex): handlers still decode OUTSIDE the serialized section
@@ -811,8 +811,6 @@ class SchedulerService:
         self.flight.decisions = self.explain
         # Live device/store memory surface (ROADMAP item 1 feeds on
         # this): rendered at scrape time from the authoritative maps.
-        from tpusched import metrics as pm
-
         pm.CallbackGauge(
             "scheduler_device_bytes",
             "live device-resident and host-retained bytes by kind "
@@ -830,10 +828,6 @@ class SchedulerService:
         # so a surviving second standby re-follows without a rebase.
         if role not in ("leader", "standby"):
             raise ValueError(f"role={role!r}: want leader|standby")
-        # Imported here, not at module top: replicate.py speaks the
-        # same pb module, and the rpc package init imports this file.
-        from tpusched.replicate import ReplicationLog
-
         self.role = role
         self._role_lock = threading.Lock()
         self._replog = (replication_log if replication_log is not None
@@ -982,9 +976,6 @@ class SchedulerService:
                 session.apply_delta(base_id, delta, sid)
             self._session_put(session)
         except Exception:
-            import logging
-            import traceback
-
             logging.getLogger("tpusched.rpc.server").warning(
                 "standby session warm-up failed; takeover will serve "
                 "via decode:\n%s", traceback.format_exc(limit=3),
@@ -1152,8 +1143,6 @@ class SchedulerService:
         caller, demotes the ladder, and abandons the wedged fetch
         worker so later dispatches get a live one (throttled: N callers
         waiting on the same wedged worker trigger ONE restart)."""
-        from concurrent.futures import TimeoutError as _FutTimeout
-
         t0 = time.perf_counter()
         try:
             with self._trace.span("fetch.join", cat="server", what=what):
@@ -1299,9 +1288,6 @@ class SchedulerService:
                         session.device.tracer = self._trace
                     self.session_seeds += 1
                 except Exception:
-                    import logging
-                    import traceback
-
                     logging.getLogger("tpusched.rpc.server").warning(
                         "device session seed failed; serving via the "
                         "decode path:\n%s", traceback.format_exc(limit=3),
@@ -1351,9 +1337,6 @@ class SchedulerService:
                     # inconsistent, so drop it (loud, like the native-
                     # decoder fallback: silent means a permanent
                     # O(cluster) regression).
-                    import logging
-                    import traceback
-
                     logging.getLogger("tpusched.rpc.server").warning(
                         "device session apply failed; dropping the "
                         "lineage and re-decoding:\n%s",
@@ -1462,8 +1445,6 @@ class SchedulerService:
         client lineages still fuse."""
         if not request.HasField("delta"):
             return None
-        import hashlib
-
         kind = ("topk" if request.top_k > 0
                 else f"full-packed{int(bool(request.packed_ok))}")
         d = request.delta
@@ -1691,9 +1672,6 @@ class SchedulerService:
 
     @staticmethod
     def _log_internal(rpc: str, exc: BaseException) -> None:
-        import logging
-        import traceback
-
         logging.getLogger("tpusched.rpc.server").error(
             "%s failed unexpectedly (INTERNAL):\n%s",
             rpc, traceback.format_exc(limit=5),
@@ -1802,9 +1780,6 @@ class SchedulerService:
             try:
                 probe = pending_probe.result(timeout=self.watchdog_s)
             except Exception:  # noqa: BLE001 — observability best-effort
-                import logging
-                import traceback
-
                 logging.getLogger("tpusched.rpc.server").warning(
                     "explain probe failed; skipping the decision "
                     "record:\n%s", traceback.format_exc(limit=3),
@@ -1843,8 +1818,6 @@ class SchedulerService:
         (liveness probe, chaos harness, operator) reads: which ladder
         rung is serving, the trip/demotion/recovery/replay counters,
         and (round 11) the replication role / lag / takeover count."""
-        import jax
-
         lad = self._ladder.snapshot()
         return pb.HealthResponse(
             ok=True, backend=jax.default_backend(),
@@ -1878,7 +1851,7 @@ class SchedulerService:
                 # "caught up" on stale state.
                 newest = (self._last_minted
                           if self._last_minted in self._stores
-                          else next(reversed(self._stores), None))
+                          else next(reversed(self._stores), None))  # tpl: disable=TPL007(deliberate: _last_minted was evicted, so most-recently-TOUCHED is the freshest state a follower can rebase onto)
                 store = self._stores.get(newest) if newest else None
             if store is not None:
                 op = resp.ops.add()

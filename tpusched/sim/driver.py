@@ -35,13 +35,18 @@ import numpy as np
 
 from tpusched import metrics as pm
 from tpusched import qos
+from tpusched import trace as tracing
+from tpusched.engine import Engine
+from tpusched.explain import ExplainCollector
 from tpusched.config import (DEFAULT_OBSERVED_AVAIL, DEFAULT_SLO_TARGET,
                              EngineConfig, QoSConfig, SimConfig)
 from tpusched.faults import FaultError
 from tpusched.host import FakeApiServer, HostScheduler
+from tpusched.sim import report
 from tpusched.sim.clock import VirtualClock
 from tpusched.sim.lifecycle import LifecycleTracker
-from tpusched.sim.workloads import Scenario, SimSetup, generate
+from tpusched.sim.workloads import (MATRIX_SCENARIOS, SCENARIOS, Scenario,
+                                    SimSetup, generate)
 
 # Sim-run counters in the process-default registry: sim runs export
 # through the same Prometheus surface as serving (ISSUE 5 "sim runs
@@ -166,8 +171,6 @@ class SimDriver:
 
         self._owns_engine = False
         if client is None and engine is None:
-            from tpusched.engine import Engine
-
             engine = Engine(self.cfg, faults=faults)
             self._owns_engine = True
         self.engine = engine
@@ -393,8 +396,6 @@ class SimDriver:
     # -- main loop ----------------------------------------------------------
 
     def run(self) -> SimResult:
-        from tpusched import trace as tracing
-
         tr = self.tracer or tracing.DEFAULT
         sc, sim = self.sc, self.sim
         wall0 = time.perf_counter()
@@ -510,12 +511,12 @@ def run_scenario(
                          explain=explain, setup=setup).run()
     if backend != "grpc":
         raise ValueError(f"backend={backend!r}: want inprocess|grpc")
-    from tpusched.rpc.client import SchedulerClient
-    from tpusched.rpc.server import make_server
+    from tpusched.rpc.client import SchedulerClient  # tpl: disable=TPL001(grpc backend is optional; the in-process sim must import without grpc)
+    from tpusched.rpc.server import make_server  # tpl: disable=TPL001(grpc backend is optional; the in-process sim must import without grpc)
 
     cfg = effective_config(scenario, config)
     if replicas > 1:
-        from tpusched.replicate import ReplicaSet
+        from tpusched.replicate import ReplicaSet  # tpl: disable=TPL001(grpc backend is optional; the in-process sim must import without grpc)
 
         fleet = ReplicaSet(replicas, config=cfg, faults=faults,
                            explain=explain)
@@ -584,8 +585,6 @@ def twin_run(
     workload; scenario may then be None. faults_factory likewise
     builds a fresh FaultPlan per arm (plans carry invocation counters),
     so soak compositions twin deterministically."""
-    from tpusched.sim import report
-
     # When the scenario rides in on the factory (trace twins), keep the
     # setup we peeked at for the FIRST arm — a large ingested trace
     # should parse once per arm, not an extra time for the header.
@@ -607,8 +606,6 @@ def twin_run(
                 f"seed={seed} qos_gain={arm_cfg.qos.qos_gain}")
         col = None
         if explain:
-            from tpusched.explain import ExplainCollector
-
             # Capacity covers a full horizon of per-tick cycles, so the
             # attribution join sees every decision, not a recent window.
             col = ExplainCollector(capacity=65536, enabled=True)
@@ -661,8 +658,6 @@ def matrix_run(
     judged across the matrix instead of one hand-picked corner.
     horizon_s caps (never extends) each scenario's virtual horizon —
     the bench-budget knob."""
-    from tpusched.sim.workloads import MATRIX_SCENARIOS, SCENARIOS
-
     names = list(scenario_names if scenario_names is not None
                  else MATRIX_SCENARIOS)
     rows = []

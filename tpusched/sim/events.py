@@ -30,6 +30,8 @@ import json
 
 import numpy as np
 
+from tpusched.config import clamp01
+
 
 @dataclasses.dataclass(frozen=True)
 class Event:
@@ -133,7 +135,7 @@ def diurnal_times(rng: np.random.Generator, base_rate: float, horizon: float,
     """Thinning (Lewis-Shedler): candidates at the peak rate
     base*(1+amplitude), kept with probability lambda(t)/peak where
     lambda(t) = base * (1 + amplitude * sin(2 pi t / period))."""
-    amplitude = min(max(amplitude, 0.0), 1.0)
+    amplitude = clamp01(amplitude)
     peak = base_rate * (1.0 + amplitude)
     out = []
     for t in poisson_times(rng, peak, horizon, t0):

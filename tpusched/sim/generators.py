@@ -39,7 +39,11 @@ import workloads, not this module, to enumerate scenarios.
 
 from __future__ import annotations
 
-from tpusched.sim.workloads import Scenario
+import dataclasses
+
+from tpusched.faults import FaultPlan
+from tpusched.sim import traces
+from tpusched.sim.workloads import Scenario, generate
 
 # A PreferNoSchedule taint on the scale-out pool: it never filters a
 # pod (the cluster stays schedulable for tolerance-less sim pods) but
@@ -194,8 +198,6 @@ def soak_fault_plan(seed: int, cycles: int = 300):
     no solve, so actual engine.fetch invocations trail the tick count —
     a window at the full cycle count could land every shot past the end
     of the run (a silent no-op soak)."""
-    from tpusched.faults import FaultPlan
-
     return FaultPlan.seeded(seed, {
         "engine.fetch": dict(kind="error", n=3,
                              window=max(cycles // 4, 6)),
@@ -205,8 +207,6 @@ def soak_fault_plan(seed: int, cycles: int = 300):
 def soak_smoke(horizon_s: float = 60.0) -> Scenario:
     """The bounded tier-1 form of soak_storm: same composition, short
     horizon, autoscale/flap times rescaled into the window."""
-    import dataclasses
-
     base = SCENARIOS["soak_storm"]
     scale = horizon_s / base.horizon_s
     return dataclasses.replace(
@@ -233,9 +233,6 @@ def generate_trace(scenario: Scenario, seed: int, path: str) -> str:
     """Generate a workload and write it as an on-disk trace: the
     generate -> write half of the trace round trip (load_trace +
     SimDriver(setup=...) is the other half). Returns `path`."""
-    from tpusched.sim import traces
-    from tpusched.sim.workloads import generate
-
     return traces.write_trace(generate(scenario, seed), path)
 
 

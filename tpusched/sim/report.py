@@ -13,6 +13,9 @@ from __future__ import annotations
 
 import numpy as np
 
+from tpusched.explain import (_NO_FEASIBLE, OUTCOME_GANG_HELD,
+                              OUTCOME_PENDING, OUTCOMES, _pending_reason)
+
 
 def _pct(xs, q) -> float:
     return round(float(np.percentile(np.asarray(xs, np.float64), q)), 6) \
@@ -218,10 +221,6 @@ def miss_attribution(res, records) -> dict:
     the table. Consistency contract (test-pinned): every "preempted"
     pod IS an eviction victim in some record; every "unschedulable"
     pod has a recorded zero-feasible pending cycle."""
-    from tpusched.explain import (_NO_FEASIBLE, OUTCOME_GANG_HELD,
-                                  OUTCOME_PENDING, OUTCOMES,
-                                  _pending_reason)
-
     pend_code = OUTCOMES.index(OUTCOME_PENDING)
     gang_code = OUTCOMES.index(OUTCOME_GANG_HELD)
     # Pod -> accumulated evidence over the record stream (records are

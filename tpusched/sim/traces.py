@@ -220,6 +220,6 @@ def replay(path: str, **run_kwargs):
     """Load a trace and run it through the real stack: load_trace +
     driver.run_scenario(setup=...). run_kwargs pass through (config,
     sim, backend, engine, faults, explain, ...)."""
-    from tpusched.sim.driver import run_scenario
+    from tpusched.sim.driver import run_scenario  # tpl: disable=TPL001(trace I/O stays importable without the driver's engine stack; replay reaches the driver only when called)
 
     return run_scenario(setup=load_trace(path), **run_kwargs)

@@ -24,8 +24,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as PS
 
 from tpusched.config import EngineConfig
+from tpusched.mesh import POD_AXIS
 from tpusched.engine import solve_core
 from tpusched.snapshot import ClusterSnapshot
 
@@ -97,10 +99,6 @@ def solve_many_jit(cfg: EngineConfig):
 def tenant_sharding(mesh, stacked: ClusterSnapshot):
     """NamedShardings putting the TENANT axis on the mesh's 'p' axis:
     whole problems route to devices, zero cross-device collectives."""
-    from jax.sharding import NamedSharding, PartitionSpec as PS
-
-    from tpusched.mesh import POD_AXIS
-
     return jax.tree.map(
         lambda _: NamedSharding(mesh, PS(POD_AXIS)), stacked
     )

@@ -44,6 +44,7 @@ of being a bare counter bump.
 from __future__ import annotations
 
 import itertools
+import random
 import threading
 import time
 from collections import deque
@@ -155,8 +156,6 @@ class TraceCollector:
 
     def __init__(self, capacity: int = 4096, seed: "int | None" = None,
                  enabled: bool = True):
-        import random
-
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=int(capacity))
         self._tls = threading.local()
@@ -300,7 +299,7 @@ class FlightRecorder:
         )
         dec = self.decisions
         if dec is not None and getattr(dec, "enabled", False):
-            from tpusched import explain as _explain
+            from tpusched import explain as _explain  # tpl: disable=TPL001(trace must stay stdlib-only at import; explain pulls the jax kernels stack)
 
             dump["decisions"] = [
                 _explain.record_dict(r, include_auction=True)
