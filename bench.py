@@ -21,7 +21,7 @@ Usage: python bench.py [--pods N] [--nodes N] [--iters N] [--only NAME]
        [--serve-clients K] [--serve-cycles N]
        [--serve-what both|assign|score]
 NAME in {headline, pairwise, gangs, preemption, pipeline, e2e, wire,
-serving, divergence}.
+serving, divergence, warm}.
 """
 
 from __future__ import annotations
@@ -1138,6 +1138,89 @@ def bench_e2e(args):
          {"placements_per_sec": stats.get("placements_per_sec")})
 
 
+def bench_warm(args):
+    """O(churn) warm-start churn sweep (ROADMAP item 3, ISSUE 11): one
+    device-resident lineage at the headline shape, value-churned at
+    0.1% / 1% / 10% of pods per cycle, each cycle solved through the
+    engine warm path (carried tableau + dirty-row refresh). Emits
+    solve_warm_ms_{p50,p99} per churn level next to a cold reference
+    measured on the SAME snapshot with the plain packed-solve program
+    (comparable to the headline fast number), so benchdiff flags
+    regressions in either path. The twin-parity contract (warm == cold
+    bitwise) is pinned by tests/test_warm.py and auditable with
+    `python -m tpusched.divergence --warm-audit N`."""
+    from tpusched import EngineConfig
+    from tpusched.device_state import DeviceSnapshot
+    from tpusched.engine import Engine
+    from tpusched.synth import make_cluster
+
+    pods, nodes = args.pods, args.nodes
+    rng = np.random.default_rng(46)
+    t0 = time.perf_counter()
+    nodes_r, pods_r, running_r = make_cluster(
+        rng, pods, nodes, n_running_per_node=1, with_qos=True,
+        as_records=True,
+    )
+    log(f"[warm] records build {time.perf_counter() - t0:.2f}s "
+        f"@{pods}x{nodes}")
+    cfg = EngineConfig(mode="fast")
+    ds = DeviceSnapshot(cfg)
+    t0 = time.perf_counter()
+    ds.full_load(nodes_r, pods_r, running_r)
+    log(f"  full_load {time.perf_counter() - t0:.2f}s")
+    engine = Engine(cfg)
+    iters = max(10, args.iters // 5)
+    try:
+        # Cold reference: the same packed program the headline bench
+        # times, on this lineage's snapshot.
+        fn = _prep(engine, ds.snap, "solve")
+        cold = bench_fn(fn, iters, label="warm-coldref")
+        emit(f"solve_cold_ref_ms_{pods}x{nodes}", cold,
+             {"mode": "fast", "direction": "lower"})
+        t0 = time.perf_counter()
+        engine.solve_warm(ds)  # tableau build + warm-program compile
+        log(f"  warm-path first run (cold tableau build) "
+            f"{time.perf_counter() - t0:.1f}s")
+        P = len(pods_r)
+        for frac in (0.001, 0.01, 0.1):
+            k = max(1, min(P, int(round(frac * P))))
+            rngc = np.random.default_rng(int(frac * 1e6) + 17)
+
+            def one_cycle(k=k, rngc=rngc):
+                picks = rngc.choice(P, size=k, replace=False)
+                ups = []
+                for i in picks:
+                    rec = pods_r[int(i)]
+                    rec["observed_avail"] = float(rngc.uniform(0.3, 1.0))
+                    ups.append(rec)
+                ds.apply(upsert_pods=ups)
+                return engine.solve_warm_async(ds).result().assignment
+
+            warm_before = ds.warm_solves
+            warmup = 3
+            stats = bench_fn(one_cycle, iters, warmup=warmup,
+                             label=f"warm-{frac:g}")
+            pct = ("%g" % (frac * 100)).replace(".", "p")
+            # bench_fn's warmup cycles also warm-solve: count them so
+            # even ONE cold fallback inside the timed loop is reported.
+            warm_got = ds.warm_solves - warm_before
+            if warm_got < iters + warmup:
+                log(f"  WARNING: {iters + warmup - warm_got} cold "
+                    "fallbacks inside the churn loop "
+                    f"({ds.warm_cold_reasons[-3:]})")
+            emit(f"solve_warm_ms_{pct}pct_{pods}x{nodes}", stats,
+                 {"mode": "fast", "direction": "lower",
+                  "churn_pods": k,
+                  "dirty_rows": list(ds.last_warm_rows),
+                  "solve_warm_ms_p50": round(stats["p50"] * 1e3, 3),
+                  "solve_warm_ms_p99": round(stats["p99"] * 1e3, 3),
+                  "cold_ref_p50_ms": round(cold["p50"] * 1e3, 3),
+                  "warm_speedup_p50": round(
+                      cold["p50"] / max(stats["p50"], 1e-9), 2)})
+    finally:
+        engine.close()
+
+
 def bench_divergence(args):
     """Fast-vs-parity agreement as NUMBERS per round (round-2 verdict
     next-step #2): identical-placement rate, placed delta, per-seed
@@ -1395,6 +1478,7 @@ BENCHES = {
     "robustness": bench_robustness,
     "sim": bench_sim,
     "explain": bench_explain,
+    "warm": bench_warm,
     # headline runs last so the final stdout line is the headline metric
     # (parity mode last within it — the stock-semantics north-star claim)
     "headline": bench_headline,

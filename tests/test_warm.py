@@ -1,0 +1,381 @@
+"""Warm-start twin parity (ROADMAP item 3, ISSUE 11): the engine warm
+path — carried device-resident tableau + dirty-row refresh — must place
+BITWISE-identically to a cold solve of the same snapshot, every cycle,
+under value churn, row reorders, vocab growth (cold fallback), bucket
+growth (rebuild -> cold fallback), preemption rounds, and gang
+admission. Plus the lifecycle contract: a warm handle never survives a
+failed host cycle or a move to a different lineage."""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+import pytest
+
+from tpusched import Engine, EngineConfig
+from tpusched.device_state import DeviceSnapshot
+from tpusched.divergence import warm_audit, warm_churn_stream
+from tpusched.host import FakeApiServer, HostScheduler, build_synthetic_cluster
+from tpusched.pipeline import warm_cycle_stream
+
+
+@pytest.fixture(scope="module")
+def fast_engine():
+    eng = Engine(EngineConfig(mode="fast"))
+    yield eng
+    eng.close()
+
+
+def _twin(engine: Engine, ds: DeviceSnapshot, context: str = ""):
+    """One warm + one cold solve of the same lineage state; byte-compare
+    THE contract arrays (placements, scores, evictions)."""
+    warm = engine.solve_warm(ds)
+    cold = engine.solve(ds.snap)
+    np.testing.assert_array_equal(warm.assignment, cold.assignment,
+                                  err_msg=f"assignment diverged {context}")
+    np.testing.assert_array_equal(
+        np.asarray(warm.chosen_score), np.asarray(cold.chosen_score),
+        err_msg=f"chosen_score diverged {context}")
+    np.testing.assert_array_equal(warm.evicted, cold.evicted,
+                                  err_msg=f"evicted diverged {context}")
+    return warm, cold
+
+
+def _nosig_records(rng, n_pods=22, n_nodes=7, n_running=6):
+    """Constraint-rich but SIGNATURE-FREE records (taints, tolerations,
+    selectors, preferred affinity, cordon, gangs, PDBs — everything the
+    static tableau caches except pairwise sigs), so the fast-mode S==0
+    program keeps the 50-cycle twin's compile budget small."""
+    from tpusched.snapshot import (NodeSelectorTerm, MatchExpression,
+                                   PreferredTerm, Toleration)
+
+    nodes = []
+    for i in range(n_nodes):
+        nodes.append(dict(
+            name=f"n{i:02d}",
+            allocatable={"cpu": 8000.0, "memory": float(32 << 30)},
+            labels={"zone": "abc"[i % 3], "disktype": "ssd" if i % 2 else "hdd"},
+            taints=([("dedicated", "batch", "NoSchedule")] if i == 0 else
+                    [("maint", "true", "PreferNoSchedule")] if i == 1 else []),
+            unschedulable=bool(i == n_nodes - 1),
+        ))
+    pods = []
+    for i in range(n_pods):
+        kw = dict(
+            name=f"p{i:02d}",
+            requests={"cpu": float(rng.integers(100, 900)),
+                      "memory": float(rng.integers(1 << 28, 1 << 30))},
+            priority=float(rng.integers(0, 100)),
+            slo_target=float(rng.choice([0.0, 0.9])),
+            observed_avail=float(rng.uniform(0.5, 1.0)),
+            labels={"app": ["web", "db", "cache"][i % 3]},
+        )
+        if i % 4 == 0:
+            kw["node_selector"] = {"disktype": "ssd"}
+        if i % 5 == 0:
+            kw["tolerations"] = [Toleration("dedicated", "Equal", "batch",
+                                            "NoSchedule")]
+        if i % 3 == 0:
+            kw["preferred_terms"] = [PreferredTerm(
+                weight=2.0,
+                term=NodeSelectorTerm(
+                    (MatchExpression("zone", "In", ("a", "b")),)),
+            )]
+        if i >= n_pods - 4:
+            kw["pod_group"] = "gang-a"
+            kw["pod_group_min_member"] = 2
+        pods.append(kw)
+    running = [
+        dict(name=f"r{i:02d}", node=f"n{i % n_nodes:02d}",
+             requests={"cpu": 600.0, "memory": float(1 << 29)},
+             priority=float(i), slack=0.1 * i,
+             labels={"app": "db" if i % 2 else "web"},
+             **({"pdb_group": "pdb-a", "pdb_disruptions_allowed": 1}
+                if i < 2 else {}))
+        for i in range(n_running)
+    ]
+    return nodes, pods, running
+
+
+def test_warm_twin_parity_50_cycles_with_cold_fallbacks(fast_engine):
+    """THE acceptance pin: >= 50 consecutive delta cycles, warm ==
+    cold byte-identical at every one — through value churn, pod
+    add/remove reorders, running removals, cordon toggles, AND a forced
+    row-bucket growth mid-run that must fall back to a cold solve and
+    then warm right back up."""
+    rng = np.random.default_rng(42)
+    nodes, pods, running = _nosig_records(rng)
+    ds = DeviceSnapshot(fast_engine.config)
+    ds.full_load(nodes, pods, running)
+    cycles = 0
+    for cyc, delta in enumerate(warm_churn_stream(
+            rng, nodes, pods, running, 50, churn_frac=0.15,
+            structural_every=6)):
+        if cyc == 25:
+            # Burst the pod row bucket: rebuild (bigger buckets) ->
+            # the next warm solve MUST take the cold path.
+            extra = [dict(name=f"burst-{j:03d}", requests={"cpu": 20.0},
+                          observed_avail=1.0)
+                     for j in range(ds.meta.buckets.pods - len(pods) + 1)]
+            pods.extend(extra)
+            stats = ds.apply(upsert_pods=extra)
+            assert stats.path == "rebuild" and stats.reason == "row_bucket"
+        ds.apply(**delta)
+        _twin(fast_engine, ds, f"at cycle {cyc}")
+        cycles += 1
+    assert cycles == 50
+    # Cold only at the start (full_load) and the forced bucket growth;
+    # everything else rode the carried tableau.
+    assert "row_bucket" in ds.warm_cold_reasons
+    assert ds.cold_solves == 2, ds.warm_cold_reasons
+    assert ds.warm_solves == 48
+
+
+def test_warm_parity_pairwise_sigs(fast_engine):
+    """Signature-involved program (spread + inter-pod affinity +
+    symmetric anti): the tableau's sig_match/member_sat columns refresh
+    must keep the validation fixpoint byte-identical."""
+    from tpusched.synth import make_cluster
+
+    rng = np.random.default_rng(7)
+    nodes, pods, running = make_cluster(
+        rng, 20, 6, as_records=True, spread_frac=0.4, interpod_frac=0.4,
+        run_anti_frac=0.2, namespace_count=2,
+    )
+    nodes, pods, running = list(nodes), list(pods), list(running)
+    ds = DeviceSnapshot(fast_engine.config)
+    ds.full_load(nodes, pods, running)
+    for cyc, delta in enumerate(warm_churn_stream(
+            rng, nodes, pods, running, 10, churn_frac=0.2,
+            structural_every=3)):
+        ds.apply(**delta)
+        _twin(fast_engine, ds, f"(sigs) at cycle {cyc}")
+    assert ds.warm_solves >= 8
+
+
+def test_warm_parity_preemption_and_gangs():
+    """Preemption rounds + gang admission on the warm path: evictions,
+    PDB budgets, and the all-or-nothing Permit gate must all ride the
+    carried tableau byte-identically."""
+    from tpusched.synth import make_cluster
+
+    cfg = EngineConfig(mode="fast", preemption=True)
+    eng = Engine(cfg)
+    try:
+        rng = np.random.default_rng(11)
+        nodes, pods, running = make_cluster(
+            rng, 18, 5, as_records=True, initial_utilization=0.8,
+            n_running_per_node=3, pdb_frac=0.3, gang_frac=0.25,
+            gang_size=2, tight_utilization=True,
+        )
+        nodes, pods, running = list(nodes), list(pods), list(running)
+        ds = DeviceSnapshot(cfg)
+        ds.full_load(nodes, pods, running)
+        evicted_any = False
+        for cyc, delta in enumerate(warm_churn_stream(
+                rng, nodes, pods, running, 8, churn_frac=0.25,
+                structural_every=4)):
+            ds.apply(**delta)
+            warm, _ = _twin(eng, ds, f"(preempt) at cycle {cyc}")
+            evicted_any = evicted_any or bool(warm.evicted.any())
+        assert ds.warm_solves >= 6
+        # The config is near-full: preemption must actually have fired
+        # somewhere in the run for this test to mean anything.
+        assert evicted_any
+    finally:
+        eng.close()
+
+
+def test_pressure_cross_changes_order_without_dirtying_the_row():
+    """The issue's dirty-set edge case, resolved by design: pod Y's
+    fate changes because pod X's pressure crossed above it (pop order
+    and preemption priority are RELATIVE) while no delta ever touches
+    Y. The warm path recomputes every pressure-dependent quantity fresh
+    from the snapshot, so Y's tableau row stays clean AND placements
+    still match cold exactly."""
+    cfg = EngineConfig(mode="fast", preemption=True)
+    eng = Engine(cfg)
+    try:
+        nodes = [dict(name="n0", allocatable={"cpu": 1000.0})]
+        # One slot's worth of capacity: whoever pops first wins it.
+        pods = [
+            dict(name="px", requests={"cpu": 900.0}, priority=10.0,
+                 slo_target=0.9, observed_avail=0.95),
+            dict(name="py", requests={"cpu": 900.0}, priority=10.5,
+                 slo_target=0.9, observed_avail=0.95),
+        ]
+        running = [dict(name="r0", node="n0",
+                        requests={"cpu": 50.0}, priority=0.0, slack=0.5)]
+        ds = DeviceSnapshot(cfg)
+        ds.full_load(nodes, pods, running)
+        w0, _ = _twin(eng, ds, "(pre-cross)")
+        meta = ds.meta
+        iy = meta.pod_names.index("py")
+        ix = meta.pod_names.index("px")
+        assert w0.assignment[iy] >= 0 and w0.assignment[ix] < 0
+        # Crash px's availability: its QoS pressure boost now outranks
+        # py. The delta touches ONLY px.
+        pods[0]["observed_avail"] = 0.1
+        ds.apply(upsert_pods=[pods[0]])
+        w1, _ = _twin(eng, ds, "(post-cross)")
+        assert w1.assignment[ix] >= 0 and w1.assignment[iy] < 0
+        # py's tableau row was never dirtied — only px churned.
+        assert ds.last_warm_rows[0] == 1
+        assert ds.warm_solves >= 1
+    finally:
+        eng.close()
+
+
+def test_cordon_invalidates_the_node_column(fast_engine):
+    """kubectl cordon arrives as a node upsert: the warm path must
+    recompute that node's COLUMN (static mask holds the schedulable
+    bit) so no new pod lands there — byte-identical to cold."""
+    rng = np.random.default_rng(3)
+    nodes, pods, running = _nosig_records(rng, n_pods=10, n_nodes=4,
+                                          n_running=3)
+    for n in nodes:
+        n["unschedulable"] = False
+    ds = DeviceSnapshot(fast_engine.config)
+    ds.full_load(nodes, pods, running)
+    w0, _ = _twin(fast_engine, ds, "(pre-cordon)")
+    # Cordon the node the solver actually favored, so placements must
+    # provably move off it.
+    placed = w0.assignment[w0.assignment >= 0]
+    assert placed.size, "need placements to displace"
+    target = int(np.bincount(placed).argmax())
+    target_name = ds.meta.node_names[target]
+    cordon_rec = next(n for n in nodes if n["name"] == target_name)
+    cordon_rec["unschedulable"] = True
+    ds.apply(upsert_nodes=[cordon_rec])
+    w1, _ = _twin(fast_engine, ds, "(post-cordon)")
+    assert not (w1.assignment == target).any()
+    assert ds.last_warm_rows[1] >= 1  # the node column went dirty
+
+
+def test_warm_cycle_stream_matches_cold(fast_engine):
+    """pipeline.warm_cycle_stream (apply(k+1) overlapped with fetch(k))
+    yields the same placements as a cold solve per cycle on a twin
+    lineage fed the identical deltas."""
+    rng = np.random.default_rng(9)
+    nodes, pods, running = _nosig_records(rng, n_pods=12, n_nodes=5,
+                                          n_running=3)
+    ds_warm = DeviceSnapshot(fast_engine.config)
+    ds_warm.full_load(nodes, pods, running)
+    ds_cold = DeviceSnapshot(fast_engine.config)
+    ds_cold.full_load(nodes, pods, running)
+    deltas = [copy.deepcopy(d) for d in warm_churn_stream(
+        rng, nodes, pods, running, 6, churn_frac=0.2, structural_every=3)]
+    outs = list(warm_cycle_stream(fast_engine, ds_warm,
+                                  copy.deepcopy(deltas)))
+    assert len(outs) == 6
+    for cyc, (stats, res) in enumerate(outs):
+        ds_cold.apply(**deltas[cyc])
+        cold = fast_engine.solve(ds_cold.snap)
+        np.testing.assert_array_equal(res.assignment, cold.assignment,
+                                      err_msg=f"stream cycle {cyc}")
+    assert ds_warm.warm_solves >= 5
+
+
+def test_warm_handle_does_not_survive_lineage_moves(fast_engine):
+    """A promoted replica (or any failover) adopting another lineage's
+    warm handle must NOT be trusted: the engine's lineage token check
+    forces a cold solve, and parity still holds."""
+    rng = np.random.default_rng(5)
+    nodes, pods, running = _nosig_records(rng, n_pods=10, n_nodes=4,
+                                          n_running=3)
+    ds_a = DeviceSnapshot(fast_engine.config)
+    ds_a.full_load(nodes, pods, running)
+    ds_b = DeviceSnapshot(fast_engine.config)
+    ds_b.full_load(nodes, pods, running)
+    fast_engine.solve_warm(ds_a)
+    fast_engine.solve_warm(ds_b)
+    pods[0]["observed_avail"] = 0.2
+    ds_b.apply(upsert_pods=[pods[0]])
+    # Simulated promotion hand-off: lineage B inherits A's handle.
+    ds_b.warm_state = ds_a.warm_state
+    _twin(fast_engine, ds_b, "(foreign handle)")
+    assert ds_b.warm_cold_reasons[-1] == "lineage_mismatch"
+    # And a different ENGINE cannot consume this engine's tableau.
+    eng2 = Engine(EngineConfig(mode="fast"))
+    try:
+        pods[1]["observed_avail"] = 0.3
+        ds_b.apply(upsert_pods=[pods[1]])
+        res = eng2.solve_warm(ds_b)
+        cold = eng2.solve(ds_b.snap)
+        np.testing.assert_array_equal(res.assignment, cold.assignment)
+        assert ds_b.warm_cold_reasons[-1] == "engine_mismatch"
+    finally:
+        eng2.close()
+
+
+def test_host_warm_matches_plain_host_and_invalidates_on_failure(
+        fast_engine):
+    """HostScheduler(warm=True) twin: identical final binds to the
+    decode-every-cycle host over the same seeded cluster; a failed
+    cycle drops the lineage (drain/restore unwind) and the next cycle
+    full-loads cold, still converging to the same end state."""
+    def build(seed=17):
+        api = FakeApiServer()
+        rng = np.random.default_rng(seed)
+        build_synthetic_cluster(api, rng, 30, 5)
+        # Pin availability: lifecycle accounting decays with wall time,
+        # which would make the two runs' inputs racy.
+        avail_rng = np.random.default_rng(99)
+        for i in range(30):
+            api.set_observed_availability(
+                f"pod-{i}", float(avail_rng.uniform(0.4, 1.0)))
+        return api
+
+    api_plain = build()
+    host_plain = HostScheduler(api_plain, fast_engine.config,
+                               engine=fast_engine, batch_size=12)
+    try:
+        host_plain.run_until_idle(max_cycles=20)
+    finally:
+        host_plain.close()
+    want = {p["name"]: p["node"] for p in api_plain.bound_pods()}
+
+    api_warm = build()
+    host_warm = HostScheduler(api_warm, fast_engine.config,
+                              engine=fast_engine, batch_size=12,
+                              warm=True)
+    try:
+        host_warm.cycle()
+        ds0 = host_warm._warm_ds
+        assert ds0 is not None and ds0.cold_solves == 1
+        # Wedge the next cycle: the unwind must restore the hints and
+        # invalidate the lineage.
+        real = fast_engine.solve_warm_async
+        calls = {"n": 0}
+
+        def boom(ds):
+            calls["n"] += 1
+            raise RuntimeError("injected warm failure")
+
+        fast_engine.solve_warm_async = boom
+        try:
+            with pytest.raises(RuntimeError, match="injected"):
+                host_warm.cycle()
+        finally:
+            fast_engine.solve_warm_async = real
+        assert calls["n"] == 1
+        assert host_warm._warm_ds is None  # lineage dropped
+        assert ds0.warm_state is None      # handle invalidated too
+        host_warm.run_until_idle(max_cycles=20)
+    finally:
+        host_warm.close()
+    got = {p["name"]: p["node"] for p in api_warm.bound_pods()}
+    assert got == want
+
+
+def test_warm_audit_smoke(fast_engine):
+    """The --warm-audit debugging tool reports clean twin runs as
+    diverged_cycle == -1 (and would carry the offending pod rows if the
+    parity contract ever tripped)."""
+    report = warm_audit(cycles=6, preset="plain", n_pods=16, n_nodes=5,
+                        churn_frac=0.2, engine=fast_engine)
+    assert report["diverged_cycle"] == -1
+    assert report["bad_pods"] == []
+    assert report["cycles"] == 6
+    assert report["warm_solves"] >= 4

@@ -1,6 +1,17 @@
 """Round-5 scratch: per-component device cost of the fast preemption
 round at the headline shape, measured as fori_loop slope (amortizes the
-axon-tunnel fetch RTT out)."""
+axon-tunnel fetch RTT out).
+
+Round 16 (warm-start, ROADMAP item 3): `--warm [churn ...]` profiles the
+warm path instead — per-cycle dirty row counts (pods / node columns /
+member columns) and warm vs cold solve walls at each churn level, the
+numbers a source edit used to be required for (the retained _tableau_nv
+slope above serves the same purpose for the preemption tableau).
+
+    python tools/prof_components.py 10000 5000
+    python tools/prof_components.py 10000 5000 --warm
+    PROF_CPU=1 python tools/prof_components.py 2000 1000 --warm
+"""
 import os
 import sys
 
@@ -50,9 +61,86 @@ def slope(label, make_body, used0, reps=3):
           f"HI={outs[HI]*1e3:.1f}ms)")
 
 
+def prof_warm(pods: int, nodes: int,
+              churns=(0.001, 0.01, 0.1), cycles: int = 5):
+    """Per-cycle warm-path profile: dirty row counts + warm solve wall
+    vs the cold packed solve on the same lineage."""
+    from tpusched.device_state import DeviceSnapshot
+    from tpusched.engine import Engine
+    from tpusched.synth import make_cluster
+
+    rng = np.random.default_rng(11)
+    nodes_r, pods_r, running_r = make_cluster(
+        rng, pods, nodes, n_running_per_node=1, with_qos=True,
+        as_records=True,
+    )
+    cfg = EngineConfig(mode="fast")
+    ds = DeviceSnapshot(cfg)
+    ds.full_load(nodes_r, pods_r, running_r)
+    eng = Engine(cfg)
+    try:
+        t0 = time.perf_counter()
+        np.asarray(eng._solve_packed_jit(ds.snap))
+        print(f"cold compile+first-run {time.perf_counter() - t0:.1f}s")
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(eng._solve_packed_jit(ds.snap))
+            ts.append(time.perf_counter() - t0)
+        cold_ms = min(ts) * 1e3
+        t0 = time.perf_counter()
+        eng.solve_warm(ds)
+        print(f"warm first run (cold tableau build) "
+              f"{time.perf_counter() - t0:.1f}s; cold solve "
+              f"{cold_ms:.1f}ms")
+        P = len(pods_r)
+        for frac in churns:
+            k = max(1, min(P, int(round(frac * P))))
+            rngc = np.random.default_rng(int(frac * 1e6) + 3)
+            print(f"-- churn {frac:g} ({k} pods/cycle)")
+            for cyc in range(cycles):
+                picks = rngc.choice(P, size=k, replace=False)
+                ups = []
+                for i in picks:
+                    rec = pods_r[int(i)]
+                    rec["observed_avail"] = float(rngc.uniform(0.3, 1.0))
+                    ups.append(rec)
+                t0 = time.perf_counter()
+                ds.apply(upsert_pods=ups)
+                apply_ms = (time.perf_counter() - t0) * 1e3
+                warm_before = ds.warm_solves
+                t0 = time.perf_counter()
+                eng.solve_warm(ds)
+                solve_ms = (time.perf_counter() - t0) * 1e3
+                dp, dn, dm = ds.last_warm_rows
+                path = "warm" if ds.warm_solves > warm_before else "cold"
+                print(f"  cycle {cyc}: rows pods={dp} nodes={dn} "
+                      f"members={dm} apply={apply_ms:.1f}ms "
+                      f"solve={solve_ms:.1f}ms ({path}; cold ref "
+                      f"{cold_ms:.1f}ms)")
+        print(f"paths: warm={ds.warm_solves} cold={ds.cold_solves} "
+              f"reasons={ds.warm_cold_reasons}")
+    finally:
+        eng.close()
+
+
 def main():
-    pods = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
-    nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 5_000
+    argv = [a for a in sys.argv[1:] if a != "--warm"]
+    warm = len(argv) != len(sys.argv) - 1
+    # Integer operands are the shape; float operands (only meaningful
+    # with --warm) override the churn sweep levels.
+    ints, churns = [], []
+    for a in argv:
+        try:
+            ints.append(int(a))
+        except ValueError:
+            churns.append(float(a))
+    pods = ints[0] if len(ints) > 0 else 10_000
+    nodes = ints[1] if len(ints) > 1 else 5_000
+    if warm:
+        prof_warm(pods, nodes,
+                  churns=tuple(churns) or (0.001, 0.01, 0.1))
+        return
     rng = np.random.default_rng(7)
     snap, _ = config5_preemption(rng, n_pods=pods, n_nodes=nodes)
     cfg = EngineConfig(mode="fast", preemption=True)

@@ -54,6 +54,7 @@ import numpy as np
 from tpusched import trace as tracing
 from tpusched.config import Buckets, EngineConfig
 from tpusched.kernels.assign import permute_rows, scatter_rows
+from tpusched.qos import pressure_of
 from tpusched.snapshot import (
     ClusterSnapshot,
     SnapshotBuilder,
@@ -79,6 +80,25 @@ class ApplyStats:
     h2d_bytes: int = 0        # bytes actually shipped host->device
     rows_scattered: int = 0   # churned+pad rows written across groups
     reordered: bool = False   # a permutation gather ran
+
+
+@dataclasses.dataclass
+class WarmDelta:
+    """One warm solve's dirty work, derived by DeviceSnapshot.warm_delta
+    from everything applied since the last committed tableau (ROADMAP
+    item 3). Index lists are positions in the CURRENT name-sorted row
+    order; perms map tableau-order rows to current order (None = order
+    unchanged). needs_cold forces a full tableau rebuild — vocabulary
+    growth, a rebuild, or a never-built lineage."""
+
+    needs_cold: bool = False
+    reason: str = ""
+    dirty_pods: "list[int] | None" = None     # pod tableau rows
+    dirty_nodes: "list[int] | None" = None    # node tableau columns
+    dirty_members: "list[int] | None" = None  # [running | pod] columns
+    pod_perm: "np.ndarray | None" = None      # int32 [pod bucket]
+    node_perm: "np.ndarray | None" = None     # int32 [node bucket]
+    member_perm: "np.ndarray | None" = None   # int32 [run+pod buckets]
 
 
 class _NeedsRebuild(Exception):
@@ -155,6 +175,26 @@ class DeviceSnapshot:
         self.rebuild_reasons: list[str] = []
         self.h2d_bytes_total = 0
         self.h2d_bytes_last = 0
+        # Warm-start residency (ROADMAP item 3): the carried tableau
+        # handle lives HERE, next to the device snapshot it was built
+        # from, so its lifetime is the lineage's. The lineage token is
+        # the identity a handle is pinned to — a handle surviving a
+        # failover/restore onto a DIFFERENT DeviceSnapshot fails the
+        # engine's `is` check and takes the cold path.
+        self.warm_lineage: object = object()
+        self.warm_state = None            # engine.WarmState (opaque here)
+        self._warm_orders = None          # (node, pod, run) orders at sync
+        self._warm_vocab = None           # (n_atoms, n_sigs) at sync
+        self._warm_pressure = None        # np [pod bucket] pressure at sync
+        self._warm_dirty_nodes: set[str] = set()
+        self._warm_dirty_pods: set[str] = set()
+        self._warm_dirty_runs: set[str] = set()
+        self._warm_cold_reason: "str | None" = "never_built"
+        # Warm-path accounting (the bench/prof/test hooks).
+        self.warm_solves = 0
+        self.cold_solves = 0
+        self.warm_cold_reasons: list[str] = []
+        self.last_warm_rows = (0, 0, 0)   # (pod, node, member) dirty rows
 
     # -- views --------------------------------------------------------------
 
@@ -265,6 +305,11 @@ class DeviceSnapshot:
         self._run_anti = {}
         self._refresh_prev_maps()
         self._device = jax.device_put(snap_np)
+        # A rebuild replaces every device array: any carried warm
+        # tableau is built on the OLD arrays (and possibly old buckets/
+        # vocab) — drop it so the next warm solve goes cold and
+        # re-anchors on this state.
+        self.invalidate_warm(reason)
         nbytes = _tree_nbytes(snap_np)
         self.full_uploads += 1
         if reason != "full_load":
@@ -680,6 +725,18 @@ class DeviceSnapshot:
         self._node_order = new_node_order
         self._pod_order = new_pod_order
         self._run_order = new_run_order
+        # Warm-start dirty accumulation (ROADMAP item 3): every name
+        # whose row this apply re-encoded (including used-resummed
+        # nodes) goes stale in the carried tableau. Vacated/pad rows
+        # and reorders are derived from the ORDER diff at warm_delta()
+        # time, so multiple applies between solves compose. Only while
+        # a tableau is actually committed: lineages that never warm-
+        # solve (the sidecar's DeviceSessions today) must not grow
+        # these sets without bound across a long serving life.
+        if self._warm_orders is not None:
+            self._warm_dirty_nodes |= node_churn
+            self._warm_dirty_pods |= pod_churn
+            self._warm_dirty_runs |= run_churn
         self._meta = SnapshotMeta(
             node_names=list(new_node_order),
             pod_names=list(new_pod_order),
@@ -700,6 +757,106 @@ class DeviceSnapshot:
             reordered=(node_perm is not None or pod_perm is not None
                        or run_perm is not None),
         )
+
+    # -- warm-start residency (ROADMAP item 3) ------------------------------
+
+    def invalidate_warm(self, reason: str) -> None:
+        """Drop the carried tableau: the next warm solve goes cold (and
+        re-anchors the lineage). Called on every rebuild, by the host on
+        a failed cycle, and available to any owner whose fetch errored
+        after dispatch (the conservative reset)."""
+        self.warm_state = None
+        self._warm_cold_reason = reason
+        self._warm_orders = None
+        self._warm_dirty_nodes = set()
+        self._warm_dirty_pods = set()
+        self._warm_dirty_runs = set()
+
+    def warm_delta(self) -> WarmDelta:
+        """Derive the dirty work accumulated since the last committed
+        tableau: churned rows at their CURRENT name-sorted positions,
+        rows vacated by shrinkage (now padding), one reorder perm per
+        axis (tableau order -> current order, exactly the permutation
+        discipline apply() uses for the snapshot arrays), and — the QoS
+        temporal-locality guard — pods whose pressure drifted since the
+        tableau was committed, found by one vectorized qos.pressure_of
+        compare. The pressure compare is DEFENSIVE: the engine
+        recomputes every pressure-dependent quantity (plugin weights,
+        pop order, preemption priorities) fresh from the snapshot each
+        solve, so a pressure change alone never changes tableau cells;
+        the compare catches out-of-band mirror edits that bypassed
+        apply(). Vocabulary growth (atoms/sigs appended by a delta)
+        forces needs_cold: new vocab rows change tableau cells of
+        UNCHURNED rows, which the row model cannot express."""
+        if self._warm_cold_reason is not None:
+            return WarmDelta(needs_cold=True, reason=self._warm_cold_reason)
+        st = self._state
+        bk = st.buckets
+        if (len(st.interner.atoms), len(st.interner.sigs)) != self._warm_vocab:
+            return WarmDelta(needs_cold=True, reason="vocab_growth")
+        o_nodes, o_pods, o_runs = self._warm_orders
+        node_perm, node_pads = self._perm(o_nodes, self._node_order,
+                                          bk.nodes)
+        pod_perm, pod_pads = self._perm(o_pods, self._pod_order, bk.pods)
+        run_perm, run_pads = self._perm(o_runs, self._run_order,
+                                        bk.running_pods)
+        pod_index = {nm: i for i, nm in enumerate(self._pod_order)}
+        run_index = {nm: i for i, nm in enumerate(self._run_order)}
+        d_nodes = {st.node_index[nm] for nm in self._warm_dirty_nodes
+                   if nm in st.node_index} | set(node_pads)
+        d_pods = {pod_index[nm] for nm in self._warm_dirty_pods
+                  if nm in pod_index} | set(pod_pads)
+        d_runs = {run_index[nm] for nm in self._warm_dirty_runs
+                  if nm in run_index} | set(run_pads)
+        cur = np.asarray(pressure_of(st.pods_np.slo_target,
+                                     st.pods_np.observed_avail))
+        prev = self._warm_pressure
+        prev_at_cur = prev[pod_perm] if pod_perm is not None else prev
+        drift = np.nonzero((cur != prev_at_cur) & st.pods_np.valid)[0]
+        d_pods |= {int(i) for i in drift}
+        # A pod is both a tableau ROW and a pairwise MEMBER column; a
+        # running pod is a member column only. Member axis layout is
+        # [running bucket | pod bucket] (kernels.pairwise).
+        d_members = {int(i) for i in d_runs} | {
+            bk.running_pods + int(i) for i in d_pods
+        }
+        member_perm = None
+        if run_perm is not None or pod_perm is not None:
+            rp = run_perm if run_perm is not None else np.arange(
+                bk.running_pods, dtype=np.int32)
+            pp = pod_perm if pod_perm is not None else np.arange(
+                bk.pods, dtype=np.int32)
+            member_perm = np.concatenate([rp, bk.running_pods + pp])
+        return WarmDelta(
+            dirty_pods=sorted(d_pods) or None,
+            dirty_nodes=sorted(d_nodes) or None,
+            dirty_members=sorted(d_members) or None,
+            pod_perm=pod_perm, node_perm=node_perm,
+            member_perm=member_perm,
+        )
+
+    def commit_warm(self, state, path: str, reason: str, rows) -> None:
+        """Engine callback at warm/cold dispatch time: store the new
+        handle and re-anchor the dirty accumulation on the snapshot
+        state the dispatched program reads."""
+        st = self._state
+        self.warm_state = state
+        self._warm_orders = (list(self._node_order),
+                             list(self._pod_order),
+                             list(self._run_order))
+        self._warm_vocab = (len(st.interner.atoms), len(st.interner.sigs))
+        self._warm_pressure = np.array(pressure_of(
+            st.pods_np.slo_target, st.pods_np.observed_avail))
+        self._warm_dirty_nodes = set()
+        self._warm_dirty_pods = set()
+        self._warm_dirty_runs = set()
+        self._warm_cold_reason = None
+        self.last_warm_rows = tuple(rows)
+        if path == "warm":
+            self.warm_solves += 1
+        else:
+            self.cold_solves += 1
+            self.warm_cold_reasons.append(reason)
 
     @staticmethod
     def _perm(old_order: list[str], new_order: list[str], bucket: int):

@@ -1,5 +1,5 @@
 """Fast-mode divergence measurement (VERDICT weak #7; SURVEY.md §7 hard
-part 1).
+part 1) and the warm-start twin audit (ROADMAP item 3).
 
 The north star demands "placement parity with stock kube-scheduler".
 Parity mode delivers it exactly (sequential scan == oracle, fuzz-tested
@@ -25,6 +25,14 @@ This module puts NUMBERS on the divergence: run both modes over seeded
 snapshots and report how often placements differ and by how much.
 
 CLI:  python -m tpusched.divergence [--preset mixed] [--seeds 10]
+      python -m tpusched.divergence --warm-audit 50 [--churn 0.05]
+
+--warm-audit N runs N delta cycles TWIN — every cycle solved once warm
+(carried tableau, dirty rows only) and once cold (full recompute) on the
+same device-resident lineage — and reports the first diverging cycle
+with the offending pod rows. The warm-start correctness contract is
+bitwise placement equality, so this is the debugging tool for when the
+twin-parity tests trip: exit code 1 on any divergence.
 """
 
 from __future__ import annotations
@@ -36,6 +44,7 @@ import json
 import numpy as np
 
 from tpusched.config import EngineConfig
+from tpusched.device_state import DeviceSnapshot
 from tpusched.engine import Engine
 from tpusched.oracle import validate_assignment
 from tpusched.synth import make_cluster
@@ -145,6 +154,133 @@ def measure(
     return out
 
 
+def warm_churn_stream(rng, nodes, pods, running, cycles: int,
+                      churn_frac: float = 0.05,
+                      structural_every: int = 5):
+    """Seeded delta-cycle generator for the warm audit (and bench churn
+    sweeps): mutates the record lists IN PLACE and yields
+    DeviceSnapshot.apply kwargs. Each cycle value-churns ~churn_frac of
+    the pending pods (observed availability / priority — the QoS
+    temporal-locality signal the warm path bets on) plus one node
+    (allocatable drift); every `structural_every`-th cycle additionally
+    exercises the structural paths: a pod add + remove (row reorder), a
+    running-pod removal (a completion), and a cordon toggle (the
+    all-residents column invalidation)."""
+    seq = 0
+    for cyc in range(cycles):
+        n_churn = max(1, int(round(churn_frac * len(pods))))
+        picks = rng.choice(len(pods), size=min(n_churn, len(pods)),
+                           replace=False)
+        up_pods = []
+        for i in picks:
+            rec = pods[int(i)]
+            rec["observed_avail"] = float(rng.uniform(0.3, 1.0))
+            if rng.random() < 0.3:
+                rec["priority"] = float(rng.integers(0, 1000))
+            up_pods.append(rec)
+        ni = int(rng.integers(len(nodes)))
+        nrec = nodes[ni]
+        alloc = dict(nrec.get("allocatable", {}))
+        if "cpu" in alloc:
+            alloc["cpu"] = float(max(1000.0, alloc["cpu"]
+                                     * float(rng.uniform(0.9, 1.1))))
+        nrec["allocatable"] = alloc
+        delta = dict(upsert_pods=up_pods, upsert_nodes=[nrec])
+        if structural_every and cyc % structural_every == structural_every - 1:
+            seq += 1
+            newp = dict(
+                name=f"warm-audit-{seq:04d}",
+                requests={"cpu": float(rng.integers(100, 800))},
+                priority=float(rng.integers(0, 1000)),
+                observed_avail=float(rng.uniform(0.5, 1.0)),
+                labels={"app": "web"},
+            )
+            pods.append(newp)
+            gone = pods.pop(int(rng.integers(len(pods) - 1)))
+            delta["upsert_pods"] = [
+                r for r in delta["upsert_pods"] if r["name"] != gone["name"]
+            ] + [newp]
+            delta["remove_pods"] = [gone["name"]]
+            if running:
+                done = running.pop(int(rng.integers(len(running))))
+                delta["remove_running"] = [done["name"]]
+            cn = int(rng.integers(len(nodes)))
+            crec = nodes[cn]
+            crec["unschedulable"] = not crec.get("unschedulable", False)
+            if crec["name"] != nrec["name"]:
+                delta["upsert_nodes"] = delta["upsert_nodes"] + [crec]
+        yield delta
+
+
+def warm_audit(
+    cycles: int = 50,
+    preset: str = "mixed",
+    n_pods: int = 80,
+    n_nodes: int = 16,
+    seed: int = 4000,
+    churn_frac: float = 0.05,
+    mode: str = "fast",
+    preemption: bool = False,
+    engine: "Engine | None" = None,
+) -> dict:
+    """Twin-run N delta cycles warm vs cold on ONE device-resident
+    lineage and report the first divergence (the --warm-audit debugging
+    tool the twin-parity contract needs when it trips). Every cycle:
+    apply a seeded churn delta, solve once through the engine warm path
+    (Engine.solve_warm: carried tableau + dirty rows), once cold
+    (Engine.solve: full recompute of the same arrays), and byte-compare
+    assignment / chosen_score / evicted. Returns a report dict:
+    diverged_cycle (-1 = clean), bad_pods [(row, name, warm_node,
+    cold_node)], and the lineage's warm/cold path counters."""
+    cfg = EngineConfig(mode=mode, preemption=preemption)
+    rng = np.random.default_rng(seed)
+    nodes, pods, running = make_cluster(
+        rng, n_pods, n_nodes, as_records=True, **PRESETS[preset]
+    )
+    nodes, pods, running = list(nodes), list(pods), list(running)
+    ds = DeviceSnapshot(cfg)
+    ds.full_load(nodes, pods, running)
+    eng = engine if engine is not None else Engine(cfg)
+    report = dict(cycles=0, diverged_cycle=-1, bad_pods=[],
+                  preset=preset, churn_frac=churn_frac, mode=mode)
+    try:
+        for cyc, delta in enumerate(warm_churn_stream(
+                rng, nodes, pods, running, cycles, churn_frac)):
+            ds.apply(**delta)
+            warm = eng.solve_warm(ds)
+            cold = eng.solve(ds.snap)
+            report["cycles"] = cyc + 1
+            same = (
+                np.array_equal(warm.assignment, cold.assignment)
+                and np.array_equal(np.asarray(warm.chosen_score),
+                                   np.asarray(cold.chosen_score))
+                and np.array_equal(warm.evicted, cold.evicted)
+            )
+            if not same:
+                bad = np.nonzero(warm.assignment != cold.assignment)[0]
+                names = ds.meta.pod_names
+                report["diverged_cycle"] = cyc
+                report["bad_pods"] = [
+                    (int(i), names[int(i)] if int(i) < len(names) else "<pad>",
+                     int(warm.assignment[int(i)]),
+                     int(cold.assignment[int(i)]))
+                    for i in bad[:32]
+                ]
+                if not len(bad):
+                    report["bad_pods"] = [
+                        (-1, "<score-or-eviction-divergence>", -1, -1)
+                    ]
+                break
+    finally:
+        if engine is None:
+            eng.close()
+    report.update(
+        warm_solves=ds.warm_solves, cold_solves=ds.cold_solves,
+        cold_reasons=ds.warm_cold_reasons,
+    )
+    return report
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", choices=sorted(PRESETS), default=None,
@@ -152,7 +288,25 @@ def main(argv=None) -> None:
     ap.add_argument("--seeds", type=int, default=10)
     ap.add_argument("--pods", type=int, default=80)
     ap.add_argument("--nodes", type=int, default=16)
+    ap.add_argument("--warm-audit", type=int, default=0, metavar="N",
+                    help="run N warm-vs-cold twin delta cycles and "
+                         "report the first divergence (exit 1)")
+    ap.add_argument("--churn", type=float, default=0.05,
+                    help="warm-audit per-cycle churned-pod fraction")
+    ap.add_argument("--seed", type=int, default=4000)
+    ap.add_argument("--preemption", action="store_true",
+                    help="warm-audit with the preemption program")
     args = ap.parse_args(argv)
+    if args.warm_audit:
+        report = warm_audit(
+            cycles=args.warm_audit, preset=args.preset or "mixed",
+            n_pods=args.pods, n_nodes=args.nodes, seed=args.seed,
+            churn_frac=args.churn, preemption=args.preemption,
+        )
+        print(json.dumps(report), flush=True)
+        if report["diverged_cycle"] >= 0:
+            raise SystemExit(1)
+        return
     presets = [args.preset] if args.preset else sorted(PRESETS)
     for p in presets:
         stats = measure(p, args.seeds, args.pods, args.nodes)

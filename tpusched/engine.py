@@ -26,8 +26,10 @@ from tpusched.config import EngineConfig
 from tpusched.faults import NO_FAULTS
 from tpusched.kernels import explain as kexplain
 from tpusched.kernels.assign import (_PREEMPT_MAX_ROUNDS,
-                                     EXPLAIN_AUCTION_STATS, score_batch,
-                                     solve_rounds, solve_sequential)
+                                     EXPLAIN_AUCTION_STATS, build_tableau,
+                                     finalize_static, refresh_tableau,
+                                     score_batch, solve_rounds,
+                                     solve_sequential)
 from tpusched.kernels.atoms import atom_sat
 from tpusched.kernels.pairwise import member_label_sat_t
 from tpusched.ring import ring_sig_counts
@@ -196,7 +198,7 @@ def _sat_tables(snap: ClusterSnapshot):
 
 
 def solve_core(cfg: EngineConfig, snap: ClusterSnapshot, mesh=None,
-               explain: bool = False):
+               explain: bool = False, static=None, member_sat_t=None):
     """Mode dispatch shared by Engine and tenants.solve_many: returns
     (assigned, chosen, used, order, commit_key, rounds, evicted) in
     either mode (parity synthesizes commit_key from pop order and
@@ -209,8 +211,16 @@ def solve_core(cfg: EngineConfig, snap: ClusterSnapshot, mesh=None,
     explain=True (decision provenance, round 12) appends one trailing
     tuple (rolled, evictor, evict_round, auction_stats) — see
     solve_rounds/solve_sequential. Placements are IDENTICAL either way
-    (the provenance arrays are pure observers; test-pinned)."""
-    node_sat_t, member_sat_t = _sat_tables(snap)
+    (the provenance arrays are pure observers; test-pinned).
+
+    static: optional precomputed StaticCtx (the warm path — ROADMAP
+    item 3): the sat-table + static-mask/score precompute is skipped and
+    `member_sat_t` (the tableau's, needed only by the ring-counts init)
+    must ride along."""
+    if static is None:
+        node_sat_t, member_sat_t = _sat_tables(snap)
+    else:
+        node_sat_t = None  # precompute skipped; solve paths take static
     init_counts = None
     if cfg.ring_counts and snap.sigs.key.shape[0]:
         P = snap.pods.valid.shape[0]
@@ -219,9 +229,11 @@ def solve_core(cfg: EngineConfig, snap: ClusterSnapshot, mesh=None,
         )
     if cfg.mode == "fast":
         return solve_rounds(cfg, snap, node_sat_t, member_sat_t,
-                            init_counts=init_counts, explain=explain)
+                            init_counts=init_counts, explain=explain,
+                            static=static)
     seq = solve_sequential(cfg, snap, node_sat_t, member_sat_t,
-                           init_counts=init_counts, explain=explain)
+                           init_counts=init_counts, explain=explain,
+                           static=static)
     if explain:
         a, c, u, o, ev, extras = seq
     else:
@@ -233,6 +245,35 @@ def solve_core(cfg: EngineConfig, snap: ClusterSnapshot, mesh=None,
     )
     base = (a, c, u, o, rank, jnp.int32(P), ev)
     return base + ((extras,) if explain else ())
+
+
+def _pack_solve(out):
+    """Flatten a solve_core output tuple into the ONE f32 result buffer
+    (layout authority: Engine.unpack). Shared by the plain, warm, and
+    cold-refresh packed programs so the packing cannot drift between
+    them. Indices are exact in f32 (< 2^24)."""
+    assigned, chosen, used, order, commit_key, rounds, ev = out
+    return jnp.concatenate([
+        assigned.astype(jnp.float32), chosen,
+        order.astype(jnp.float32), commit_key.astype(jnp.float32),
+        used.reshape(-1), ev.astype(jnp.float32),
+        rounds.astype(jnp.float32)[None],
+    ])
+
+
+@dataclasses.dataclass
+class WarmState:
+    """The carried-state handle of the warm path (ROADMAP item 3): one
+    lineage's device-resident WarmTableau plus the identity facts that
+    decide whether it may be trusted next cycle. Held by the owning
+    DeviceSnapshot (device_state.commit_warm) and consumed only by
+    Engine.solve_warm_async — reads of `.tableau` anywhere else are the
+    stale-tableau hazard tpuschedlint TPL011 guards."""
+
+    tableau: Any       # device kernels.assign.WarmTableau
+    lineage: Any       # DeviceSnapshot.warm_lineage token at build time
+    shapes: tuple      # snapshot leaf shapes the tableau was traced at
+    engine: Any        # the Engine whose programs built the tableau
 
 
 class Engine:
@@ -273,15 +314,8 @@ class Engine:
             # One flat f32 output = ONE device->host fetch. The transport
             # (axon tunnel here, gRPC in deployment) pays a fixed round
             # trip per fetched buffer, which dwarfs the payload cost —
-            # same lesson as SURVEY.md §7 hard part 6. Indices are exact
-            # in f32 (< 2^24).
-            assigned, chosen, used, order, commit_key, rounds, ev = _solve(snap)
-            return jnp.concatenate([
-                assigned.astype(jnp.float32), chosen,
-                order.astype(jnp.float32), commit_key.astype(jnp.float32),
-                used.reshape(-1), ev.astype(jnp.float32),
-                rounds.astype(jnp.float32)[None],
-            ])
+            # same lesson as SURVEY.md §7 hard part 6.
+            return _pack_solve(_solve(snap))
 
         def _score(snap: ClusterSnapshot):
             node_sat_t, member_sat_t = _sat_tables(snap)
@@ -317,6 +351,13 @@ class Engine:
         # pay neither trace time nor executable memory for them.
         self._explain_solve_jit = None
         self._explain_probe_jits: dict[int, Any] = {}
+        # Warm-start programs (ROADMAP item 3): compiled lazily on the
+        # first solve_warm_async call. ONE jit each — jax's shape-keyed
+        # cache specializes per (snapshot buckets, pow2-padded dirty
+        # sizes, perm presence), and the dirty sizes are pow2-bucketed
+        # so the compile set stays bounded.
+        self._warm_solve_jit = None
+        self._cold_refresh_jit = None
         # ONE background fetch worker: fetch order == dispatch order,
         # which fetch-driven transports (axon tunnel) rely on — two
         # concurrent D2H reads would race for the single execution
@@ -442,6 +483,127 @@ class Engine:
             return res
 
         return PendingFetch(unpack, self._submit_fetch(buf), t0)
+
+    # -- O(churn) warm-start solving (ROADMAP item 3) -----------------------
+
+    @staticmethod
+    def _pad_idx(idx) -> "np.ndarray | None":
+        """Pow2-pad a dirty index list by repeating the first index
+        (duplicate scatter writes carry identical recomputed content, so
+        order cannot matter) — bounded jit-shape set. None when empty,
+        so an all-clean axis skips its scatter at trace time."""
+        if idx is None or len(idx) == 0:
+            return None
+        n = len(idx)
+        cap = 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+        out = np.full(cap, idx[0], np.int32)
+        out[:n] = list(idx)
+        return out
+
+    @staticmethod
+    def _shape_key(snap: ClusterSnapshot) -> tuple:
+        return tuple(np.shape(leaf) for leaf in jax.tree.leaves(snap))
+
+    def _ensure_warm_jits(self) -> None:
+        if self._warm_solve_jit is not None:
+            return
+        cfg, mesh = self.config, self.mesh
+
+        def _cold(snap: ClusterSnapshot):
+            node_sat_t, member_sat_t = _sat_tables(snap)
+            tab = build_tableau(cfg, snap, node_sat_t, member_sat_t)
+            static = finalize_static(cfg, snap, tab)
+            out = solve_core(cfg, snap, mesh=mesh, static=static,
+                             member_sat_t=tab.member_sat_t)
+            return _pack_solve(out), tab
+
+        def _warm(snap: ClusterSnapshot, tab, dp, dn, dm, pperm, nperm,
+                  mperm):
+            tab = refresh_tableau(cfg, snap, tab, dirty_pods=dp,
+                                  dirty_nodes=dn, dirty_members=dm,
+                                  pod_perm=pperm, node_perm=nperm,
+                                  member_perm=mperm)
+            static = finalize_static(cfg, snap, tab)
+            out = solve_core(cfg, snap, mesh=mesh, static=static,
+                             member_sat_t=tab.member_sat_t)
+            return _pack_solve(out), tab
+
+        self._cold_refresh_jit = jax.jit(_cold)
+        self._warm_solve_jit = jax.jit(_warm)
+
+    def solve_warm_async(self, device) -> PendingFetch:
+        """Warm-start solve of a device-resident lineage (ROADMAP item
+        3): `device` is a tpusched.device_state.DeviceSnapshot. The
+        lineage's accumulated dirty state (device.warm_delta()) decides
+        the path:
+
+          * warm — the carried tableau is reordered + scatter-refreshed
+            for exactly the dirty pod rows / node columns / member
+            columns, then the normal solve runs against it. Per-pod QoS
+            weights, score normalizations, pop order, and all pair-state
+            counts are recomputed fresh from the CURRENT snapshot every
+            solve, so placements are bitwise-identical to a cold solve
+            (the twin-parity contract, pinned in tests/test_warm.py).
+          * cold — anything the row model cannot express (vocab/bucket
+            growth, a rebuild, a foreign or missing tableau) rebuilds
+            the tableau from scratch inside the same program; cost is
+            the plain solve's, and the lineage is warm again afterwards.
+
+        The handle is committed back into the DeviceSnapshot
+        (commit_warm) at DISPATCH time; a caller whose fetch later
+        fails should device.invalidate_warm() — the conservative reset.
+        Explain mode is not traced on the warm program; use the
+        explained (cold) path when provenance is on."""
+        self._ensure_warm_jits()
+        snap = device.snap
+        delta = device.warm_delta()
+        warm = device.warm_state
+        shapes = self._shape_key(snap)
+        reason = None
+        if delta.needs_cold:
+            reason = delta.reason or "needs_cold"
+        elif warm is None:
+            reason = "no_tableau"
+        elif warm.lineage is not device.warm_lineage:
+            # A handle carried across a failover/restore to a DIFFERENT
+            # lineage (e.g. a promoted replica) must never be trusted.
+            reason = "lineage_mismatch"
+        elif warm.engine is not self:
+            reason = "engine_mismatch"
+        elif warm.shapes != shapes:
+            reason = "shape_change"
+        t0 = time.perf_counter()
+        if reason is not None:
+            buf, tab = self._cold_refresh_jit(snap)
+            path, rows = "cold", (0, 0, 0)
+        else:
+            buf, tab = self._warm_solve_jit(
+                snap, warm.tableau,
+                self._pad_idx(delta.dirty_pods),
+                self._pad_idx(delta.dirty_nodes),
+                self._pad_idx(delta.dirty_members),
+                delta.pod_perm, delta.node_perm, delta.member_perm,
+            )
+            path = "warm"
+            rows = (len(delta.dirty_pods or ()),
+                    len(delta.dirty_nodes or ()),
+                    len(delta.dirty_members or ()))
+        device.commit_warm(
+            WarmState(tableau=tab, lineage=device.warm_lineage,
+                      shapes=shapes, engine=self),
+            path=path, reason=reason or "", rows=rows,
+        )
+
+        def unpack(raw, seconds):
+            res = self.unpack(snap, raw)
+            res.solve_seconds = seconds
+            return res
+
+        return PendingFetch(unpack, self._submit_fetch(buf), t0)
+
+    def solve_warm(self, device) -> SolveResult:
+        """Blocking form of solve_warm_async."""
+        return self.solve_warm_async(device).result()
 
     # -- decision provenance (round 12) -------------------------------------
 

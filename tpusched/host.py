@@ -35,6 +35,7 @@ from tpusched.config import (
     EngineConfig,
     clamp01,
 )
+from tpusched.device_state import DeviceSnapshot
 from tpusched.engine import Engine
 from tpusched.qos import observed_availability, slack_of
 from tpusched.rpc.codec import decode_snapshot, snapshot_to_proto
@@ -284,6 +285,7 @@ class HostScheduler:
         explain=None,
         refresh_frac: "float | None" = None,
         tracer=None,
+        warm: bool = False,
     ):
         """explain (round 12, ISSUE 8): optional
         tpusched.explain.ExplainCollector; None falls back to the
@@ -298,7 +300,19 @@ class HostScheduler:
 
         tracer: optional tpusched.trace.TraceCollector for the
         per-cycle host.cycle span; None falls back to the process
-        default at emit time (injected-collector discipline, TPL009)."""
+        default at emit time (injected-collector discipline, TPL009).
+
+        warm (ROADMAP item 3): in-process engines only — maintain ONE
+        device-resident DeviceSnapshot lineage across cycles, feed it
+        the api's change hints as record deltas, and warm-start each
+        solve from the carried tableau (Engine.solve_warm_async).
+        Placements are bitwise-identical to the decode-every-cycle path
+        (the twin-parity contract); availability freshness follows the
+        delta transports' hint contract (FakeApiServer re-hints only
+        past AVAIL_REHINT_EPS drift). Any cycle failure invalidates the
+        lineage — the next cycle full-loads and solves cold. While the
+        explain collector is enabled, cycles fall back to the explained
+        decode path (the warm program is never traced with observers)."""
         self.api = api
         self.tracer = tracer
         self.config = config or EngineConfig()
@@ -322,6 +336,19 @@ class HostScheduler:
             self._engine = None
         else:
             self._engine = engine if engine is not None else Engine(self.config)
+        if warm and client is not None:
+            raise ValueError(
+                "warm=True is the in-process device-resident path; gRPC "
+                "transports keep their lineage in the sidecar's "
+                "DeviceSession"
+            )
+        self._warm = warm
+        self._warm_ds: "DeviceSnapshot | None" = None
+        # Last cycle's snapshot membership per class (node / pending /
+        # running names): the solve input is the FILTERED pending list
+        # (backoff windows, batch cap), so membership changes without a
+        # change hint and the delta must carry the symmetric difference.
+        self._warm_members = None
         # Sidecar transport (chosen by `transport`; use_delta=False is
         # the legacy spelling of "full"):
         #   "delta"    — DeltaSession: each cycle ships only churned
@@ -414,10 +441,91 @@ class HostScheduler:
 
     def _restore_hints(self, changed) -> None:
         """Un-drain change hints a cycle consumed but never shipped."""
-        if self._delta is not None or self._pipeline is not None:
+        if self._delta is not None or self._pipeline is not None \
+                or self._warm:
             restore = getattr(self.api, "restore_changed", None)
             if restore is not None:
                 restore(changed)
+
+    @staticmethod
+    def _result_names(meta, res):
+        """(assignments, evicted) as name pairs from an in-process
+        SolveResult + its SnapshotMeta — shared by the warm and decode
+        cycle paths so the bind inputs cannot drift between them."""
+        assignments = [
+            (meta.pod_names[i], meta.node_names[int(n)])
+            for i, n in enumerate(res.assignment[: meta.n_pods])
+            if n >= 0
+        ]
+        evicted = []
+        if res.evicted is not None and res.evicted.any():
+            names = meta.running_names or []
+            evicted = [
+                names[m] for m in np.argwhere(res.evicted).ravel()
+                if m < len(names)
+            ]
+        return assignments, evicted
+
+    def _warm_reset(self, reason: str) -> None:
+        """Drop the warm lineage (ROADMAP item 3): the carried tableau
+        must not survive a failed cycle, drain/restore unwind, or an
+        explain-mode detour — the next warm cycle full-loads a fresh
+        DeviceSnapshot and solves cold."""
+        if self._warm_ds is not None:
+            self._warm_ds.invalidate_warm(reason)
+        self._warm_ds = None
+        self._warm_members = None
+
+    def _warm_cycle_solve(self, nodes_r, pods_r, running_r, changed,
+                          backlog: int = 0):
+        """One in-process warm cycle: reconcile the device-resident
+        lineage with this cycle's record snapshot and warm-solve it.
+        Deltas come from the api change hints PLUS the membership diff
+        per class — backoff windows and the batch cap move pods in and
+        out of the solve input without any hint, and a bind moves a pod
+        pending -> running under one hint. changed=None (first cycle, or
+        an informer re-list) rebuilds the lineage from scratch.
+
+        backlog: total pending pods (pre-batch-cap); the lineage's
+        running bucket is floored to current running + backlog so a
+        draining queue does not force a row_bucket rebuild (= a cold
+        solve) every cycle as binds land."""
+        cur = (
+            {r["name"] for r in nodes_r},
+            {r["name"] for r in pods_r},
+            {r["name"] for r in running_r},
+        )
+        ds = self._warm_ds
+        if ds is None or changed is None or self._warm_members is None:
+            buckets = self.buckets
+            if buckets is None:
+                buckets = Buckets.fit(
+                    len(pods_r), len(nodes_r),
+                    len(running_r) + backlog,
+                )
+            ds = DeviceSnapshot(self.config, buckets)
+            ds.full_load(nodes_r, pods_r, running_r)
+            self._warm_ds = ds
+        else:
+            prev_n, prev_p, prev_r = self._warm_members
+            touch = set(changed)
+            ds.apply(
+                upsert_nodes=[r for r in nodes_r
+                              if r["name"] in touch
+                              or r["name"] not in prev_n],
+                remove_nodes=sorted(prev_n - cur[0]),
+                upsert_pods=[r for r in pods_r
+                             if r["name"] in touch
+                             or r["name"] not in prev_p],
+                remove_pods=sorted(prev_p - cur[1]),
+                upsert_running=[r for r in running_r
+                                if r["name"] in touch
+                                or r["name"] not in prev_r],
+                remove_running=sorted(prev_r - cur[2]),
+            )
+        self._warm_members = cur
+        res = self._engine.solve_warm_async(ds).result()
+        return res, ds.meta
 
     # -- snapshot assembly --------------------------------------------------
 
@@ -489,7 +597,14 @@ class HostScheduler:
         # snapshot missed — shipping a stale delta record next cycle.
         changed = None
         epoch_fn = e0 = None
-        if self._delta is not None or self._pipeline is not None:
+        # Warm cycles suspend while the explain collector is on (the
+        # warm program carries no provenance observers); the lineage is
+        # dropped so it cannot go hint-stale while bypassed.
+        warm_cycle = self._warm and not self.explain.enabled
+        if self._warm and not warm_cycle and self._warm_ds is not None:
+            self._warm_reset("explain_enabled")
+        if self._delta is not None or self._pipeline is not None \
+                or warm_cycle:
             drain = getattr(self.api, "drain_changed", None)
             epoch_fn = getattr(self.api, "relist_epoch", None)
             if epoch_fn is not None:
@@ -520,7 +635,16 @@ class HostScheduler:
                 return None
             pending = pending[: self.batch_size]
             t0 = time.perf_counter()
-            msg = self._wire_snapshot(pending)
+            if warm_cycle:
+                # Record-dialect snapshot (the DeviceSnapshot input);
+                # the wire proto is never built on the warm path.
+                nodes_r = [self._node_record(n)
+                           for n in self.api.list_nodes()]
+                running_r = [self._running_record(p)
+                             for p in self.api.bound_pods()]
+                pods_r = [self._pending_record(p) for p in pending]
+            else:
+                msg = self._wire_snapshot(pending)
             build_s = time.perf_counter() - t0
             # An informer re-list between the drain and these reads
             # replaced the cache with state the drained hints cannot
@@ -529,7 +653,20 @@ class HostScheduler:
                 changed = None
 
             t0 = time.perf_counter()
-            if self.client is not None:
+            if warm_cycle:
+                # Inside the try: a failed apply/solve restores the
+                # hints AND invalidates the lineage (the unwind below),
+                # so the next cycle full-loads and solves cold instead
+                # of trusting half-applied warm state.
+                try:
+                    res, meta = self._warm_cycle_solve(
+                        nodes_r, pods_r, running_r, changed,
+                        backlog=len(all_pending),
+                    )
+                except BaseException:
+                    self._warm_reset("cycle_error")
+                    raise
+            elif self.client is not None:
                 if self._pipeline is not None:
                     # Depth-1 AssignPipeline: submit drains the pipe
                     # before returning, so exactly one response comes
@@ -545,7 +682,10 @@ class HostScheduler:
         except BaseException:
             self._restore_hints(changed)
             raise
-        if self.client is not None:
+        if warm_cycle:
+            assignments, evicted = self._result_names(meta, res)
+            solve_s = time.perf_counter() - t0
+        elif self.client is not None:
             # Packed parallel-array response: three frombuffer reads
             # instead of P Python proto message traversals (~30 ms per
             # 10k-pod cycle on each side of the wire).
@@ -580,18 +720,7 @@ class HostScheduler:
             else:
                 pending_solve = self._engine.solve_async(snap)
                 res = pending_solve.result()
-            assignments = [
-                (meta.pod_names[i], meta.node_names[int(n)])
-                for i, n in enumerate(res.assignment[: meta.n_pods])
-                if n >= 0
-            ]
-            evicted = []
-            if res.evicted is not None and res.evicted.any():
-                names = meta.running_names or []
-                evicted = [
-                    names[m] for m in np.argwhere(res.evicted).ravel()
-                    if m < len(names)
-                ]
+            assignments, evicted = self._result_names(meta, res)
             solve_s = time.perf_counter() - t0
 
         t0 = time.perf_counter()

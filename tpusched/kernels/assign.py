@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from tpusched.config import DO_NOT_SCHEDULE, EngineConfig
+from tpusched.kernels.atoms import atom_sat, gather_term_sat
 from tpusched.kernels import filter as kfilter
 from tpusched.kernels import pairwise as kpair
 from tpusched.kernels import preempt as kpreempt
@@ -78,43 +79,186 @@ class StaticCtx:
     rw: Any         # [R] resource score weights
 
 
-def precompute_static(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
-                      member_sat_t) -> StaticCtx:
-    nodes, pods = snap.nodes, snap.pods
+@struct.dataclass
+class WarmTableau:
+    """The carried warm-start tableau (ROADMAP item 3): every CELL-LOCAL
+    static table of the Filter/Score program, resident on device across
+    delta cycles inside a lineage. "Cell-local" means cell (p, n) depends
+    only on pod p's row, node n's row, and the (vocab-stable) atom/sig
+    tables — so a delta cycle can recompute exactly the dirty rows and
+    columns and scatter-merge them (refresh_tableau), and the result is
+    the same table a from-scratch build would produce. Everything with
+    cross-row coupling (per-pod score normalization, QoS weights, pop
+    order, pair-state counts) is deliberately EXCLUDED and recomputed
+    fresh each solve by finalize_static / the solve drivers — that is
+    what makes warm placements bitwise-equal to cold ones.
+
+    Access discipline (tpuschedlint TPL011): the tableau is only valid
+    straight after the engine warm path refreshed it against the current
+    snapshot; reads outside engine.py / device_state.py / this module
+    are the stale-tableau hazard class."""
+
+    node_sat_t: Any    # [A, N] bool  atom satisfaction over node labels
+    member_sat_t: Any  # [A, M+P] bool  over member (running|pending) labels
+    sig_match: Any     # [S, M+P] bool  signature selector x member
+    mask: Any          # [P, N] bool  static feasibility (taints/affinity/cordon)
+    aff_ok: Any        # [P, N] bool  node-affinity component alone
+    na_raw: Any        # [P, N] f32  pre-normalize preferred-affinity sums
+    tt_count: Any      # [P, N] f32  intolerable PreferNoSchedule taint counts
+
+
+def _tableau_cells(snap: ClusterSnapshot, pods_v, nodes_v, node_sat_v):
+    """The cell-local tableau block for any (pods view, nodes view)
+    pair: full build passes the whole snapshot, refresh passes gathered
+    dirty rows/columns. One shared body so a refreshed cell runs the
+    exact op sequence the full build ran (bool ops are exact; the f32
+    sums reduce over identical per-cell extents)."""
     aff_ok = kfilter.node_affinity_mask(
-        node_sat_t, pods.req_term_atoms, pods.req_term_valid
+        node_sat_v, pods_v.req_term_atoms, pods_v.req_term_valid
     )
     # Cordon (NodeUnschedulable plugin): closed to new pods UNLESS the
     # pod tolerates node.kubernetes.io/unschedulable (DaemonSet pattern).
     cordon_ok = (
-        nodes.schedulable[None, :] | pods.tolerates_unsched[:, None]
+        nodes_v.schedulable[None, :] | pods_v.tolerates_unsched[:, None]
     )
     mask = (
         aff_ok
-        & kfilter.taint_mask(nodes.taint_ids, snap.taint_effect, pods.tolerated)
-        & nodes.valid[None, :]
+        & kfilter.taint_mask(nodes_v.taint_ids, snap.taint_effect,
+                             pods_v.tolerated)
+        & nodes_v.valid[None, :]
         & cordon_ok
-        & pods.valid[:, None]
+        & pods_v.valid[:, None]
     )
+    na_raw = kscore.node_affinity_raw(
+        node_sat_v, pods_v.pref_term_atoms, pods_v.pref_term_valid,
+        pods_v.pref_weight,
+    )
+    tt_count = kscore.taint_intolerable_count(
+        nodes_v.taint_ids, snap.taint_effect, pods_v.tolerated
+    )
+    return mask, aff_ok, na_raw, tt_count
+
+
+def build_tableau(cfg: EngineConfig, snap: ClusterSnapshot,
+                  node_sat_t, member_sat_t) -> WarmTableau:
+    """Full (cold) tableau build from the snapshot's sat tables."""
+    mask, aff_ok, na_raw, tt_count = _tableau_cells(
+        snap, snap.pods, snap.nodes, node_sat_t
+    )
+    return WarmTableau(
+        node_sat_t=node_sat_t, member_sat_t=member_sat_t,
+        sig_match=kpair.sig_member_match(snap, member_sat_t),
+        mask=mask, aff_ok=aff_ok, na_raw=na_raw, tt_count=tt_count,
+    )
+
+
+def refresh_tableau(cfg: EngineConfig, snap: ClusterSnapshot,
+                    tab: WarmTableau, dirty_pods=None, dirty_nodes=None,
+                    dirty_members=None, pod_perm=None, node_perm=None,
+                    member_perm=None) -> WarmTableau:
+    """O(churn) tableau maintenance: reorder gathers (when record
+    insertion/removal shifted the name-sorted row order — exactly the
+    permutations device_state applies to the snapshot arrays), then
+    recompute and scatter-merge the dirty pod ROWS, node COLUMNS, and
+    member columns. Order matters: node sat rows first (the pod-row and
+    node-column recomputes read them), then rows, then columns; an
+    overlapping (dirty pod, dirty node) cell is written twice with the
+    same fresh value. Dirty index arrays may carry repeated indices
+    (pow2 padding) — duplicate scatters write identical content.
+
+    Vocabulary growth (new atoms/sigs/taints/topo keys) is NOT
+    expressible here — those change rows this function never touches —
+    and must force a cold rebuild; device_state.warm_delta() is the
+    gatekeeper."""
+    nst, mst, sm = tab.node_sat_t, tab.member_sat_t, tab.sig_match
+    mask, aff_ok = tab.mask, tab.aff_ok
+    na_raw, ttc = tab.na_raw, tab.tt_count
+    if node_perm is not None:
+        nst = nst[:, node_perm]
+        mask = mask[:, node_perm]
+        aff_ok = aff_ok[:, node_perm]
+        na_raw = na_raw[:, node_perm]
+        ttc = ttc[:, node_perm]
+    if pod_perm is not None:
+        mask = mask[pod_perm]
+        aff_ok = aff_ok[pod_perm]
+        na_raw = na_raw[pod_perm]
+        ttc = ttc[pod_perm]
+    if member_perm is not None:
+        mst = mst[:, member_perm]
+        sm = sm[:, member_perm]
+    if dirty_nodes is not None:
+        nv = jax.tree.map(lambda a: a[dirty_nodes], snap.nodes)
+        sat_rows = atom_sat(snap.atoms, nv.label_pairs, nv.label_keys,
+                            nv.label_nums)                   # [D, A]
+        nst = nst.at[:, dirty_nodes].set(sat_rows.T)
+    if dirty_members is not None:
+        lp = jnp.concatenate(
+            [snap.running.label_pairs, snap.pods.label_pairs]
+        )[dirty_members]
+        lk = jnp.concatenate(
+            [snap.running.label_keys, snap.pods.label_keys]
+        )[dirty_members]
+        mns = jnp.concatenate(
+            [snap.running.namespace, snap.pods.namespace]
+        )[dirty_members]
+        sat_cols = atom_sat(snap.atoms, lp, lk, None).T      # [A, D]
+        mst = mst.at[:, dirty_members].set(sat_cols)
+        match = gather_term_sat(sat_cols, snap.sigs.atoms)   # [S, D]
+        ns_ok = kpair.ns_scope_ok(snap.sigs.ns, snap.sigs.ns_all, mns)
+        sm = sm.at[:, dirty_members].set(
+            match & ns_ok & snap.sigs.valid[:, None]
+        )
+    if dirty_pods is not None:
+        pv = jax.tree.map(lambda a: a[dirty_pods], snap.pods)
+        m_r, a_r, n_r, t_r = _tableau_cells(snap, pv, snap.nodes, nst)
+        mask = mask.at[dirty_pods].set(m_r)
+        aff_ok = aff_ok.at[dirty_pods].set(a_r)
+        na_raw = na_raw.at[dirty_pods].set(n_r)
+        ttc = ttc.at[dirty_pods].set(t_r)
+    if dirty_nodes is not None:
+        nv = jax.tree.map(lambda a: a[dirty_nodes], snap.nodes)
+        m_c, a_c, n_c, t_c = _tableau_cells(
+            snap, snap.pods, nv, nst[:, dirty_nodes]
+        )
+        mask = mask.at[:, dirty_nodes].set(m_c)
+        aff_ok = aff_ok.at[:, dirty_nodes].set(a_c)
+        na_raw = na_raw.at[:, dirty_nodes].set(n_c)
+        ttc = ttc.at[:, dirty_nodes].set(t_c)
+    return WarmTableau(node_sat_t=nst, member_sat_t=mst, sig_match=sm,
+                       mask=mask, aff_ok=aff_ok, na_raw=na_raw,
+                       tt_count=ttc)
+
+
+def finalize_static(cfg: EngineConfig, snap: ClusterSnapshot,
+                    tab: WarmTableau) -> StaticCtx:
+    """StaticCtx from a (fresh or carried) tableau: everything with
+    cross-row coupling — per-pod QoS plugin weights (pressure is read
+    from the CURRENT snapshot, so a pressure change never needs a dirty
+    row) and the per-pod max-normalizations of the NA/TT scores — is
+    recomputed here, every solve, warm or cold."""
+    nodes, pods = snap.nodes, snap.pods
     w = effective_weights(
         cfg, pressure_of(pods.slo_target, pods.observed_avail)
     )  # dict of [P] arrays
-    na = kscore.node_affinity_score(
-        node_sat_t, pods.pref_term_atoms, pods.pref_term_valid,
-        pods.pref_weight, nodes.valid,
-    )
-    tt = kscore.taint_toleration_score(
-        nodes.taint_ids, snap.taint_effect, pods.tolerated, nodes.valid
-    )
+    na = kscore.default_normalize(tab.na_raw, nodes.valid)
+    tt = kscore.taint_toleration_from_count(tab.tt_count, nodes.valid)
     static_score = (
         w["node_affinity"][:, None] * na + w["taint_toleration"][:, None] * tt
     ).astype(jnp.float32)
     return StaticCtx(
-        mask=mask, aff_ok=aff_ok, score=static_score,
-        sig_match=kpair.sig_member_match(snap, member_sat_t),
+        mask=tab.mask, aff_ok=tab.aff_ok, score=static_score,
+        sig_match=tab.sig_match,
         w_lr=w["least_requested"], w_ba=w["balanced_allocation"],
         w_ts=w["topology_spread"], w_ia=w["interpod_affinity"],
         rw=jnp.asarray(cfg.score_weights_vector(), jnp.float32),
+    )
+
+
+def precompute_static(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
+                      member_sat_t) -> StaticCtx:
+    return finalize_static(
+        cfg, snap, build_tableau(cfg, snap, node_sat_t, member_sat_t)
     )
 
 
@@ -281,7 +425,7 @@ def _preempt_branch(cfg: EngineConfig, snap: ClusterSnapshot, static,
 
 def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
                      node_sat_t, member_sat_t, init_counts=None,
-                     explain: bool = False):
+                     explain: bool = False, static=None):
     """Exact sequential commit: stock scheduleOne semantics on device,
     including inline PostFilter preemption (cfg.preemption) at the exact
     point upstream runs it — immediately after a pod fails Filter.
@@ -290,8 +434,11 @@ def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
     auction table) — in parity mode "evict_round" is the pop-order step
     at which the eviction committed, and the auction table is all-zero
     (there is no auction; the shape is kept so the engine's packed
-    explain layout is mode-independent)."""
-    static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
+    explain layout is mode-independent). static: optional precomputed
+    StaticCtx (the warm path's finalize_static output); None computes
+    it from the sat tables."""
+    if static is None:
+        static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
     P = snap.pods.valid.shape[0]
     M = snap.running.valid.shape[0]
     order = pop_order(cfg, snap)
@@ -372,10 +519,11 @@ def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
 
 
 def score_batch(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
-                member_sat_t, init_counts=None):
+                member_sat_t, init_counts=None, static=None):
     """One-shot [P, N] feasibility + scores against the current snapshot
     (no commits): the ScoreBatch gRPC surface (SURVEY.md C12)."""
-    static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
+    if static is None:
+        static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
     st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts)
     return batched_cycle(cfg, snap, static, snap.nodes.used, st0)
 
@@ -1524,7 +1672,7 @@ def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
 
 def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
                  node_sat_t, member_sat_t, init_counts=None,
-                 explain: bool = False):
+                 explain: bool = False, static=None):
     """Fast mode: optimistic batched rounds with validate-and-rollback.
     Returns (assigned, chosen, used, order, round_of, rounds, evicted);
     with explain=True (decision provenance, round 12) an extra trailing
@@ -1532,8 +1680,10 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
     mask [P], per-victim preemptor pod index / commit-round [M] (-1 =
     not evicted), and the [_PREEMPT_MAX_ROUNDS, EXPLAIN_AUCTION_STATS]
     per-round auction table. The explain accumulation is traced only
-    when requested, so the default program is unchanged."""
-    static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
+    when requested, so the default program is unchanged. static:
+    optional precomputed StaticCtx (the warm path)."""
+    if static is None:
+        static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
     pods, nodes = snap.pods, snap.nodes
     P = pods.valid.shape[0]
     N = nodes.valid.shape[0]

@@ -44,29 +44,54 @@ def balanced_allocation(alloc, used, requests, resource_weights):
     return (1.0 - jnp.sqrt(var)) * MAX_NODE_SCORE
 
 
+def node_affinity_raw(node_sat_t, pref_term_atoms, pref_term_valid,
+                      pref_weight):
+    """Pre-normalization preferred-affinity score: sum of satisfied term
+    weights per (pod, node). CELL-LOCAL (each output cell depends only on
+    its pod row and node sat column) — the cacheable half of
+    node_affinity_score; the per-pod max-normalization couples a row to
+    every node and is re-applied from this raw table each solve (the
+    warm-start tableau split)."""
+    term_ok = gather_term_sat(node_sat_t, pref_term_atoms)    # [..., PT, N]
+    term_ok &= pref_term_valid[..., None]
+    return jnp.sum(pref_weight[..., None] * term_ok, axis=-2)  # [..., N]
+
+
 def node_affinity_score(node_sat_t, pref_term_atoms, pref_term_valid,
                         pref_weight, node_valid):
     """Preferred node affinity: sum of satisfied term weights, then
     DefaultNormalizeScore (max -> 100) per pod."""
-    term_ok = gather_term_sat(node_sat_t, pref_term_atoms)    # [..., PT, N]
-    term_ok &= pref_term_valid[..., None]
-    raw = jnp.sum(pref_weight[..., None] * term_ok, axis=-2)  # [..., N]
+    raw = node_affinity_raw(node_sat_t, pref_term_atoms, pref_term_valid,
+                            pref_weight)
     return default_normalize(raw, node_valid)
 
 
-def taint_toleration_score(node_taint_ids, taint_effect, tolerated, node_valid):
-    """Count intolerable PreferNoSchedule taints, inverse-normalized."""
+def taint_intolerable_count(node_taint_ids, taint_effect, tolerated):
+    """Intolerable PreferNoSchedule taints per (pod, node), as f32.
+    Cell-local (see node_affinity_raw): the cacheable half of
+    taint_toleration_score."""
     tid = jnp.clip(node_taint_ids, 0, None)
     soft = (node_taint_ids >= 0) & (taint_effect[tid] == EFFECT_PREFER_NO_SCHEDULE)
     if tolerated.ndim == 1:
         intol = soft & ~tolerated[tid]
     else:
         intol = soft[None] & ~tolerated[:, tid]
-    count = jnp.sum(intol, axis=-1).astype(jnp.float32)       # [..., N]
+    return jnp.sum(intol, axis=-1).astype(jnp.float32)        # [..., N]
+
+
+def taint_toleration_from_count(count, node_valid):
+    """Inverse-normalize the intolerable-taint counts (per-pod row max
+    coupling — the non-cacheable half of taint_toleration_score)."""
     mx = jnp.max(jnp.where(node_valid, count, 0.0), axis=-1, keepdims=True)
     return jnp.where(
         mx > 0, (mx - count) * MAX_NODE_SCORE / jnp.maximum(mx, 1e-9), MAX_NODE_SCORE
     )
+
+
+def taint_toleration_score(node_taint_ids, taint_effect, tolerated, node_valid):
+    """Count intolerable PreferNoSchedule taints, inverse-normalized."""
+    count = taint_intolerable_count(node_taint_ids, taint_effect, tolerated)
+    return taint_toleration_from_count(count, node_valid)
 
 
 # -- NormalizeScore helpers (C5) --------------------------------------------

@@ -789,6 +789,58 @@ class TestCloseDiscipline(Rule):
         return False
 
 
+# ---------------------------------------------------------------------------
+# TPL011 — carried warm-tableau access discipline.
+# ---------------------------------------------------------------------------
+
+class CarriedTableauDiscipline(Rule):
+    """The warm-start tableau (kernels.assign.WarmTableau, carried
+    across delta cycles as WarmState.tableau inside a DeviceSnapshot
+    lineage) is only coherent with the cluster snapshot straight after
+    the engine warm path has refreshed its dirty rows — anywhere else
+    it is LAST cycle's Filter/Score tables wearing this cycle's shapes,
+    and reading it is the stale-state hazard class ISSUE 11 introduces
+    (the warm analogue of the TPL007 dict-order bug: silently valid-
+    looking, wrong under churn). `.tableau` reads are allowed only in
+    the engine warm path and the residency layer; everything else
+    consumes SolveResults or the DeviceSnapshot warm counters. A
+    deliberate read elsewhere (a debugging tool that accepts staleness)
+    takes a suppression whose reason says so.
+    """
+
+    rule_id = "TPL011"
+    title = "carried warm tableau read outside the engine warm path"
+    incident = ("ISSUE 11 (warm-start): tableau cells are only valid "
+                "straight after the engine's dirty-row refresh; a "
+                "stale read elsewhere solves against last cycle's "
+                "Filter/Score tables")
+
+    ALLOWED = frozenset({
+        "tpusched/engine.py",
+        "tpusched/device_state.py",
+        "tpusched/kernels/assign.py",
+    })
+    ATTRS = frozenset({"tableau"})
+
+    def applies(self, relpath: str) -> bool:
+        if relpath in self.ALLOWED:
+            return False
+        return product_path(relpath) or is_test_path(relpath)
+
+    def check(self, tree, src, relpath, ctx, parents):
+        findings = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and node.attr in self.ATTRS:
+                findings.append(self.finding(
+                    relpath, node,
+                    f".{node.attr} (the carried warm tableau) read "
+                    "outside the engine warm path — consume the "
+                    "SolveResult / DeviceSnapshot warm counters "
+                    "instead, or suppress with the staleness rationale",
+                ))
+        return findings
+
+
 RULES = (
     FunctionLevelImport,
     UnseededRandomness,
@@ -800,6 +852,7 @@ RULES = (
     StringSortedRounds,
     CollectorDefaultDiscipline,
     TestCloseDiscipline,
+    CarriedTableauDiscipline,
 )
 
 

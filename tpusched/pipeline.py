@@ -75,6 +75,41 @@ def solve_stream(
         yield pmeta, pending.result()
 
 
+def warm_cycle_stream(
+    engine: Engine,
+    device,
+    deltas: Iterable[dict],
+) -> Iterator[tuple[Any, SolveResult]]:
+    """Pipeline consecutive DELTA CYCLES of one device-resident lineage
+    through the warm-start path (ROADMAP item 3): `device` is a
+    tpusched.device_state.DeviceSnapshot, each item of `deltas` is a
+    dict of DeviceSnapshot.apply kwargs. Yields (ApplyStats,
+    SolveResult) in order.
+
+    Unlike solve_stream (independent snapshots), consecutive cycles here
+    share one lineage and FEED FORWARD through the carried tableau —
+    they cannot be reordered, but the host-side work of cycle k+1
+    (apply(): record normalization, dirty-set accounting, scatter-index
+    building) still overlaps cycle k's in-flight result fetch, because
+    apply() mutates the host mirror and builds NEW device arrays
+    functionally while the dispatched program holds the old ones.
+
+    Contract note: the engine commits the refreshed warm handle at
+    dispatch time; a caller that abandons the stream mid-flight after a
+    fetch error should device.invalidate_warm("stream_error")."""
+    in_flight = None  # (ApplyStats, PendingFetch)
+    for delta in deltas:
+        stats = device.apply(**delta)
+        pending = engine.solve_warm_async(device)
+        if in_flight is not None:
+            pstats, prev = in_flight
+            yield pstats, prev.result()
+        in_flight = (stats, pending)
+    if in_flight is not None:
+        pstats, prev = in_flight
+        yield pstats, prev.result()
+
+
 def bench_overlap(
     engine: Engine,
     batches: list[Any],
