@@ -26,6 +26,7 @@ def test_from_dict_round_trips_every_live_field():
         "preemption": True,
         "ring_counts": True,
         "mesh_shape": [4, 2],
+        "compact_cap": 256,
     }
     cfg = EngineConfig.from_dict(d)
     assert cfg.resources == ("cpu", "memory", "pods", "nvidia.com/gpu")
@@ -40,6 +41,7 @@ def test_from_dict_round_trips_every_live_field():
     assert cfg.preemption is True
     assert cfg.ring_counts is True
     assert cfg.mesh_shape == (4, 2)
+    assert cfg.compact_cap == 256
 
 
 def test_from_dict_rejects_unknown_keys():
@@ -53,7 +55,7 @@ def test_every_engineconfig_field_is_yaml_reachable():
     settable = {
         "resources", "score_resource_weights", "weights", "qos", "mode",
         "max_rounds", "tie_break", "tie_seed", "preemption",
-        "ring_counts", "mesh_shape",
+        "ring_counts", "mesh_shape", "compact_cap",
     }
     fields = {f.name for f in dataclasses.fields(EngineConfig)}
     assert fields == settable, (

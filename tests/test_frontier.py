@@ -1,0 +1,365 @@
+"""Frontier-compacted commit rounds + bounded-divergence warm solving
+(ISSUE 12).
+
+Two contracts:
+  * COMPACTION IS BITWISE — the signature-path rounds run on gathered
+    [cap, N] frontier views once pending fits, and must equal the
+    full-width reference on assignment/chosen_score/evicted, byte for
+    byte, across structural-churn twin cycles incl. preemption rounds,
+    gang admission, and cordons (cfg.compact_cap=0 is the reference
+    engine; a tiny explicit cap exercises the compacted program on
+    small clusters).
+  * INCREMENTAL IS VALID — solve_warm(incremental=True) seeds rounds
+    with the previous assignment and re-solves only the frontier; it
+    may legally diverge from cold, but the validity contract (no
+    capacity overflow, no pairwise violation, carried pods still
+    feasible on their nodes) must hold on every cycle: in-kernel audit
+    (SolveResult.inc_info) clean AND oracle.validate_assignment clean,
+    with forced spills (cordon, capacity shrink) re-placing instead of
+    overflowing, and the carry dying with the lineage on an unwind.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tpusched import Engine, EngineConfig
+from tpusched.device_state import DeviceSnapshot
+from tpusched.divergence import warm_audit, warm_churn_stream
+from tpusched.oracle import validate_assignment
+from tpusched.synth import make_cluster
+
+
+@pytest.fixture(scope="module")
+def twin_engines():
+    """(full-width reference, compacted) fast engines; the explicit
+    compact_cap=8 forces the compacted program at test-sized P."""
+    ref = Engine(EngineConfig(mode="fast", compact_cap=0))
+    cmp_ = Engine(EngineConfig(mode="fast", compact_cap=8))
+    yield ref, cmp_
+    ref.close()
+    cmp_.close()
+
+
+@pytest.fixture(scope="module")
+def inc_engine():
+    eng = Engine(EngineConfig(mode="fast"))
+    yield eng
+    eng.close()
+
+
+def _assert_bitwise(a, b, context: str):
+    np.testing.assert_array_equal(
+        a.assignment, b.assignment,
+        err_msg=f"assignment diverged {context}")
+    np.testing.assert_array_equal(
+        np.asarray(a.chosen_score), np.asarray(b.chosen_score),
+        err_msg=f"chosen_score diverged {context}")
+    np.testing.assert_array_equal(
+        a.evicted, b.evicted, err_msg=f"evicted diverged {context}")
+
+
+def test_sig_compact_bitwise_twin_40_churn_cycles(twin_engines):
+    """THE part-1 acceptance pin (with the preemption twin below:
+    50+ structural-churn twin cycles): a pairwise-heavy lineage churned
+    through value edits, pod add/remove reorders, running removals, and
+    cordon toggles — compacted == full-width byte-identical every
+    cycle, and the compacted result stays audit-valid."""
+    ref, cmp_ = twin_engines
+    rng = np.random.default_rng(21)
+    nodes, pods, running = make_cluster(
+        rng, 48, 12, as_records=True, spread_frac=0.4, interpod_frac=0.4,
+        run_anti_frac=0.2, namespace_count=2, cordon_frac=0.1,
+        selector_frac=0.2, taint_frac=0.15, toleration_frac=0.2,
+    )
+    nodes, pods, running = list(nodes), list(pods), list(running)
+    ds = DeviceSnapshot(ref.config)
+    ds.full_load(nodes, pods, running)
+    cycles = 0
+    for cyc, delta in enumerate(warm_churn_stream(
+            rng, nodes, pods, running, 40, churn_frac=0.15,
+            structural_every=4)):
+        ds.apply(**delta)
+        a = ref.solve(ds.snap)
+        b = cmp_.solve(ds.snap)
+        _assert_bitwise(a, b, f"at cycle {cyc}")
+        cycles += 1
+        if cyc % 8 == 0:
+            viol = validate_assignment(
+                ds.snap, cmp_.config, b.assignment,
+                commit_key=b.commit_key, evicted=b.evicted,
+            )
+            assert not viol, viol[:5]
+    assert cycles == 40
+
+
+def test_sig_compact_bitwise_preemption_and_gangs():
+    """Preemption auction rounds (incl. the compacted S>0 cross-commit
+    validation fixpoint), PDB budgets, and gang admission — bitwise
+    across churn cycles with evictions actually firing."""
+    ref = Engine(EngineConfig(mode="fast", preemption=True,
+                              compact_cap=0))
+    cmp_ = Engine(EngineConfig(mode="fast", preemption=True,
+                               compact_cap=8))
+    try:
+        rng = np.random.default_rng(31)
+        nodes, pods, running = make_cluster(
+            rng, 36, 8, as_records=True, initial_utilization=0.8,
+            n_running_per_node=3, pdb_frac=0.3, gang_frac=0.25,
+            gang_size=2, tight_utilization=True, spread_frac=0.3,
+            interpod_frac=0.3, run_anti_frac=0.15,
+        )
+        nodes, pods, running = list(nodes), list(pods), list(running)
+        ds = DeviceSnapshot(ref.config)
+        ds.full_load(nodes, pods, running)
+        evicted_any = False
+        for cyc, delta in enumerate(warm_churn_stream(
+                rng, nodes, pods, running, 12, churn_frac=0.25,
+                structural_every=4)):
+            ds.apply(**delta)
+            a = ref.solve(ds.snap)
+            b = cmp_.solve(ds.snap)
+            _assert_bitwise(a, b, f"(preempt) at cycle {cyc}")
+            evicted_any = evicted_any or bool(b.evicted.any())
+        assert evicted_any, "preemption never fired; twin proves nothing"
+    finally:
+        ref.close()
+        cmp_.close()
+
+
+def test_incremental_validity_sweep(inc_engine):
+    """Churned cycles through solve_warm(incremental=True): the
+    in-kernel audit and the oracle must both be clean every cycle, the
+    frontier must stay a fraction of the cluster on value churn, and
+    placement throughput must track the cold twin."""
+    eng = inc_engine
+    rng = np.random.default_rng(41)
+    nodes, pods, running = make_cluster(
+        rng, 40, 10, as_records=True, spread_frac=0.3, interpod_frac=0.3,
+        run_anti_frac=0.15, namespace_count=2,
+    )
+    nodes, pods, running = list(nodes), list(pods), list(running)
+    ds = DeviceSnapshot(eng.config)
+    ds.full_load(nodes, pods, running)
+    eng.solve_warm(ds)  # establish the carry
+    placed_w = placed_c = 0
+    for cyc, delta in enumerate(warm_churn_stream(
+            rng, nodes, pods, running, 10, churn_frac=0.15,
+            structural_every=3)):
+        ds.apply(**delta)
+        res = eng.solve_warm(ds, incremental=True)
+        cold = eng.solve(ds.snap)
+        assert res.inc_info is not None, "incremental path not taken"
+        assert res.inc_info["audit_violations"] == 0, res.inc_info
+        viol = validate_assignment(
+            ds.snap, eng.config, res.assignment,
+            commit_key=res.commit_key, evicted=res.evicted,
+        )
+        assert not viol, (cyc, viol[:5])
+        placed_w += int((res.assignment >= 0).sum())
+        placed_c += int((cold.assignment >= 0).sum())
+    assert ds.incremental_solves == 10, (
+        ds.incremental_solves, ds.warm_cold_reasons)
+    # Bounded divergence, not degraded throughput: the incremental path
+    # must place within a few percent of the cold twin over the sweep.
+    assert placed_w >= 0.95 * placed_c, (placed_w, placed_c)
+
+
+def test_incremental_carried_pods_skip_the_rounds(inc_engine):
+    """The point of the mode: on a pure value-churn cycle the carried
+    pods never re-enter the commit rounds — carried + frontier
+    partition the valid pods, and the frontier is just the dirty set
+    (no signatures -> no closure)."""
+    eng = inc_engine
+    rng = np.random.default_rng(43)
+    nodes, pods, running = make_cluster(rng, 40, 10, as_records=True)
+    nodes, pods, running = list(nodes), list(pods), list(running)
+    ds = DeviceSnapshot(eng.config)
+    ds.full_load(nodes, pods, running)
+    first = eng.solve_warm(ds)
+    placed0 = int((first.assignment >= 0).sum())
+    assert placed0 > 10
+    # Touch exactly 3 pods' availability.
+    for rec in pods[:3]:
+        rec["observed_avail"] = 0.31
+    ds.apply(upsert_pods=pods[:3])
+    res = eng.solve_warm(ds, incremental=True)
+    info = res.inc_info
+    assert info is not None and info["audit_violations"] == 0
+    assert info["frontier"] <= 3 + (len(pods) - placed0), info
+    assert info["carried"] >= placed0 - 3, (info, placed0)
+
+
+def test_incremental_spill_on_cordon(inc_engine):
+    """Forced violation spill: cordoning a node a carried pod sits on
+    must spill it back into the frontier and re-place it elsewhere —
+    never leave it on the now-infeasible node."""
+    eng = inc_engine
+    nodes = [dict(name=f"n{i}", allocatable={"cpu": 4000.0})
+             for i in range(3)]
+    pods = [dict(name=f"p{i}", requests={"cpu": 500.0},
+                 priority=float(10 - i)) for i in range(6)]
+    ds = DeviceSnapshot(eng.config)
+    ds.full_load(nodes, pods, [])
+    first = eng.solve_warm(ds)
+    meta = ds.meta
+    target = int(first.assignment[0])
+    assert target >= 0
+    target_name = meta.node_names[target]
+    crec = next(n for n in nodes if n["name"] == target_name)
+    crec["unschedulable"] = True
+    ds.apply(upsert_nodes=[crec])
+    res = eng.solve_warm(ds, incremental=True)
+    assert res.inc_info is not None
+    assert res.inc_info["audit_violations"] == 0, res.inc_info
+    # Nothing may remain on (or newly land on) the cordoned node.
+    assert not (res.assignment == target).any()
+    viol = validate_assignment(ds.snap, eng.config, res.assignment,
+                               commit_key=res.commit_key,
+                               evicted=res.evicted)
+    assert not viol, viol
+
+
+def test_incremental_capacity_edge_carry(inc_engine):
+    """Capacity-edge carry: shrinking a node below its carried demand
+    spills the LOWEST-priority carried pods (rank-ordered prefix keeps
+    the rest) and the end state never overflows."""
+    eng = inc_engine
+    nodes = [dict(name="n0", allocatable={"cpu": 4000.0}),
+             dict(name="n1", allocatable={"cpu": 4000.0})]
+    pods = [dict(name=f"p{i}", requests={"cpu": 900.0},
+                 priority=float(100 - i)) for i in range(8)]
+    ds = DeviceSnapshot(eng.config)
+    ds.full_load(nodes, pods, [])
+    first = eng.solve_warm(ds)
+    assert int((first.assignment >= 0).sum()) == 8
+    nodes[0]["allocatable"] = {"cpu": 2000.0}  # held 4 x 900
+    ds.apply(upsert_nodes=[nodes[0]])
+    res = eng.solve_warm(ds, incremental=True)
+    assert res.inc_info is not None
+    assert res.inc_info["cap_violations"] == 0, res.inc_info
+    assert res.inc_info["audit_violations"] == 0, res.inc_info
+    # No node over its (current) allocatable.
+    P = len(pods)
+    for n, name in enumerate(ds.meta.node_names):
+        load = sum(
+            900.0 for i in range(P) if int(res.assignment[i]) == n
+        )
+        alloc = 2000.0 if name == "n0" else 4000.0
+        assert load <= alloc + 1e-6, (name, load)
+
+
+def test_incremental_carry_dies_with_the_lineage(inc_engine):
+    """Invalidation on unwind: invalidate_warm (what the host's failed-
+    cycle unwind calls) drops the carry too — the next incremental
+    request falls back through cold (rebuilding the tableau), then the
+    cycle after is incremental again."""
+    eng = inc_engine
+    rng = np.random.default_rng(47)
+    nodes, pods, running = make_cluster(rng, 20, 6, as_records=True)
+    nodes, pods, running = list(nodes), list(pods), list(running)
+    ds = DeviceSnapshot(eng.config)
+    ds.full_load(nodes, pods, running)
+    eng.solve_warm(ds)
+    assert ds.carry_arrays() is not None
+    ds.invalidate_warm("unit_unwind")
+    assert ds.carry_arrays() is None
+    inc0, cold0 = ds.incremental_solves, ds.cold_solves
+    res = eng.solve_warm(ds, incremental=True)
+    assert res.inc_info is None            # cold fallback, no audit
+    assert ds.cold_solves == cold0 + 1
+    pods[0]["observed_avail"] = 0.4
+    ds.apply(upsert_pods=[pods[0]])
+    res2 = eng.solve_warm(ds, incremental=True)
+    assert res2.inc_info is not None
+    assert ds.incremental_solves == inc0 + 1
+
+
+def test_host_incremental_serves_and_unwinds():
+    """HostScheduler(warm='incremental') binds a synthetic cluster to
+    idle, and a wedged cycle unwinds the lineage (carry included) while
+    later cycles still converge."""
+    from tpusched.host import (FakeApiServer, HostScheduler,
+                               build_synthetic_cluster)
+
+    cfg = EngineConfig(mode="fast")
+    eng = Engine(cfg)
+    api = FakeApiServer()
+    rng = np.random.default_rng(53)
+    build_synthetic_cluster(api, rng, 24, 5)
+    host = HostScheduler(api, cfg, engine=eng, batch_size=10,
+                         warm="incremental")
+    try:
+        host.cycle()
+        ds = host._warm_ds
+        assert ds is not None
+        real = eng.solve_warm_async
+        def boom(d, incremental=False):
+            raise RuntimeError("injected")
+        eng.solve_warm_async = boom
+        try:
+            with pytest.raises(RuntimeError, match="injected"):
+                host.cycle()
+        finally:
+            eng.solve_warm_async = real
+        assert host._warm_ds is None
+        assert ds.carry_arrays() is None   # unwind dropped the carry
+        host.run_until_idle(max_cycles=30)
+        assert not api.pending_pods()
+    finally:
+        host.close()
+        eng.close()
+
+
+def test_server_warm_routing_counts_paths():
+    """Sidecar warm routing (make_server(warm=...)): a session-backed
+    delta Assign rides the warm path and scheduler_warm_solves_total
+    labels what actually served (cold until the lineage's tableau
+    lands, bitwise after), with scheduler_solve_rounds counting every
+    batch."""
+    pytest.importorskip("grpc")
+    from tpusched.rpc import tpusched_pb2 as pb
+    from tpusched.rpc.codec import snapshot_to_proto
+    from tpusched.rpc.server import SchedulerService
+
+    svc = SchedulerService(EngineConfig(mode="fast"), warm="bitwise")
+    try:
+        nodes = [dict(name=f"n{i}", allocatable={"cpu": 4000.0})
+                 for i in range(3)]
+        pods = [dict(name=f"p{i}", requests={"cpu": 400.0},
+                     priority=float(i)) for i in range(6)]
+        msg = snapshot_to_proto(nodes, pods, [])
+        r1 = svc.Assign(pb.AssignRequest(snapshot=msg, packed_ok=True),
+                        None)
+        assert r1.snapshot_id
+        sid = r1.snapshot_id
+        for cyc in range(3):
+            pods[0]["priority"] = float(10 + cyc)
+            delta = pb.SnapshotDelta(base_id=sid)
+            delta.upsert_pods.extend(
+                snapshot_to_proto([], [pods[0]], []).pods)
+            r = svc.Assign(pb.AssignRequest(delta=delta, packed_ok=True),
+                           None)
+            sid = r.snapshot_id
+        text = svc.Metrics(pb.MetricsRequest(), None).prometheus_text
+    finally:
+        svc.close()
+    # Full send = cold; first session delta solves cold (no tableau
+    # yet) but COMMITS one; later deltas ride the bitwise warm path.
+    assert 'scheduler_warm_solves_total{path="bitwise"}' in text
+    assert 'scheduler_warm_solves_total{path="cold"}' in text
+    assert "scheduler_solve_rounds_count 4" in text
+
+
+def test_warm_audit_incremental_smoke(inc_engine):
+    """divergence --warm-audit --incremental: validity-clean sweep,
+    quality-drift fields populated, incremental counter moving."""
+    report = warm_audit(cycles=6, preset="plain", n_pods=16, n_nodes=5,
+                        churn_frac=0.2, engine=inc_engine,
+                        incremental=True)
+    assert report["diverged_cycle"] == -1
+    assert report["validity_violations"] == 0
+    assert report["incremental_solves"] >= 4
+    assert report["placed_warm_total"] > 0
+    assert "mean_abs_score_drift" in report

@@ -268,6 +268,13 @@ def test_server_metrics_render_strict_and_labeled():
     assert 'scheduler_stage_duration_seconds_bucket{stage="decode",' \
            'le="+Inf"}' in text
     assert 'stage="fetch.join"' in text
+    # Commit-round + warm-path observability (round 17, ISSUE 12): the
+    # rounds histogram counted the served Assign and the warm counter
+    # labeled it cold (no warm routing configured on this service).
+    assert types["scheduler_solve_rounds"] == "histogram"
+    assert types["scheduler_warm_solves_total"] == "counter"
+    assert "scheduler_solve_rounds_count 1" in text
+    assert 'scheduler_warm_solves_total{path="cold"} 1' in text
 
 
 # ---------------------------------------------------------------------------

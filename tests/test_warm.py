@@ -349,7 +349,7 @@ def test_host_warm_matches_plain_host_and_invalidates_on_failure(
         real = fast_engine.solve_warm_async
         calls = {"n": 0}
 
-        def boom(ds):
+        def boom(ds, incremental=False):
             calls["n"] += 1
             raise RuntimeError("injected warm failure")
 

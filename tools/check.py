@@ -13,6 +13,12 @@ Chains, in order:
   mypy     mypy --strict over the typed beachhead (mypy.ini scopes
            it: config.py, qos.py, metrics.py); SKIPPED gracefully
            when mypy is not installed
+  warmaudit  fast `divergence --warm-audit 5` smoke at a tiny shape,
+           BOTH modes sharing one engine: the PR 10 bitwise warm
+           contract (warm == cold byte-identical) and the ISSUE 12
+           incremental validity contract (in-kernel audit + oracle
+           clean) stay gated pre-PR; SKIPPED gracefully when jax is
+           not installed
 
 Prints a per-stage summary and exits non-zero if any stage fails.
 Documented in tools/README.md as the thing to run before mailing a PR.
@@ -92,11 +98,45 @@ def stage_mypy() -> "tuple[str, str]":
     return ("ok" if rc == 0 else "FAIL"), out
 
 
+_WARMAUDIT_CODE = """
+import json, os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from tpusched.config import EngineConfig
+from tpusched.divergence import warm_audit
+from tpusched.engine import Engine
+eng = Engine(EngineConfig(mode="fast"))
+try:
+    kw = dict(cycles=5, preset="plain", n_pods=16, n_nodes=5,
+              churn_frac=0.2, engine=eng)
+    a = warm_audit(**kw)
+    b = warm_audit(incremental=True, **kw)
+finally:
+    eng.close()
+print(json.dumps(dict(bitwise_diverged=a["diverged_cycle"],
+                      inc_diverged=b["diverged_cycle"],
+                      inc_validity=b["validity_violations"],
+                      inc_solves=b["incremental_solves"])))
+bad = (a["diverged_cycle"] >= 0 or b["diverged_cycle"] >= 0
+       or b["validity_violations"] or b["incremental_solves"] < 3)
+raise SystemExit(1 if bad else 0)
+"""
+
+
+def stage_warmaudit() -> "tuple[str, str]":
+    try:
+        import jax  # noqa: F401
+    except ImportError:
+        return "skip", "jax not installed on this image"
+    rc, out = _run([sys.executable, "-c", _WARMAUDIT_CODE])
+    return ("ok" if rc == 0 else "FAIL"), out
+
+
 STAGES = (
     ("regen", stage_regen),
     ("lint", stage_lint),
     ("syntax", stage_syntax),
     ("mypy", stage_mypy),
+    ("warmaudit", stage_warmaudit),
 )
 
 

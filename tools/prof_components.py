@@ -8,8 +8,18 @@ member columns) and warm vs cold solve walls at each churn level, the
 numbers a source edit used to be required for (the retained _tableau_nv
 slope above serves the same purpose for the preemption tableau).
 
+Round 17 (frontier compaction, ISSUE 12): `--rounds [preset]` profiles
+WHERE the commit rounds spend their time — solve with the round cap at
+sampled values, diff the walls into per-round cost, and read the
+placed/pending (= next round's frontier) counts at each cap, with the
+compacted and full-width engines side by side. This is the evidence
+trail for the compaction claim the same way `--warm` validated the
+tableau: late rounds carry tiny frontiers, so their wall should track
+the [cap, N] view, not [P, N]. preset: pairwise (default) | preempt.
+
     python tools/prof_components.py 10000 5000
     python tools/prof_components.py 10000 5000 --warm
+    python tools/prof_components.py 2000 500 --rounds preempt
     PROF_CPU=1 python tools/prof_components.py 2000 1000 --warm
 """
 import os
@@ -124,19 +134,80 @@ def prof_warm(pods: int, nodes: int,
         eng.close()
 
 
+def prof_rounds(pods: int, nodes: int, preset: str = "pairwise",
+                caps=(1, 2, 4, 8, 16, 32, 64), reps: int = 3):
+    """Per-round wall / frontier-size profile (see module docstring).
+    Each sampled cap is a separate compile (max_rounds is a trace-time
+    constant), so this is a profiling tool, not a bench."""
+    from tpusched.engine import Engine
+    from tpusched.synth import config3_pairwise, config5_preemption
+
+    rng = np.random.default_rng(13)
+    if preset == "preempt":
+        snap, _ = config5_preemption(rng, n_pods=pods, n_nodes=nodes)
+        base = dict(mode="fast", preemption=True)
+    else:
+        snap, _ = config3_pairwise(rng, pods, nodes)
+        base = dict(mode="fast")
+    snap = jax.device_put(snap)
+    P = int(snap.pods.valid.shape[0])
+
+    def measure(cfg_kw, cap):
+        eng = Engine(EngineConfig(max_rounds=cap, **cfg_kw))
+        try:
+            res = eng.unpack(snap, eng._solve_packed_jit(snap))  # compile
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                np.asarray(eng._solve_packed_jit(snap))
+                ts.append(time.perf_counter() - t0)
+            placed = int((res.assignment >= 0).sum())
+            pend = int((res.assignment < 0).sum())
+            return min(ts) * 1e3, placed, pend, int(res.rounds)
+        finally:
+            eng.close()
+
+    print(f"preset={preset} P={P} N={snap.nodes.valid.shape[0]} "
+          f"(walls are min of {reps}; per-round = wall delta / cap "
+          "delta; pending@cap is the frontier the NEXT round pays for)")
+    print(f"{'cap':>5} {'compact_ms':>11} {'full_ms':>9} {'d_ms/rnd':>9} "
+          f"{'placed':>7} {'pending':>8} {'rounds':>7}")
+    prev = None
+    for cap in caps:
+        w_c, placed, pend, rounds = measure(base, cap)
+        w_f, _, _, _ = measure({**base, "compact_cap": 0}, cap)
+        per = ""
+        if prev is not None and cap > prev[0]:
+            per = f"{(w_c - prev[1]) / (cap - prev[0]):.2f}"
+        print(f"{cap:>5} {w_c:>11.1f} {w_f:>9.1f} {per:>9} "
+              f"{placed:>7} {pend:>8} {rounds:>7}")
+        prev = (cap, w_c)
+        if pend == 0 and rounds < cap:
+            print(f"  fixpoint at {rounds} rounds; stopping the sweep")
+            break
+
+
 def main():
-    argv = [a for a in sys.argv[1:] if a != "--warm"]
-    warm = len(argv) != len(sys.argv) - 1
+    argv = [a for a in sys.argv[1:] if a not in ("--warm", "--rounds")]
+    warm = "--warm" in sys.argv[1:]
+    rounds_mode = "--rounds" in sys.argv[1:]
     # Integer operands are the shape; float operands (only meaningful
-    # with --warm) override the churn sweep levels.
-    ints, churns = [], []
+    # with --warm) override the churn sweep levels; a bare word after
+    # --rounds picks the preset.
+    ints, churns, words = [], [], []
     for a in argv:
         try:
             ints.append(int(a))
         except ValueError:
-            churns.append(float(a))
+            try:
+                churns.append(float(a))
+            except ValueError:
+                words.append(a)
     pods = ints[0] if len(ints) > 0 else 10_000
     nodes = ints[1] if len(ints) > 1 else 5_000
+    if rounds_mode:
+        prof_rounds(pods, nodes, preset=(words[0] if words else "pairwise"))
+        return
     if warm:
         prof_warm(pods, nodes,
                   churns=tuple(churns) or (0.001, 0.01, 0.1))

@@ -304,6 +304,17 @@ class EngineConfig:
     # long-context analogue). Requires Engine(mesh=...) with a
     # multi-device mesh; counts are bit-identical to the dense path.
     ring_counts: bool = False
+    # Frontier compaction of the fast-mode SIGNATURE-path commit rounds
+    # (ISSUE 12): once the pending frontier fits this many pods, rounds
+    # run on a gathered [cap, N] view instead of full-width [P, N] —
+    # bitwise-identical placements (kernels.assign._solve_rounds_sig
+    # documents the width-invariance construction; pinned by
+    # tests/test_frontier.py). -1 = auto (the residual-compaction cap,
+    # kernels.assign._RESIDUAL_CAP, skipped when P is not meaningfully
+    # larger); 0 = off, every round full-width (the twin-test reference
+    # and a conservative escape hatch); > 0 = explicit cap (tests use a
+    # tiny cap to exercise the compacted program on small clusters).
+    compact_cap: int = -1
 
     def resource_index(self, name: str) -> int:
         return self.resources.index(name)
@@ -325,7 +336,7 @@ class EngineConfig:
         if "qos" in d:
             kw["qos"] = QoSConfig(**d["qos"])
         for k in ("mode", "max_rounds", "tie_break", "tie_seed",
-                  "preemption", "ring_counts"):
+                  "preemption", "ring_counts", "compact_cap"):
             if k in d:
                 kw[k] = d[k]
         if "mesh_shape" in d:
@@ -333,7 +344,7 @@ class EngineConfig:
         extra = set(d) - {
             "resources", "score_resource_weights", "weights", "qos",
             "mode", "max_rounds", "tie_break", "tie_seed", "mesh_shape",
-            "preemption", "ring_counts",
+            "preemption", "ring_counts", "compact_cap",
         }
         if extra:
             raise ValueError(f"unknown EngineConfig keys: {sorted(extra)}")

@@ -193,8 +193,15 @@ class DeviceSnapshot:
         # Warm-path accounting (the bench/prof/test hooks).
         self.warm_solves = 0
         self.cold_solves = 0
+        self.incremental_solves = 0
         self.warm_cold_reasons: list[str] = []
         self.last_warm_rows = (0, 0, 0)   # (pod, node, member) dirty rows
+        # Previous-cycle assignment carry (ISSUE 12, the incremental
+        # warm path's seed): name-keyed so row reorders between cycles
+        # cannot misroute it. Committed by the engine's warm unpack on
+        # the joining thread — the same single-caller serialization
+        # discipline apply() relies on.
+        self._carry = None  # (pod_names, node_names, assign np, chosen np)
 
     # -- views --------------------------------------------------------------
 
@@ -761,16 +768,19 @@ class DeviceSnapshot:
     # -- warm-start residency (ROADMAP item 3) ------------------------------
 
     def invalidate_warm(self, reason: str) -> None:
-        """Drop the carried tableau: the next warm solve goes cold (and
-        re-anchors the lineage). Called on every rebuild, by the host on
-        a failed cycle, and available to any owner whose fetch errored
-        after dispatch (the conservative reset)."""
+        """Drop the carried tableau AND the assignment carry: the next
+        warm solve goes cold (and re-anchors the lineage), and the next
+        incremental solve falls back to the bitwise path until a fresh
+        carry lands. Called on every rebuild, by the host on a failed
+        cycle (the unwind contract), and available to any owner whose
+        fetch errored after dispatch (the conservative reset)."""
         self.warm_state = None
         self._warm_cold_reason = reason
         self._warm_orders = None
         self._warm_dirty_nodes = set()
         self._warm_dirty_pods = set()
         self._warm_dirty_runs = set()
+        self._carry = None
 
     def warm_delta(self) -> WarmDelta:
         """Derive the dirty work accumulated since the last committed
@@ -854,9 +864,56 @@ class DeviceSnapshot:
         self.last_warm_rows = tuple(rows)
         if path == "warm":
             self.warm_solves += 1
+        elif path == "incremental":
+            self.incremental_solves += 1
         else:
             self.cold_solves += 1
             self.warm_cold_reasons.append(reason)
+
+    def commit_carry(self, pod_names, node_names, assignment, chosen,
+                     ) -> None:
+        """Store a completed solve's assignment as the next incremental
+        cycle's seed (ISSUE 12). `pod_names`/`node_names` are the name
+        orders of the snapshot that solve ran against — the carry is
+        NAME-keyed, so later applies reordering rows (or a rebuild
+        renumbering nodes) reroute rather than corrupt it."""
+        self._carry = (list(pod_names), list(node_names),
+                       np.asarray(assignment), np.asarray(chosen))
+
+    def carry_arrays(self):
+        """Map the stored carry onto the CURRENT name-sorted row order:
+        (carry [pod bucket] int32 node index | -1, chosen [pod bucket]
+        f32 as-of-placement scores) or None when no carry exists (never
+        solved, or invalidated). Pods/nodes that vanished since the
+        carried solve simply drop out (-1 = pending)."""
+        if self._carry is None:
+            return None
+        prev_pods, prev_nodes, a, c = self._carry
+        bk = self._state.buckets
+        # Steady-state fast path: no row churn since the carried solve
+        # (same pod AND node name orders, same buckets) means the carry
+        # maps identically — skip the O(P) per-name remap loop that
+        # would otherwise run on every incremental dispatch.
+        if (prev_pods == self._pod_order and prev_nodes == self._node_order
+                and a.shape[0] == bk.pods):
+            return (np.asarray(a, np.int32).copy(),
+                    np.asarray(c, np.float32).copy())
+        carry = np.full(bk.pods, -1, np.int32)
+        chos = np.full(bk.pods, -np.inf, np.float32)
+        prev_idx = {nm: i for i, nm in enumerate(prev_pods)}
+        node_now = self._state.node_index
+        for i, nm in enumerate(self._pod_order):
+            j = prev_idx.get(nm)
+            if j is None or j >= len(a):
+                continue
+            n = int(a[j])
+            if n < 0 or n >= len(prev_nodes):
+                continue
+            ni = node_now.get(prev_nodes[n], -1)
+            if ni >= 0:
+                carry[i] = ni
+                chos[i] = np.float32(c[j])
+        return carry, chos
 
     @staticmethod
     def _perm(old_order: list[str], new_order: list[str], bucket: int):

@@ -26,13 +26,19 @@ snapshots and report how often placements differ and by how much.
 
 CLI:  python -m tpusched.divergence [--preset mixed] [--seeds 10]
       python -m tpusched.divergence --warm-audit 50 [--churn 0.05]
+      python -m tpusched.divergence --warm-audit 50 --incremental
 
 --warm-audit N runs N delta cycles TWIN — every cycle solved once warm
 (carried tableau, dirty rows only) and once cold (full recompute) on the
 same device-resident lineage — and reports the first diverging cycle
-with the offending pod rows. The warm-start correctness contract is
-bitwise placement equality, so this is the debugging tool for when the
-twin-parity tests trip: exit code 1 on any divergence.
+with the offending pod rows, plus placement-quality drift (placed-count
+and chosen-score deltas vs the cold twin). The bitwise warm contract is
+byte equality, so this is the debugging tool for when the twin-parity
+tests trip: exit code 1 on any divergence. With --incremental the warm
+arm is the BOUNDED-DIVERGENCE path (ISSUE 12): placements may legally
+drift, so the audit enforces the validity contract instead — the
+in-kernel audit and oracle.validate_assignment must both be clean every
+cycle — and exit 1 means a validity violation, not mere divergence.
 """
 
 from __future__ import annotations
@@ -222,16 +228,31 @@ def warm_audit(
     mode: str = "fast",
     preemption: bool = False,
     engine: "Engine | None" = None,
+    incremental: bool = False,
 ) -> dict:
     """Twin-run N delta cycles warm vs cold on ONE device-resident
-    lineage and report the first divergence (the --warm-audit debugging
-    tool the twin-parity contract needs when it trips). Every cycle:
-    apply a seeded churn delta, solve once through the engine warm path
+    lineage (the --warm-audit debugging tool). Every cycle: apply a
+    seeded churn delta, solve once through the engine warm path
     (Engine.solve_warm: carried tableau + dirty rows), once cold
-    (Engine.solve: full recompute of the same arrays), and byte-compare
-    assignment / chosen_score / evicted. Returns a report dict:
-    diverged_cycle (-1 = clean), bad_pods [(row, name, warm_node,
-    cold_node)], and the lineage's warm/cold path counters."""
+    (Engine.solve: full recompute of the same arrays).
+
+    Bitwise mode (default): byte-compare assignment / chosen_score /
+    evicted and report the first divergence — diverged_cycle (-1 =
+    clean) + bad_pods [(row, name, warm_node, cold_node)].
+
+    incremental=True (ISSUE 12): the warm solve is the BOUNDED-
+    DIVERGENCE path (solve_warm(incremental=True)); placements may
+    legally differ from the cold twin, so the audit instead enforces
+    the VALIDITY contract — the in-kernel audit (SolveResult.inc_info)
+    must be clean AND oracle.validate_assignment must find nothing —
+    and diverged_cycle marks the first validity failure.
+
+    Both modes now also report PLACEMENT-QUALITY drift vs the cold
+    twin (trivially zero in a clean bitwise run): placed-count totals
+    and per-cycle worst delta, plus the mean |chosen_score| drift over
+    pods both twins placed (carried placements keep their
+    as-of-placement score, so nonzero drift here is expected churn
+    aging, not a bug)."""
     cfg = EngineConfig(mode=mode, preemption=preemption)
     rng = np.random.default_rng(seed)
     nodes, pods, running = make_cluster(
@@ -242,14 +263,51 @@ def warm_audit(
     ds.full_load(nodes, pods, running)
     eng = engine if engine is not None else Engine(cfg)
     report = dict(cycles=0, diverged_cycle=-1, bad_pods=[],
-                  preset=preset, churn_frac=churn_frac, mode=mode)
+                  preset=preset, churn_frac=churn_frac, mode=mode,
+                  incremental=incremental, validity_violations=0,
+                  placed_warm_total=0, placed_cold_total=0,
+                  worst_cycle_placed_delta=0)
+    drift = []
     try:
+        if incremental:
+            # Establish the lineage's carry (the seed the bounded-
+            # divergence path starts from) before the audited cycles.
+            eng.solve_warm(ds)
         for cyc, delta in enumerate(warm_churn_stream(
                 rng, nodes, pods, running, cycles, churn_frac)):
             ds.apply(**delta)
-            warm = eng.solve_warm(ds)
+            warm = eng.solve_warm(ds, incremental=incremental)
             cold = eng.solve(ds.snap)
             report["cycles"] = cyc + 1
+            pw = int((warm.assignment >= 0).sum())
+            pc = int((cold.assignment >= 0).sum())
+            report["placed_warm_total"] += pw
+            report["placed_cold_total"] += pc
+            if abs(pw - pc) > abs(report["worst_cycle_placed_delta"]):
+                report["worst_cycle_placed_delta"] = pw - pc
+            both = (warm.assignment >= 0) & (cold.assignment >= 0)
+            if both.any():
+                wsc = np.asarray(warm.chosen_score)[both]
+                csc = np.asarray(cold.chosen_score)[both]
+                drift.append(float(np.mean(np.abs(wsc - csc))))
+            if incremental:
+                viol = list(validate_assignment(
+                    ds.snap, cfg, warm.assignment,
+                    commit_key=warm.commit_key, evicted=warm.evicted,
+                ))
+                inc_bad = (warm.inc_info or {}).get("audit_violations", 0)
+                if viol or inc_bad:
+                    report["validity_violations"] += len(viol) + inc_bad
+                    if report["diverged_cycle"] < 0:
+                        report["diverged_cycle"] = cyc
+                        report["bad_pods"] = [
+                            (-1, f"<validity: {v}>", -1, -1)
+                            for v in viol[:16]
+                        ] + ([(-1, f"<in-kernel audit: "
+                                   f"{warm.inc_info}>", -1, -1)]
+                             if inc_bad else [])
+                    break
+                continue
             same = (
                 np.array_equal(warm.assignment, cold.assignment)
                 and np.array_equal(np.asarray(warm.chosen_score),
@@ -276,7 +334,12 @@ def warm_audit(
             eng.close()
     report.update(
         warm_solves=ds.warm_solves, cold_solves=ds.cold_solves,
+        incremental_solves=ds.incremental_solves,
         cold_reasons=ds.warm_cold_reasons,
+        placed_delta_total=(report["placed_warm_total"]
+                            - report["placed_cold_total"]),
+        mean_abs_score_drift=(round(float(np.mean(drift)), 6)
+                              if drift else 0.0),
     )
     return report
 
@@ -296,12 +359,19 @@ def main(argv=None) -> None:
     ap.add_argument("--seed", type=int, default=4000)
     ap.add_argument("--preemption", action="store_true",
                     help="warm-audit with the preemption program")
+    ap.add_argument("--incremental", action="store_true",
+                    help="warm-audit the bounded-divergence incremental "
+                         "path: validity contract + quality drift "
+                         "instead of bitwise parity")
     args = ap.parse_args(argv)
+    if args.incremental and not args.warm_audit:
+        ap.error("--incremental requires --warm-audit N")
     if args.warm_audit:
         report = warm_audit(
             cycles=args.warm_audit, preset=args.preset or "mixed",
             n_pods=args.pods, n_nodes=args.nodes, seed=args.seed,
             churn_frac=args.churn, preemption=args.preemption,
+            incremental=args.incremental,
         )
         print(json.dumps(report), flush=True)
         if report["diverged_cycle"] >= 0:

@@ -25,11 +25,11 @@ from tpusched import trace as tracing
 from tpusched.config import EngineConfig
 from tpusched.faults import NO_FAULTS
 from tpusched.kernels import explain as kexplain
-from tpusched.kernels.assign import (_PREEMPT_MAX_ROUNDS,
+from tpusched.kernels.assign import (_PREEMPT_MAX_ROUNDS, INC_AUDIT_LEN,
                                      EXPLAIN_AUCTION_STATS, build_tableau,
                                      finalize_static, refresh_tableau,
-                                     score_batch, solve_rounds,
-                                     solve_sequential)
+                                     score_batch, solve_incremental,
+                                     solve_rounds, solve_sequential)
 from tpusched.kernels.atoms import atom_sat
 from tpusched.kernels.pairwise import member_label_sat_t
 from tpusched.ring import ring_sig_counts
@@ -50,6 +50,11 @@ class SolveResult:
     # the host must delete these before binding their preemptors.
     evicted: np.ndarray | None = None
     solve_seconds: float = 0.0
+    # Incremental warm solves only (ISSUE 12): the in-kernel validity
+    # audit + frontier accounting — keys cap_violations /
+    # static_violations / pair_violations / audit_violations (their
+    # sum; the validity contract demands 0) / carried / frontier.
+    inc_info: "dict | None" = None
 
 
 @dataclasses.dataclass
@@ -358,6 +363,12 @@ class Engine:
         # so the compile set stays bounded.
         self._warm_solve_jit = None
         self._cold_refresh_jit = None
+        # Incremental (bounded-divergence) warm programs (ISSUE 12):
+        # one jit per FRONTIER BUCKET — the commit rounds run on a
+        # [cap, N] compacted view whose width is a compile-time
+        # constant, so the frontier size pow2-buckets into a small
+        # family exactly like the dirty-row scatters.
+        self._warm_inc_jits: dict[int, Any] = {}
         # ONE background fetch worker: fetch order == dispatch order,
         # which fetch-driven transports (axon tunnel) rely on — two
         # concurrent D2H reads would race for the single execution
@@ -531,7 +542,59 @@ class Engine:
         self._cold_refresh_jit = jax.jit(_cold)
         self._warm_solve_jit = jax.jit(_warm)
 
-    def solve_warm_async(self, device) -> PendingFetch:
+    def _warm_inc_fn(self, cap: int):
+        """The incremental warm program at one frontier-compaction
+        bucket (compile-time constant; see _warm_inc_jits)."""
+        fn = self._warm_inc_jits.get(cap)
+        if fn is None:
+            cfg = self.config
+
+            def _inc(snap: ClusterSnapshot, tab, dp, dn, dm, pperm,
+                     nperm, mperm, carry, carry_chosen, frontier, dnode,
+                     _cap=cap):
+                tab = refresh_tableau(cfg, snap, tab, dirty_pods=dp,
+                                      dirty_nodes=dn, dirty_members=dm,
+                                      pod_perm=pperm, node_perm=nperm,
+                                      member_perm=mperm)
+                out = solve_incremental(cfg, snap, tab, carry,
+                                        carry_chosen, frontier, dnode,
+                                        _cap)
+                return jnp.concatenate([_pack_solve(out[:7]), out[7]]), tab
+
+            fn = self._warm_inc_jits[cap] = jax.jit(_inc)
+        return fn
+
+    @staticmethod
+    def _frontier_bucket(est: int, P: int) -> int:
+        """Pow2 frontier-compaction width for an estimated frontier of
+        `est` pods: 2x headroom for closure expansion + revalidation
+        spills, floored at 64 (tiny views re-gather more rounds than
+        they save), 0 (= full-width rounds) once the bucket would reach
+        the pod axis anyway."""
+        want = max(64, 2 * max(est, 1))
+        cap = 1 << (want - 1).bit_length()
+        return 0 if cap >= P else cap
+
+    def unpack_incremental(self, snap: ClusterSnapshot, buf):
+        """Decode the incremental program's packed buffer: the standard
+        solve layout + the INC_AUDIT_LEN in-kernel audit tail. Returns
+        (SolveResult, info dict) — info keys mirror
+        SolveResult.inc_info."""
+        buf = np.asarray(buf)
+        res = Engine.unpack(snap, buf[:-INC_AUDIT_LEN])
+        audit = buf[-INC_AUDIT_LEN:]
+        info = dict(
+            cap_violations=int(audit[0]),
+            static_violations=int(audit[1]),
+            pair_violations=int(audit[2]),
+            audit_violations=int(audit[0] + audit[1] + audit[2]),
+            carried=int(audit[3]),
+            frontier=int(audit[4]),
+        )
+        return res, info
+
+    def solve_warm_async(self, device, incremental: bool = False,
+                         ) -> PendingFetch:
         """Warm-start solve of a device-resident lineage (ROADMAP item
         3): `device` is a tpusched.device_state.DeviceSnapshot. The
         lineage's accumulated dirty state (device.warm_delta()) decides
@@ -553,8 +616,28 @@ class Engine:
         (commit_warm) at DISPATCH time; a caller whose fetch later
         fails should device.invalidate_warm() — the conservative reset.
         Explain mode is not traced on the warm program; use the
-        explained (cold) path when provenance is on."""
+        explained (cold) path when provenance is on.
+
+        incremental=True (ISSUE 12, bounded-divergence warm rounds):
+        the previous cycle's assignment — committed back into the
+        lineage by every solve_warm fetch (DeviceSnapshot.commit_carry)
+        — seeds the round loop for clean pods; the dirty set expands to
+        its signature-cluster/node closure, carried placements are
+        revalidated in batched passes (violations spill), and commit
+        rounds run only over the pending frontier on a pow2-bucketed
+        compacted view (kernels.assign.solve_incremental). NOT bitwise
+        vs cold: governed by the validity contract enforced in-kernel
+        (SolveResult.inc_info carries the audit; `python -m
+        tpusched.divergence --warm-audit N --incremental` twin-audits
+        validity AND placement-quality drift). Falls back to the
+        bitwise warm path when the lineage has no carry yet, and to
+        cold for everything the row model cannot express — exactly the
+        ladder of the plain warm path."""
         self._ensure_warm_jits()
+        if incremental and self.config.ring_counts:
+            raise NotImplementedError(
+                "incremental warm solve does not support ring_counts"
+            )
         snap = device.snap
         delta = device.warm_delta()
         warm = device.warm_state
@@ -572,10 +655,44 @@ class Engine:
             reason = "engine_mismatch"
         elif warm.shapes != shapes:
             reason = "shape_change"
+        carry = device.carry_arrays() if incremental else None
         t0 = time.perf_counter()
+        inc_run = False
         if reason is not None:
             buf, tab = self._cold_refresh_jit(snap)
             path, rows = "cold", (0, 0, 0)
+        elif incremental and carry is not None:
+            carry_arr, chosen_arr = carry
+            P = snap.pods.valid.shape[0]
+            frontier = np.zeros(P, bool)
+            if delta.dirty_pods:
+                frontier[np.asarray(delta.dirty_pods, np.int32)] = True
+            dnode = None
+            if delta.dirty_nodes:
+                dnode = np.zeros(snap.nodes.valid.shape[0], bool)
+                dnode[np.asarray(delta.dirty_nodes, np.int32)] = True
+            # Estimate over REAL rows only (name-sorted reals precede
+            # the bucket's padding tail): pad rows read as carry -1 and
+            # would inflate the estimate past the pow2 boundary,
+            # silently disabling compaction for lineages sitting just
+            # above one.
+            n_real = len(device.meta.pod_names)
+            est = (int(frontier[:n_real].sum())
+                   + int((carry_arr[:n_real] < 0).sum()))
+            cap = self._frontier_bucket(est, P)
+            buf, tab = self._warm_inc_fn(cap)(
+                snap, warm.tableau,
+                self._pad_idx(delta.dirty_pods),
+                self._pad_idx(delta.dirty_nodes),
+                self._pad_idx(delta.dirty_members),
+                delta.pod_perm, delta.node_perm, delta.member_perm,
+                carry_arr, chosen_arr, frontier, dnode,
+            )
+            path = "incremental"
+            inc_run = True
+            rows = (len(delta.dirty_pods or ()),
+                    len(delta.dirty_nodes or ()),
+                    len(delta.dirty_members or ()))
         else:
             buf, tab = self._warm_solve_jit(
                 snap, warm.tableau,
@@ -593,17 +710,31 @@ class Engine:
                       shapes=shapes, engine=self),
             path=path, reason=reason or "", rows=rows,
         )
+        # Name orders of the snapshot THIS dispatch solves, captured
+        # now: the carry maps by name, so a concurrent next-cycle
+        # apply() shifting rows cannot corrupt it.
+        pod_names = list(device.meta.pod_names)
+        node_names = list(device.meta.node_names)
 
         def unpack(raw, seconds):
-            res = self.unpack(snap, raw)
+            if inc_run:
+                res, info = self.unpack_incremental(snap, raw)
+                res.inc_info = info
+            else:
+                res = self.unpack(snap, raw)
             res.solve_seconds = seconds
+            # Every warm-path result becomes the next incremental
+            # cycle's carry (join-thread call — same single-caller
+            # discipline as DeviceSnapshot.apply).
+            device.commit_carry(pod_names, node_names, res.assignment,
+                                np.asarray(res.chosen_score))
             return res
 
         return PendingFetch(unpack, self._submit_fetch(buf), t0)
 
-    def solve_warm(self, device) -> SolveResult:
+    def solve_warm(self, device, incremental: bool = False) -> SolveResult:
         """Blocking form of solve_warm_async."""
-        return self.solve_warm_async(device).result()
+        return self.solve_warm_async(device, incremental=incremental).result()
 
     # -- decision provenance (round 12) -------------------------------------
 

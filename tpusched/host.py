@@ -285,7 +285,7 @@ class HostScheduler:
         explain=None,
         refresh_frac: "float | None" = None,
         tracer=None,
-        warm: bool = False,
+        warm: "bool | str" = False,
     ):
         """explain (round 12, ISSUE 8): optional
         tpusched.explain.ExplainCollector; None falls back to the
@@ -312,7 +312,14 @@ class HostScheduler:
         past AVAIL_REHINT_EPS drift). Any cycle failure invalidates the
         lineage — the next cycle full-loads and solves cold. While the
         explain collector is enabled, cycles fall back to the explained
-        decode path (the warm program is never traced with observers)."""
+        decode path (the warm program is never traced with observers).
+
+        warm="incremental" (ISSUE 12): additionally seed each solve
+        with the previous cycle's assignment and run commit rounds only
+        over the pending frontier (Engine.solve_warm_async(incremental=
+        True)) — bounded divergence under the in-kernel validity
+        contract instead of bitwise parity; every cycle failure drops
+        the carry with the lineage (the same unwind)."""
         self.api = api
         self.tracer = tracer
         self.config = config or EngineConfig()
@@ -342,7 +349,13 @@ class HostScheduler:
                 "transports keep their lineage in the sidecar's "
                 "DeviceSession"
             )
-        self._warm = warm
+        if warm not in (False, True, "bitwise", "incremental"):
+            raise ValueError(
+                f"warm={warm!r}: want False, True/'bitwise', or "
+                "'incremental'"
+            )
+        self._warm = bool(warm)
+        self._warm_incremental = warm == "incremental"
         self._warm_ds: "DeviceSnapshot | None" = None
         # Last cycle's snapshot membership per class (node / pending /
         # running names): the solve input is the FILTERED pending list
@@ -524,7 +537,9 @@ class HostScheduler:
                 remove_running=sorted(prev_r - cur[2]),
             )
         self._warm_members = cur
-        res = self._engine.solve_warm_async(ds).result()
+        res = self._engine.solve_warm_async(
+            ds, incremental=self._warm_incremental
+        ).result()
         return res, ds.meta
 
     # -- snapshot assembly --------------------------------------------------

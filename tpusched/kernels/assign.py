@@ -694,16 +694,76 @@ def _spread_waterfill_deal(snap: ClusterSnapshot, pair_st, used, relaxed,
     return cand, val, ok
 
 
+def _node_add(used, node, mask, requests, rank, width: int, sign=1.0):
+    """used.at[node[p]].add(sign * requests[p]) for masked rows, as ONE
+    unique-index add per node: rows sort by (node, rank), per-node
+    request totals come off a segmented prefix sum PADDED to `width`
+    rows, and only each segment's last row scatters. Replaces the
+    order-unspecified duplicate f32 scatter-add, which made `used`
+    depend on the pod-axis layout: the frontier-compaction contract
+    (compacted [F, N] rounds bitwise == full-width [P, N] rounds) needs
+    every f32 reduction over the pod axis to be width-invariant, and a
+    width-padded front-packed cumsum + disjoint single adds is exactly
+    that (masked rows sort to the front in the same (node, rank) order
+    at any width; the tail is zeros)."""
+    P = node.shape[0]
+    N = used.shape[0]
+    node_m = jnp.where(mask, jnp.clip(node, 0, N - 1), N)
+    perm = jnp.lexsort((rank, node_m))
+    node_s = node_m[perm]
+    mask_s = mask[perm]
+    req_s = jnp.where(mask_s[:, None], requests[perm], 0.0)
+    if width > P:
+        req_pad = jnp.concatenate(
+            [req_s, jnp.zeros((width - P, req_s.shape[1]), req_s.dtype)]
+        )
+    else:
+        req_pad = req_s
+    cum = jnp.cumsum(req_pad, axis=0)[:P]                    # [P, R]
+    idx = jnp.arange(P, dtype=jnp.int32)
+    boundary = jnp.concatenate(
+        [jnp.ones(1, bool), node_s[1:] != node_s[:-1]]
+    )
+    seg_start = jax.lax.cummax(jnp.where(boundary, idx, 0))
+    offset = jnp.where(
+        (seg_start > 0)[:, None], cum[jnp.clip(seg_start - 1, 0, None)], 0.0
+    )
+    total = cum - offset                                     # incl. own row
+    is_last = jnp.concatenate([node_s[1:] != node_s[:-1], jnp.ones(1, bool)])
+    is_last &= mask_s
+    # Non-last rows add exact 0.0 at node 0 (a no-op); last rows hit
+    # DISTINCT nodes, so the unspecified duplicate-add order never sees
+    # two real contributions.
+    return used.at[jnp.where(is_last, node_s, 0)].add(
+        jnp.where(is_last[:, None], sign * total, 0.0)
+    )
+
+
 def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
                  rank, K: int, dealt_override=None,
                  dealt_override_val=None, dealt_override_ok=None,
                  score_full=None, tie_pick=None,
-                 rank_is_sorted: bool = False):
+                 rank_is_sorted: bool = False,
+                 cum_width: "int | None" = None):
     """One round's dealing + capacity-prefix conflict resolution +
     rescue, shape-generic over the pod axis (used on the full [P, N]
     matrices and on the compacted residual view — same math per pod;
     see _RESIDUAL_CAP for the f32 reduction-order caveat). Returns
     (used2, choice, chosen_val); choice[p] = committed node or -1.
+
+    cum_width (the frontier-compaction contract, ISSUE 12): when set,
+    every f32 reduction over the pod axis is made WIDTH-INVARIANT so a
+    compacted [F, N] call is bitwise-identical to the full-width [P, N]
+    call it stands in for: node desirability sums go through int32
+    fixed-point (integer adds are associativity-exact; f32 column sums
+    change with the reduction tree when the row count changes), demand
+    and per-node capacity prefixes cumsum over arrays padded/scattered
+    to `cum_width` rows (identical layouts at any view width — real
+    rows front-packed or rank-scattered, zeros elsewhere), and `used`
+    updates apply as unique-per-node segment totals (_node_add) instead
+    of order-unspecified duplicate scatter-adds. None keeps the legacy
+    reductions (the no-signature paths, whose residual compaction
+    predates — and documents — the non-bitwise caveat).
 
     Load-balancing scores give every pod nearly the SAME global node
     ranking, so per-pod argmax/top-K concentrates all commits on the
@@ -727,9 +787,21 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
     BIG = jnp.int32(2**31 - 1)
     allowed_col = allowed[:, None]
     n_allowed = jnp.maximum(allowed.sum(), 1)
-    desir = jnp.sum(
-        jnp.where(feasible & allowed_col, masked, 0.0), axis=0
-    ) / n_allowed                                            # [N]
+    if cum_width is None:
+        desir = jnp.sum(
+            jnp.where(feasible & allowed_col, masked, 0.0), axis=0
+        ) / n_allowed                                        # [N]
+    else:
+        # Fixed-point desirability (see docstring): 1/16 granularity is
+        # ample for a dealing-order heuristic, and clipping bounds the
+        # int32 column sums at P * 2^15 (exact for P <= 64k).
+        contrib = jnp.where(feasible & allowed_col, masked, 0.0)
+        iq = jnp.clip(
+            jnp.round(contrib * 16.0), -32768.0, 32768.0
+        ).astype(jnp.int32)
+        desir = jnp.sum(iq, axis=0).astype(jnp.float32) / (
+            16.0 * n_allowed.astype(jnp.float32)
+        )
     desir = jnp.where(
         jnp.any(feasible & allowed_col, axis=0), desir, NEG_INF
     )
@@ -745,7 +817,14 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
     # by rank) cumsum directly; full-width callers have rank as a
     # permutation of 0..P-1 and scatter into rank-major layout.
     dem = jnp.where(allowed[:, None], requests, 0.0)
-    if rank_is_sorted:
+    if cum_width is not None:
+        # Width-invariant layout: scatter the view's demand at GLOBAL
+        # rank positions of a [cum_width, R] array — byte-identical to
+        # the full-width rank-major scatter (absent pods demand 0) — so
+        # the f32 prefix sums agree bitwise at any view width.
+        rm = jnp.zeros((cum_width, dem.shape[1]), dem.dtype).at[rank].set(dem)
+        my_dem = jnp.cumsum(rm, axis=0)[rank]                # [P, R]
+    elif rank_is_sorted:
         my_dem = jnp.cumsum(dem, axis=0)                     # [P, R]
     else:
         rm = jnp.zeros_like(dem).at[rank].set(dem)
@@ -820,7 +899,17 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
         cand_s = cand_m[perm]
         act_s = active[perm]
         req_s = jnp.where(act_s[:, None], requests[perm], 0.0)
-        cum = jnp.cumsum(req_s, axis=0)                      # [P, R]
+        if cum_width is not None and cum_width > P:
+            # Active rows front-pack identically at any width (inactive
+            # rows sort to the sentinel tail with zero demand), so a
+            # zero-padded cumsum is bitwise width-invariant.
+            req_pad = jnp.concatenate([
+                req_s, jnp.zeros((cum_width - P, req_s.shape[1]),
+                                 req_s.dtype),
+            ])
+            cum = jnp.cumsum(req_pad, axis=0)[:P]            # [P, R]
+        else:
+            cum = jnp.cumsum(req_s, axis=0)                  # [P, R]
         idx = jnp.arange(P, dtype=jnp.int32)
         boundary = jnp.concatenate(
             [jnp.ones(1, bool), cand_s[1:] != cand_s[:-1]]
@@ -842,9 +931,13 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
         commit_s = fits & prefix_ok
         commit_j = jnp.zeros(P, bool).at[perm].set(commit_s)
         nofit = jnp.zeros(P, bool).at[perm].set(bad)
-        used_j = used_j.at[jnp.clip(cand, 0, N - 1)].add(
-            jnp.where(commit_j[:, None], requests, 0.0)
-        )
+        if cum_width is not None:
+            used_j = _node_add(used_j, cand, commit_j, requests, rank,
+                               cum_width)
+        else:
+            used_j = used_j.at[jnp.clip(cand, 0, N - 1)].add(
+                jnp.where(commit_j[:, None], requests, 0.0)
+            )
         choice_j = jnp.where(commit_j, cand, choice_j)
         # Only pods whose own node is full advance their pointer;
         # prefix-blocked pods retry the same node next sub-step.
@@ -990,14 +1083,19 @@ EXPLAIN_AUCTION_STATS = (
 )
 
 
-def _spread_excess_mask(snap: ClusterSnapshot, static: StaticCtx, rank,
+def _spread_excess_mask(snap: ClusterSnapshot, aff_ok, rank,
                         choice, kept_v, st_v):
     """[P] bool: kept members to revert so every kept DNS-spread
     constraint holds against st_v's (end-of-round) counts. Per (sig,
     domain) group of revert-eligible members, the highest-priority
     prefix whose size respects every kept member's skew bound survives;
     the excess reverts. Shared by solve_rounds' commit-validation
-    fixpoint and _preempt_rounds' round validation (round 6)."""
+    fixpoint, _preempt_rounds' round validation (round 6), and the
+    incremental warm path's carried-placement revalidation + in-kernel
+    audit (ISSUE 12). Shape-generic over the pod axis: pass a view
+    snapshot (gathered pods rows) plus the matching aff_ok/rank/choice
+    rows and the verdict is row-for-row what the full-width call gives
+    (all cross-pod reductions here are integer-exact)."""
     pods, nodes = snap.pods, snap.nodes
     P = pods.valid.shape[0]
     N = nodes.valid.shape[0]
@@ -1020,7 +1118,7 @@ def _spread_excess_mask(snap: ClusterSnapshot, static: StaticCtx, rank,
         # Per-pod allowance T = min over eligible domains of the
         # END-state count, plus the pod's own maxSkew.
         nc_p = node_cnt[s_c]                                 # [P, N]
-        eligible = nodes.valid[None, :] & static.aff_ok & (
+        eligible = nodes.valid[None, :] & aff_ok & (
             dom_s_v[s_c] >= 0
         )
         min_end = jnp.min(
@@ -1067,6 +1165,44 @@ def _spread_excess_mask(snap: ClusterSnapshot, static: StaticCtx, rank,
         bad_c = jnp.zeros(P, bool).at[perm2].set(mem_s & ~survive_s)
         bad |= bad_c
     return bad
+
+
+def _compact_cap(cfg: EngineConfig, P: int) -> int:
+    """Resolved signature-path frontier-compaction cap (ISSUE 12):
+    0 = compaction off (the full-width reference the bitwise twin tests
+    compare against), cfg.compact_cap -1 = auto (_RESIDUAL_CAP), else
+    the explicit cap. Disabled when P is not meaningfully larger than
+    the cap (the gathers would not pay for themselves) — except for an
+    EXPLICIT positive cap, which tests use to exercise the compacted
+    program on small clusters."""
+    cap = _RESIDUAL_CAP if cfg.compact_cap < 0 else cfg.compact_cap
+    if cap <= 0:
+        return 0
+    if cfg.compact_cap < 0 and P <= cap:
+        return 0
+    return min(cap, P)
+
+
+def _pods_view(snap: ClusterSnapshot, static: StaticCtx, sel):
+    """Compacted pod-axis view (the frontier gather): pod rows, static
+    rows, and the sig_match MEMBER columns of the selected pods, as a
+    (view snapshot, view StaticCtx) pair every shape-generic kernel in
+    this module accepts in place of the full-width pair. Running
+    members, nodes, sigs, and all [S, N]/[N, R] state stay full — the
+    compaction only narrows the pod axis."""
+    M = snap.running.valid.shape[0]
+    pods_v = jax.tree.map(lambda a: a[sel], snap.pods)
+    snap_v = snap.replace(pods=pods_v)
+    sig_v = jnp.concatenate(
+        [static.sig_match[:, :M], static.sig_match[:, M + sel]], axis=1
+    )
+    static_v = StaticCtx(
+        mask=static.mask[sel], aff_ok=static.aff_ok[sel],
+        score=static.score[sel], sig_match=sig_v,
+        w_lr=static.w_lr[sel], w_ba=static.w_ba[sel],
+        w_ts=static.w_ts[sel], w_ia=static.w_ia[sel], rw=static.rw,
+    )
+    return snap_v, static_v
 
 
 def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
@@ -1318,13 +1454,6 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             # slot freed only by this batch's evictions opens next
             # batch (the snapshot then has the victims gone), exactly
             # like upstream's nominate-then-requeue.
-            choice_full = jnp.full(P, -1, jnp.int32).at[sel].set(
-                jnp.where(keep_all, target_all, -1)
-            )
-            keep_full = jnp.zeros(P, bool).at[sel].set(keep_all)
-            st2 = kpair.pair_state_commit(
-                snap, st2, static.sig_match, choice_full, keep_full
-            )
             # Same-round cross-commit validation (round 6): the claim
             # scan's NODE exclusivity does not bound pairwise
             # interactions — spread constraints are per-DOMAIN (many
@@ -1338,6 +1467,32 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             # Their victims stay evicted (the eviction was decided
             # against valid round-start state; upstream's
             # nominate-then-requeue can strand evictions the same way).
+            #
+            # Frontier compaction (ISSUE 12): every keep is in `sel`,
+            # so with compaction on the whole fixpoint runs on the
+            # [C]-wide view — pair_state_commit / ia_ok_at_choice /
+            # _spread_excess_mask only ever touch exact (integer-
+            # valued) reductions, so the view verdicts are bitwise the
+            # full-width ones (the compact-off engine keeps the [P]
+            # arrays as the twin-test reference).
+            compact_pv = _compact_cap(cfg, P) > 0
+            if compact_pv:
+                snap_pv, static_pv = _pods_view(snap, static, sel)
+                choice_pv = jnp.where(keep_all, target_all, -1)
+                keep_pv = keep_all
+                hp_pv = has_pair[sel]
+                rank_pv = rank[sel]
+            else:
+                snap_pv, static_pv = snap, static
+                choice_pv = jnp.full(P, -1, jnp.int32).at[sel].set(
+                    jnp.where(keep_all, target_all, -1)
+                )
+                keep_pv = jnp.zeros(P, bool).at[sel].set(keep_all)
+                hp_pv = has_pair
+                rank_pv = rank
+            st2 = kpair.pair_state_commit(
+                snap_pv, st2, static_pv.sig_match, choice_pv, keep_pv
+            )
 
             def pv_cond(vs):
                 return vs[-1]
@@ -1345,24 +1500,25 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             def pv_body(vs):
                 st_v, kept_v, _ = vs
                 ia_ok = kpair.ia_ok_at_choice(
-                    snap, st_v, static.sig_match, choice_full,
-                    jnp.where(kept_v, choice_full, -1),
+                    snap_pv, st_v, static_pv.sig_match, choice_pv,
+                    jnp.where(kept_v, choice_pv, -1),
                 )
-                bad = kept_v & has_pair & ~ia_ok
+                bad = kept_v & hp_pv & ~ia_ok
                 bad = bad | (kept_v & _spread_excess_mask(
-                    snap, static, rank, choice_full, kept_v, st_v
+                    snap_pv, static_pv.aff_ok, rank_pv, choice_pv,
+                    kept_v, st_v
                 ))
                 st_v = kpair.pair_state_commit(
-                    snap, st_v, static.sig_match, choice_full, bad,
+                    snap_pv, st_v, static_pv.sig_match, choice_pv, bad,
                     sign=-1.0,
                 )
                 return st_v, kept_v & ~bad, jnp.any(bad)
 
             st2, kept_final, _ = jax.lax.while_loop(
                 pv_cond, pv_body,
-                (st2, keep_full, jnp.any(keep_full & has_pair)),
+                (st2, keep_pv, jnp.any(keep_pv & hp_pv)),
             )
-            keep_valid = kept_final[sel]
+            keep_valid = kept_final if compact_pv else kept_final[sel]
             keep = keep & keep_valid
             keep_pl = keep_pl & keep_valid
             keep_all = keep | keep_pl
@@ -1534,15 +1690,27 @@ def _make_round_nosig(cfg, alloc, req, mask, sscore, valid, rank, pod_ids,
 
 def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
                         static: StaticCtx, rank, order, max_rounds: int,
-                        K: int):
+                        K: int, init=None, skip_full: bool = False,
+                        cap: "int | None" = None):
     """Fast-mode rounds when the snapshot has NO pairwise signatures
     (trace-time fact; the common resource/affinity-only serving case):
     tranches of the top-_RESIDUAL_CAP pending pods by rank run [C, N]
     views to fixpoint (see tranche_path below). Returns
-    (used, assigned, chosen, round_of, rounds)."""
+    (used, assigned, chosen, round_of, rounds).
+
+    init: optional seeded (used, assigned, chosen, round_of, progress,
+    r) — the incremental warm path enters with carried placements
+    already assigned and their capacity applied. skip_full=True also
+    skips the full-width round 1 (with a small pending frontier it
+    would cost [P, N] to place a handful of pods; the tranche loop is
+    strictly cheaper there). cap: explicit tranche width — the
+    incremental path passes its pow2 FRONTIER bucket so the [C, N]
+    view tracks the frontier, not the residual cap (at 2000 pods the
+    default small-P guard would otherwise run full-width rounds and
+    hand back the very cost the mode exists to shed)."""
     pods, nodes = snap.pods, snap.nodes
     P = pods.valid.shape[0]
-    C = _RESIDUAL_CAP
+    C = _RESIDUAL_CAP if cap is None else max(1, min(cap, P))
     BIG = jnp.int32(2**31 - 1)
     cond_f, body_f = _make_round_nosig(
         cfg, nodes.allocatable, pods.requests, static.mask, static.score,
@@ -1550,12 +1718,13 @@ def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
         static.w_lr, static.w_ba, static.w_ts,
         static.rw, max_rounds, K,
     )
-    init = (
-        nodes.used, jnp.full(P, -1, jnp.int32),
-        jnp.full(P, NEG_INF, jnp.float32), jnp.full(P, -1, jnp.int32),
-        jnp.array(True), jnp.int32(0),
-    )
-    if P <= 2 * C:
+    if init is None:
+        init = (
+            nodes.used, jnp.full(P, -1, jnp.int32),
+            jnp.full(P, NEG_INF, jnp.float32), jnp.full(P, -1, jnp.int32),
+            jnp.array(True), jnp.int32(0),
+        )
+    if P <= (2 * C if cap is None else C):
         # Too small for compaction to pay for its gathers.
         st = jax.lax.while_loop(cond_f, body_f, init)
         used, assigned, chosen, round_of, _, rounds = st
@@ -1567,7 +1736,7 @@ def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
     # enabled — that config exists because the cluster is near
     # capacity, round 1 then places little and costs ~50 ms, and the
     # tranche loop handles a large pending set strictly cheaper.
-    state1 = init if cfg.preemption else body_f(init)
+    state1 = init if (cfg.preemption or skip_full) else body_f(init)
 
     # TRANCHE processing (round 5; replaces the full-width rounds whose
     # 13 x ~45 ms sweeps dominated the preemption-config solve):
@@ -1670,134 +1839,129 @@ def _solve_rounds_nosig(cfg: EngineConfig, snap: ClusterSnapshot,
     return tranche_path(state1)
 
 
-def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
-                 node_sat_t, member_sat_t, init_counts=None,
-                 explain: bool = False, static=None):
-    """Fast mode: optimistic batched rounds with validate-and-rollback.
-    Returns (assigned, chosen, used, order, round_of, rounds, evicted);
-    with explain=True (decision provenance, round 12) an extra trailing
-    tuple (rolled, evictor, evict_round, auction_stats) — gang-rollback
-    mask [P], per-victim preemptor pod index / commit-round [M] (-1 =
-    not evicted), and the [_PREEMPT_MAX_ROUNDS, EXPLAIN_AUCTION_STATS]
-    per-round auction table. The explain accumulation is traced only
-    when requested, so the default program is unchanged. static:
-    optional precomputed StaticCtx (the warm path)."""
-    if static is None:
-        static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
+def _sig_involvement(snap: ClusterSnapshot, static: StaticCtx, st0):
+    """(invol [P, S] bool | None, has_pair [P] bool).
+
+    has_pair: pods whose pairwise validation can ever fail — own spread
+    or inter-pod terms, plus symmetric-anti TARGETS: a pod with NO
+    constraints of its own can still be displaced by symmetric
+    anti-affinity, so it must revalidate if any live anti term (running
+    holders via st0.anti — domain-aware, so key-less holders don't
+    count — or pending holders, whose node is unknown yet) has a
+    selector matching it.
+
+    invol: signature-involvement — the sigs whose counts a pod's checks
+    read (its own constraint sigs) or whose counts its commit writes
+    (selectors matching it). Pods with DISJOINT involvement cannot
+    affect each other's pairwise validation, so conservative pods may
+    commit concurrently one-per-sig-cluster instead of one-per-round
+    globally — the difference between O(#conservative) and
+    O(#sig-clusters) rounds on spread-heavy workloads. It is also the
+    incremental warm path's signature-cluster CLOSURE relation: a dirty
+    pod drags every invol-overlapping pod into the re-solve frontier
+    (ISSUE 12). None when the snapshot has no signatures."""
+    pods = snap.pods
+    P = pods.valid.shape[0]
+    has_pair = jnp.any(pods.ts_valid, axis=1) | jnp.any(pods.ia_valid, axis=1)
+    if snap.sigs.key.shape[0] == 0:
+        return None, has_pair
+    M = snap.running.valid.shape[0]
+    anti_possible = st0.anti.sum(axis=1) > 0
+    for t in range(pods.ia_key.shape[1]):
+        s_t = jnp.clip(pods.ia_sig[:, t], 0, None)
+        hold = kpair._pod_anti_holds(snap, t) & pods.valid
+        anti_possible = anti_possible.at[s_t].max(hold)
+    sym_target = jnp.any(
+        static.sig_match[:, M:] & anti_possible[:, None], axis=0
+    )
+    has_pair = has_pair | sym_target
+    invol = static.sig_match[:, M:].T & pods.valid[:, None]  # [P, S]
+    for c in range(pods.ts_key.shape[1]):
+        s_c = jnp.clip(pods.ts_sig[:, c], 0, None)
+        invol = invol.at[jnp.arange(P), s_c].max(pods.ts_valid[:, c])
+    for t in range(pods.ia_key.shape[1]):
+        s_t = jnp.clip(pods.ia_sig[:, t], 0, None)
+        invol = invol.at[jnp.arange(P), s_t].max(pods.ia_valid[:, t])
+    return invol, has_pair
+
+
+def _solve_rounds_sig(cfg: EngineConfig, snap: ClusterSnapshot,
+                      static: StaticCtx, rank, order, invol, has_pair,
+                      init, max_rounds: int, K: int, cap: int):
+    """The signature-path (S > 0) commit-round loop, frontier-compacted
+    (ISSUE 12): full-width [P, N] rounds run only while the pending
+    frontier exceeds `cap`; once it fits, each round gathers the WHOLE
+    pending frontier (top-`cap` by rank — a superset, so every pod that
+    could commit, gate, or validate is in view) into a [cap, N] view via
+    _pods_view, runs the identical round math there, and scatters the
+    commits back. cap == 0 keeps every round full-width — the reference
+    the bitwise twin tests compare against.
+
+    BITWISE CONTRACT: compacted rounds equal full-width rounds on
+    assignment/chosen_score/evicted. Every cross-pod reduction in the
+    round is width-invariant by construction — integer/boolean/min
+    reductions are exact in any tree; the f32 ones go through
+    _deal_commit(cum_width=P) and _node_add (fixed-point desirability
+    sums, width-padded rank-major cumsums, unique-per-node adds); sorts
+    key on globally-unique ranks so view layouts gather to identical
+    sequences. Pinned by tests/test_frontier.py across structural-churn
+    twins incl. preemption and gang admission.
+
+    init/returns: (used, assigned, pair_st, conservative, chosen,
+    round_of, progress, r) — `init` may carry a warm-seeded state (the
+    incremental path: carried assignments pre-committed into used and
+    pair_st, r starting past the carried commit key)."""
     pods, nodes = snap.pods, snap.nodes
     P = pods.valid.shape[0]
     N = nodes.valid.shape[0]
-    order = pop_order(cfg, snap)
-    rank = jnp.zeros(P, jnp.int32).at[order].set(jnp.arange(P, dtype=jnp.int32))
-    has_pair = jnp.any(pods.ts_valid, axis=1) | jnp.any(pods.ia_valid, axis=1)
-    st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts)
-    # A pod with NO constraints of its own can still be displaced by
-    # symmetric anti-affinity: it must revalidate if any live anti term
-    # (running holders via st0.anti — domain-aware, so key-less holders
-    # don't count — or pending holders, whose node is unknown yet) has a
-    # selector matching it.
-    S = snap.sigs.key.shape[0]
-    invol = None
-    if S:
-        M = snap.running.valid.shape[0]
-        anti_possible = st0.anti.sum(axis=1) > 0
-        for t in range(pods.ia_key.shape[1]):
-            s_t = jnp.clip(pods.ia_sig[:, t], 0, None)
-            hold = kpair._pod_anti_holds(snap, t) & pods.valid
-            anti_possible = anti_possible.at[s_t].max(hold)
-        sym_target = jnp.any(
-            static.sig_match[:, M:] & anti_possible[:, None], axis=0
-        )
-        has_pair = has_pair | sym_target
-        # Signature-involvement [P, S]: the sigs whose counts a pod's
-        # checks read (its own constraint sigs) or whose counts its
-        # commit writes (selectors matching it). Pods with DISJOINT
-        # involvement cannot affect each other's pairwise validation, so
-        # conservative pods may commit concurrently one-per-sig-cluster
-        # instead of one-per-round globally — the difference between
-        # O(#conservative) and O(#sig-clusters) rounds on spread-heavy
-        # workloads.
-        invol = static.sig_match[:, M:].T & pods.valid[:, None]  # [P, S]
-        for c in range(pods.ts_key.shape[1]):
-            s_c = jnp.clip(pods.ts_sig[:, c], 0, None)
-            invol = invol.at[jnp.arange(P), s_c].max(pods.ts_valid[:, c])
-        for t in range(pods.ia_key.shape[1]):
-            s_t = jnp.clip(pods.ia_sig[:, t], 0, None)
-            invol = invol.at[jnp.arange(P), s_t].max(pods.ia_valid[:, t])
     BIG = jnp.int32(2**31 - 1)
-    # Round bound: worst case is one conservative pod committing per
-    # round, so the auto bound is O(P); cfg.max_rounds > 0 caps it lower
-    # (pods still pending at the cap stay unassigned that batch).
-    max_rounds = cfg.max_rounds if cfg.max_rounds > 0 else 2 * P + 8
 
-    def cond(state):
-        progress, r = state[-2], state[-1]
-        return progress & (r < max_rounds)
-
-    K = _fallback_depth(N)
-
-    def body(state):
-        used, assigned, pair_st, conservative, chosen, round_of, _, r = state
-        pending = assigned == -1
-
+    def round_math(snap_v, static_v, invol_v, hp_v, rank_v, pod_ids,
+                   pending_v, conservative_v, used, pair_st, r):
+        """One commit round over a (possibly compacted) pod-axis view.
+        Returns (used3, st3, kept, choice, chosen_val, fb_mask)."""
         feasible, score, relaxed = batched_cycle(
-            cfg, snap, static, used, pair_st, return_relaxed=True
+            cfg, snap_v, static_v, used, pair_st, return_relaxed=True
         )
-        feasible &= pending[:, None]
-        relaxed &= pending[:, None]
+        feasible &= pending_v[:, None]
+        relaxed &= pending_v[:, None]
         masked = jnp.where(feasible, score, NEG_INF)
         want = jnp.any(feasible, axis=1)
-
         # Conservative pods commit only when first among wanting pods
         # they could INTERACT with: minimal rank within every signature
         # cluster they touch (pods with disjoint involvement are
-        # independent). Pods with no involvement at all can never
-        # re-violate; let them retry freely.
-        if invol is None:
-            first_rank = jnp.min(jnp.where(want, rank, BIG))
-            ok_cons = rank == first_rank
-        else:
-            cons_want = want & conservative
-            rank_or_big = jnp.where(cons_want, rank, BIG)       # [P]
-            min_rank_sig = jnp.min(
-                jnp.where(invol, rank_or_big[:, None], BIG), axis=0
-            )                                                   # [S]
-            ok_cons = jnp.all(
-                jnp.where(invol, rank[:, None] == min_rank_sig[None, :], True),
-                axis=1,
-            )
-        allowed = want & (~conservative | ok_cons)
+        # independent).
+        cons_want = want & conservative_v
+        rank_or_big = jnp.where(cons_want, rank_v, BIG)         # [F]
+        min_rank_sig = jnp.min(
+            jnp.where(invol_v, rank_or_big[:, None], BIG), axis=0
+        )                                                       # [S]
+        ok_cons = jnp.all(
+            jnp.where(invol_v, rank_v[:, None] == min_rank_sig[None, :],
+                      True),
+            axis=1,
+        )
+        allowed = want & (~conservative_v | ok_cons)
 
         # Water-fill membership and activation use the RELAXED rows: a
         # DNS pod whose every in-bound domain is skew-blocked against
         # round-start counts can still legally place under end-of-round
         # semantics (the validator's state) — see _spread_waterfill_deal.
-        allowed_r = jnp.any(relaxed, axis=1) & (~conservative | ok_cons)
+        allowed_r = jnp.any(relaxed, axis=1) & (~conservative_v | ok_cons)
         sp_cand, sp_val, sp_ok = _spread_waterfill_deal(
-            snap, pair_st, used, relaxed, score, allowed_r, rank, K
+            snap_v, pair_st, used, relaxed, score, allowed_r, rank_v, K
         )
         used2, choice, chosen_val = _deal_commit(
-            nodes.allocatable, pods.requests, used, feasible, masked,
-            allowed | sp_ok, rank, K, dealt_override=sp_cand,
+            nodes.allocatable, snap_v.pods.requests, used, feasible,
+            masked, allowed | sp_ok, rank_v, K, dealt_override=sp_cand,
             dealt_override_val=sp_val, dealt_override_ok=sp_ok,
             score_full=score,
-            tie_pick=pick_node_batch(
-                cfg, masked, jnp.arange(P, dtype=jnp.int32)
-            ),
+            tie_pick=pick_node_batch(cfg, masked, pod_ids),
+            cum_width=P,
         )
         commit = choice >= 0
-        if snap.sigs.key.shape[0] == 0:
-            # No pairwise constraints (trace-time): counts are empty and
-            # no commit can violate anything — skip validation wholesale.
-            assigned2 = jnp.where(commit, choice, assigned)
-            chosen2 = jnp.where(commit, chosen_val, chosen)
-            round_of2 = jnp.where(commit, r, round_of)
-            all_done = jnp.all((assigned2 >= 0) | ~pods.valid)
-            progress = jnp.any(commit) & ~all_done
-            return (used2, assigned2, pair_st, conservative, chosen2,
-                    round_of2, progress, r + 1)
         st2 = kpair.pair_state_commit(
-            snap, pair_st, static.sig_match, choice, commit
+            snap_v, pair_st, static_v.sig_match, choice, commit
         )
 
         # Validate committed pairwise pods against end-of-round counts;
@@ -1819,13 +1983,9 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
         #     pods retry WITHOUT the conservative gate: next round's
         #     start-state counts mask the full domains, so the dealer
         #     redirects them. Reverting ALL violators and serializing
-        #     them (the old policy) cost O(pods-with-spread) rounds on
-        #     spread-heavy workloads (~141 rounds on BASELINE config 3);
-        #     excess-only reverts converge in a handful.
-        def spread_excess(st_v, kept_v):
-            return _spread_excess_mask(snap, static, rank, choice,
-                                       kept_v, st_v)
-
+        #     them cost O(pods-with-spread) rounds on spread-heavy
+        #     workloads (~141 rounds on BASELINE config 3); excess-only
+        #     reverts converge in a handful.
         def vcond(vs):
             return vs[-1]
 
@@ -1836,73 +1996,163 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             # expensive as a scoring round; the fixpoint only reads
             # the chosen-node column.
             ia_ok_at = kpair.ia_ok_at_choice(
-                snap, st_v, static.sig_match, choice,
+                snap_v, st_v, static_v.sig_match, choice,
                 jnp.where(kept_v, choice, -1),
             )
-            ia_bad_all = kept_v & has_pair & ~ia_ok_at
-            # Rank-ordered partial reverts (round-4: replaces marking
-            # every IA violator conservative, which serialized them
-            # one-per-sig-cluster per round — 146 rounds on the 10k x 5k
-            # pairwise config). PROTECT the violator that precedes every
-            # other violator it could interact with (minimal rank across
-            # all its involved sigs): its violation is usually induced
-            # by same-round higher-rank commits, which revert first; the
-            # fixpoint then re-checks it against the surviving state.
-            # If a pass finds only protected violators left, they are
-            # genuinely invalid against the kept state — revert them too
-            # (also guarantees each pass reverts >= 1, so the loop
+            ia_bad_all = kept_v & hp_v & ~ia_ok_at
+            # Rank-ordered partial reverts (round-4): PROTECT the
+            # violator that precedes every other violator it could
+            # interact with (minimal rank across all its involved
+            # sigs): its violation is usually induced by same-round
+            # higher-rank commits, which revert first; the fixpoint
+            # then re-checks it against the surviving state. If a pass
+            # finds only protected violators left, they are genuinely
+            # invalid against the kept state — revert them too (also
+            # guarantees each pass reverts >= 1, so the loop
             # terminates).
-            bad_rank = jnp.where(ia_bad_all, rank, BIG)
+            bad_rank = jnp.where(ia_bad_all, rank_v, BIG)
             min_bad_sig = jnp.min(
-                jnp.where(invol, bad_rank[:, None], BIG), axis=0
+                jnp.where(invol_v, bad_rank[:, None], BIG), axis=0
             )                                                   # [S]
             protected = ia_bad_all & jnp.all(
-                jnp.where(invol, rank[:, None] == min_bad_sig[None, :], True),
+                jnp.where(invol_v,
+                          rank_v[:, None] == min_bad_sig[None, :], True),
                 axis=1,
             )
             ia_bad = ia_bad_all & ~protected
-            sp_bad = spread_excess(st_v, kept_v) & ~ia_bad_all
+            sp_bad = _spread_excess_mask(
+                snap_v, static_v.aff_ok, rank_v, choice, kept_v, st_v
+            ) & ~ia_bad_all
             stuck = ~jnp.any(ia_bad | sp_bad) & jnp.any(ia_bad_all)
             ia_bad = ia_bad | (ia_bad_all & stuck)
             new_viol = ia_bad | sp_bad
-            used_v = used_v.at[jnp.clip(choice, 0, N - 1)].add(
-                -jnp.where(new_viol[:, None], pods.requests, 0.0)
-            )
+            used_v = _node_add(used_v, choice, new_viol,
+                               snap_v.pods.requests, rank_v, P, sign=-1.0)
             st_v = kpair.pair_state_commit(
-                snap, st_v, static.sig_match, choice, new_viol, sign=-1.0
+                snap_v, st_v, static_v.sig_match, choice, new_viol,
+                sign=-1.0,
             )
             return (st_v, used_v, kept_v & ~new_viol, jnp.any(new_viol))
 
-        any_pair_committed = jnp.any(commit & has_pair)
         st3, used3, kept, _ = jax.lax.while_loop(
-            vcond, vbody, (st2, used2, commit, any_pair_committed),
+            vcond, vbody, (st2, used2, commit, jnp.any(commit & hp_v)),
         )
         viol = commit & ~kept
-        assigned2 = jnp.where(kept, choice, assigned)
-        chosen2 = jnp.where(kept, chosen_val, chosen)
-        # Progress backstop: reverted pods retry optimistically against
-        # next round's start-state counts (which now mask the domains
-        # they lost), so they normally converge without any gating. But
-        # if EVERY commit of this round was reverted, optimism alone
-        # proves nothing placed — mark the first reverted pod (by rank)
-        # conservative so the ordered one-per-cluster path guarantees
-        # progress, exactly the old behavior as a fallback.
         if _DEBUG_ROUNDS:
             jax.debug.print(
                 "round {r}: allowed={a} commit={c} kept={k} viol={v}",
                 r=r, a=allowed.sum(), c=commit.sum(), k=kept.sum(),
                 v=viol.sum(),
             )
+        # Progress backstop: reverted pods retry optimistically against
+        # next round's start-state counts (which now mask the domains
+        # they lost), so they normally converge without any gating. But
+        # if EVERY commit of this round was reverted, optimism alone
+        # proves nothing placed — mark the first reverted pod (by rank)
+        # conservative so the ordered one-per-cluster path guarantees
+        # progress.
         need_fb = ~jnp.any(kept) & jnp.any(viol)
-        fb_first = rank == jnp.min(jnp.where(viol, rank, BIG))
+        fb_first = rank_v == jnp.min(jnp.where(viol, rank_v, BIG))
         fb_mask = viol & fb_first & need_fb
+        return used3, st3, kept, choice, chosen_val, fb_mask
+
+    ids = jnp.arange(P, dtype=jnp.int32)
+
+    def full_body(state):
+        used, assigned, pair_st, conservative, chosen, round_of, _, r = state
+        pending = assigned == -1
+        used3, st3, kept, choice, chosen_val, fb_mask = round_math(
+            snap, static, invol, has_pair, rank, ids, pending,
+            conservative, used, pair_st, r,
+        )
+        assigned2 = jnp.where(kept, choice, assigned)
+        chosen2 = jnp.where(kept, chosen_val, chosen)
+        round_of2 = jnp.where(kept, r, round_of)
         new_conservative = fb_mask & ~conservative
         conservative2 = conservative | fb_mask
-        round_of2 = jnp.where(kept, r, round_of)
         all_done = jnp.all((assigned2 >= 0) | ~pods.valid)
         progress = (jnp.any(kept) | jnp.any(new_conservative)) & ~all_done
         return (used3, assigned2, st3, conservative2, chosen2,
                 round_of2, progress, r + 1)
+
+    def full_cond(state):
+        progress, r = state[-2], state[-1]
+        ok = progress & (r < max_rounds)
+        if cap:
+            # Hand off to the compacted loop once the whole pending
+            # frontier fits one view (never before: the view must hold
+            # EVERY pending pod for the bitwise contract to hold).
+            ok &= jnp.sum(
+                ((state[1] == -1) & pods.valid).astype(jnp.int32)
+            ) > cap
+        return ok
+
+    state = jax.lax.while_loop(full_cond, full_body, init)
+    if not cap:
+        return state
+
+    def compact_body(state):
+        used, assigned, pair_st, conservative, chosen, round_of, _, r = state
+        pend = (assigned == -1) & pods.valid
+        sel, _ = _top_by_rank(pend, order, cap)
+        snap_v, static_v = _pods_view(snap, static, sel)
+        used3, st3, kept, choice, chosen_val, fb_mask = round_math(
+            snap_v, static_v, invol[sel], has_pair[sel], rank[sel], sel,
+            pend[sel], conservative[sel], used, pair_st, r,
+        )
+        assigned2 = assigned.at[sel].set(
+            jnp.where(kept, choice, assigned[sel])
+        )
+        chosen2 = chosen.at[sel].set(
+            jnp.where(kept, chosen_val, chosen[sel])
+        )
+        round_of2 = round_of.at[sel].set(
+            jnp.where(kept, r, round_of[sel])
+        )
+        new_conservative = fb_mask & ~conservative[sel]
+        conservative2 = conservative.at[sel].set(
+            conservative[sel] | fb_mask
+        )
+        all_done = jnp.all((assigned2 >= 0) | ~pods.valid)
+        progress = (jnp.any(kept) | jnp.any(new_conservative)) & ~all_done
+        return (used3, assigned2, st3, conservative2, chosen2,
+                round_of2, progress, r + 1)
+
+    def compact_cond(state):
+        progress, r = state[-2], state[-1]
+        return progress & (r < max_rounds)
+
+    return jax.lax.while_loop(compact_cond, compact_body, state)
+
+
+def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
+                 node_sat_t, member_sat_t, init_counts=None,
+                 explain: bool = False, static=None):
+    """Fast mode: optimistic batched rounds with validate-and-rollback.
+    Returns (assigned, chosen, used, order, round_of, rounds, evicted);
+    with explain=True (decision provenance, round 12) an extra trailing
+    tuple (rolled, evictor, evict_round, auction_stats) — gang-rollback
+    mask [P], per-victim preemptor pod index / commit-round [M] (-1 =
+    not evicted), and the [_PREEMPT_MAX_ROUNDS, EXPLAIN_AUCTION_STATS]
+    per-round auction table. The explain accumulation is traced only
+    when requested, so the default program is unchanged. static:
+    optional precomputed StaticCtx (the warm path)."""
+    if static is None:
+        static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
+    pods, nodes = snap.pods, snap.nodes
+    P = pods.valid.shape[0]
+    N = nodes.valid.shape[0]
+    order = pop_order(cfg, snap)
+    rank = jnp.zeros(P, jnp.int32).at[order].set(jnp.arange(P, dtype=jnp.int32))
+    st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts)
+    S = snap.sigs.key.shape[0]
+    invol, has_pair = _sig_involvement(snap, static, st0)
+    BIG = jnp.int32(2**31 - 1)
+    # Round bound: worst case is one conservative pod committing per
+    # round, so the auto bound is O(P); cfg.max_rounds > 0 caps it lower
+    # (pods still pending at the cap stay unassigned that batch).
+    max_rounds = cfg.max_rounds if cfg.max_rounds > 0 else 2 * P + 8
+    K = _fallback_depth(N)
 
     if S == 0:
         # No pairwise signatures (trace-time): dedicated path with
@@ -1918,9 +2168,11 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             jnp.zeros(P, bool), jnp.full(P, NEG_INF, jnp.float32),
             jnp.full(P, -1, jnp.int32), jnp.array(True), jnp.int32(0),
         )
-        used, assigned, st_f, _, chosen, round_of, _, rounds = (
-            jax.lax.while_loop(cond, body, init)
+        out = _solve_rounds_sig(
+            cfg, snap, static, rank, order, invol, has_pair, init,
+            max_rounds, K, _compact_cap(cfg, P),
         )
+        used, assigned, st_f, _, chosen, round_of, _, rounds = out
     M = snap.running.valid.shape[0]
     evicted = jnp.zeros(M, bool)
     evictor = evict_rd = astats = None
@@ -1954,3 +2206,224 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
     if explain:
         return base + ((rolled, evictor, evict_rd, astats),)
     return base
+
+
+def _capacity_prefix_keep(alloc, used_base, requests, node, rank, active):
+    """[P] bool: per node, the longest rank-ordered prefix of `active`
+    rows whose cumulative requests fit alloc - used_base — the same
+    capacity-prefix rule _deal_commit's sub-step commits by, applied to
+    the incremental warm path's CARRIED placements: a node whose
+    allocatable shrank (or whose carried demand grew) spills its
+    lowest-priority carried pods back into the pending frontier instead
+    of overflowing."""
+    P = node.shape[0]
+    N = alloc.shape[0]
+    node_m = jnp.where(active, jnp.clip(node, 0, N - 1), N)
+    perm = jnp.lexsort((rank, node_m))
+    node_s = node_m[perm]
+    act_s = active[perm]
+    req_s = jnp.where(act_s[:, None], requests[perm], 0.0)
+    cum = jnp.cumsum(req_s, axis=0)
+    idx = jnp.arange(P, dtype=jnp.int32)
+    boundary = jnp.concatenate(
+        [jnp.ones(1, bool), node_s[1:] != node_s[:-1]]
+    )
+    seg_start = jax.lax.cummax(jnp.where(boundary, idx, 0))
+    offset = jnp.where(
+        (seg_start > 0)[:, None], cum[jnp.clip(seg_start - 1, 0, None)], 0.0
+    )
+    within = cum - offset
+    cap_node = jnp.clip(node_s, 0, N - 1)
+    fits = jnp.all(
+        used_base[cap_node] + within <= alloc[cap_node], axis=-1
+    ) & act_s
+    bad = act_s & ~fits
+    last_bad = jax.lax.cummax(jnp.where(bad, idx, -1))
+    keep_s = fits & (last_bad < seg_start)
+    return jnp.zeros(P, bool).at[perm].set(keep_s)
+
+
+# Layout of the incremental solve's in-kernel audit vector (appended to
+# the packed solve buffer by the engine's incremental program):
+#   [cap_violations, carried_static_violations, carried_pair_violations,
+#    carried_count, frontier_count]
+INC_AUDIT_LEN = 5
+
+
+def solve_incremental(cfg: EngineConfig, snap: ClusterSnapshot, tab,
+                      carry, carry_chosen, frontier0, dirty_node_mask,
+                      cap: int):
+    """Bounded-divergence warm commit rounds (ISSUE 12, tentpole 2):
+    seed the round loop with the previous cycle's assignment for clean
+    pods and run commit rounds only over the pending FRONTIER, so solve
+    time scales with churn, not the cluster.
+
+      1. The frontier starts from the lineage's dirty pods (frontier0)
+         and expands to its SIGNATURE-CLUSTER closure (pods whose invol
+         rows overlap a dirty pod's — their counts a dirty commit can
+         read or write) and NODE closure (carried pods sitting on a
+         dirty node: its capacity/labels may have moved under them).
+      2. Every remaining carried placement is revalidated against
+         CURRENT state in one batched pass per class: static mask at
+         the carried node (taints/affinity/cordon), per-node rank-
+         ordered capacity prefix vs current allocatable, and — when
+         signatures exist — the pairwise fixpoint (ia_ok_at_choice +
+         _spread_excess_mask, the exact validators the cold rounds
+         use). Violations SPILL into the frontier.
+      3. Survivors pre-commit (capacity + pair state + commit key 0)
+         and the normal round machinery — frontier-compacted — places
+         the frontier; preemption rounds and the gang Permit gate run
+         unchanged on top.
+
+    NOT bitwise vs a cold solve (the round fixpoint is globally
+    coupled); governed instead by the validity contract — no capacity
+    overflow, no pairwise violation, carried pods still feasible on
+    their nodes — enforced by the passes above and re-checked by the
+    in-kernel audit appended to the result (INC_AUDIT_LEN tail;
+    `divergence --warm-audit --incremental` additionally reports the
+    placement-quality drift vs a cold twin). One known soft spot,
+    shared with the cold fast path: a post-rollback gang member's
+    departure can strip a match another pod's REQUIRED positive
+    affinity relied on — the audit reports it rather than masking it.
+
+    carry: [P] int32 previous-cycle node per pod in CURRENT row order
+    (-1 = no carry); carry_chosen: [P] f32 their as-of-placement
+    scores (carried placements keep them — upstream nominates without
+    rescoring); frontier0: [P] bool dirty basis; dirty_node_mask: [N]
+    bool or None; cap: frontier-compaction width for the rounds (0 =
+    full-width).
+
+    Returns (assigned, chosen, used, order, round_of, rounds, evicted,
+    audit[INC_AUDIT_LEN] f32)."""
+    static = finalize_static(cfg, snap, tab)
+    pods, nodes = snap.pods, snap.nodes
+    P = pods.valid.shape[0]
+    N = nodes.valid.shape[0]
+    order = pop_order(cfg, snap)
+    rank = jnp.zeros(P, jnp.int32).at[order].set(
+        jnp.arange(P, dtype=jnp.int32)
+    )
+    st0 = kpair.pair_state_init(snap, static.sig_match)
+    S = snap.sigs.key.shape[0]
+    invol, has_pair = _sig_involvement(snap, static, st0)
+    max_rounds = cfg.max_rounds if cfg.max_rounds > 0 else 2 * P + 8
+    K = _fallback_depth(N)
+
+    carry = jnp.where(pods.valid, carry, -1)
+    fr = frontier0 & pods.valid
+    if invol is not None:
+        hot = jnp.any(invol & fr[:, None], axis=0)           # [S]
+        fr = fr | jnp.any(invol & hot[None, :], axis=1)
+    if dirty_node_mask is not None:
+        fr = fr | ((carry >= 0)
+                   & dirty_node_mask[jnp.clip(carry, 0, None)])
+    carried = pods.valid & (carry >= 0) & ~fr
+    frontier_n = jnp.sum((pods.valid & (carry < 0) | fr).astype(jnp.float32))
+    # Revalidation pass 1: static feasibility at the carried node.
+    ok_static = tab.mask[jnp.arange(P), jnp.clip(carry, 0, None)]
+    carried &= ok_static
+    # Pass 2: per-node capacity prefix vs CURRENT allocatable.
+    carried &= _capacity_prefix_keep(
+        nodes.allocatable, nodes.used, pods.requests, carry, rank, carried
+    )
+    used = _node_add(nodes.used, carry, carried, pods.requests, rank, P)
+    st = st0
+    if S:
+        st = kpair.pair_state_commit(
+            snap, st, static.sig_match, carry, carried
+        )
+
+        # Pass 3: pairwise revalidation to fixpoint — a spill can strip
+        # the match another carried pod's positive affinity relied on,
+        # so iterate until clean (each pass spills >= 1, so it
+        # terminates; in the common cycle it exits after one check).
+        def rcond(vs):
+            return vs[-1]
+
+        def rbody(vs):
+            st_v, used_v, kept_v, _ = vs
+            ia = kpair.ia_ok_at_choice(
+                snap, st_v, static.sig_match, carry,
+                jnp.where(kept_v, carry, -1),
+            )
+            bad = kept_v & has_pair & ~ia
+            bad = bad | (kept_v & _spread_excess_mask(
+                snap, tab.aff_ok, rank, carry, kept_v, st_v
+            ))
+            st_v = kpair.pair_state_commit(
+                snap, st_v, static.sig_match, carry, bad, sign=-1.0
+            )
+            used_v = _node_add(used_v, carry, bad, pods.requests, rank, P,
+                               sign=-1.0)
+            return st_v, used_v, kept_v & ~bad, jnp.any(bad)
+
+        st, used, carried, _ = jax.lax.while_loop(
+            rcond, rbody, (st, used, carried, jnp.any(carried & has_pair))
+        )
+    assigned = jnp.where(carried, carry, -1)
+    chosen = jnp.where(carried, carry_chosen, NEG_INF)
+    round_of = jnp.where(carried, 0, -1)
+    carried_n = jnp.sum(carried.astype(jnp.float32))
+    if S == 0:
+        used, assigned, chosen, round_of, rounds = _solve_rounds_nosig(
+            cfg, snap, static, rank, order, max_rounds, K,
+            init=(used, assigned, chosen, round_of, jnp.array(True),
+                  jnp.int32(1)),
+            skip_full=True, cap=(cap if cap > 0 else None),
+        )
+        st_f = st
+    else:
+        init = (used, assigned, st, jnp.zeros(P, bool), chosen, round_of,
+                jnp.array(True), jnp.int32(1))
+        out = _solve_rounds_sig(
+            cfg, snap, static, rank, order, invol, has_pair, init,
+            max_rounds, K, cap,
+        )
+        used, assigned, st_f, _, chosen, round_of, _, rounds = out
+    M = snap.running.valid.shape[0]
+    evicted = jnp.zeros(M, bool)
+    if cfg.preemption and M > 0:
+        pr_out = _preempt_rounds(
+            cfg, snap, static, rank, order, rounds,
+            used, assigned, st_f, evicted, round_of, chosen,
+            has_pair=has_pair,
+        )
+        (used, assigned, st_f, evicted, round_of, chosen,
+         preempt_r) = pr_out[:7]
+        rounds = rounds + preempt_r
+    used, assigned, chosen, st_f, rolled = gang_rollback(
+        snap, used, assigned, chosen, st_f, static.sig_match
+    )
+    round_of = jnp.where(rolled, -1, round_of)
+
+    # In-kernel validity audit (the contract's enforcement receipt).
+    # Relative tolerance: request magnitudes span cpu-millis to memory
+    # bytes, so an absolute epsilon would be meaningless at one end.
+    alloc = nodes.allocatable
+    tol = jnp.maximum(jnp.abs(alloc) * 1e-5, 1e-4)
+    cap_bad = (used > alloc + tol) & (used > nodes.used + tol)
+    final_carried = carried & (assigned == carry) & (assigned >= 0)
+    ok_static_f = tab.mask[jnp.arange(P), jnp.clip(assigned, 0, None)]
+    s_viol = jnp.sum((final_carried & ~ok_static_f).astype(jnp.float32))
+    if S:
+        st_car = kpair.pair_state_seed(
+            snap, static.sig_match, carry, final_carried
+        )
+        ia_f = kpair.ia_ok_at_choice(
+            snap, st_car, static.sig_match, carry,
+            jnp.where(final_carried, carry, -1),
+        )
+        sp_f = _spread_excess_mask(
+            snap, tab.aff_ok, rank, carry, final_carried, st_car
+        )
+        p_viol = (jnp.sum((final_carried & has_pair & ~ia_f)
+                          .astype(jnp.float32))
+                  + jnp.sum(sp_f.astype(jnp.float32)))
+    else:
+        p_viol = jnp.float32(0.0)
+    audit = jnp.stack([
+        jnp.sum(cap_bad.astype(jnp.float32)), s_viol,
+        jnp.asarray(p_viol, jnp.float32), carried_n, frontier_n,
+    ])
+    return (assigned, chosen, used, order, round_of, rounds, evicted,
+            audit)

@@ -211,6 +211,21 @@ def pair_state_add_pod(snap: ClusterSnapshot, st: PairState, sig_match,
     return PairState(counts=counts, anti=anti, match_tot=match_tot)
 
 
+def pair_state_seed(snap: ClusterSnapshot, sig_match, choice, mask,
+                    counts=None) -> PairState:
+    """State with a PRE-COMMITTED pending assignment: running members
+    plus every pending pod p with mask[p] counted at choice[p]. The
+    incremental warm path (ISSUE 12) seeds its round loop with this —
+    carried placements enter the counts exactly as if the rounds had
+    just committed them, so frontier commits validate against the same
+    state a cold solve would have reached — and its in-kernel audit
+    recounts the final carried set through the same helper."""
+    st = pair_state_init(snap, sig_match, counts=counts)
+    if snap.sigs.key.shape[0] == 0:
+        return st
+    return pair_state_commit(snap, st, sig_match, choice, mask)
+
+
 def pair_state_evict(snap: ClusterSnapshot, st: PairState, sig_match,
                      evict_m) -> PairState:
     """Remove evicted RUNNING members' contributions (preemption,

@@ -79,6 +79,7 @@ def warm_cycle_stream(
     engine: Engine,
     device,
     deltas: Iterable[dict],
+    incremental: bool = False,
 ) -> Iterator[tuple[Any, SolveResult]]:
     """Pipeline consecutive DELTA CYCLES of one device-resident lineage
     through the warm-start path (ROADMAP item 3): `device` is a
@@ -96,11 +97,27 @@ def warm_cycle_stream(
 
     Contract note: the engine commits the refreshed warm handle at
     dispatch time; a caller that abandons the stream mid-flight after a
-    fetch error should device.invalidate_warm("stream_error")."""
+    fetch error should device.invalidate_warm("stream_error").
+
+    incremental=True (ISSUE 12): route each cycle through the
+    bounded-divergence warm path (Engine.solve_warm_async(incremental=
+    True)). The assignment CARRY is committed at result-join time, so
+    the stream joins cycle k BEFORE dispatching cycle k+1 — apply(k+1)
+    (the host-side record work) still overlaps fetch(k), only the
+    dispatch is deferred; dispatching early would seed k+1 from the
+    k-1 carry and widen the divergence for no latency win (the device
+    is serial across cycles of one lineage anyway)."""
     in_flight = None  # (ApplyStats, PendingFetch)
     for delta in deltas:
         stats = device.apply(**delta)
-        pending = engine.solve_warm_async(device)
+        if incremental:
+            if in_flight is not None:
+                pstats, prev = in_flight
+                yield pstats, prev.result()
+                in_flight = None
+            pending = engine.solve_warm_async(device, incremental=True)
+        else:
+            pending = engine.solve_warm_async(device)
         if in_flight is not None:
             pstats, prev = in_flight
             yield pstats, prev.result()

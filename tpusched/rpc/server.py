@@ -257,6 +257,21 @@ class _Metrics:
             "pending-pod causes on explained cycles (dominant filter "
             "reason, or outranked when feasible nodes existed)",
             ("reason",), registry=r)
+        # Commit-round + warm-path observability (round 17, ISSUE 12):
+        # the frontier-compaction win is a ROUND-COUNT story, so rounds
+        # get a first-class histogram instead of living only in the
+        # per-batch JSON log lines, and every Assign solve is labeled
+        # by the path that produced it — cold (the plain packed solve),
+        # bitwise (warm tableau, placements == cold), or incremental
+        # (bounded-divergence frontier rounds).
+        self.solve_rounds = pm.Histogram(
+            "scheduler_solve_rounds",
+            "commit rounds per solved Assign batch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256), registry=r)
+        self.warm_solves = pm.Counter(
+            "scheduler_warm_solves_total",
+            "Assign solves by warm path (bitwise|incremental|cold)",
+            ("path",), registry=r)
 
     def observe(self, n_pods: int, n_placed: int, n_evicted: int,
                 dur: float, rpc: str = "Assign"):
@@ -684,6 +699,7 @@ class SchedulerService:
         replication_log: "ReplicationLog | None" = None,
         explain=False,
         explain_k: int = 3,
+        warm: "str | None" = None,
     ):
         """audit_stream: optional file-like; when set, every Assign
         emits one JSON record PER POD (pod, node, score, commit_key —
@@ -723,7 +739,18 @@ class SchedulerService:
         DecisionRecord lands in the collector, served by the Explainz
         rpc and carried in flight-recorder dumps. Off (default) the
         serving path is byte-identical to round 11: one enabled-check
-        per Assign. explain_k: candidate depth per pod."""
+        per Assign. explain_k: candidate depth per pod.
+
+        warm (round 17, ISSUE 12): None (default) keeps every Assign on
+        the plain packed solve; "bitwise" routes delta Assigns whose
+        lineage has a live DeviceSession through the warm-tableau path
+        (placements bitwise == cold); "incremental" through the
+        bounded-divergence frontier path (solve time scales with the
+        delta's churn — the in-kernel validity audit rides
+        SolveResult.inc_info). Either way full-send Assigns, explained
+        cycles, forks, and degraded rungs fall back to the plain solve,
+        and scheduler_warm_solves_total{path} counts what actually
+        served."""
         self.config = config or EngineConfig()
         # Floor buckets pin compile shapes across requests (a feature
         # first appearing mid-serving would otherwise trigger a full
@@ -766,6 +793,11 @@ class SchedulerService:
         # Device-resident lineages: current snapshot_id -> DeviceSession
         # (LRU by insertion, capped — each holds a cluster on device).
         self._session_cap = device_sessions
+        if warm not in (None, "bitwise", "incremental"):
+            raise ValueError(
+                f"warm={warm!r}: want None, 'bitwise', or 'incremental'"
+            )
+        self._warm = warm
         self._sessions: dict[str, DeviceSession] = {}
         self._seeding: set[str] = set()   # base_ids mid-seed (dedupe)
         self.session_seeds = 0
@@ -1197,8 +1229,10 @@ class SchedulerService:
 
     def _resolve_decoded(self, request):
         """Full-or-delta request -> (snap, meta, snapshot_id,
-        decode_seconds, device_stats|None) with the decoded arrays
-        ready for dispatch.
+        decode_seconds, device_stats|None, device_session|None) with
+        the decoded arrays ready for dispatch; the trailing session is
+        non-None exactly when the delta applied through a live
+        DeviceSession (the warm-solve routing hook, round 17).
 
         Delta requests against a lineage with a live DeviceSession skip
         the recompose + full decode + full H2D entirely: the delta
@@ -1356,18 +1390,19 @@ class SchedulerService:
                         # (hit-then-decode) is one miss, not hit+miss —
                         # hits + seeds + misses == delta requests.
                         self.session_hits += 1
-                    return snap, meta, sid, time.perf_counter() - t0, stats
+                    return (snap, meta, sid, time.perf_counter() - t0, stats,
+                            session)
             self.session_misses += 1
             # Bytes composition straight into the (native) decoder: no
             # Python ClusterSnapshot is materialized on the delta path.
             with self._trace.span("store.compose", cat="server"):
                 raw = store.compose_bytes()
             snap, meta, decode_s = self._decode(raw)
-            return snap, meta, sid, decode_s, None
+            return snap, meta, sid, decode_s, None, None
         msg = request.snapshot
         if not delta_safe(msg) or level == "stateless":
             snap, meta, decode_s = self._decode(msg)
-            return snap, meta, "", decode_s, None
+            return snap, meta, "", decode_s, None, None
         store = SnapshotStore()
         # One serialize pass per record at full-send time so every
         # later delta cycle serializes only its churn (apply_delta) and
@@ -1375,7 +1410,7 @@ class SchedulerService:
         store.set_full_bytes(msg)
         sid = self._register_store(store, "full", msg.SerializeToString())
         snap, meta, decode_s = self._decode(msg)
-        return snap, meta, sid, decode_s, None
+        return snap, meta, sid, decode_s, None, None
 
     def _decode(self, snapshot_msg):
         t0 = time.perf_counter()
@@ -1575,7 +1610,8 @@ class SchedulerService:
         """Leader path: resolve + decode outside the dispatch slot,
         dispatch the requested form once (k = fused max for top-k),
         return the shared payload followers slice from."""
-        snap, meta, sid, decode_s, dstats = self._resolve_decoded(request)
+        snap, meta, sid, decode_s, dstats, session = \
+            self._resolve_decoded(request)
         P, N = meta.n_pods, meta.n_nodes
         pending_topk = pending_full = None
         k_used = 0
@@ -1678,7 +1714,8 @@ class SchedulerService:
         )
 
     def _assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
-        snap, meta, sid, decode_s, dstats = self._resolve_decoded(request)
+        snap, meta, sid, decode_s, dstats, session = \
+            self._resolve_decoded(request)
         # Staged handling (round 6): decode runs OUTSIDE the dispatch
         # slot (so a concurrent request's decode overlaps this solve),
         # the slot is held only long enough to enqueue the program, and
@@ -1688,11 +1725,13 @@ class SchedulerService:
         # round-robin fair instead of lock-race ordered.
         explain_on = self.explain.enabled
         pending_probe = None
+        warm_path = "cold"
         t_q = time.perf_counter()
         with self._gate.slot(self._peer(context)):
             self._stage_done("gate.wait", t_q)
             with self._trace.span("dispatch", cat="server",
                                   explained=explain_on):
+                pending = None
                 if explain_on:
                     # Explained cycle (round 12): the solve carries the
                     # provenance extras and a second program decomposes
@@ -1700,7 +1739,43 @@ class SchedulerService:
                     pending, pending_probe = (
                         self._engine.solve_explained_async(
                             snap, self._explain_k))
-                else:
+                elif self._warm is not None and session is not None:
+                    # Warm routing (round 17, ISSUE 12): the delta
+                    # already applied on this lineage's DeviceSnapshot,
+                    # so the carried tableau (and, incrementally, the
+                    # assignment carry) is one dirty-row refresh away.
+                    # Under session.lock: dispatch must see the exact
+                    # state this request's delta produced — a
+                    # concurrent apply having moved the lineage past it
+                    # falls back to the plain solve of OUR decoded
+                    # arrays (same heal as a fork).
+                    try:
+                        with session.lock:
+                            if session.device.snap is snap:
+                                dev = session.device
+                                before = (dev.warm_solves,
+                                          dev.incremental_solves)
+                                pending = self._engine.solve_warm_async(
+                                    dev,
+                                    incremental=(
+                                        self._warm == "incremental"),
+                                )
+                                if dev.warm_solves > before[0]:
+                                    warm_path = "bitwise"
+                                elif dev.incremental_solves > before[1]:
+                                    warm_path = "incremental"
+                    except Exception:
+                        # The warm path is an optimization: any failure
+                        # heals through the plain solve (loud — silent
+                        # means a permanent round-count regression).
+                        logging.getLogger("tpusched.rpc.server").warning(
+                            "warm solve dispatch failed; serving via "
+                            "the plain solve:\n%s",
+                            traceback.format_exc(limit=3),
+                        )
+                        pending = None
+                        warm_path = "cold"
+                if pending is None and not explain_on:
                     pending = self._engine.solve_async(snap)
         resp = pb.AssignResponse(snapshot_id=sid)
         P = meta.n_pods
@@ -1715,10 +1790,20 @@ class SchedulerService:
                 # ship the table.
                 resp.node_names.extend(meta.node_names)
         exd = None
-        if explain_on:
-            res, exd = self._join_guarded(pending, "Assign solve")
-        else:
-            res = self._join_guarded(pending, "Assign solve")
+        try:
+            if explain_on:
+                res, exd = self._join_guarded(pending, "Assign solve")
+            else:
+                res = self._join_guarded(pending, "Assign solve")
+        except BaseException:
+            if warm_path != "cold" and session is not None:
+                # The conservative reset the warm contract demands: a
+                # dispatch whose FETCH failed may have committed a
+                # tableau/carry the device never validated — drop them
+                # so the lineage's next solve re-anchors cold instead
+                # of repeating a poisoned warm state every request.
+                session.device.invalidate_warm("fetch_error")
+            raise
         t_p = time.perf_counter()
         with self._trace.span("reply.pack", cat="server"):
             ni = np.asarray(res.assignment[:P], dtype=np.int32)
@@ -1811,6 +1896,8 @@ class SchedulerService:
                         placed, n_evicted, res.rounds, dstats=dstats)
         self.metrics.observe(meta.n_pods, placed, n_evicted,
                              decode_s + res.solve_seconds)
+        self.metrics.solve_rounds.observe(res.rounds)
+        self.metrics.warm_solves.labels(warm_path).inc()
         return resp
 
     def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
@@ -1987,6 +2074,7 @@ def make_server(
     replication_log: "ReplicationLog | None" = None,
     explain=False,
     explain_k: int = 3,
+    warm: "str | None" = None,
 ):
     """Build (grpc.Server, bound_port, service). Unlimited message size:
     a 10k-pod snapshot exceeds the 4 MB default. max_workers default 8:
@@ -1999,14 +2087,17 @@ def make_server(
     (SchedulerService; tpusched/replicate.py ReplicaSet wires a
     standby's follower loop); explain/explain_k: decision provenance
     (round 12 — True or an ExplainCollector makes every Assign an
-    explained cycle, served by the Explainz rpc)."""
+    explained cycle, served by the Explainz rpc); warm: warm-solve
+    routing for session-backed delta Assigns (round 17, ISSUE 12 —
+    None | "bitwise" | "incremental"; SchedulerService docstring)."""
     svc = SchedulerService(config, buckets, log_stream=log_stream,
                            audit_stream=audit_stream,
                            device_sessions=device_sessions,
                            faults=faults, watchdog_s=watchdog_s,
                            ladder=ladder, tracer=tracer, flight=flight,
                            role=role, replication_log=replication_log,
-                           explain=explain, explain_k=explain_k)
+                           explain=explain, explain_k=explain_k,
+                           warm=warm)
 
     def handler(fn, req_cls):
         return grpc.unary_unary_rpc_method_handler(
