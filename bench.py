@@ -21,7 +21,7 @@ Usage: python bench.py [--pods N] [--nodes N] [--iters N] [--only NAME]
        [--serve-clients K] [--serve-cycles N]
        [--serve-what both|assign|score]
 NAME in {headline, pairwise, gangs, preemption, pipeline, e2e, wire,
-serving, divergence, warm}.
+serving, divergence, warm, ledger}.
 """
 
 from __future__ import annotations
@@ -1330,6 +1330,100 @@ def bench_warm(args):
         engine.close()
 
 
+def bench_ledger(args):
+    """Cycle flight-ledger overhead (round 18, ISSUE 13 acceptance):
+    the same 2000x1000 fast solve loop run with the ledger OFF (the
+    wrapper's one-attribute-read disabled path) and ON (per-dispatch
+    shape-class check + one CycleRecord build/append/sentinel per
+    cycle), emitted as `ledger_overhead_pct` (p50 delta as a
+    percentage — acceptance: <= 1%). `compile_count_total` rides
+    along: the XLA cache misses ledger.COMPILES has attributed so far
+    this process — the round-over-round retrace budget ROADMAP item 4
+    will drive to ~0. Both are registered lower-better in
+    tools/benchdiff.py."""
+    from tpusched import Engine, EngineConfig
+    from tpusched import ledger as ledgermod
+    from tpusched import metrics as pmetrics
+    from tpusched.synth import config2_scale
+
+    pods, nodes = min(args.pods, 2000), min(args.nodes, 1000)
+    rng = np.random.default_rng(49)
+    snap, _ = _build(config2_scale, rng, pods, nodes, with_qos=True)
+    engine = Engine(EngineConfig(mode="fast"))
+    led = ledgermod.CycleLedger(registry=pmetrics.Registry())
+    churn = max(1, pods // 100)
+    iters = max(20, args.iters // 10)
+
+    def one_cycle():
+        # The serving-shaped ledger work a HostScheduler cycle pays:
+        # compile-counter diff, record build, ring append + rolling
+        # aggregation + sentinel. Identical code both arms; only the
+        # enabled flag differs.
+        c0 = ledgermod.COMPILES.counters()
+        res = engine.solve_async(dsnap).result()
+        c1 = ledgermod.COMPILES.counters()
+        led.observe(ledgermod.CycleRecord(
+            ts=time.monotonic(), source="bench", pods=pods, nodes=nodes,
+            running=0, placed=int((res.assignment >= 0).sum()),
+            evicted=0, churn=churn, rounds=int(res.rounds),
+            warm_path="cold", solve_s=res.solve_seconds,
+            stages=dict(solve=res.solve_seconds),
+            compiles=c1[0] - c0[0],
+            compile_s=round(c1[1] - c0[1], 6),
+        ))
+        return ()
+
+    try:
+        dsnap = engine.put(snap)
+        t0 = time.perf_counter()
+        materialize(engine._solve_packed_jit(dsnap))
+        log(f"  compile+first-run {time.perf_counter() - t0:.1f}s")
+        log(f"[ledger] OFF arm @{pods}x{nodes} fast ({iters} iters)")
+        was_default, was_watch = (ledgermod.DEFAULT.enabled,
+                                  ledgermod.COMPILES.enabled)
+        led.enabled = False
+        ledgermod.set_enabled(False)
+        try:
+            off = bench_fn(one_cycle, iters, label="ledger-off")
+        finally:
+            ledgermod.set_enabled(True)
+            ledgermod.DEFAULT.enabled = was_default
+            ledgermod.COMPILES.enabled = was_watch
+        led.enabled = True
+        log(f"[ledger] ON arm @{pods}x{nodes} fast ({iters} iters)")
+        on = bench_fn(one_cycle, iters, label="ledger-on")
+    finally:
+        engine.close()
+    overhead_pct = ((on["p50"] - off["p50"]) / max(off["p50"], 1e-9)
+                    * 100.0)
+    log(f"  ledger overhead p50: {overhead_pct:+.2f}% "
+        f"(off {off['p50'] * 1e3:.1f}ms -> on {on['p50'] * 1e3:.1f}ms); "
+        f"{len(led.records())} records, {led.anomalies} anomalies")
+    line = {
+        "metric": "ledger_overhead_pct",
+        "value": round(overhead_pct, 3), "unit": "pct",
+        "direction": "lower", "vs_baseline": None,
+        "ledger_on_p50_ms": round(on["p50"] * 1e3, 3),
+        "ledger_off_p50_ms": round(off["p50"] * 1e3, 3),
+        "iters": iters, "records": len(led.records()),
+    }
+    if TRANSPORT:
+        line["rtt_ms"] = TRANSPORT["rtt_ms"]
+    print(json.dumps(line), flush=True)
+    total, compile_s = ledgermod.COMPILES.counters()
+    line = {
+        "metric": "compile_count_total",
+        "value": int(total), "unit": "count",
+        "direction": "lower", "vs_baseline": None,
+        "compile_s_total": round(compile_s, 3),
+    }
+    if TRANSPORT:
+        line["rtt_ms"] = TRANSPORT["rtt_ms"]
+    log(f"compile_count_total: {total} ({compile_s:.1f}s wall so far "
+        "this process)")
+    print(json.dumps(line), flush=True)
+
+
 def bench_divergence(args):
     """Fast-vs-parity agreement as NUMBERS per round (round-2 verdict
     next-step #2): identical-placement rate, placed delta, per-seed
@@ -1588,6 +1682,7 @@ BENCHES = {
     "sim": bench_sim,
     "explain": bench_explain,
     "warm": bench_warm,
+    "ledger": bench_ledger,
     # headline runs last so the final stdout line is the headline metric
     # (parity mode last within it — the stock-semantics north-star claim)
     "headline": bench_headline,

@@ -1,0 +1,441 @@
+"""Cycle flight ledger (round 18, ISSUE 13): record schema + the
+sim-vs-live twin contract, sentinel attribution (forced retrace / churn
+burst / preemption must land on the right cause label), flight-recorder
+wiring, compile/retrace tracking on the engine's jit entry points, the
+pipeline stream's emission, and the Statusz rpc surface."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpusched import ledger as lg
+from tpusched import metrics as pm
+from tpusched import trace as tracing
+
+
+def _rec(**kw):
+    """A steady-state baseline cycle: 10 pods, 5 churn, 2 rounds,
+    10 ms solve, no compiles, no evictions."""
+    base = dict(ts=0.0, source="test", pods=10, nodes=4, running=2,
+                placed=10, evicted=0, churn=5, frontier=0, rounds=2,
+                warm_path="cold", solve_s=0.01, stages={"solve": 0.01},
+                compiles=0, compile_s=0.0)
+    base.update(kw)
+    return lg.CycleRecord(**base)
+
+
+# ---------------------------------------------------------------------------
+# Schema.
+# ---------------------------------------------------------------------------
+
+
+def test_record_dict_matches_schema_and_validates():
+    d = lg.record_dict(_rec())
+    assert list(d) == list(lg.SCHEMA)
+    lg.validate_record(d)
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda d: d.pop("churn"),                      # missing key
+    lambda d: d.update(extra_field=1),             # extra key
+    lambda d: d.update(rounds="2"),                # wrong type
+    lambda d: d.update(solve_s=True),              # bool is not seconds
+    lambda d: d.update(stages={"solve": "fast"}),  # non-numeric stage
+    lambda d: d.update(warm_path="bitwise"),       # non-canonical path
+])
+def test_validate_record_rejects_drift(mutate):
+    d = lg.record_dict(_rec())
+    mutate(d)
+    with pytest.raises(ValueError):
+        lg.validate_record(d)
+
+
+# ---------------------------------------------------------------------------
+# Sentinel attribution.
+# ---------------------------------------------------------------------------
+
+
+def _fed_ledger(registry, n=24, **kw):
+    led = lg.CycleLedger(registry=registry, min_cycles=16, **kw)
+    for _ in range(n):
+        out = led.observe(_rec())
+        assert out is not None and out.anomaly == ""
+    return led
+
+
+@pytest.mark.parametrize("spike,cause", [
+    # A retrace inside the cycle wins over everything else.
+    (dict(compiles=1, compile_s=0.8, churn=500), "compile"),
+    # Rounds above the rolling median (no retrace).
+    (dict(rounds=64), "round_growth"),
+    # Churn above its rolling p95 (rounds at the median: not growth).
+    (dict(churn=500), "churn_burst"),
+    # A preemption tranche active (evictions), nothing else elevated.
+    (dict(evicted=3), "preemption"),
+    # Slow with no correlate at all.
+    (dict(), "unknown"),
+])
+def test_sentinel_attributes_spike_causes(spike, cause):
+    reg = pm.Registry()
+    led = _fed_ledger(reg)
+    try:
+        out = led.observe(_rec(solve_s=1.0, **spike))
+        assert out.anomaly == cause
+        assert led.anomalies == 1
+        text = reg.render()
+        assert (f'scheduler_cycle_anomalies_total{{cause="{cause}"}} 1'
+                in text)
+    finally:
+        led.close()
+
+
+def test_sentinel_quiet_on_normal_cycles_and_below_min_cycles():
+    led = lg.CycleLedger(registry=pm.Registry(), min_cycles=16)
+    try:
+        # Below min_cycles even a huge spike stays unflagged: the
+        # rolling windows have no statistical footing yet.
+        for _ in range(3):
+            led.observe(_rec())
+        assert led.observe(_rec(solve_s=50.0, compiles=1)).anomaly == ""
+    finally:
+        led.close()
+    led2 = _fed_ledger(pm.Registry())
+    try:
+        # At steady state, a cycle at the baseline solve time is NOT an
+        # anomaly (the threshold is the covering bucket bound, so equal
+        # cost never trips it).
+        assert led2.observe(_rec()).anomaly == ""
+        assert led2.anomalies == 0
+    finally:
+        led2.close()
+
+
+def test_sentinel_fires_flight_recorder_with_the_record():
+    flight = tracing.FlightRecorder()
+    tracer = tracing.TraceCollector(seed=7)
+    with tracer.span("cycle.context", cat="test"):
+        pass
+    reg = pm.Registry()
+    led = _fed_ledger(reg, flight=flight, tracer=tracer)
+    try:
+        led.observe(_rec(solve_s=1.0, compiles=2, compile_s=0.9))
+        assert flight.trips == 1
+        dump = flight.dumps()[0]
+        assert dump["reason"] == "cycle_anomaly"
+        assert dump["extra"]["cause"] == "compile"
+        # The dump carries the full record (validated) AND the span
+        # ring, so the anomaly ships its causal trace.
+        lg.validate_record(dump["extra"]["cycle"])
+        assert dump["extra"]["cycle"]["compiles"] == 2
+        assert any(s["name"] == "cycle.context" for s in dump["spans"])
+    finally:
+        led.close()
+
+
+def test_disabled_ledger_records_nothing():
+    led = lg.CycleLedger(registry=pm.Registry(), enabled=False)
+    try:
+        assert led.observe(_rec()) is None
+        assert led.records() == []
+    finally:
+        led.close()
+
+
+# ---------------------------------------------------------------------------
+# JSONL black box.
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_black_box_persists_validated_records(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    led = lg.CycleLedger(registry=pm.Registry(), jsonl=str(path))
+    try:
+        for i in range(3):
+            led.observe(_rec(pods=10 + i))
+    finally:
+        led.close()
+    lines = path.read_text().splitlines()
+    assert len(lines) == 3
+    for i, line in enumerate(lines):
+        d = lg.validate_record(json.loads(line))
+        assert d["pods"] == 10 + i and d["cycle"] == i + 1
+
+
+# ---------------------------------------------------------------------------
+# Compile/retrace tracking (the engine's jit entry points).
+# ---------------------------------------------------------------------------
+
+
+def test_compile_watcher_dedupes_keys():
+    w = lg.CompileWatcher(capacity=4)
+    assert not w.known(("a", (8,)))
+    assert w.note(("a", (8,)), "solve", "P8", 0.5)
+    assert w.known(("a", (8,)))
+    assert not w.note(("a", (8,)), "solve", "P8", 0.5), \
+        "a racing duplicate must not double-count"
+    assert w.note(("a", (16,)), "solve", "P16", 0.25)
+    assert w.counters() == (2, 0.75)
+    assert [e["shape"] for e in w.timeline()] == ["P8", "P16"]
+
+
+def test_engine_counts_one_compile_per_shape_class():
+    """The forced-retrace half of the ISSUE 13 acceptance: a repeat
+    solve at a known shape class records NO compile event; a solve at
+    a new bucket shape (the retrace) records exactly one, with wall
+    time."""
+    from tpusched.config import EngineConfig
+    from tpusched.engine import Engine
+    from tpusched.synth import config2_scale
+
+    eng = Engine(EngineConfig(mode="fast"))
+    try:
+        snap_a, _ = config2_scale(np.random.default_rng(0), 6, 3,
+                                  with_qos=True)
+        snap_b, _ = config2_scale(np.random.default_rng(1), 40, 20,
+                                  with_qos=True)
+        c0 = lg.COMPILES.counters()[0]
+        eng.solve(snap_a)
+        assert lg.COMPILES.counters()[0] == c0 + 1
+        eng.solve(snap_a)  # cache hit: no new event
+        assert lg.COMPILES.counters()[0] == c0 + 1
+        eng.solve(snap_b)  # bucket growth => retrace
+        assert lg.COMPILES.counters()[0] == c0 + 2
+        ev = lg.COMPILES.timeline()[-1]
+        assert ev["fn"] == "solve_packed" and ev["compile_s"] > 0
+        assert ev["shape"].startswith("P")
+    finally:
+        eng.close()
+
+
+def test_forced_retrace_attributed_as_compile_anomaly():
+    """End-to-end forced retrace: a host cycle that pays a fresh XLA
+    compile after a steady baseline must be flagged by the sentinel
+    with cause="compile" (the acceptance scenario)."""
+    from tpusched.config import EngineConfig
+    from tpusched.engine import Engine
+    from tpusched.synth import config2_scale
+
+    eng = Engine(EngineConfig(mode="fast"))
+    reg = pm.Registry()
+    led = lg.CycleLedger(registry=reg, min_cycles=16)
+    snap_a, _ = config2_scale(np.random.default_rng(0), 6, 3,
+                              with_qos=True)
+    snap_b, _ = config2_scale(np.random.default_rng(1), 40, 20,
+                              with_qos=True)
+
+    def cycle(snap):
+        c0 = lg.COMPILES.counters()
+        res = eng.solve(snap)
+        c1 = lg.COMPILES.counters()
+        return led.observe(_rec(
+            solve_s=res.solve_seconds, compiles=c1[0] - c0[0],
+            compile_s=c1[1] - c0[1],
+        ))
+
+    try:
+        # Warm the baseline shape OUTSIDE the ledger: its compile-cost
+        # cycle must not inflate the rolling p99 the spike is judged
+        # against (in production min_cycles plays this role).
+        eng.solve(snap_a)
+        for _ in range(20):
+            out = cycle(snap_a)
+        assert out.anomaly == "", "steady state must stay quiet"
+        spike = cycle(snap_b)  # retrace: slow AND compile-correlated
+        assert spike.compiles >= 1
+        assert spike.anomaly == "compile"
+        assert ('scheduler_cycle_anomalies_total{cause="compile"} 1'
+                in reg.render())
+    finally:
+        eng.close()
+        led.close()
+
+
+# ---------------------------------------------------------------------------
+# The sim-vs-live twin contract (satellite).
+# ---------------------------------------------------------------------------
+
+
+def test_sim_and_live_ledger_schemas_are_twins():
+    """Virtual-time replays must produce the SAME ledger schema as
+    live serving — source and clock differ, fields do not."""
+    from tpusched.config import EngineConfig
+    from tpusched.host import FakeApiServer, HostScheduler
+    from tpusched.sim import workloads
+    from tpusched.sim.driver import SimDriver
+
+    led_live = lg.CycleLedger(registry=pm.Registry())
+    api = FakeApiServer()
+    api.add_node("n0", allocatable={"cpu": 8000.0,
+                                    "memory": float(32 << 30)})
+    for i in range(4):
+        api.add_pod(f"p{i}", requests={"cpu": 100.0,
+                                       "memory": float(1 << 28)})
+    host = HostScheduler(api, EngineConfig(mode="fast"), ledger=led_live)
+    try:
+        host.run_until_idle()
+    finally:
+        host.close()
+
+    led_sim = lg.CycleLedger(registry=pm.Registry())
+    sc = workloads.Scenario(
+        name="ledger_tiny", horizon_s=20.0, n_nodes=2,
+        arrival="poisson", rate=0.0, prefill=4,
+        prefill_duration_s=(5.0, 8.0),
+        mix=((1.0, 0.0, (5.0, 8.0), (50, 51), (1800.0, 2000.0)),),
+    )
+    res = SimDriver(sc, seed=0, config=EngineConfig(mode="fast"),
+                    ledger=led_sim).run()
+    assert res.cycles > 0
+
+    live = led_live.records()
+    sim = led_sim.records()
+    assert live and sim
+    d_live = lg.record_dict(live[-1])
+    d_sim = lg.record_dict(sim[-1])
+    assert set(d_live) == set(d_sim) == set(lg.SCHEMA)
+    lg.validate_record(d_live)
+    lg.validate_record(d_sim)
+    assert d_live["source"] == "host"
+    assert d_sim["source"] == "sim"
+    # Sim records ride the VIRTUAL clock: every ts sits inside the
+    # scenario horizon, not at wall epoch seconds.
+    assert all(0.0 <= r.ts <= sc.horizon_s for r in sim)
+    led_live.close()
+    led_sim.close()
+
+
+def test_warm_cycle_stream_emits_pipeline_records(rng):
+    """warm_cycle_stream threads the ledger: one record per delta
+    cycle, source="pipeline", churn from the delta's record count,
+    warm path cold on the first (tableau build) then warm."""
+    from tpusched.config import EngineConfig
+    from tpusched.device_state import DeviceSnapshot
+    from tpusched.engine import Engine
+    from tpusched.pipeline import warm_cycle_stream
+    from tpusched.synth import make_cluster
+
+    nodes_r, pods_r, running_r = make_cluster(
+        rng, 12, 4, n_running_per_node=1, with_qos=True, as_records=True)
+    cfg = EngineConfig(mode="fast")
+    ds = DeviceSnapshot(cfg)
+    ds.full_load(nodes_r, pods_r, running_r)
+    eng = Engine(cfg)
+    led = lg.CycleLedger(registry=pm.Registry())
+    deltas = []
+    for i in range(3):
+        rec = dict(pods_r[i])
+        rec["observed_avail"] = 0.4 + 0.1 * i
+        deltas.append(dict(upsert_pods=[rec]))
+    try:
+        out = list(warm_cycle_stream(eng, ds, deltas, ledger=led))
+    finally:
+        eng.close()
+    assert len(out) == 3
+    recs = led.records()
+    assert [r.source for r in recs] == ["pipeline"] * 3
+    assert [r.churn for r in recs] == [1, 1, 1]
+    assert recs[0].warm_path == "cold", "first cycle builds the tableau"
+    assert {r.warm_path for r in recs[1:]} == {"warm"}
+    for r in recs:
+        lg.validate_record(lg.record_dict(r))
+    led.close()
+
+
+# ---------------------------------------------------------------------------
+# The Statusz rpc surface.
+# ---------------------------------------------------------------------------
+
+
+def test_statusz_rpc_serves_ledger_and_metrics(thread_leak_check):
+    from tpusched.config import EngineConfig
+    from tpusched.rpc import tpusched_pb2 as pb
+    from tpusched.rpc.client import SchedulerClient
+    from tpusched.rpc.codec import snapshot_to_proto
+    from tpusched.rpc.server import make_server
+
+    server, port, svc = make_server("127.0.0.1:0",
+                                    config=EngineConfig(mode="fast"))
+    server.start()
+    try:
+        with SchedulerClient(f"127.0.0.1:{port}") as client:
+            msg = snapshot_to_proto(
+                [dict(name="n0", allocatable={"cpu": 4000.0,
+                                              "memory": float(16 << 30)})],
+                [dict(name="p0", requests={"cpu": 500.0,
+                                           "memory": float(1 << 30)})],
+                [],
+            )
+            resp = client.assign(msg, packed_ok=True)
+            delta = pb.SnapshotDelta(base_id=resp.snapshot_id)
+            delta.upsert_pods.append(msg.pods[0])
+            client.assign_delta(delta, packed_ok=True)
+            payload = json.loads(client.statusz().statusz_json)
+            metrics_text = client.metrics_text()
+    finally:
+        server.stop(0)
+        svc.close()
+    assert payload["cycles"] == 2
+    assert payload["role"] == "leader"
+    recs = payload["records"]
+    assert len(recs) == 2
+    for rec in recs:
+        lg.validate_record(rec)
+    assert recs[0]["source"] == "sidecar"
+    # Full send carries no churn; the delta cycle's churn is its one
+    # upserted record.
+    assert recs[0]["churn"] == 0 and recs[1]["churn"] == 1
+    # Stage walls joined from the request's spans: the same names the
+    # trace shows.
+    assert "decode" in recs[0]["stages"]
+    assert "fetch.join" in recs[0]["stages"]
+    # The first Assign paid the solve compile; it is attributed there.
+    assert recs[0]["compiles"] >= 1
+    assert payload["solve"]["p99_ms"] > 0
+    assert payload["compiles"]["total"] >= 1
+    assert payload["compiles"]["timeline"], "compile timeline present"
+    # Raw bucket exports ride along for the fleet merge.
+    assert payload["solve"]["hist"]["counts"]
+    # Ledger families render in THIS server's Metrics rpc.
+    assert "# TYPE scheduler_cycle_anomalies_total counter" in metrics_text
+    assert ('scheduler_cycles_total{source="sidecar",warm_path="cold"} 2'
+            in metrics_text)
+
+
+def test_statusz_fleet_merge_sums_counts_and_requantiles():
+    """tools/statusz.py merge: counts sum; quantiles re-derive from the
+    SUMMED bucket counts (exact), not from averaging quantiles."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "tpusched_statusz_tool",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "statusz.py"),
+    )
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+
+    def payload(addr, solve_s, n):
+        reg = pm.Registry()
+        led = lg.CycleLedger(registry=reg)
+        for _ in range(n):
+            led.observe(_rec(solve_s=solve_s))
+        p = led.statusz(last=4)
+        p["address"] = addr
+        led.close()
+        return p
+
+    a = payload("r1:1", 0.01, 10)
+    b = payload("r2:1", 0.5, 10)
+    merged = tool.merge_fleet([a, b])
+    assert merged["cycles"] == 20
+    assert merged["warm_mix"] == {"cold": 20}
+    # Merged p99 must reflect the SLOW replica's bucket mass.
+    assert merged["solve"]["p99_ms"] > 100.0
+    # Merged p50 sits between the two replicas' medians.
+    assert 5.0 < merged["solve"]["p50_ms"] < 500.0
+    text = tool.render_text(merged)
+    assert "cycles 20" in text
+    html = tool.render_html([merged])
+    assert "tpusched cycle flight ledger" in html

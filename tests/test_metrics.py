@@ -148,6 +148,63 @@ def test_histogram_bucket_mismatch_rejected():
         pm.Histogram("x_seconds", "x", buckets=(10, 20), registry=r)
 
 
+def test_histogram_quantile_bucket_interpolated():
+    """Round 18 (ISSUE 13): the bucket-interpolated quantile estimator
+    shared by statusz and the cycle-ledger sentinel — empty series,
+    single-bucket interpolation, the +Inf overflow convention, the
+    non-interpolated (bucket-bound) form, and labeled series."""
+    import math
+
+    r = pm.Registry()
+    h = pm.Histogram("t_quant_seconds", "q", buckets=(1.0, 2.0, 4.0),
+                     registry=r)
+    # Empty (series never created, then created-but-empty via labels).
+    assert math.isnan(h.quantile(0.5))
+    assert h.series_counts() == []
+    # Single bucket: all mass in (1.0, 2.0] interpolates linearly.
+    for _ in range(4):
+        h.observe(1.5)
+    assert h.quantile(0.5) == pytest.approx(1.5)
+    assert h.quantile(1.0) == pytest.approx(2.0)
+    # Non-interpolated: the covering bucket's upper bound.
+    assert h.quantile(0.5, interpolate=False) == 2.0
+    # +Inf overflow: beyond the layout's resolution the last finite
+    # bound is the honest floor (prometheus convention).
+    h.observe(100.0)
+    assert h.quantile(0.999) == 4.0
+    assert h.series_counts() == [0, 4, 0, 1]
+    # Labeled series quantile + raw export.
+    hl = pm.Histogram("t_quant_l_seconds", "q", buckets=(1.0, 2.0),
+                      labelnames=("stage",), registry=r)
+    hl.labels("decode").observe(0.5)
+    assert hl.quantile(1.0, "decode") == pytest.approx(1.0)
+    assert math.isnan(hl.quantile(0.5, "solve"))
+    # The free function agrees with the method (the statusz fleet
+    # merge re-derives quantiles from summed raw counts).
+    assert pm.bucket_quantile((1.0, 2.0, 4.0), [0, 4, 0, 0], 0.5) == \
+        pytest.approx(1.5)
+    assert math.isnan(pm.bucket_quantile((1.0,), [0, 0], 0.5))
+
+
+def test_callback_gauge_error_renders_type_line_only():
+    """ISSUE 13 satellite: a raising callback must render the TYPE
+    line ALONE — zero sample lines for the family — so the family
+    stays discoverable while the scrape stays up."""
+    r = pm.Registry()
+    pm.CallbackGauge("t_exploding", "boom", ("k",),
+                     callback=lambda: 1 / 0, registry=r)
+    text = r.render()
+    check_prometheus(text)
+    lines = [ln for ln in text.splitlines() if "t_exploding" in ln]
+    assert lines == ["# TYPE t_exploding gauge"], \
+        "a failing callback must render no samples, only the TYPE line"
+    # A label-less raising callback behaves identically.
+    r2 = pm.Registry()
+    pm.CallbackGauge("t_exploding_scalar", "boom",
+                     callback=lambda: [][0], registry=r2)
+    assert r2.render() == "# TYPE t_exploding_scalar gauge\n"
+
+
 def test_duration_buckets_cover_long_solves():
     """The round-8 histogram topped out at 5.0 s while 10k x 5k solves
     run far longer — every real solve landed in +Inf. The shape-aware

@@ -45,6 +45,13 @@ _LOWER_BETTER_NAME = re.compile(
 # pattern accidentally matches (scenario suffixes like *_p99_s_qos or a
 # future *_s-suffixed scenario name must not flip attainment metrics).
 _HIGHER_BETTER_NAME = re.compile(r"(attainment|goodput|qps)")
+# Registered per-metric directions (round 18, ISSUE 13): names whose
+# unit/pattern inference would be wrong or ambiguous. Consulted after
+# an explicit bench-line "direction" annotation, before inference.
+_EXPLICIT_DIRECTION = {
+    "ledger_overhead_pct": "lower",    # flight-ledger on-vs-off cost
+    "compile_count_total": "lower",    # XLA cache misses per bench run
+}
 
 
 def round_key(path: Path) -> str:
@@ -85,9 +92,12 @@ def extract_metrics(path: Path) -> dict:
 def lower_is_better(metric: str, unit: str,
                     direction: "str | None" = None) -> bool:
     """direction (an explicit bench-line annotation) wins; then the
-    always-higher-better names; then unit/name inference."""
+    registered per-metric table; then the always-higher-better names;
+    then unit/name inference."""
     if direction is not None:
         return direction == "lower"
+    if metric in _EXPLICIT_DIRECTION:
+        return _EXPLICIT_DIRECTION[metric] == "lower"
     if _HIGHER_BETTER_NAME.search(metric):
         return False
     return (unit in _LOWER_BETTER_UNITS

@@ -19,6 +19,11 @@ Chains, in order:
            incremental validity contract (in-kernel audit + oracle
            clean) stay gated pre-PR; SKIPPED gracefully when jax is
            not installed
+  statusz  boot a real sidecar, serve one Assign cycle, scrape the
+           Statusz rpc + the Metrics render, and validate the
+           CycleRecord schema (tpusched.ledger.validate_record) and
+           the exposition format — the round-18 flight-ledger surface
+           stays wired end to end; SKIPPED when jax/grpc are absent
 
 Prints a per-stage summary and exits non-zero if any stage fails.
 Documented in tools/README.md as the thing to run before mailing a PR.
@@ -131,12 +136,73 @@ def stage_warmaudit() -> "tuple[str, str]":
     return ("ok" if rc == 0 else "FAIL"), out
 
 
+_STATUSZ_CODE = """
+import json, os, re
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from tpusched import ledger as ledgering
+from tpusched.config import EngineConfig
+from tpusched.rpc.client import SchedulerClient
+from tpusched.rpc.codec import snapshot_to_proto
+from tpusched.rpc.server import make_server
+
+server, port, svc = make_server("127.0.0.1:0",
+                                config=EngineConfig(mode="fast"))
+server.start()
+try:
+    with SchedulerClient(f"127.0.0.1:{port}") as client:
+        msg = snapshot_to_proto(
+            [dict(name="n0", allocatable={"cpu": 4000.0,
+                                          "memory": float(16 << 30)})],
+            [dict(name="p0", requests={"cpu": 500.0,
+                                       "memory": float(1 << 30)})],
+            [],
+        )
+        client.assign(msg, packed_ok=True)
+        sz = json.loads(client.statusz().statusz_json)
+        metrics_text = client.metrics_text()
+finally:
+    server.stop(0)
+    svc.close()
+assert sz["records"], "sidecar served a cycle but the ledger is empty"
+for rec in sz["records"]:
+    ledgering.validate_record(rec)
+assert sz["cycles"] >= 1 and sz["warm_mix"], sz
+# Exposition-format smoke (the strict checker lives in tests/): every
+# line is a TYPE/HELP comment or a sample, and the ledger families
+# render in THIS server's registry.
+assert metrics_text.endswith("\\n")
+sample = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\\{[^{}]*\\})? [^ ]+$")
+for line in metrics_text.splitlines():
+    assert line and line.strip() == line, repr(line)
+    if line.startswith("#"):
+        assert line.startswith(("# TYPE ", "# HELP ")), repr(line)
+    else:
+        assert sample.match(line), repr(line)
+assert "# TYPE scheduler_cycle_anomalies_total counter" in metrics_text
+assert "# TYPE scheduler_cycle_solve_seconds histogram" in metrics_text
+print(json.dumps(dict(records=len(sz["records"]), cycles=sz["cycles"],
+                      compiles=sz["compiles"]["total"])))
+"""
+
+
+def stage_statusz() -> "tuple[str, str]":
+    try:
+        import grpc  # noqa: F401
+        import jax  # noqa: F401
+    except ImportError:
+        return "skip", "jax/grpc not installed on this image"
+    rc, out = _run([sys.executable, "-c", _STATUSZ_CODE])
+    return ("ok" if rc == 0 else "FAIL"), out
+
+
 STAGES = (
     ("regen", stage_regen),
     ("lint", stage_lint),
     ("syntax", stage_syntax),
     ("mypy", stage_mypy),
     ("warmaudit", stage_warmaudit),
+    ("statusz", stage_statusz),
 )
 
 

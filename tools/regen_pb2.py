@@ -102,6 +102,15 @@ MESSAGE_ADDS = {
     "ExplainzResponse": [
         ("explain_json", 1, F.TYPE_STRING, "explainJson"),
     ],
+    # Round 18 (ISSUE 13): the cycle flight ledger's Statusz surface —
+    # per-cycle telemetry joined (stages, warm mix, compile timeline,
+    # sentinel anomalies) as one JSON payload tools/statusz.py renders.
+    "StatuszRequest": [
+        ("max_records", 1, F.TYPE_INT32, "maxRecords"),
+    ],
+    "StatuszResponse": [
+        ("statusz_json", 1, F.TYPE_STRING, "statuszJson"),
+    ],
 }
 
 # New unary service methods: service name -> [(method, input, output)].
@@ -112,6 +121,8 @@ METHOD_ADDS = {
          ".tpusched.ReplicateResponse"),
         ("Explainz", ".tpusched.ExplainzRequest",
          ".tpusched.ExplainzResponse"),
+        ("Statusz", ".tpusched.StatuszRequest",
+         ".tpusched.StatuszResponse"),
     ],
 }
 

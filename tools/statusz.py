@@ -1,0 +1,306 @@
+#!/usr/bin/env python
+"""Statusz dashboard: render a sidecar's cycle flight ledger (round 18,
+ISSUE 13).
+
+Scrapes the Statusz rpc of one or more sidecars and renders the joined
+per-cycle telemetry — rolling p50/p99 per serving stage, warm-path mix,
+churn/round aggregates, the compile/retrace timeline (per shape-class,
+with compile wall time), sentinel anomaly counts by cause, and the
+last-N CycleRecords — as a text dashboard, optionally as a standalone
+HTML page, or as raw JSON.
+
+With several addresses (the PR-6 replicated fleet) a MERGED fleet view
+is appended: cycle/anomaly/warm-mix counts sum, and stage/solve
+quantiles are re-derived from the summed raw bucket counts
+(tpusched.metrics.bucket_quantile — merging counts is exact where
+averaging per-replica quantiles is not).
+
+Usage:
+  python tools/statusz.py 127.0.0.1:50051
+  python tools/statusz.py HOST:P1 HOST:P2 HOST:P3 --records 16
+  python tools/statusz.py HOST:PORT --html /tmp/statusz.html
+  python tools/statusz.py HOST:PORT --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from tpusched import metrics as pm  # noqa: E402
+from tpusched.rpc.client import SchedulerClient  # noqa: E402
+
+
+def fetch(address: str, records: int) -> dict:
+    with SchedulerClient(address, timeout=30.0) as client:
+        payload = json.loads(client.statusz(max_records=records).statusz_json)
+    payload["address"] = address
+    return payload
+
+
+# ---------------------------------------------------------------------------
+# Fleet merge.
+# ---------------------------------------------------------------------------
+
+
+def _merge_hist(into: "dict | None", hist: "dict | None") -> "dict | None":
+    """Sum two raw bucket exports ({le, counts}); None-propagating, and
+    a bucket-layout mismatch (shouldn't happen — all replicas run the
+    same code) drops the merge rather than summing misaligned bins."""
+    if hist is None or not hist.get("counts"):
+        return into
+    if into is None:
+        return dict(le=list(hist["le"]), counts=list(hist["counts"]))
+    if into["le"] != hist["le"] or len(into["counts"]) != len(hist["counts"]):
+        return into
+    into["counts"] = [a + b for a, b in zip(into["counts"], hist["counts"])]
+    return into
+
+
+def _hist_quantiles(hist: "dict | None") -> "tuple":
+    if hist is None or not hist.get("counts"):
+        return None, None
+    le = tuple(float(b) for b in hist["le"])
+    p50 = pm.bucket_quantile(le, hist["counts"], 0.50)
+    p99 = pm.bucket_quantile(le, hist["counts"], 0.99)
+    return p50, p99
+
+
+def _sum_into(acc: "dict[str, int]", d: "dict[str, int]") -> None:
+    for k, v in (d or {}).items():
+        acc[k] = acc.get(k, 0) + int(v)
+
+
+def merge_fleet(payloads: "list[dict]") -> dict:
+    """One fleet-level summary from N replicas' Statusz payloads."""
+    merged: dict = dict(
+        address=",".join(p["address"] for p in payloads),
+        role="fleet", serving_path="-",
+        cycles=sum(int(p.get("cycles", 0)) for p in payloads),
+        anomalies={}, warm_mix={}, sources={},
+        anomalies_total=sum(int(p.get("anomalies_total", 0))
+                            for p in payloads),
+        watchdog_trips=sum(int(p.get("watchdog_trips", 0))
+                           for p in payloads),
+        flight_dumps=sum(int(p.get("flight_dumps", 0)) for p in payloads),
+        records=[],
+    )
+    solve_hist = None
+    stage_hists: "dict[str, dict | None]" = {}
+    compile_total = 0
+    compile_s = 0.0
+    timeline: list = []
+    for p in payloads:
+        _sum_into(merged["anomalies"], p.get("anomalies", {}))
+        _sum_into(merged["warm_mix"], p.get("warm_mix", {}))
+        _sum_into(merged["sources"], p.get("sources", {}))
+        solve_hist = _merge_hist(solve_hist, p.get("solve", {}).get("hist"))
+        for stage, agg in p.get("stages", {}).items():
+            stage_hists[stage] = _merge_hist(stage_hists.get(stage),
+                                             agg.get("hist"))
+        comp = p.get("compiles", {})
+        compile_total += int(comp.get("total", 0))
+        compile_s += float(comp.get("compile_s_total", 0.0))
+        for ev in comp.get("timeline", []):
+            timeline.append(dict(ev, replica=p["address"]))
+    p50, p99 = _hist_quantiles(solve_hist)
+    merged["solve"] = dict(p50_ms=_ms(p50), p99_ms=_ms(p99))
+    merged["stages"] = {}
+    for stage in sorted(stage_hists):
+        p50, p99 = _hist_quantiles(stage_hists[stage])
+        merged["stages"][stage] = dict(p50_ms=_ms(p50), p99_ms=_ms(p99))
+    merged["compiles"] = dict(
+        total=compile_total, compile_s_total=round(compile_s, 3),
+        timeline=sorted(timeline, key=lambda e: float(e.get("ts", 0.0))),
+    )
+    return merged
+
+
+def _ms(v: "float | None") -> "float | None":
+    return None if v is None else round(v * 1e3, 3)
+
+
+# ---------------------------------------------------------------------------
+# Text rendering.
+# ---------------------------------------------------------------------------
+
+
+def _fmt(v, width: int = 10) -> str:
+    if v is None:
+        return f"{'-':>{width}}"
+    if isinstance(v, float):
+        return f"{v:>{width}.3f}"
+    return f"{v!s:>{width}}"
+
+
+def _mix_line(d: "dict[str, int]") -> str:
+    return " ".join(f"{k}={d[k]}" for k in sorted(d)) or "-"
+
+
+def render_text(p: dict) -> str:
+    lines = [
+        f"== {p['address']}  role={p.get('role', '?')} "
+        f"serving={p.get('serving_path', '?')} ==",
+        f"cycles {p.get('cycles', 0)}   warm mix: "
+        f"{_mix_line(p.get('warm_mix', {}))}   sources: "
+        f"{_mix_line(p.get('sources', {}))}",
+        f"anomalies: {_mix_line(p.get('anomalies', {}))} "
+        f"(total {p.get('anomalies_total', 0)}; watchdog trips "
+        f"{p.get('watchdog_trips', 0)}, flight dumps "
+        f"{p.get('flight_dumps', 0)})",
+    ]
+    solve = p.get("solve", {})
+    lines.append(f"solve p50/p99: {_fmt(solve.get('p50_ms'), 1).strip()}"
+                 f"/{_fmt(solve.get('p99_ms'), 1).strip()} ms")
+    stages = p.get("stages", {})
+    if stages:
+        lines.append(f"{'stage':<16} {'p50_ms':>10} {'p99_ms':>10}")
+        for stage in sorted(stages):
+            agg = stages[stage]
+            lines.append(f"{stage:<16} {_fmt(agg.get('p50_ms'))} "
+                         f"{_fmt(agg.get('p99_ms'))}")
+    comp = p.get("compiles", {})
+    lines.append(f"compiles: {comp.get('total', 0)} "
+                 f"({comp.get('compile_s_total', 0.0):.2f}s wall)")
+    for ev in comp.get("timeline", [])[-12:]:
+        where = f" @{ev['replica']}" if "replica" in ev else ""
+        lines.append(f"  {ev.get('fn', '?'):<28} {ev.get('shape', '?'):<20} "
+                     f"{float(ev.get('compile_s', 0.0)):>8.3f}s{where}")
+    recs = p.get("records", [])
+    if recs:
+        cols = ("cycle", "source", "pods", "placed", "evicted", "churn",
+                "rounds", "warm_path", "compiles", "anomaly")
+        lines.append("recent cycles (oldest first):")
+        lines.append("  " + " ".join(f"{c:>9}" for c in cols)
+                     + f" {'solve_ms':>10}")
+        for r in recs:
+            lines.append("  " + " ".join(f"{r.get(c, ''):>9}" for c in cols)
+                         + f" {r.get('solve_s', 0.0) * 1e3:>10.2f}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# HTML rendering.
+# ---------------------------------------------------------------------------
+
+_HTML_HEAD = """<!doctype html>
+<html><head><meta charset="utf-8"><title>tpusched statusz</title>
+<style>
+ body { font: 13px/1.45 monospace; margin: 1.5em; background: #fafafa; }
+ h2 { margin: 1em 0 0.3em; }
+ table { border-collapse: collapse; margin: 0.4em 0 1em; }
+ th, td { border: 1px solid #ccc; padding: 2px 8px; text-align: right; }
+ th { background: #eee; }
+ td.l, th.l { text-align: left; }
+ .anom { color: #b00; font-weight: bold; }
+</style></head><body>
+<h1>tpusched cycle flight ledger</h1>
+"""
+
+
+def _table(headers, rows) -> str:
+    out = ["<table><tr>"]
+    out += [f'<th class="l">{html.escape(str(h))}</th>' for h in headers]
+    out.append("</tr>")
+    for row in rows:
+        out.append("<tr>")
+        for cell in row:
+            cls = ' class="anom"' if isinstance(cell, str) and cell and \
+                cell in ("compile", "round_growth", "churn_burst",
+                         "preemption", "unknown") else ""
+            out.append(f"<td{cls}>{html.escape(str(cell))}</td>")
+        out.append("</tr>")
+    out.append("</table>")
+    return "".join(out)
+
+
+def render_html(payloads: "list[dict]") -> str:
+    parts = [_HTML_HEAD]
+    for p in payloads:
+        parts.append(f"<h2>{html.escape(p['address'])} "
+                     f"(role={html.escape(str(p.get('role')))}, "
+                     f"serving={html.escape(str(p.get('serving_path')))})"
+                     f"</h2>")
+        solve = p.get("solve", {})
+        parts.append(_table(
+            ["cycles", "solve p50 ms", "solve p99 ms", "anomalies",
+             "warm mix", "watchdog trips"],
+            [[p.get("cycles", 0), solve.get("p50_ms"), solve.get("p99_ms"),
+              _mix_line(p.get("anomalies", {})),
+              _mix_line(p.get("warm_mix", {})),
+              p.get("watchdog_trips", 0)]],
+        ))
+        stages = p.get("stages", {})
+        if stages:
+            parts.append(_table(
+                ["stage", "p50 ms", "p99 ms"],
+                [[s, stages[s].get("p50_ms"), stages[s].get("p99_ms")]
+                 for s in sorted(stages)],
+            ))
+        comp = p.get("compiles", {})
+        if comp.get("timeline"):
+            parts.append("<h3>compile timeline</h3>")
+            parts.append(_table(
+                ["fn", "shape-class", "compile s", "replica"],
+                [[ev.get("fn"), ev.get("shape"), ev.get("compile_s"),
+                  ev.get("replica", "")] for ev in comp["timeline"]],
+            ))
+        recs = p.get("records", [])
+        if recs:
+            parts.append("<h3>recent cycles</h3>")
+            parts.append(_table(
+                ["cycle", "source", "pods", "placed", "evicted", "churn",
+                 "rounds", "warm", "solve ms", "compiles", "anomaly"],
+                [[r["cycle"], r["source"], r["pods"], r["placed"],
+                  r["evicted"], r["churn"], r["rounds"], r["warm_path"],
+                  round(r["solve_s"] * 1e3, 2), r["compiles"],
+                  r["anomaly"]] for r in recs],
+            ))
+    parts.append("</body></html>\n")
+    return "".join(parts)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("addresses", nargs="+",
+                    help="sidecar address(es); several = per-replica "
+                         "views plus a merged fleet view")
+    ap.add_argument("--records", type=int, default=32,
+                    help="last-N CycleRecords per replica (default 32)")
+    ap.add_argument("--html", default=None,
+                    help="also write a standalone HTML dashboard here")
+    ap.add_argument("--json", action="store_true",
+                    help="print raw payload JSON instead of the tables")
+    args = ap.parse_args()
+
+    payloads = []
+    for addr in args.addresses:
+        try:
+            payloads.append(fetch(addr, args.records))
+        except Exception as e:  # a down replica must not hide the rest
+            print(f"[statusz] {addr}: fetch failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+    if not payloads:
+        print("no replica answered", file=sys.stderr)
+        return 1
+    views = list(payloads)
+    if len(payloads) > 1:
+        views.append(merge_fleet(payloads))
+    if args.json:
+        print(json.dumps(views, indent=2))
+    else:
+        print("\n\n".join(render_text(v) for v in views))
+    if args.html:
+        Path(args.html).write_text(render_html(views))
+        print(f"[statusz] wrote {args.html}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -80,6 +80,11 @@ class ApplyStats:
     h2d_bytes: int = 0        # bytes actually shipped host->device
     rows_scattered: int = 0   # churned+pad rows written across groups
     reordered: bool = False   # a permutation gather ran
+    # Wire-level churn: upsert+remove records this apply carried (the
+    # cycle ledger's churn field for warm/pipeline cycles, round 18 —
+    # rows_scattered counts pad rows and used-resums too, so it
+    # overstates what the CLIENT changed).
+    churn_records: int = 0
 
 
 @dataclasses.dataclass
@@ -398,19 +403,24 @@ class DeviceSnapshot:
         for name in remove_running:
             self._running.pop(name, None)
         self._rebuild_members()
+        churn = (len(upsert_nodes) + len(remove_nodes) + len(upsert_pods)
+                 + len(remove_pods) + len(upsert_running)
+                 + len(remove_running))
         try:
-            return self._apply_incremental(
+            stats = self._apply_incremental(
                 upsert_nodes, remove_nodes, upsert_pods, remove_pods,
                 upsert_running, remove_running,
             )
         except _NeedsRebuild as e:
-            return self._rebuild(e.reason)
+            stats = self._rebuild(e.reason)
         except Exception:  # noqa: BLE001 — heal, then let tests catch it
             logging.getLogger("tpusched.device_state").warning(
                 "incremental delta apply failed; rebuilding this "
                 "lineage:\n%s", traceback.format_exc(limit=4),
             )
-            return self._rebuild("incremental_error")
+            stats = self._rebuild("incremental_error")
+        stats.churn_records = churn
+        return stats
 
     def _apply_incremental(self, upsert_nodes, remove_nodes, upsert_pods,
                            remove_pods, upsert_running, remove_running
@@ -844,6 +854,23 @@ class DeviceSnapshot:
             pod_perm=pod_perm, node_perm=node_perm,
             member_perm=member_perm,
         )
+
+    def warm_marker(self) -> "tuple[int, int]":
+        """(warm_solves, incremental_solves) snapshot BEFORE a warm
+        dispatch — pair with warm_path_taken to classify what the
+        dispatch actually served. One authority (round 18, ISSUE 13):
+        the host, the warm stream, and the ledger's warm-mix must all
+        read the commit_warm counters the same way."""
+        return (self.warm_solves, self.incremental_solves)
+
+    def warm_path_taken(self, marker: "tuple[int, int]") -> str:
+        """Path the dispatch since `marker` took (the ledger's
+        canonical spelling): incremental | warm | cold."""
+        if self.incremental_solves > marker[1]:
+            return "incremental"
+        if self.warm_solves > marker[0]:
+            return "warm"
+        return "cold"
 
     def commit_warm(self, state, path: str, reason: str, rows) -> None:
         """Engine callback at warm/cold dispatch time: store the new

@@ -9,6 +9,7 @@ changes; EngineConfig is closed over as compile-time constants.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import logging
 import queue
 import threading
@@ -21,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpusched import ledger as ledgering
 from tpusched import trace as tracing
 from tpusched.config import EngineConfig
 from tpusched.faults import NO_FAULTS
@@ -266,6 +268,23 @@ def _pack_solve(out):
     ])
 
 
+# Per-Engine nonce for compile-watcher keys: jit caches are
+# per-instance, so a second engine's first solve at a known shape is a
+# NEW compile and must count as one (itertools.count is atomic).
+_ENGINE_IDS = itertools.count(1)
+
+
+def _shape_label(args) -> str:
+    """Human shape-class label for the compile timeline: the snapshot's
+    bucket dims when one is present, else a leaf-count tag."""
+    for a in args:
+        if isinstance(a, ClusterSnapshot):
+            return (f"P{a.pods.valid.shape[0]}"
+                    f"xN{a.nodes.valid.shape[0]}"
+                    f"xM{a.running.valid.shape[0]}")
+    return f"{len(jax.tree.leaves(args))}leaves"
+
+
 @dataclasses.dataclass
 class WarmState:
     """The carried-state handle of the warm path (ROADMAP item 3): one
@@ -345,10 +364,17 @@ class Engine:
                 any_feasible.astype(jnp.float32),
             ])
 
-        self._solve_jit = jax.jit(_solve)
-        self._solve_packed_jit = jax.jit(_solve_packed)
-        self._score_jit = jax.jit(_score)
-        self._score_top1_jit = jax.jit(_score_top1)
+        # Compile attribution (round 18, ISSUE 13): every jit entry
+        # point is wrapped so the first dispatch of a new shape class
+        # records one compile event (count + wall time) in
+        # ledger.COMPILES — the per-cycle retrace visibility the cycle
+        # ledger's sentinel keys "compile" anomalies off.
+        self._jit_nonce = next(_ENGINE_IDS)
+        self._solve_jit = self._traced_jit("solve", _solve)
+        self._solve_packed_jit = self._traced_jit("solve_packed",
+                                                  _solve_packed)
+        self._score_jit = self._traced_jit("score", _score)
+        self._score_top1_jit = self._traced_jit("score_top1", _score_top1)
         self._score_fn = _score
         self._topk_jits: dict[int, Any] = {}  # k -> jitted top-k path
         # Decision-provenance programs (round 12): compiled LAZILY on
@@ -385,6 +411,33 @@ class Engine:
         self._pool_finalizer = weakref.finalize(
             self, self._fetch_pool._q.put, None
         )
+
+    def _traced_jit(self, name: str, fn):
+        """jax.jit plus compile/retrace attribution (round 18, ISSUE
+        13): the FIRST dispatch of a new (engine, program, arg-shapes)
+        class runs trace+lower+compile synchronously, so its wall time
+        prices the compile; ledger.COMPILES records one event per
+        class and cycle emitters diff its counters around a cycle.
+        Steady state costs one set-membership check per dispatch (a
+        disabled watcher: one attribute read)."""
+        jf = jax.jit(fn)
+        nonce = self._jit_nonce
+
+        def dispatch(*args):
+            watcher = ledgering.COMPILES
+            if not watcher.enabled:
+                return jf(*args)
+            key = (nonce, name,
+                   tuple(np.shape(l) for l in jax.tree.leaves(args)))
+            if watcher.known(key):
+                return jf(*args)
+            t0 = time.perf_counter()
+            out = jf(*args)
+            watcher.note(key, name, _shape_label(args),
+                         time.perf_counter() - t0)
+            return out
+
+        return dispatch
 
     # -- public API ---------------------------------------------------------
 
@@ -539,8 +592,9 @@ class Engine:
                              member_sat_t=tab.member_sat_t)
             return _pack_solve(out), tab
 
-        self._cold_refresh_jit = jax.jit(_cold)
-        self._warm_solve_jit = jax.jit(_warm)
+        self._cold_refresh_jit = self._traced_jit("warm_cold_refresh",
+                                                  _cold)
+        self._warm_solve_jit = self._traced_jit("warm_refresh", _warm)
 
     def _warm_inc_fn(self, cap: int):
         """The incremental warm program at one frontier-compaction
@@ -561,7 +615,8 @@ class Engine:
                                         _cap)
                 return jnp.concatenate([_pack_solve(out[:7]), out[7]]), tab
 
-            fn = self._warm_inc_jits[cap] = jax.jit(_inc)
+            fn = self._warm_inc_jits[cap] = self._traced_jit(
+                f"warm_incremental_cap{cap}", _inc)
         return fn
 
     @staticmethod
@@ -789,7 +844,8 @@ class Engine:
                     astats.reshape(-1),
                 ])
 
-            self._explain_solve_jit = jax.jit(_packed_explained)
+            self._explain_solve_jit = self._traced_jit(
+                "solve_explained", _packed_explained)
         N = snap.nodes.valid.shape[0]
         kk = int(min(max(int(k), 1), max(N, 1)))
         probe_fn = self._explain_probe_jits.get(kk)
@@ -807,7 +863,8 @@ class Engine:
                     cfg, s, node_sat_t, member_sat_t, _k, init_counts=ic
                 )
 
-            probe_fn = self._explain_probe_jits[kk] = jax.jit(_probe)
+            probe_fn = self._explain_probe_jits[kk] = self._traced_jit(
+                f"explain_probe_k{kk}", _probe)
 
         t0 = time.perf_counter()
         solve_buf = self._explain_solve_jit(snap)   # async dispatch
@@ -892,7 +949,8 @@ class Engine:
                     jnp.where(ok, v, 0.0).ravel(),
                 ])
 
-            fn = self._topk_jits[k] = jax.jit(_topk)
+            fn = self._topk_jits[k] = self._traced_jit(
+                f"score_topk_k{k}", _topk)
         P = snap.pods.valid.shape[0]
 
         def unpack(buf, seconds):

@@ -26,6 +26,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from tpusched import explain as explaining
+from tpusched import ledger as ledgering
 from tpusched import metrics as pm
 from tpusched import trace as tracing
 from tpusched.config import (
@@ -286,6 +287,7 @@ class HostScheduler:
         refresh_frac: "float | None" = None,
         tracer=None,
         warm: "bool | str" = False,
+        ledger=None,
     ):
         """explain (round 12, ISSUE 8): optional
         tpusched.explain.ExplainCollector; None falls back to the
@@ -319,7 +321,18 @@ class HostScheduler:
         over the pending frontier (Engine.solve_warm_async(incremental=
         True)) — bounded divergence under the in-kernel validity
         contract instead of bitwise parity; every cycle failure drops
-        the carry with the lineage (the same unwind)."""
+        the carry with the lineage (the same unwind).
+
+        ledger (round 18, ISSUE 13): optional
+        tpusched.ledger.CycleLedger; None falls back to the process
+        default at emit time (injected-collector discipline). Every
+        successful cycle appends one CycleRecord — batch/placed/
+        evicted counts, build/solve/bind stage walls, churn (the
+        drained change hints), warm path taken, commit rounds, and
+        the XLA cache misses the cycle paid (ledger.COMPILES delta).
+        The record's `ts` rides this host's clock, so virtual-time
+        drivers emit virtual timestamps; `ledger_source` tags the
+        emitter ("host"; the sim driver re-tags its host "sim")."""
         self.api = api
         self.tracer = tracer
         self.config = config or EngineConfig()
@@ -425,6 +438,8 @@ class HostScheduler:
             "scheduling cycles re-driven after a transient rpc failure")
         self.explain = explain if explain is not None \
             else explaining.DEFAULT
+        self.ledger = ledger
+        self.ledger_source = "host"
 
     def _io(self) -> ThreadPoolExecutor:
         """Lazy pool for concurrent API-server writes (binds/deletes)."""
@@ -537,10 +552,14 @@ class HostScheduler:
                 remove_running=sorted(prev_r - cur[2]),
             )
         self._warm_members = cur
+        # Path taken, read off the lineage counters around the solve
+        # (commit_warm stamps them at dispatch): the ledger's warm-mix
+        # must report what actually served, incl. cold fallbacks.
+        marker = ds.warm_marker()
         res = self._engine.solve_warm_async(
             ds, incremental=self._warm_incremental
         ).result()
-        return res, ds.meta
+        return res, ds.meta, ds.warm_path_taken(marker)
 
     # -- snapshot assembly --------------------------------------------------
 
@@ -605,6 +624,14 @@ class HostScheduler:
         (pods in their backoff window don't count — they re-enter the
         active queue when it expires)."""
         now = self._clock()
+        # Flight-ledger context (round 18, ISSUE 13): compile counters
+        # snapshot BEFORE any solve work so the record attributes
+        # exactly the retraces this cycle paid.
+        lg = self.ledger or ledgering.DEFAULT
+        comp0 = ledgering.COMPILES.counters() if lg.enabled else (0, 0.0)
+        warm_path = "cold"
+        rounds = frontier = 0
+        n_nodes = n_running = 0
         # Drain change hints BEFORE reading cluster state: an event
         # landing between the drain and the reads stays in the
         # accumulator for next cycle (harmless over-inclusion), whereas
@@ -674,7 +701,7 @@ class HostScheduler:
                 # so the next cycle full-loads and solves cold instead
                 # of trusting half-applied warm state.
                 try:
-                    res, meta = self._warm_cycle_solve(
+                    res, meta, warm_path = self._warm_cycle_solve(
                         nodes_r, pods_r, running_r, changed,
                         backlog=len(all_pending),
                     )
@@ -700,6 +727,10 @@ class HostScheduler:
         if warm_cycle:
             assignments, evicted = self._result_names(meta, res)
             solve_s = time.perf_counter() - t0
+            rounds = int(res.rounds)
+            if res.inc_info:
+                frontier = int(res.inc_info.get("frontier", 0))
+            n_nodes, n_running = len(nodes_r), len(running_r)
         elif self.client is not None:
             # Packed parallel-array response: three frombuffer reads
             # instead of P Python proto message traversals (~30 ms per
@@ -713,6 +744,8 @@ class HostScheduler:
             ]
             evicted = list(resp.evicted)
             solve_s = time.perf_counter() - t0
+            rounds = int(resp.rounds)
+            n_nodes, n_running = len(msg.nodes), len(msg.running)
         else:
             snap, meta = decode_snapshot(msg, self.config, self.buckets)
             # Async dispatch: the window between dispatch and join is
@@ -737,6 +770,8 @@ class HostScheduler:
                 res = pending_solve.result()
             assignments, evicted = self._result_names(meta, res)
             solve_s = time.perf_counter() - t0
+            rounds = int(res.rounds)
+            n_nodes, n_running = meta.n_nodes, meta.n_running
 
         t0 = time.perf_counter()
         # Deletes before binds: a preemptor's room must exist before its
@@ -799,6 +834,25 @@ class HostScheduler:
             "host.cycle", dur_s=stats.total_seconds, cat="host",
             batch=stats.batch_size, placed=placed, evicted=len(evicted),
         )
+        # One flight-ledger record per completed cycle (round 18,
+        # ISSUE 13): the cycle-sequence join of everything above —
+        # sizes, stage walls, churn, warm path, rounds, and the
+        # retraces this cycle paid. The sentinel inside observe()
+        # flags and attributes p99 spikes.
+        if lg.enabled:
+            c1, s1 = ledgering.COMPILES.counters()
+            lg.observe(ledgering.CycleRecord(
+                ts=float(now), source=self.ledger_source,
+                pods=len(pending), nodes=int(n_nodes),
+                running=int(n_running), placed=placed,
+                evicted=len(evicted),
+                churn=len(changed) if changed else 0,
+                frontier=frontier, rounds=rounds, warm_path=warm_path,
+                solve_s=solve_s,
+                stages=dict(build=build_s, solve=solve_s, bind=bind_s),
+                compiles=c1 - comp0[0],
+                compile_s=round(s1 - comp0[1], 6),
+            ))
         return stats
 
     @staticmethod

@@ -27,6 +27,8 @@ import math
 import threading
 from typing import Any, Callable, Iterable, Mapping, cast
 
+from tpusched.config import clamp01
+
 
 def escape_label_value(v: str) -> str:
     """Prometheus text exposition escaping for label values."""
@@ -80,6 +82,42 @@ def pow_buckets(lo: int, hi: int, factor: int = 4) -> "tuple[int, ...]":
 # watchdog-scale hung solve) — the fix for the 5.0s truncation.
 DURATION_BUCKETS = log_buckets(1e-4, 600.0, per_decade=3)
 BYTE_BUCKETS = pow_buckets(1 << 10, 1 << 30, factor=4)
+
+
+def bucket_quantile(buckets: "tuple[float, ...]", counts: "list[int]",
+                    q: float, interpolate: bool = True) -> float:
+    """Quantile estimate from histogram bucket counts (round 18,
+    ISSUE 13: shared by Histogram.quantile, the cycle-ledger sentinel,
+    and tools/statusz.py's cross-replica merge, so one interpolation
+    rule serves them all).
+
+    `buckets` are the finite upper bounds; `counts` are the PER-BUCKET
+    (non-cumulative) counts with the +Inf overflow count as the final
+    element (len(buckets) + 1 entries). Returns NaN for an empty
+    histogram. A quantile landing in the overflow bucket returns the
+    last finite bound (the prometheus histogram_quantile convention:
+    beyond the layout's resolution, the floor is the honest answer).
+    interpolate=False returns the covering bucket's upper bound
+    instead of interpolating within it — the conservative form for
+    DISCRETE quantities (round counts, churn sizes), where a linear
+    split inside a bucket would manufacture fractional thresholds no
+    observation ever had."""
+    total = sum(counts)
+    if total <= 0:
+        return math.nan
+    rank = max(float(q), 0.0) * total
+    cum = 0.0
+    for i, b in enumerate(buckets):
+        prev_cum = cum
+        cum += counts[i]
+        if cum >= rank:
+            if not interpolate or counts[i] <= 0:
+                return float(b)
+            lo = float(buckets[i - 1]) if i > 0 else 0.0
+            frac = clamp01((rank - prev_cum) / counts[i], default=1.0)
+            return lo + (float(b) - lo) * frac
+    # Overflow bucket: the layout can't resolve past its last bound.
+    return float(buckets[-1]) if buckets else math.nan
 
 
 class Registry:
@@ -353,6 +391,35 @@ class Histogram(_Metric):
         if self.labelnames:
             raise ValueError(f"{self.name} has labels; use .labels().observe()")
         self.labels().observe(v)
+
+    def quantile(self, q: float, *label_values: Any,
+                 interpolate: bool = True) -> float:
+        """Bucket-interpolated quantile estimate for one series
+        (label-less histograms pass no label values). NaN when the
+        series has no observations (or was never created) — see
+        bucket_quantile for the interpolation/overflow rules."""
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            child = self._children.get(key)
+        if child is None:
+            return math.nan
+        with child._lock:
+            counts = list(child.counts)
+        return bucket_quantile(self.buckets, counts, q,
+                               interpolate=interpolate)
+
+    def series_counts(self, *label_values: Any) -> "list[int]":
+        """Per-bucket counts (overflow last) of one series — the raw
+        export tools/statusz.py ships across replicas so a fleet-level
+        quantile can merge counts instead of averaging quantiles.
+        Empty list when the series does not exist."""
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            child = self._children.get(key)
+        if child is None:
+            return []
+        with child._lock:
+            return list(child.counts)
 
     def render_lines(self) -> "list[str]":
         lines = [f"# TYPE {self.name} histogram"]

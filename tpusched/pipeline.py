@@ -37,6 +37,7 @@ from typing import Any, Callable, Iterable, Iterator
 
 import numpy as np
 
+from tpusched import ledger as ledgering
 from tpusched.engine import Engine, SolveResult
 from tpusched.snapshot import ClusterSnapshot
 
@@ -80,6 +81,7 @@ def warm_cycle_stream(
     device,
     deltas: Iterable[dict],
     incremental: bool = False,
+    ledger=None,
 ) -> Iterator[tuple[Any, SolveResult]]:
     """Pipeline consecutive DELTA CYCLES of one device-resident lineage
     through the warm-start path (ROADMAP item 3): `device` is a
@@ -106,25 +108,69 @@ def warm_cycle_stream(
     (the host-side record work) still overlaps fetch(k), only the
     dispatch is deferred; dispatching early would seed k+1 from the
     k-1 carry and widen the divergence for no latency win (the device
-    is serial across cycles of one lineage anyway)."""
-    in_flight = None  # (ApplyStats, PendingFetch)
+    is serial across cycles of one lineage anyway).
+
+    ledger (round 18, ISSUE 13): optional tpusched.ledger.CycleLedger;
+    None falls back to the process default. Each cycle appends one
+    CycleRecord (source="pipeline") at its result join — warm path
+    taken, churn carried by the delta, commit rounds/frontier, and
+    the XLA cache misses its dispatch paid."""
+    lg = ledger or ledgering.DEFAULT
+
+    def _join(entry):
+        stats, pending, ctx = entry
+        res = pending.result()
+        if ctx is not None:
+            evicted = 0
+            if res.evicted is not None:
+                evicted = int(res.evicted.sum())
+            frontier = 0
+            if res.inc_info:
+                frontier = int(res.inc_info.get("frontier", 0))
+            lg.observe(ledgering.CycleRecord(
+                ts=time.time(), source="pipeline", pods=ctx["pods"],
+                nodes=ctx["nodes"], running=ctx["running"],
+                placed=int((res.assignment[: ctx["pods"]] >= 0).sum()),
+                evicted=evicted, churn=ctx["churn"], frontier=frontier,
+                rounds=int(res.rounds), warm_path=ctx["path"],
+                solve_s=res.solve_seconds,
+                stages=dict(solve=res.solve_seconds),
+                compiles=ctx["compiles"],
+                compile_s=round(ctx["compile_s"], 6),
+            ))
+        return stats, res
+
+    in_flight = None  # (ApplyStats, PendingFetch, ledger ctx | None)
     for delta in deltas:
         stats = device.apply(**delta)
+        marker = device.warm_marker()
+        comp0 = ledgering.COMPILES.counters() if lg.enabled else (0, 0.0)
         if incremental:
             if in_flight is not None:
-                pstats, prev = in_flight
-                yield pstats, prev.result()
+                yield _join(in_flight)
                 in_flight = None
             pending = engine.solve_warm_async(device, incremental=True)
         else:
             pending = engine.solve_warm_async(device)
+        ctx = None
+        if lg.enabled:
+            # Captured at dispatch: commit_warm stamped the path
+            # counters, the jit wrapper recorded any compile this
+            # dispatch paid, and meta still names THIS cycle's rows (a
+            # concurrent next apply would shift them before the join).
+            comp1 = ledgering.COMPILES.counters()
+            meta = device.meta
+            ctx = dict(path=device.warm_path_taken(marker),
+                       pods=meta.n_pods, nodes=meta.n_nodes,
+                       running=meta.n_running,
+                       churn=stats.churn_records,
+                       compiles=comp1[0] - comp0[0],
+                       compile_s=comp1[1] - comp0[1])
         if in_flight is not None:
-            pstats, prev = in_flight
-            yield pstats, prev.result()
-        in_flight = (stats, pending)
+            yield _join(in_flight)
+        in_flight = (stats, pending, ctx)
     if in_flight is not None:
-        pstats, prev = in_flight
-        yield pstats, prev.result()
+        yield _join(in_flight)
 
 
 def bench_overlap(

@@ -231,6 +231,7 @@ class SchedulerClient:
         self._debugz = _MethodRef(self, "Debugz")
         self._replicate = _MethodRef(self, "Replicate")
         self._explainz = _MethodRef(self, "Explainz")
+        self._statusz = _MethodRef(self, "Statusz")
 
     _RPCS = (
         ("ScoreBatch", pb.ScoreRequest, pb.ScoreResponse),
@@ -240,6 +241,7 @@ class SchedulerClient:
         ("Debugz", pb.DebugzRequest, pb.DebugzResponse),
         ("Replicate", pb.ReplicateRequest, pb.ReplicateResponse),
         ("Explainz", pb.ExplainzRequest, pb.ExplainzResponse),
+        ("Statusz", pb.StatuszRequest, pb.StatuszResponse),
     )
 
     def _connect(self) -> None:
@@ -504,6 +506,16 @@ class SchedulerClient:
             pb.ExplainzRequest(pod=pod, victim=victim,
                                max_records=max_records,
                                include_auction=include_auction),
+        )
+
+    def statusz(self, max_records: int = 32) -> pb.StatuszResponse:
+        """Cycle flight ledger (round 18, ISSUE 13): rolling per-stage
+        p50/p99, warm-path mix, compile timeline, sentinel anomalies,
+        and the last-N CycleRecords as one JSON payload — see
+        SchedulerService.Statusz and tools/statusz.py."""
+        return self._call(
+            self._statusz,
+            pb.StatuszRequest(max_records=int(max_records)),
         )
 
     def close(self):

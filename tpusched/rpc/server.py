@@ -133,6 +133,7 @@ import numpy as np
 import grpc
 
 from tpusched import explain as explaining
+from tpusched import ledger as ledgering
 from tpusched import metrics as pm
 from tpusched import trace as tracing
 from tpusched.faults import NO_FAULTS
@@ -700,6 +701,8 @@ class SchedulerService:
         explain=False,
         explain_k: int = 3,
         warm: "str | None" = None,
+        ledger: "ledgering.CycleLedger | None" = None,
+        ledger_jsonl: "str | None" = None,
     ):
         """audit_stream: optional file-like; when set, every Assign
         emits one JSON record PER POD (pod, node, score, commit_key —
@@ -750,7 +753,20 @@ class SchedulerService:
         SolveResult.inc_info). Either way full-send Assigns, explained
         cycles, forks, and degraded rungs fall back to the plain solve,
         and scheduler_warm_solves_total{path} counts what actually
-        served."""
+        served.
+
+        ledger (round 18, ISSUE 13): injectable
+        tpusched.ledger.CycleLedger; by default the service builds its
+        own, registered in ITS metrics registry (so
+        scheduler_cycle_anomalies_total and friends render in this
+        server's Metrics rpc) and wired to its flight recorder and
+        span ring (an anomaly's flight dump carries the causal trace).
+        Every served Assign appends one CycleRecord — stage walls
+        joined from the request's spans, delta churn, warm path,
+        commit rounds, and the XLA cache misses the request paid —
+        served by the Statusz rpc / tools/statusz.py. ledger_jsonl:
+        optional path for the JSONL black box (every record appended;
+        ignored when `ledger` is injected)."""
         self.config = config or EngineConfig()
         # Floor buckets pin compile shapes across requests (a feature
         # first appearing mid-serving would otherwise trigger a full
@@ -841,6 +857,16 @@ class SchedulerService:
                 enabled=bool(explain), topk=int(explain_k))
         self._explain_k = int(self.explain.topk)
         self.flight.decisions = self.explain
+        # Cycle flight ledger (round 18, ISSUE 13): per-cycle telemetry
+        # ring + regression sentinel, families in THIS server's metrics
+        # registry, anomaly dumps into THIS server's flight recorder /
+        # span ring (docstring). Served by the Statusz rpc.
+        if ledger is not None:
+            self.ledger = ledger
+        else:
+            self.ledger = ledgering.CycleLedger(
+                registry=self.metrics.registry, flight=self.flight,
+                tracer=self._trace, jsonl=ledger_jsonl)
         # Live device/store memory surface (ROADMAP item 1 feeds on
         # this): rendered at scrape time from the authoritative maps.
         pm.CallbackGauge(
@@ -1435,6 +1461,7 @@ class SchedulerService:
             self._closed = True
         self._gate.close()
         self._engine.close(wait=True)
+        self.ledger.close()  # releases the JSONL black box, if any
         with self._store_lock:
             self._sessions.clear()
         if not already:
@@ -1714,6 +1741,19 @@ class SchedulerService:
         )
 
     def _assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
+        # Flight-ledger context (round 18, ISSUE 13): compile counters
+        # BEFORE any decode/dispatch so the record attributes exactly
+        # the retraces this request paid; churn is the delta's own
+        # record count (0 for full sends — a full send is a reload,
+        # not churn).
+        comp0 = (ledgering.COMPILES.counters()
+                 if self.ledger.enabled else (0, 0.0))
+        churn = 0
+        if request.HasField("delta"):
+            d = request.delta
+            churn = (len(d.upsert_nodes) + len(d.remove_nodes)
+                     + len(d.upsert_pods) + len(d.remove_pods)
+                     + len(d.upsert_running) + len(d.remove_running))
         snap, meta, sid, decode_s, dstats, session = \
             self._resolve_decoded(request)
         # Staged handling (round 6): decode runs OUTSIDE the dispatch
@@ -1898,6 +1938,36 @@ class SchedulerService:
                              decode_s + res.solve_seconds)
         self.metrics.solve_rounds.observe(res.rounds)
         self.metrics.warm_solves.labels(warm_path).inc()
+        # One flight-ledger record per served Assign (round 18, ISSUE
+        # 13): stage walls joined from this request's completed spans
+        # (same names a trace shows — decode, delta.apply, dispatch,
+        # fetch.join, reply.*), falling back to the directly measured
+        # walls when tracing is off. The sentinel inside observe()
+        # flags p99 spikes and attributes them from the record itself.
+        if self.ledger.enabled:
+            c1, s1 = ledgering.COMPILES.counters()
+            ctx = self._trace.current()
+            stages = self._trace.durations(ctx[0]) if ctx else {}
+            if not stages:
+                stages = {"decode": decode_s,
+                          "fetch.join": res.solve_seconds}
+            frontier = 0
+            if res.inc_info:
+                frontier = int(res.inc_info.get("frontier", 0))
+            self.ledger.observe(ledgering.CycleRecord(
+                ts=time.time(), source="sidecar", pods=meta.n_pods,
+                nodes=meta.n_nodes, running=meta.n_running,
+                placed=placed, evicted=n_evicted, churn=churn,
+                frontier=frontier, rounds=int(res.rounds),
+                # The ledger schema's canonical spelling is "warm"
+                # (cold|warm|incremental); the warm-solves counter
+                # keeps its historical "bitwise" label.
+                warm_path=("warm" if warm_path == "bitwise"
+                           else warm_path),
+                solve_s=res.solve_seconds,
+                stages=stages, compiles=c1 - comp0[0],
+                compile_s=round(s1 - comp0[1], 6),
+            ))
         return resp
 
     def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
@@ -2028,6 +2098,26 @@ class SchedulerService:
             trace_json=json.dumps({"traces": traces}), flight_json=flight
         )
 
+    def Statusz(self, request: pb.StatuszRequest,
+                context) -> pb.StatuszResponse:
+        """The cycle flight ledger (round 18, ISSUE 13): rolling
+        p50/p99 per stage, warm-path mix, churn/round aggregates, the
+        compile timeline, sentinel anomaly counts, and the last-N
+        CycleRecords — plus this replica's identity facts so
+        tools/statusz.py's fleet merge can label columns. Served on
+        standbys too (observability must not promote), like Health/
+        Metrics/Debugz. A debug surface: record JSON follows
+        tpusched.ledger.SCHEMA, not a stable API."""
+        n = int(request.max_records)
+        n = 32 if n <= 0 else min(n, 256)
+        payload = self.ledger.statusz(last=n)
+        lad = self._ladder.snapshot()
+        payload["role"] = self.role
+        payload["serving_path"] = lad["level"]
+        payload["watchdog_trips"] = self.watchdog_trips
+        payload["flight_dumps"] = self.flight.trips
+        return pb.StatuszResponse(statusz_json=json.dumps(payload))
+
     def Explainz(self, request: pb.ExplainzRequest,
                  context) -> pb.ExplainzResponse:
         """Decision provenance (round 12): last-N DecisionRecords as
@@ -2075,6 +2165,8 @@ def make_server(
     explain=False,
     explain_k: int = 3,
     warm: "str | None" = None,
+    ledger: "ledgering.CycleLedger | None" = None,
+    ledger_jsonl: "str | None" = None,
 ):
     """Build (grpc.Server, bound_port, service). Unlimited message size:
     a 10k-pod snapshot exceeds the 4 MB default. max_workers default 8:
@@ -2089,7 +2181,10 @@ def make_server(
     (round 12 — True or an ExplainCollector makes every Assign an
     explained cycle, served by the Explainz rpc); warm: warm-solve
     routing for session-backed delta Assigns (round 17, ISSUE 12 —
-    None | "bitwise" | "incremental"; SchedulerService docstring)."""
+    None | "bitwise" | "incremental"; SchedulerService docstring);
+    ledger/ledger_jsonl: the cycle flight ledger + its optional JSONL
+    black box (round 18, ISSUE 13 — served by the Statusz rpc /
+    tools/statusz.py)."""
     svc = SchedulerService(config, buckets, log_stream=log_stream,
                            audit_stream=audit_stream,
                            device_sessions=device_sessions,
@@ -2097,7 +2192,8 @@ def make_server(
                            ladder=ladder, tracer=tracer, flight=flight,
                            role=role, replication_log=replication_log,
                            explain=explain, explain_k=explain_k,
-                           warm=warm)
+                           warm=warm, ledger=ledger,
+                           ledger_jsonl=ledger_jsonl)
 
     def handler(fn, req_cls):
         return grpc.unary_unary_rpc_method_handler(
@@ -2114,6 +2210,7 @@ def make_server(
         "Debugz": handler(svc.Debugz, pb.DebugzRequest),
         "Replicate": handler(svc.Replicate, pb.ReplicateRequest),
         "Explainz": handler(svc.Explainz, pb.ExplainzRequest),
+        "Statusz": handler(svc.Statusz, pb.StatuszRequest),
     }
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
@@ -2131,11 +2228,12 @@ def make_server(
 
 def serve(address: str = "127.0.0.1:50051", config: EngineConfig | None = None,
           audit_path: str | None = None, watchdog_s: float = WATCHDOG_S,
-          explain: bool = False):
+          explain: bool = False, ledger_jsonl: str | None = None):
     """Blocking entry point: python -m tpusched.rpc.server"""
     audit = open(audit_path, "a") if audit_path else None
     server, port, svc = make_server(address, config, audit_stream=audit,
-                                    watchdog_s=watchdog_s, explain=explain)
+                                    watchdog_s=watchdog_s, explain=explain,
+                                    ledger_jsonl=ledger_jsonl)
     server.start()
     print(f"tpusched sidecar listening on port {port}", file=sys.stderr)
     try:
@@ -2158,6 +2256,10 @@ if __name__ == "__main__":
     ap.add_argument("--explain", action="store_true",
                     help="record decision provenance for every Assign "
                          "(served by the Explainz rpc / tools/explainz.py)")
+    ap.add_argument("--ledger-jsonl", default=None,
+                    help="append every cycle flight-ledger record to "
+                         "this JSONL black box (round 18; the Statusz "
+                         "rpc serves the in-memory ring either way)")
     args = ap.parse_args()
     cfg = None
     if args.config:
@@ -2165,4 +2267,5 @@ if __name__ == "__main__":
 
         cfg = load_config(args.config)
     serve(args.address, cfg, audit_path=args.audit,
-          watchdog_s=args.watchdog_s, explain=args.explain)
+          watchdog_s=args.watchdog_s, explain=args.explain,
+          ledger_jsonl=args.ledger_jsonl)

@@ -133,6 +133,7 @@ class SimDriver:
         tracer=None,
         explain=None,
         setup: "SimSetup | None" = None,
+        ledger=None,
     ):
         """explain (round 12): optional ExplainCollector threaded into
         the in-process HostScheduler — every cycle records a
@@ -145,7 +146,15 @@ class SimDriver:
         caller wants to inspect/serialize first. When given, no
         generation happens here; the scenario rides in on the setup
         (pass scenario=None). Note a setup's event queue is consumed
-        by the run — build/load a fresh one per run."""
+        by the run — build/load a fresh one per run.
+
+        ledger (round 18, ISSUE 13): optional
+        tpusched.ledger.CycleLedger threaded into the HostScheduler —
+        virtual-time replays then emit the SAME CycleRecord schema as
+        live serving (tests/test_ledger.py pins the twin), with
+        source="sim" and ts on the virtual clock, so a recorded
+        workload's flight ledger is directly comparable to the
+        production one it replays."""
         if setup is not None:
             if scenario is not None and scenario is not setup.scenario:
                 raise ValueError(
@@ -182,7 +191,12 @@ class SimDriver:
             transport="pipeline" if client is not None else "delta",
             explain=explain,
             refresh_frac=self.sim.pipeline_refresh_frac,
+            ledger=ledger,
         )
+        # Re-tag the host's ledger records: a virtual-time replay's
+        # cycles must be distinguishable from live host cycles while
+        # keeping the identical schema (the twin contract).
+        self.host.ledger_source = "sim"
         self.backend = "grpc" if client is not None else "inprocess"
 
         self.life = LifecycleTracker()
@@ -485,6 +499,7 @@ def run_scenario(
     replicas: int = 1,
     explain=None,
     setup: "SimSetup | None" = None,
+    ledger=None,
 ) -> SimResult:
     """One sim run. backend="grpc" spins an in-process sidecar and
     drives the full host -> gRPC path (AssignPipeline transport);
@@ -499,7 +514,9 @@ def run_scenario(
     the initial leader only).
     setup (ISSUE 9): a prebuilt SimSetup (trace replay via
     traces.load_trace, or a pre-generated workload) instead of
-    `scenario` — generated and ingested workloads ride this one path."""
+    `scenario` — generated and ingested workloads ride this one path.
+    ledger (round 18): optional CycleLedger for the in-process host's
+    CycleRecord emission (grpc runs record server-side instead)."""
     if setup is not None:
         scenario = setup.scenario
         seed = setup.seed
@@ -508,7 +525,8 @@ def run_scenario(
             raise ValueError("replicas > 1 needs backend='grpc'")
         return SimDriver(scenario, seed, config=config, sim=sim,
                          engine=engine, faults=faults, tracer=tracer,
-                         explain=explain, setup=setup).run()
+                         explain=explain, setup=setup,
+                         ledger=ledger).run()
     if backend != "grpc":
         raise ValueError(f"backend={backend!r}: want inprocess|grpc")
     from tpusched.rpc.client import SchedulerClient  # tpl: disable=TPL001(grpc backend is optional; the in-process sim must import without grpc)

@@ -232,6 +232,17 @@ class TraceCollector:
             out = [s for s in out if s.trace_id == trace_id]
         return out
 
+    def durations(self, trace_id: str) -> "dict[str, float]":
+        """Total completed-span seconds per stage name for one trace —
+        the cycle ledger's stage-timing join (round 18, ISSUE 13): a
+        CycleRecord's `stages` dict is this, so a ledger anomaly names
+        the same stages a trace shows. Open (unfinished) spans are
+        absent by construction; disabled collectors return {}."""
+        out: "dict[str, float]" = {}
+        for s in self.spans(trace_id):
+            out[s.name] = out.get(s.name, 0.0) + s.dur_s
+        return out
+
     def traces(self, last: int = 16) -> "dict[str, list]":
         """The most recent `last` traces (trace_id -> spans, oldest
         span first within each), by recency of each trace's newest
