@@ -1,0 +1,434 @@
+"""Whole-program analysis tests (round 19, ISSUE 14).
+
+Unit coverage for the interprocedural substrate the TPL1xx rules run
+on: call-graph resolution (precise paths, recursion, the bounded
+dynamic-dispatch fallback, cross-module edges), lock identity, held-
+lock propagation, the deliberately-cyclic two-lock fixture the
+analysis MUST flag, jit-family boundedness proofs, and the checked-in
+hierarchy artifact staying in sync with the tree (a stale artifact
+blinds the runtime witness)."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from tpusched.lint.interproc import (
+    Program,
+    scan_product_sources,
+    write_hierarchy,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def prog(**sources: str) -> Program:
+    """Program over {name: src} with tpusched/-style relpaths."""
+    return Program({k.replace("__", "/") + ".py": v
+                    for k, v in sources.items()})
+
+
+# ---------------------------------------------------------------------------
+# Call-graph resolution.
+# ---------------------------------------------------------------------------
+
+def test_self_call_resolves_precisely():
+    p = prog(tpusched__a=(
+        "class A:\n"
+        "    def f(self):\n"
+        "        return self.g()\n"
+        "    def g(self):\n"
+        "        return 1\n"
+    ))
+    calls = p.functions["tpusched/a.py::A.f"].calls
+    assert [c.targets for c in calls] == [("tpusched/a.py::A.g",)]
+    assert calls[0].kind == "self"
+
+
+def test_inherited_method_resolves_through_program_base():
+    p = prog(tpusched__a=(
+        "class Base:\n"
+        "    def g(self):\n"
+        "        return 1\n"
+        "class A(Base):\n"
+        "    def f(self):\n"
+        "        return self.g()\n"
+    ))
+    calls = p.functions["tpusched/a.py::A.f"].calls
+    assert calls[0].targets == ("tpusched/a.py::Base.g",)
+
+
+def test_cross_module_import_edge():
+    p = prog(
+        tpusched__a=(
+            "from tpusched.b import helper\n"
+            "def f():\n"
+            "    return helper()\n"
+        ),
+        tpusched__b=(
+            "def helper():\n"
+            "    return 1\n"
+        ),
+    )
+    calls = p.functions["tpusched/a.py::f"].calls
+    assert calls[0].targets == ("tpusched/b.py::helper",)
+    assert calls[0].kind == "import"
+
+
+def test_module_attr_call_resolves_and_module_misses_stay_unresolved():
+    p = prog(
+        tpusched__a=(
+            "import subprocess\n"
+            "from tpusched import b\n"
+            "def f():\n"
+            "    b.helper()\n"
+            "    subprocess.run(['x'])\n"
+        ),
+        tpusched__b=(
+            "def helper():\n"
+            "    return 1\n"
+        ),
+    )
+    calls = {c.raw: c for c in p.functions["tpusched/a.py::f"].calls}
+    assert calls["b.helper"].targets == ("tpusched/b.py::helper",)
+    # `subprocess.run` must NOT dynamic-dispatch onto a program method
+    # named `run` — the receiver is a foreign module.
+    assert calls["subprocess.run"].targets == ()
+
+
+def test_dynamic_dispatch_fallback_and_its_bounds():
+    many = "\n".join(
+        f"class C{i}:\n    def popular(self):\n        return {i}\n"
+        for i in range(8)
+    )
+    p = prog(tpusched__a=(
+        "class A:\n"
+        "    def unique_helper(self):\n"
+        "        return 1\n"
+        "def f(x):\n"
+        "    x.unique_helper()\n"
+        "    x.popular()\n"
+        "    x.append(1)\n"
+        f"{many}\n"
+        "def g():\n"
+        "    return 2\n"
+        "def h(y):\n"
+        "    y.g()\n"
+    ))
+    calls = {c.raw: c for c in p.functions["tpusched/a.py::f"].calls}
+    # unknown receiver, unique program METHOD name: resolves
+    assert calls["x.unique_helper"].targets == (
+        "tpusched/a.py::A.unique_helper",)
+    assert calls["x.unique_helper"].kind == "dynamic"
+    # too many candidates (8 > cap): no signal, unresolved
+    assert calls["x.popular"].targets == ()
+    # builtin container protocol: never dispatched
+    assert calls["x.append"].targets == ()
+    # module FUNCTIONS are not dispatch targets for attribute calls
+    hcalls = p.functions["tpusched/a.py::h"].calls
+    assert hcalls[0].targets == ()
+
+
+def test_recursion_terminates_and_reaches_the_lock():
+    p = prog(tpusched__a=(
+        "import threading\n"
+        "_mu = threading.Lock()\n"
+        "_other = threading.Lock()\n"
+        "def f(n):\n"
+        "    return g(n)\n"
+        "def g(n):\n"
+        "    if n:\n"
+        "        return f(n - 1)\n"
+        "    with _other:\n"
+        "        return 0\n"
+        "def entry():\n"
+        "    with _mu:\n"
+        "        f(3)\n"
+    ))
+    edges = p.lock_edges()
+    assert [(e.src, e.dst) for e in edges] == [
+        ("tpusched/a.py::_mu", "tpusched/a.py::_other")
+    ]
+    # chain goes through the mutual recursion exactly once
+    assert edges[0].chain == ("tpusched/a.py::f", "tpusched/a.py::g")
+    assert p.lock_cycles() == []
+
+
+def test_typed_receiver_and_return_type_inference():
+    p = prog(tpusched__a=(
+        "import threading\n"
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def submit(self):\n"
+        "        with self._lock:\n"
+        "            return 1\n"
+        "class Owner:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "        self._w = Worker()\n"
+        "    def _pool(self):\n"
+        "        return self._w\n"
+        "    def go(self):\n"
+        "        with self._mu:\n"
+        "            self._pool().submit()\n"
+    ))
+    edges = {(e.src, e.dst) for e in p.lock_edges()}
+    assert ("tpusched/a.py::Owner._mu",
+            "tpusched/a.py::Worker._lock") in edges
+
+
+def test_injected_or_default_attr_type_infers_from_the_fallback_arm():
+    p = prog(tpusched__a=(
+        "import threading\n"
+        "class Log:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def append(self, x):\n"
+        "        with self._lock:\n"
+        "            return x\n"
+        "class Svc:\n"
+        "    def __init__(self, log=None):\n"
+        "        self._mu = threading.Lock()\n"
+        "        self._log = log if log is not None else Log()\n"
+        "    def put(self, x):\n"
+        "        with self._mu:\n"
+        "            self._log.append(x)\n"
+    ))
+    edges = {(e.src, e.dst) for e in p.lock_edges()}
+    # `.append` is a builtin-protocol name, so ONLY the typed receiver
+    # (through the injected-or-default idiom) can produce this edge.
+    assert ("tpusched/a.py::Svc._mu", "tpusched/a.py::Log._lock") in edges
+
+
+# ---------------------------------------------------------------------------
+# The deliberately cyclic two-lock fixture.
+# ---------------------------------------------------------------------------
+
+CYCLIC_TWO_MODULE = dict(
+    tpusched__mod_a=(
+        "import threading\n"
+        "from tpusched.mod_b import poke_b\n"
+        "A_LOCK = threading.Lock()\n"
+        "def use_a_then_b():\n"
+        "    with A_LOCK:\n"
+        "        poke_b()\n"
+        "def poke_a():\n"
+        "    with A_LOCK:\n"
+        "        return 1\n"
+    ),
+    tpusched__mod_b=(
+        "import threading\n"
+        "B_LOCK = threading.Lock()\n"
+        "def poke_b():\n"
+        "    with B_LOCK:\n"
+        "        return 1\n"
+        "def use_b_then_a():\n"
+        "    from tpusched.mod_a import poke_a\n"
+        "    with B_LOCK:\n"
+        "        poke_a()\n"
+    ),
+)
+
+
+def test_cross_module_two_lock_cycle_is_flagged():
+    p = prog(**CYCLIC_TWO_MODULE)
+    cycles = p.lock_cycles()
+    assert cycles == [("tpusched/mod_a.py::A_LOCK",
+                       "tpusched/mod_b.py::B_LOCK")]
+    cyc_edges = {(e.src, e.dst) for e in p.cyclic_edges()}
+    assert cyc_edges == {
+        ("tpusched/mod_a.py::A_LOCK", "tpusched/mod_b.py::B_LOCK"),
+        ("tpusched/mod_b.py::B_LOCK", "tpusched/mod_a.py::A_LOCK"),
+    }
+
+
+def test_consistent_order_has_no_cycle():
+    consistent = dict(CYCLIC_TWO_MODULE)
+    consistent["tpusched__mod_b"] = (
+        "import threading\n"
+        "B_LOCK = threading.Lock()\n"
+        "def poke_b():\n"
+        "    with B_LOCK:\n"
+        "        return 1\n"
+    )
+    p = prog(**consistent)
+    assert p.lock_cycles() == []
+    assert {(e.src, e.dst) for e in p.lock_edges()} == {
+        ("tpusched/mod_a.py::A_LOCK", "tpusched/mod_b.py::B_LOCK"),
+    }
+
+
+def test_unresolved_lockish_withs_surface_in_the_graph_doc():
+    """A lock-looking context expression the analysis cannot name is a
+    known blind spot: it must be visible in --graph (the static
+    counterpart of the witness's unmodeled-edge report), not silently
+    dropped."""
+    p = prog(tpusched__a=(
+        "def f(child):\n"
+        "    with child._lock:\n"
+        "        return 1\n"
+    ))
+    fn = p.functions["tpusched/a.py::f"]
+    assert fn.unresolved_locks == [("child._lock", 2)]
+    doc = p.graph_doc()
+    assert doc["functions"]["tpusched/a.py::f"]["unresolved_locks"] == [
+        {"raw": "child._lock", "line": 2}
+    ]
+
+
+def test_same_instance_reacquisition_is_the_one_lock_cycle():
+    p = prog(tpusched__a=(
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self._helper()\n"
+        "    def _helper(self):\n"
+        "        with self._lock:\n"
+        "            return 1\n"
+    ))
+    assert p.lock_cycles() == [("tpusched/a.py::A._lock",)]
+    # ...but only when the chain is all-self (same instance provable):
+    p2 = prog(tpusched__a=(
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def outer(self, other):\n"
+        "        with self._lock:\n"
+        "            other._helper()\n"
+        "    def _helper(self):\n"
+        "        with self._lock:\n"
+        "            return 1\n"
+    ))
+    assert p2.lock_cycles() == []
+
+
+# ---------------------------------------------------------------------------
+# Jit-family boundedness proofs.
+# ---------------------------------------------------------------------------
+
+def test_jit_family_bounded_one_hop_through_callers():
+    p = prog(tpusched__e=(
+        "import jax\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._jits = {}\n"
+        "    def _fn(self, cap):\n"
+        "        fn = self._jits.get(cap)\n"
+        "        if fn is None:\n"
+        "            fn = self._jits[cap] = jax.jit(lambda v: v)\n"
+        "        return fn\n"
+        "    def _bucket(self, est):\n"
+        "        return 1 << est.bit_length()\n"
+        "    def solve(self, est):\n"
+        "        return self._fn(self._bucket(est))\n"
+    ))
+    fam = [s for s in p.jit_sites if s.kind == "family"]
+    assert len(fam) == 1 and fam[0].bounded is True
+    assert fam[0].bound_via == "bounded by callers"
+
+
+def test_jit_family_unbounded_when_a_caller_passes_raw_keys():
+    p = prog(tpusched__e=(
+        "import jax\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._jits = {}\n"
+        "    def _fn(self, cap):\n"
+        "        fn = self._jits.get(cap)\n"
+        "        if fn is None:\n"
+        "            fn = self._jits[cap] = jax.jit(lambda v: v)\n"
+        "        return fn\n"
+        "    def solve(self, k):\n"
+        "        return self._fn(k)\n"
+    ))
+    fam = [s for s in p.jit_sites if s.kind == "family"]
+    assert len(fam) == 1 and fam[0].bounded is False
+
+
+def test_jit_family_len_cap_counts_as_bounded():
+    p = prog(tpusched__e=(
+        "import jax\n"
+        "_CACHE = {}\n"
+        "def fn(key):\n"
+        "    f = _CACHE.get(key)\n"
+        "    if f is None:\n"
+        "        if len(_CACHE) >= 8:\n"
+        "            _CACHE.clear()\n"
+        "        f = _CACHE[key] = jax.jit(lambda v: v)\n"
+        "    return f\n"
+    ))
+    fam = [s for s in p.jit_sites if s.kind == "family"]
+    assert len(fam) == 1 and fam[0].bounded is True
+    assert fam[0].bound_via == "len-capped memo"
+
+
+def test_jit_local_then_store_classifies_as_family_not_per_call():
+    p = prog(tpusched__e=(
+        "import jax\n"
+        "_CACHE = {}\n"
+        "def fn(key):\n"
+        "    f = jax.jit(lambda v: v)\n"
+        "    _CACHE[key] = f\n"
+        "    return f\n"
+    ))
+    kinds = [s.kind for s in p.jit_sites]
+    assert kinds == ["family"]
+
+
+# ---------------------------------------------------------------------------
+# The real tree: artifact freshness + the known hot edges.
+# ---------------------------------------------------------------------------
+
+def real_program() -> Program:
+    return Program(scan_product_sources(REPO_ROOT))
+
+
+def test_hierarchy_artifact_in_sync(tmp_path):
+    """tools/lock_hierarchy.json must match a fresh regeneration: the
+    runtime witness keys locks by (path, line), so a stale artifact
+    silently un-wraps locks and the tier-1 gate stops observing."""
+    p = real_program()
+    fresh = tmp_path / "hierarchy.json"
+    write_hierarchy(fresh, p)
+    checked_in = REPO_ROOT / "tools" / "lock_hierarchy.json"
+    assert checked_in.exists(), (
+        "run `python tools/lint.py --write-hierarchy`"
+    )
+    assert json.loads(checked_in.read_text()) == json.loads(
+        fresh.read_text()), (
+        "tools/lock_hierarchy.json is stale — regenerate with "
+        "`python tools/lint.py --write-hierarchy` and commit"
+    )
+
+
+def test_real_tree_is_acyclic_and_carries_the_hot_edges():
+    """The documented hot edges (tools/README.md) exist, and the
+    whole-tree lock order is cycle-free — THE deadlock gate."""
+    p = real_program()
+    assert p.lock_cycles() == []
+    edges = {(e.src.split("::")[1], e.dst.split("::")[1])
+             for e in p.lock_edges()}
+    assert ("SchedulerService._role_lock",
+            "SchedulerService._store_lock") in edges
+    assert ("SchedulerService._store_lock",
+            "ReplicationLog._lock") in edges
+    assert ("DeviceSession.lock", "Engine._pool_lock") in edges
+    assert ("DeviceSession.lock", "_OrderedFetchWorker._lock") in edges
+    assert ("_ScoreCoalescer._lock", "_Fusion._lock") in edges
+
+
+def test_real_tree_has_no_unbounded_jit_families():
+    """ISSUE 14 acceptance: zero unbounded jit families at HEAD (the
+    compile-treadmill class ROADMAP item 4's sentinel attributes)."""
+    p = real_program()
+    assert p.unbounded_families() == []
+    # and the known families are present AND proven bounded
+    fams = {s.family: s for s in p.jit_sites if s.kind == "family"}
+    assert fams["self._warm_inc_jits"].bounded is True
+    assert fams["self._topk_jits"].bounded is True
+    assert fams["self._explain_probe_jits"].bounded is True

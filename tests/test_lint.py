@@ -182,6 +182,130 @@ FIXTURES = [
         None,
         "def f(warm):\n    return warm.tableau\n",
     ),
+    (
+        # TPL101 (ISSUE 14): inconsistent two-lock order in one class
+        # is a deadlock-shaped cycle; a consistent global order is not.
+        "TPL101", "tpusched/foo.py",
+        "import threading\n\n\nclass A:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()\n"
+        "        self._b_lock = threading.Lock()\n\n"
+        "    def one(self):\n"
+        "        with self._a_lock:\n"
+        "            with self._b_lock:\n"
+        "                return 1\n\n"
+        "    def two(self):\n"
+        "        with self._b_lock:\n"
+        "            with self._a_lock:\n"
+        "                return 2\n",
+        "import threading\n\n\nclass A:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()\n"
+        "        self._b_lock = threading.Lock()\n\n"
+        "    def one(self):\n"
+        "        with self._a_lock:\n"
+        "            with self._b_lock:\n"
+        "                return 1\n\n"
+        "    def two(self):\n"
+        "        with self._a_lock:\n"
+        "            with self._b_lock:\n"
+        "                return 2\n",
+    ),
+    (
+        # TPL101 degenerate form: provably same-instance re-acquisition
+        # of a non-reentrant Lock through a self-call chain.
+        "TPL101", "tpusched/foo.py",
+        "import threading\n\n\nclass A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            return self._helper()\n\n"
+        "    def _helper(self):\n"
+        "        with self._lock:\n"
+        "            return 1\n",
+        "import threading\n\n\nclass A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            return self._helper_locked()\n\n"
+        "    def _helper_locked(self):\n"
+        "        return 1\n",
+    ),
+    (
+        # TPL102 (ISSUE 14): a fetch join reached THROUGH a call made
+        # under the lock — invisible to the lexical TPL003.
+        "TPL102", "tpusched/foo.py",
+        "import threading\n\n\nclass A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def f(self, fut):\n"
+        "        with self._lock:\n"
+        "            return self._join(fut)\n\n"
+        "    def _join(self, fut):\n"
+        "        return fut.result()\n",
+        "import threading\n\n\nclass A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def f(self, fut):\n"
+        "        with self._lock:\n"
+        "            pending = fut\n"
+        "        return self._join(pending)\n\n"
+        "    def _join(self, fut):\n"
+        "        return fut.result()\n",
+    ),
+    (
+        # TPL103 (ISSUE 14): a per-call jax.jit rebuilds the compile
+        # cache every invocation; module-level construction is the fix.
+        "TPL103", "tpusched/foo.py",
+        "import jax\n\n\ndef f(x):\n"
+        "    fn = jax.jit(lambda v: v + 1)\n"
+        "    return fn(x)\n",
+        "import jax\n\n_FN = jax.jit(lambda v: v + 1)\n\n\n"
+        "def f(x):\n    return _FN(x)\n",
+    ),
+    (
+        # TPL104 (ISSUE 14): a memo-dict jit family keyed by a raw
+        # request value compiles per distinct key; a pow2/bucket helper
+        # on the key bounds the family.
+        "TPL104", "tpusched/foo.py",
+        "import jax\n\n\nclass E:\n"
+        "    def __init__(self):\n"
+        "        self._jits = {}\n\n"
+        "    def fn(self, k):\n"
+        "        f = self._jits.get(k)\n"
+        "        if f is None:\n"
+        "            f = self._jits[k] = jax.jit(lambda v: v)\n"
+        "        return f\n",
+        "import jax\n\n\ndef pow2_bucket(k):\n"
+        "    return 1 << (max(int(k), 1) - 1).bit_length()\n\n\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._jits = {}\n\n"
+        "    def fn(self, k):\n"
+        "        kb = pow2_bucket(k)\n"
+        "        f = self._jits.get(kb)\n"
+        "        if f is None:\n"
+        "            f = self._jits[kb] = jax.jit(lambda v: v)\n"
+        "        return f\n",
+    ),
+    (
+        # TPL105 (ISSUE 14): a jit-wrapped closure reading self state
+        # bakes the value in at trace time; bind to a local first.
+        "TPL105", "tpusched/foo.py",
+        "import jax\n\n\nclass E:\n"
+        "    def build(self):\n"
+        "        def _fn(v):\n"
+        "            return v * self.scale\n"
+        "        self._jit = jax.jit(_fn)\n",
+        "import jax\n\n\nclass E:\n"
+        "    def build(self):\n"
+        "        scale = self.scale\n\n"
+        "        def _fn(v):\n"
+        "            return v * scale\n"
+        "        self._jit = jax.jit(_fn)\n",
+    ),
 ]
 
 
@@ -302,7 +426,7 @@ def test_missing_baseline_is_empty(tmp_path):
 
 def test_rule_table_is_complete():
     ids = [cls.rule_id for cls in RULES]
-    assert len(ids) == len(set(ids)) == 11
+    assert len(ids) == len(set(ids)) == 16
     for cls in RULES:
         assert cls.incident, f"{cls.rule_id} must cite its incident"
         assert cls.title, f"{cls.rule_id} must carry a title"

@@ -119,3 +119,30 @@ def test_zipf_weights_is_the_shared_tenant_skew_definition():
     np.testing.assert_allclose(zipf_weights(3, -2.0), np.full(3, 1 / 3))
     with pytest.raises(ValueError):
         zipf_weights(0, 1.0)
+
+
+def test_solve_many_jit_cache_cap_evicts_oldest():
+    """ISSUE 14 (TPL104 fix coverage): the repr-keyed jit memo stays
+    bounded past the cap by evicting OLDEST-first — never a wholesale
+    clear, which would turn steady-state config diversity just past
+    the cap into a periodic full-recompile storm."""
+    from tpusched import tenants
+
+    saved = dict(tenants._JIT_CACHE)
+    tenants._JIT_CACHE.clear()
+    try:
+        cap = tenants._JIT_CACHE_CAP
+        from tpusched.config import QoSConfig
+
+        cfgs = [EngineConfig(mode="fast", qos=QoSConfig(qos_gain=100.0 + i))
+                for i in range(cap + 2)]
+        fns = [tenants.solve_many_jit(c) for c in cfgs]
+        assert len(tenants._JIT_CACHE) <= cap
+        # recent entries survive: same jit object on re-request
+        assert tenants.solve_many_jit(cfgs[-1]) is fns[-1]
+        assert tenants.solve_many_jit(cfgs[-cap + 1]) is fns[-cap + 1]
+        # the oldest were evicted: a FRESH jit object comes back
+        assert tenants.solve_many_jit(cfgs[0]) is not fns[0]
+    finally:
+        tenants._JIT_CACHE.clear()
+        tenants._JIT_CACHE.update(saved)

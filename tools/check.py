@@ -7,12 +7,20 @@ Chains, in order:
            the descriptor splice recipe)
   lint     tools/lint.py over tpusched/ tools/ bench.py tests/
            (the tpuschedlint invariant suite, empty baseline)
+  lockgraph  tools/lint.py --check-hierarchy: the checked-in
+           tools/lock_hierarchy.json matches a fresh regeneration
+           (line drift blinds the runtime lock-order witness) and the
+           static lock order is acyclic
+  jitlint  tools/lint.py --jit-report: every jax.jit/_traced_jit site
+           enumerated; fails on any unbounded jit family (compile-
+           cache treadmill — ROADMAP item 4's anomaly source)
   syntax   byte-compile every tracked .py (pyflakes when the image
            has it; stdlib compile() otherwise — this image must not
            grow dependencies)
   mypy     mypy --strict over the typed beachhead (mypy.ini scopes
-           it: config.py, qos.py, metrics.py); SKIPPED gracefully
-           when mypy is not installed
+           it: config.py, qos.py, metrics.py, ledger.py, trace.py,
+           tpusched/lint/); SKIPPED gracefully when mypy is not
+           installed
   warmaudit  fast `divergence --warm-audit 5` smoke at a tiny shape,
            BOTH modes sharing one engine: the PR 10 bitwise warm
            contract (warm == cold byte-identical) and the ISSUE 12
@@ -40,7 +48,8 @@ REPO_ROOT = Path(__file__).resolve().parents[1]
 LINT_PATHS = ("tpusched", "tools", "bench.py", "tests")
 SYNTAX_ROOTS = ("tpusched", "tools", "tests", "bench.py")
 MYPY_TARGETS = ("tpusched/config.py", "tpusched/qos.py",
-                "tpusched/metrics.py")
+                "tpusched/metrics.py", "tpusched/ledger.py",
+                "tpusched/trace.py", "tpusched/lint")
 
 
 def _run(cmd: "list[str]") -> "tuple[int, str]":
@@ -57,6 +66,16 @@ def stage_regen() -> "tuple[str, str]":
 
 def stage_lint() -> "tuple[str, str]":
     rc, out = _run([sys.executable, "tools/lint.py", *LINT_PATHS])
+    return ("ok" if rc == 0 else "FAIL"), out
+
+
+def stage_lockgraph() -> "tuple[str, str]":
+    rc, out = _run([sys.executable, "tools/lint.py", "--check-hierarchy"])
+    return ("ok" if rc == 0 else "FAIL"), out
+
+
+def stage_jitlint() -> "tuple[str, str]":
+    rc, out = _run([sys.executable, "tools/lint.py", "--jit-report"])
     return ("ok" if rc == 0 else "FAIL"), out
 
 
@@ -199,6 +218,8 @@ def stage_statusz() -> "tuple[str, str]":
 STAGES = (
     ("regen", stage_regen),
     ("lint", stage_lint),
+    ("lockgraph", stage_lockgraph),
+    ("jitlint", stage_jitlint),
     ("syntax", stage_syntax),
     ("mypy", stage_mypy),
     ("warmaudit", stage_warmaudit),
@@ -215,7 +236,7 @@ def main() -> int:
             status, detail = "FAIL", f"stage crashed: {e!r}"
         results.append((name, status, detail))
         marker = {"ok": "+", "skip": "~", "FAIL": "!"}[status]
-        print(f"[{marker}] {name:<7} {status}")
+        print(f"[{marker}] {name:<9} {status}")
         if status == "FAIL" and detail:
             print("\n".join(f"      {ln}" for ln in detail.splitlines()[:40]))
         elif detail and status != "ok":

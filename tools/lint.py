@@ -20,11 +20,19 @@ The baseline at HEAD is kept EMPTY; entries are grandfathered debt,
 not a second suppression mechanism.
 
   python tools/lint.py --list-rules     # rule table + incident lineage
+
+Whole-program surfaces (round 19, ISSUE 14):
+
+  python tools/lint.py --graph            # call graph + held-lock sets (JSON)
+  python tools/lint.py --write-hierarchy  # regenerate tools/lock_hierarchy.json
+  python tools/lint.py --check-hierarchy  # fail if the artifact is stale/cyclic
+  python tools/lint.py --jit-report       # every jit site, families + bounds
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
@@ -38,10 +46,76 @@ from tpusched.lint import (  # noqa: E402
     load_baseline,
     write_baseline,
 )
+from tpusched.lint import interproc  # noqa: E402
 from tpusched.lint.engine import apply_baseline  # noqa: E402
 
 DEFAULT_BASELINE = REPO_ROOT / "tools" / "lint_baseline.json"
+DEFAULT_HIERARCHY = REPO_ROOT / "tools" / "lock_hierarchy.json"
 DEFAULT_PATHS = ("tpusched", "tools", "bench.py", "tests")
+
+
+def _program() -> "interproc.Program":
+    return interproc.Program(interproc.scan_product_sources(REPO_ROOT))
+
+
+def cmd_graph() -> int:
+    print(json.dumps(_program().graph_doc(), indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_write_hierarchy() -> int:
+    prog = _program()
+    interproc.write_hierarchy(DEFAULT_HIERARCHY, prog)
+    doc = prog.hierarchy_doc()
+    print(f"lockgraph: wrote {len(doc['locks'])} locks / "
+          f"{len(doc['edges'])} edges to {DEFAULT_HIERARCHY}")
+    return 0
+
+
+def cmd_check_hierarchy() -> int:
+    """The lockgraph gate: the checked-in artifact must match a fresh
+    regeneration byte-for-byte (line numbers drift with edits — a stale
+    artifact blinds the runtime witness), and the order must be acyclic."""
+    prog = _program()
+    fresh = json.dumps(prog.hierarchy_doc(), indent=2, sort_keys=True) + "\n"
+    ok = True
+    if not DEFAULT_HIERARCHY.exists():
+        print("lockgraph: tools/lock_hierarchy.json missing — run "
+              "`python tools/lint.py --write-hierarchy`", file=sys.stderr)
+        ok = False
+    elif DEFAULT_HIERARCHY.read_text() != fresh:
+        print("lockgraph: tools/lock_hierarchy.json is STALE — run "
+              "`python tools/lint.py --write-hierarchy` and commit it",
+              file=sys.stderr)
+        ok = False
+    cycles = prog.lock_cycles()
+    if cycles:
+        for c in cycles:
+            print(f"lockgraph: CYCLE {' <-> '.join(c)}", file=sys.stderr)
+        ok = False
+    doc = prog.hierarchy_doc()
+    print(f"lockgraph: {len(doc['locks'])} locks, {len(doc['edges'])} "
+          f"edges, {len(cycles)} cycles"
+          + ("" if not ok else " — in sync"))
+    return 0 if ok else 1
+
+
+def cmd_jit_report() -> int:
+    """The jitlint gate: enumerate every jax.jit/_traced_jit site with
+    its caching classification; unbounded families fail (they are also
+    TPL104 findings, but this surface reports the WHOLE inventory)."""
+    prog = _program()
+    for s in prog.jit_sites:
+        fam = f" family={s.family}" if s.family else ""
+        bound = ""
+        if s.kind == "family":
+            bound = (f" bounded={s.bounded}"
+                     + (f" ({s.bound_via})" if s.bound_via else ""))
+        print(f"{s.path}:{s.line}: {s.kind}{fam}{bound}")
+    bad = prog.unbounded_families()
+    print(f"jitlint: {len(prog.jit_sites)} jit sites, "
+          f"{len(bad)} unbounded families")
+    return 1 if bad else 0
 
 
 def main(argv=None) -> int:
@@ -56,8 +130,24 @@ def main(argv=None) -> int:
                     help="ignore the baseline (show every finding)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
+    ap.add_argument("--graph", action="store_true",
+                    help="dump the call graph + held-lock sets as JSON")
+    ap.add_argument("--write-hierarchy", action="store_true",
+                    help="regenerate tools/lock_hierarchy.json")
+    ap.add_argument("--check-hierarchy", action="store_true",
+                    help="fail when the hierarchy artifact is stale or cyclic")
+    ap.add_argument("--jit-report", action="store_true",
+                    help="enumerate jit sites; fail on unbounded families")
     args = ap.parse_args(argv)
 
+    if args.graph:
+        return cmd_graph()
+    if args.write_hierarchy:
+        return cmd_write_hierarchy()
+    if args.check_hierarchy:
+        return cmd_check_hierarchy()
+    if args.jit_report:
+        return cmd_jit_report()
     if args.list_rules:
         for cls in RULES:
             print(f"{cls.rule_id}  {cls.title}")

@@ -420,7 +420,7 @@ class Engine:
         class and cycle emitters diff its counters around a cycle.
         Steady state costs one set-membership check per dispatch (a
         disabled watcher: one attribute read)."""
-        jf = jax.jit(fn)
+        jf = jax.jit(fn)  # tpl: disable=TPL103(the _traced_jit factory IS the cache: every call site stores the wrapper in an attr or bounded memo family, which TPL103/TPL104 enforce at those sites)
         nonce = self._jit_nonce
 
         def dispatch(*args):
@@ -618,6 +618,17 @@ class Engine:
             fn = self._warm_inc_jits[cap] = self._traced_jit(
                 f"warm_incremental_cap{cap}", _inc)
         return fn
+
+    @staticmethod
+    def _k_bucket(k: int, n: int) -> int:
+        """Pow2 compile bucket for a top-k request (TPL104, ISSUE 14):
+        the top-k jit families are keyed by THIS (O(log N) programs,
+        not one per distinct k) and callers slice the first k columns
+        — lax.top_k sorts descending, so top-kb's k-prefix IS top-k,
+        bitwise. Clamped to n: a bucket past the node axis would pad
+        the program for columns that cannot exist."""
+        kb = 1 << (max(int(k), 1) - 1).bit_length()
+        return min(kb, int(n))
 
     @staticmethod
     def _frontier_bucket(est: int, P: int) -> int:
@@ -848,9 +859,13 @@ class Engine:
                 "solve_explained", _packed_explained)
         N = snap.nodes.valid.shape[0]
         kk = int(min(max(int(k), 1), max(N, 1)))
-        probe_fn = self._explain_probe_jits.get(kk)
+        # Compile bucket (TPL104): probe programs are keyed by the pow2
+        # bucket of k and unpack slices back — same prefix-stability
+        # argument as score_topk_async (lax.top_k sorts descending).
+        kb = self._k_bucket(kk, max(N, 1))
+        probe_fn = self._explain_probe_jits.get(kb)
         if probe_fn is None:
-            def _probe(s: ClusterSnapshot, _k=kk):
+            def _probe(s: ClusterSnapshot, _k=kb):
                 node_sat_t, member_sat_t = _sat_tables(s)
                 ic = None
                 if cfg.ring_counts and s.sigs.key.shape[0]:
@@ -863,8 +878,8 @@ class Engine:
                     cfg, s, node_sat_t, member_sat_t, _k, init_counts=ic
                 )
 
-            probe_fn = self._explain_probe_jits[kk] = self._traced_jit(
-                f"explain_probe_k{kk}", _probe)
+            probe_fn = self._explain_probe_jits[kb] = self._traced_jit(
+                f"explain_probe_k{kb}", _probe)
 
         t0 = time.perf_counter()
         solve_buf = self._explain_solve_jit(snap)   # async dispatch
@@ -876,7 +891,14 @@ class Engine:
             return res, exd
 
         def unpack_probe(raw, _seconds):
-            return kexplain.unpack_probe(snap, raw, kk)
+            se = kexplain.unpack_probe(snap, raw, kb)
+            if kb == kk:
+                return se
+            return dataclasses.replace(
+                se, k=kk, topk_idx=se.topk_idx[:, :kk],
+                topk_score=se.topk_score[:, :kk],
+                topk_terms=se.topk_terms[:, :kk, :],
+            )
 
         return (
             PendingFetch(unpack_solve, self._submit_fetch(solve_buf), t0),
@@ -930,33 +952,37 @@ class Engine:
         ScoreBatch handler build its response name tables while the
         device ranks."""
         k = int(k)
-        if not 1 <= k <= snap.nodes.valid.shape[0]:
+        N = snap.nodes.valid.shape[0]
+        if not 1 <= k <= N:
             raise ValueError(
-                f"top_k={k} out of range for {snap.nodes.valid.shape[0]} "
-                "node slots"
+                f"top_k={k} out of range for {N} node slots"
             )
-        fn = self._topk_jits.get(k)
+        # Compile bucket (TPL104): the family is keyed by the pow2
+        # bucket, the device ranks kb columns, and unpack slices the
+        # first k — identical to a direct top-k (descending sort).
+        kb = self._k_bucket(k, N)
+        fn = self._topk_jits.get(kb)
         if fn is None:
             score = self._score_fn
 
-            def _topk(s: ClusterSnapshot):
+            def _topk(s: ClusterSnapshot, _kb=kb):
                 feasible, scores = score(s)
                 masked = jnp.where(feasible, scores, -jnp.inf)
-                v, i = jax.lax.top_k(masked, k)
+                v, i = jax.lax.top_k(masked, _kb)
                 ok = jnp.isfinite(v)
                 return jnp.concatenate([
                     jnp.where(ok, i, -1).astype(jnp.float32).ravel(),
                     jnp.where(ok, v, 0.0).ravel(),
                 ])
 
-            fn = self._topk_jits[k] = self._traced_jit(
-                f"score_topk_k{k}", _topk)
+            fn = self._topk_jits[kb] = self._traced_jit(
+                f"score_topk_k{kb}", _topk)
         P = snap.pods.valid.shape[0]
 
         def unpack(buf, seconds):
-            half = P * k
-            idx = buf[:half].astype(np.int32).reshape(P, k)
-            val = buf[half:].reshape(P, k).astype(np.float32)
+            half = P * kb
+            idx = buf[:half].astype(np.int32).reshape(P, kb)[:, :k]
+            val = buf[half:].reshape(P, kb).astype(np.float32)[:, :k]
             return idx, val, seconds
 
         t0 = time.perf_counter()

@@ -95,7 +95,7 @@ class CycleRecord:
     rounds: int = 0            # commit rounds
     warm_path: str = "cold"    # cold | warm | incremental
     solve_s: float = 0.0       # the quantity the sentinel judges
-    stages: dict = dataclasses.field(default_factory=dict)
+    stages: "dict[str, float]" = dataclasses.field(default_factory=dict)
     compiles: int = 0          # XLA cache misses paid inside the cycle
     compile_s: float = 0.0     # their compile wall time
     cycle: int = 0
@@ -103,7 +103,7 @@ class CycleRecord:
 
 
 # Field name -> accepted types; THE schema authority (docstring).
-SCHEMA: "dict[str, tuple]" = {
+SCHEMA: "dict[str, tuple[type, ...]]" = {
     "cycle": (int,),
     "ts": (int, float),
     "source": (str,),
@@ -124,13 +124,13 @@ SCHEMA: "dict[str, tuple]" = {
 }
 
 
-def record_dict(rec: CycleRecord) -> dict:
+def record_dict(rec: CycleRecord) -> "dict[str, Any]":
     """Plain dict in SCHEMA key order (JSONL lines, Statusz payloads)."""
     d = dataclasses.asdict(rec)
     return {k: d[k] for k in SCHEMA}
 
 
-def validate_record(d: "dict[str, Any]") -> dict:
+def validate_record(d: "dict[str, Any]") -> "dict[str, Any]":
     """Schema check for one record dict (the sim-vs-live twin contract
     and the check.py statusz smoke). Raises ValueError on any drift:
     missing/extra keys, wrong field types, non-numeric stage values."""
@@ -175,18 +175,18 @@ class CompileWatcher:
 
     def __init__(self, capacity: int = 256, seen_cap: int = 4096):
         self._lock = threading.Lock()
-        self._seen: dict = {}      # insertion-ordered key set
+        self._seen: "dict[Any, None]" = {}  # insertion-ordered key set
         self._seen_cap = int(seen_cap)
-        self._events: deque = deque(maxlen=int(capacity))
+        self._events: "deque[dict[str, Any]]" = deque(maxlen=int(capacity))
         self.total = 0
         self.compile_s_total = 0.0
         self.enabled = True
 
-    def known(self, key) -> bool:
+    def known(self, key: Any) -> bool:
         with self._lock:
             return key in self._seen
 
-    def note(self, key, fn: str, shape: str, dur_s: float) -> bool:
+    def note(self, key: Any, fn: str, shape: str, dur_s: float) -> bool:
         """Record one first-dispatch (compile) event; False when a
         racing first caller already recorded this key."""
         ev = dict(ts=time.time(), fn=fn, shape=shape,
@@ -208,7 +208,7 @@ class CompileWatcher:
         with self._lock:
             return self.total, self.compile_s_total
 
-    def timeline(self) -> "list[dict]":
+    def timeline(self) -> "list[dict[str, Any]]":
         with self._lock:
             return list(self._events)
 
@@ -235,7 +235,7 @@ class CycleLedger:
                  watcher: "CompileWatcher | None" = None,
                  enabled: bool = True):
         self._lock = threading.Lock()
-        self._ring: deque = deque(maxlen=int(capacity))
+        self._ring: "deque[CycleRecord]" = deque(maxlen=int(capacity))
         self._mint = itertools.count(1)
         self.enabled = enabled
         self.min_cycles = int(min_cycles)
@@ -375,11 +375,11 @@ class CycleLedger:
             out = out[len(out) - min(last, len(out)):]
         return out
 
-    def _hist_export(self, hist: pm.Histogram, *labels) -> dict:
+    def _hist_export(self, hist: pm.Histogram, *labels: Any) -> "dict[str, Any]":
         counts = hist.series_counts(*labels)
         return dict(le=list(hist.buckets), counts=counts)
 
-    def statusz(self, last: int = 32) -> dict:
+    def statusz(self, last: int = 32) -> "dict[str, Any]":
         """The Statusz payload: rolling p50/p99 per stage, warm-path
         mix, churn/round aggregates, the compile timeline, anomaly
         counts, the last-N records, and the RAW bucket counts
@@ -397,7 +397,7 @@ class CycleLedger:
                 anomalies[r.anomaly] = anomalies.get(r.anomaly, 0) + 1
         with self._lock:
             stage_names = sorted(self._stage_names)
-        stages = {}
+        stages: "dict[str, Any]" = {}
         for stage in stage_names:
             stages[stage] = dict(
                 p50_ms=_ms(self._h_stage.quantile(0.50, stage)),

@@ -14,6 +14,22 @@ tools/README.md "Static analysis"):
     TPL008  sorted() on round/seq-shaped keys without a numeric key
     TPL009  trace.DEFAULT/explain.DEFAULT outside the fallback idiom
     TPL010  closeable class never closed in a test function
+    TPL011  carried warm-tableau read outside the engine warm path
+
+Whole-program analyses (round 19, ISSUE 14; call graph + per-function
+summaries in tpusched/lint/interproc.py, runtime cross-check in
+tpusched/lint/witness.py):
+
+    TPL101  lock-order cycle (potential deadlock)
+    TPL102  transitive known-cost call under a lock (TPL003, deep)
+    TPL103  per-call jax.jit construction (retrace hazard)
+    TPL104  unbounded jit family (no bounding bucket on the memo key)
+    TPL105  jit-wrapped closure reads mutable self state
+
+The static lock order is checked in as tools/lock_hierarchy.json
+(regenerate: ``python tools/lint.py --write-hierarchy``; staleness is a
+``tools/check.py`` lockgraph failure) and validated at runtime by the
+lock-order witness tier-1 installs via tests/conftest.py.
 
 Run via ``python tools/lint.py tpusched tools bench.py tests`` (the
 tier-1 gate, tests/test_lint.py::test_tree_is_clean) or through

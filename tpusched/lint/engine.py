@@ -26,7 +26,11 @@ import json
 import re
 import tokenize
 from pathlib import Path, PurePosixPath
-from typing import Iterable, Sequence
+from typing import TYPE_CHECKING, Any, Iterable, Sequence
+
+if TYPE_CHECKING:
+    from tpusched.lint.interproc import Program
+    from tpusched.lint.rules import Rule
 
 #: Engine-level pseudo-rule for malformed suppression comments.
 BAD_SUPPRESSION = "TPL000"
@@ -45,7 +49,7 @@ class Finding:
     def render(self) -> str:
         return f"{self.path}:{self.line}: {self.rule} {self.message}"
 
-    def key(self) -> tuple:
+    def key(self) -> "tuple[str, int, str]":
         """Baseline identity (message excluded: wording may evolve
         without re-grandfathering a finding)."""
         return (self.path, self.line, self.rule)
@@ -64,12 +68,19 @@ class LintContext:
         self,
         root: "Path | None" = None,
         closeable_classes: "set[str] | None" = None,
-        benchdiff=None,
-    ):
+        benchdiff: Any = None,
+        program_sources: "dict[str, str] | None" = None,
+    ) -> None:
         self.root = Path(root) if root is not None else _default_root()
         self._closeable = closeable_classes
         self._benchdiff = benchdiff
         self._benchdiff_loaded = benchdiff is not None
+        # Whole-program index (round 19, ISSUE 14): `program_sources`
+        # injects an explicit {relpath: src} universe for multi-file
+        # rule tests; None scans the real product tree lazily.
+        self._program_sources = program_sources
+        self._base_sources: "dict[str, str] | None" = None
+        self._program: "Program | None" = None
 
     @property
     def closeable_classes(self) -> "set[str]":
@@ -78,8 +89,34 @@ class LintContext:
             self._closeable = scan_closeable_classes(self.root / "tpusched")
         return self._closeable
 
+    def program_view(self, relpath: str, src: str) -> "Program":
+        """The interprocedural Program the TPL1xx rules run against
+        when linting (relpath, src).
+
+        Real-tree runs (the file on disk matches `src`) share ONE
+        cached whole-program index, so the gate builds the call graph
+        once. A fixture snippet (no such file, or content differs)
+        gets an ISOLATED program over the injected `program_sources`
+        plus the snippet — per-rule fixture twins stay hermetic instead
+        of resolving against the live tree."""
+        from tpusched.lint import interproc  # tpl: disable=TPL001(lazy: keeps engine.py importable standalone without the analysis layer — rules.py does load interproc at module top for the shared COSTLY sets, but engine alone must not)
+
+        if self._program_sources is not None:
+            base = self._program_sources
+        else:
+            if self._base_sources is None:
+                self._base_sources = interproc.scan_product_sources(self.root)
+            base = self._base_sources
+        if base.get(relpath) == src:
+            if self._program is None:
+                self._program = interproc.Program(base)
+            return self._program
+        srcs = dict(self._program_sources or {})
+        srcs[relpath] = src
+        return interproc.Program(srcs)
+
     @property
-    def benchdiff(self):
+    def benchdiff(self) -> Any:
         """tools/benchdiff.py as a module (direction-inference source
         of truth for TPL006), or None when the repo doesn't carry it."""
         if not self._benchdiff_loaded:
@@ -93,7 +130,7 @@ def _default_root() -> Path:
     return Path(__file__).resolve().parents[2]
 
 
-def _load_benchdiff(root: Path):
+def _load_benchdiff(root: Path) -> Any:
     path = root / "tools" / "benchdiff.py"
     if not path.exists():
         return None
@@ -176,7 +213,7 @@ def parse_suppressions(src: str) -> "tuple[dict[int, set[str]], list[tuple[int, 
     return by_line, errors
 
 
-def load_baseline(path: Path) -> "set[tuple]":
+def load_baseline(path: "Path | str") -> "set[tuple[str, int, str]]":
     """Baseline file: JSON list of {path, line, rule}. Missing file ==
     empty baseline."""
     if not Path(path).exists():
@@ -184,13 +221,14 @@ def load_baseline(path: Path) -> "set[tuple]":
     doc = json.loads(Path(path).read_text())
     if not isinstance(doc, list):
         raise ValueError(f"{path}: baseline must be a JSON list")
-    out = set()
+    out: "set[tuple[str, int, str]]" = set()
     for rec in doc:
         out.add((str(rec["path"]), int(rec["line"]), str(rec["rule"])))
     return out
 
 
-def write_baseline(path: Path, findings: "Sequence[Finding]") -> None:
+def write_baseline(path: "Path | str",
+                   findings: "Sequence[Finding]") -> None:
     recs = [
         {"path": f.path, "line": f.line, "rule": f.rule}
         for f in sorted(findings)
@@ -207,7 +245,8 @@ def build_parent_map(tree: ast.AST) -> "dict[ast.AST, ast.AST]":
 
 
 class LintEngine:
-    def __init__(self, rules=None, ctx: "LintContext | None" = None):
+    def __init__(self, rules: "Iterable[Rule] | None" = None,
+                 ctx: "LintContext | None" = None) -> None:
         if rules is None:
             from tpusched.lint.rules import default_rules  # tpl: disable=TPL001(rules imports Finding from engine; importing rules at module top would be a cycle)
 
@@ -243,7 +282,7 @@ class LintEngine:
 
     # -- filesystem entries ------------------------------------------
 
-    def lint_file(self, path: Path) -> "list[Finding]":
+    def lint_file(self, path: "Path | str") -> "list[Finding]":
         path = Path(path).resolve()
         try:
             rel = path.relative_to(self.ctx.root).as_posix()
@@ -257,7 +296,7 @@ class LintEngine:
             ) from None
         return self.lint_text(path.read_text(), rel)
 
-    def lint_paths(self, paths: "Iterable[Path]") -> "list[Finding]":
+    def lint_paths(self, paths: "Iterable[Path | str]") -> "list[Finding]":
         findings: list[Finding] = []
         for path in paths:
             path = Path(path)
@@ -270,6 +309,7 @@ class LintEngine:
 
 
 def apply_baseline(
-    findings: "Sequence[Finding]", baseline: "set[tuple]"
+    findings: "Sequence[Finding]",
+    baseline: "set[tuple[str, int, str]]",
 ) -> "list[Finding]":
     return [f for f in findings if f.key() not in baseline]

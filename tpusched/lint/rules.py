@@ -17,9 +17,13 @@ is safe — each rule selects its own territory.
 from __future__ import annotations
 
 import ast
-from typing import Iterator
+from typing import TYPE_CHECKING, Iterator
 
+from tpusched.lint import interproc
 from tpusched.lint.engine import Finding
+
+if TYPE_CHECKING:
+    from tpusched.lint.engine import LintContext
 
 __all__ = ["RULES", "default_rules", "Rule"]
 
@@ -110,7 +114,9 @@ class Rule:
     def applies(self, relpath: str) -> bool:
         return product_path(relpath)
 
-    def check(self, tree, src, relpath, ctx, parents) -> "list[Finding]":
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
         raise NotImplementedError
 
     def finding(self, relpath: str, node: ast.AST, message: str) -> Finding:
@@ -144,7 +150,9 @@ class FunctionLevelImport(Rule):
     def applies(self, relpath: str) -> bool:
         return relpath.startswith("tpusched/") and not is_test_path(relpath)
 
-    def check(self, tree, src, relpath, ctx, parents):
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
         findings = []
         for node in ast.walk(tree):
             if not isinstance(node, (ast.Import, ast.ImportFrom)):
@@ -163,7 +171,8 @@ class FunctionLevelImport(Rule):
         return findings
 
     @staticmethod
-    def _inside_function(node, parents) -> bool:
+    def _inside_function(node: ast.AST,
+                         parents: "dict[ast.AST, ast.AST]") -> bool:
         p = parents.get(node)
         while p is not None:
             if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
@@ -173,7 +182,7 @@ class FunctionLevelImport(Rule):
         return False
 
     @staticmethod
-    def _top_modules(node) -> "set[str]":
+    def _top_modules(node: "ast.Import | ast.ImportFrom") -> "set[str]":
         if isinstance(node, ast.Import):
             return {a.name.split(".")[0] for a in node.names}
         if node.module is None or node.level:  # relative import
@@ -214,7 +223,9 @@ class UnseededRandomness(Rule):
     def applies(self, relpath: str) -> bool:
         return (relpath.startswith(self.SCOPES) or relpath in self.FILES)
 
-    def check(self, tree, src, relpath, ctx, parents):
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
         aliases = import_aliases(tree)
         findings = []
         for node in ast.walk(tree):
@@ -280,15 +291,14 @@ class WorkUnderLock(Rule):
                 "store nbytes under _store_lock, stalling Assign "
                 "registration behind every Metrics scrape")
 
-    COSTLY = frozenset({
-        "result", "block_until_ready", "device_put", "sleep",
-        "urlopen", "compose_bytes", "serve_forever", "exec_module",
-        "solve", "solve_async", "solve_explained", "score_topk",
-        "run_until_idle",
-    })
-    COSTLY_BARE = frozenset({"open", "sleep"})
+    # Shared authority with the whole-program analyses (ISSUE 14):
+    # TPL102 propagates the same cost model through the call graph.
+    COSTLY = interproc.COSTLY
+    COSTLY_BARE = interproc.COSTLY_BARE
 
-    def check(self, tree, src, relpath, ctx, parents):
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
         findings = []
         for node in ast.walk(tree):
             if not isinstance(node, (ast.With, ast.AsyncWith)):
@@ -305,7 +315,7 @@ class WorkUnderLock(Rule):
         return findings
 
     @staticmethod
-    def _lock_expr(node) -> "str | None":
+    def _lock_expr(node: "ast.With | ast.AsyncWith") -> "str | None":
         for item in node.items:
             for sub in ast.walk(item.context_expr):
                 t = terminal_name(sub)
@@ -313,7 +323,9 @@ class WorkUnderLock(Rule):
                     return dotted_name(item.context_expr) or t
         return None
 
-    def _costly_calls(self, body) -> "Iterator[tuple[ast.Call, str]]":
+    def _costly_calls(
+            self, body: "list[ast.stmt]",
+    ) -> "Iterator[tuple[ast.Call, str]]":
         stack = list(body)
         while stack:
             node = stack.pop()
@@ -349,7 +361,9 @@ class InlineUnitClamp(Rule):
     incident = ("PR 5 review: NaN slo-target annotations sailed "
                 "through naive min/max clamps in kube.py parse paths")
 
-    def check(self, tree, src, relpath, ctx, parents):
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
         findings = []
         for node in ast.walk(tree):
             if self._is_unit_clamp(node):
@@ -361,7 +375,7 @@ class InlineUnitClamp(Rule):
         return findings
 
     @classmethod
-    def _is_unit_clamp(cls, node) -> bool:
+    def _is_unit_clamp(cls, node: ast.AST) -> bool:
         outer = cls._minmax(node)
         if outer is None:
             return False
@@ -379,7 +393,7 @@ class InlineUnitClamp(Rule):
         return False
 
     @staticmethod
-    def _minmax(node) -> "tuple[str, list] | None":
+    def _minmax(node: ast.AST) -> "tuple[str, list[ast.expr]] | None":
         if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
                 and node.func.id in ("min", "max") and len(node.args) >= 2
                 and not node.keywords):
@@ -406,7 +420,9 @@ class UnnamedThread(Rule):
     incident = ("PR 2/PR 3 thread_leak_check matches by name; unnamed "
                 "bench/tool driver threads slipped every leak audit")
 
-    def check(self, tree, src, relpath, ctx, parents):
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
         aliases = import_aliases(tree)
         findings = []
         for node in ast.walk(tree):
@@ -468,7 +484,9 @@ class BenchMetricDirection(Rule):
     def applies(self, relpath: str) -> bool:
         return relpath.rsplit("/", 1)[-1] == "bench.py"
 
-    def check(self, tree, src, relpath, ctx, parents):
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
         bd = ctx.benchdiff
         if bd is None:  # no benchdiff in this tree: nothing to resolve against
             return []
@@ -514,7 +532,8 @@ class BenchMetricDirection(Rule):
         return findings
 
     @staticmethod
-    def _fields(node: ast.Dict):
+    def _fields(node: ast.Dict) -> (
+            "tuple[ast.expr | None, str | None, ast.expr | None] | None"):
         """(metric value node, static unit or None, direction value
         node or None) for dicts carrying a "metric" key; None for
         other dicts."""
@@ -535,7 +554,7 @@ class BenchMetricDirection(Rule):
         return name_node, unit, direction
 
     @staticmethod
-    def _static_name(node) -> "str | None":
+    def _static_name(node: "ast.AST | None") -> "str | None":
         """Literal or f-string metric name, formatted values rendered
         as '0' so shape suffixes still pattern-match."""
         if isinstance(node, ast.Constant) and isinstance(node.value, str):
@@ -569,7 +588,9 @@ class DictOrderSelection(Rule):
                 "next(reversed(_stores)) = most-recently-TOUCHED "
                 "store, not the newest registered one")
 
-    def check(self, tree, src, relpath, ctx, parents):
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
         findings = []
         for node in ast.walk(tree):
             if (isinstance(node, ast.Call)
@@ -606,7 +627,9 @@ class StringSortedRounds(Rule):
     TOKENS = frozenset({"round", "rounds", "seq", "seqs", "rid",
                         "rids", "cycle", "cycles"})
 
-    def check(self, tree, src, relpath, ctx, parents):
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
         findings = []
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):
@@ -662,7 +685,9 @@ class CollectorDefaultDiscipline(Rule):
                 and relpath not in self.OWNERS
                 and relpath not in self.ENTRY_POINTS)
 
-    def check(self, tree, src, relpath, ctx, parents):
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
         aliases = import_aliases(tree)
         collector_aliases = {
             local for local, full in aliases.items() if full in self.MODULES
@@ -702,7 +727,8 @@ class CollectorDefaultDiscipline(Rule):
         return findings
 
     @staticmethod
-    def _is_fallback(node, parents) -> bool:
+    def _is_fallback(node: ast.AST,
+                     parents: "dict[ast.AST, ast.AST]") -> bool:
         p = parents.get(node)
         if isinstance(p, ast.BoolOp) and isinstance(p.op, ast.Or):
             return node in p.values[1:]
@@ -734,7 +760,9 @@ class TestCloseDiscipline(Rule):
         return (relpath.startswith("tests/")
                 and relpath.rsplit("/", 1)[-1].startswith("test_"))
 
-    def check(self, tree, src, relpath, ctx, parents):
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
         closeable = ctx.closeable_classes
         if not closeable:
             return []
@@ -745,7 +773,9 @@ class TestCloseDiscipline(Rule):
                 findings.extend(self._check_fn(fn, relpath, closeable))
         return findings
 
-    def _check_fn(self, fn, relpath, closeable):
+    def _check_fn(self, fn: "ast.FunctionDef | ast.AsyncFunctionDef",
+                  relpath: str,
+                  closeable: "set[str]") -> "list[Finding]":
         candidates = []  # (varname, assign node, class name)
         for node in ast.walk(fn):
             if (isinstance(node, ast.Assign) and len(node.targets) == 1
@@ -766,7 +796,7 @@ class TestCloseDiscipline(Rule):
         return out
 
     @staticmethod
-    def _satisfied(fn, var: str) -> bool:
+    def _satisfied(fn: ast.AST, var: str) -> bool:
         for node in ast.walk(fn):
             # x.close / x.stop referenced anywhere (call, addfinalizer,
             # ExitStack.callback, ...).
@@ -827,7 +857,9 @@ class CarriedTableauDiscipline(Rule):
             return False
         return product_path(relpath) or is_test_path(relpath)
 
-    def check(self, tree, src, relpath, ctx, parents):
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
         findings = []
         for node in ast.walk(tree):
             if isinstance(node, ast.Attribute) and node.attr in self.ATTRS:
@@ -839,6 +871,236 @@ class CarriedTableauDiscipline(Rule):
                     "instead, or suppress with the staleness rationale",
                 ))
         return findings
+
+
+# ---------------------------------------------------------------------------
+# TPL1xx — whole-program analyses (round 19, ISSUE 14). These rules run
+# over the interprocedural Program index (tpusched/lint/interproc.py):
+# per-function summaries + a heuristic call graph with held-lock
+# propagation. Each rule reports only findings anchored in the CURRENT
+# file, so the engine's per-line suppression/baseline machinery applies
+# unchanged, and a cross-module hazard is reported once per involved
+# acquisition site.
+# ---------------------------------------------------------------------------
+
+class LockOrderCycle(Rule):
+    """A cycle in the static lock-order graph is a potential deadlock:
+    thread 1 holds A wanting B while thread 2 holds B wanting A — no
+    single file shows it, which is why it survives review. Edges come
+    from held-lock propagation (a lock acquired anywhere in a function
+    transitively callable from a `with`-lock body), so a two-module
+    cycle is caught even when neither file nests `with` statements.
+    A provably same-instance re-acquisition of a non-reentrant Lock
+    (all-self-call chain) is the degenerate one-lock cycle and flags
+    too. The checked-in tools/lock_hierarchy.json carries the full
+    order; the runtime witness (tpusched/lint/witness.py) cross-checks
+    it against observed acquisition orders under tier-1.
+    """
+
+    rule_id = "TPL101"
+    title = "lock-order cycle (potential deadlock)"
+    incident = ("ISSUE 14: ~33 locks across 15 modules; the "
+                "_role_lock->_store_lock and session.lock->engine "
+                "edges span files no single review pass reads together")
+
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
+        prog = ctx.program_view(relpath, src)
+        findings = []
+        for e in prog.cyclic_edges():
+            if e.src_path != relpath:
+                continue
+            if e.src == e.dst:
+                msg = (f"same-instance re-acquisition of non-reentrant "
+                       f"{e.src} (via {e.render_chain()}) — guaranteed "
+                       "deadlock; split a _locked variant out")
+            else:
+                cyc = next((c for c in prog.lock_cycles()
+                            if e.src in c and e.dst in c), ())
+                msg = (f"lock-order cycle: {e.src} -> {e.dst} "
+                       f"(via {e.render_chain()}); cycle members: "
+                       f"{', '.join(cyc)} — acquire in one global order")
+            findings.append(Finding(relpath, e.src_line, self.rule_id, msg))
+        return findings
+
+
+class TransitiveWorkUnderLock(Rule):
+    """TPL003 generalized from lexical to whole-program: a known-cost
+    call (fetch join, H2D, sleep, I/O, full solve) reached THROUGH a
+    function called under a lock serializes every contender exactly
+    like a lexical one — it is just invisible to a per-file pass. One
+    finding per (rooting call, cost kind), anchored at the call inside
+    the `with` body so the suppression (and its mandatory reason)
+    lands where the next reader looks.
+    """
+
+    rule_id = "TPL102"
+    title = "transitive known-cost call under a lock"
+    incident = ("ISSUE 14: session.lock delta applies reach device_put "
+                "through DeviceSnapshot.apply; PR 7's TPL003 scrape "
+                "incident, one call deeper")
+
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
+        prog = ctx.program_view(relpath, src)
+        findings = []
+        seen: "set[tuple[int, str]]" = set()
+        for fid in sorted(prog.functions):
+            fn = prog.functions[fid]
+            if fn.path != relpath:
+                continue
+            for region in fn.regions:
+                lexical = {name for name, _ in region.costly}
+                for tfid, (chain, _pure, line) in sorted(
+                        prog.region_reach(region).items()):
+                    tfn = prog.functions.get(tfid)
+                    if tfn is None or len(chain) < 1:
+                        continue
+                    for cname, _cline in tfn.costly:
+                        key = (line, cname)
+                        if key in seen or cname in lexical:
+                            continue
+                        seen.add(key)
+                        via = " -> ".join(
+                            c.split("::", 1)[-1] for c in chain)
+                        findings.append(Finding(
+                            relpath, line, self.rule_id,
+                            f"call under `with {region.acq.raw}:` "
+                            f"transitively reaches {cname}() via {via} "
+                            "— hoist the work out of the critical "
+                            "section (or suppress with the rationale "
+                            "for why the section must cover it)",
+                        ))
+        return findings
+
+
+class PerCallJitConstruction(Rule):
+    """`jax.jit(...)` constructed inside a per-call function and not
+    memoized (module constant, self-attribute, or a memo dict) builds a
+    FRESH jit object per invocation: jax's shape-keyed compile cache
+    hangs off the jit object, so every call retraces and recompiles —
+    the exact compile anomalies ledger.COMPILES attributes
+    (`scheduler_cycle_anomalies_total{cause="compile"}`, ROADMAP item
+    4). tpusched/ only: bench/profiler scripts construct jits per run
+    deliberately.
+    """
+
+    rule_id = "TPL103"
+    title = "per-call jax.jit construction (retrace hazard)"
+    incident = ("ROADMAP item 4 / PR 13 sentinel: p99 spikes traced to "
+                "retraces; ring_sig_counts_host recompiled per call")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("tpusched/") and not is_test_path(relpath)
+
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
+        prog = ctx.program_view(relpath, src)
+        return [
+            Finding(relpath, s.line, self.rule_id,
+                    "jax.jit constructed per call — memoize it (module "
+                    "constant, self-attribute, or a BOUNDED memo dict) "
+                    "so the shape-keyed compile cache survives the call")
+            for s in prog.jit_sites
+            if s.path == relpath and s.kind == "per_call"
+        ]
+
+
+class UnboundedJitFamily(Rule):
+    """A memo-dict jit family (`self._topk_jits[k] = jit(...)`) keyed
+    by an unbounded value compiles one XLA program PER DISTINCT KEY —
+    an adversarial (or merely diverse) request stream turns the cache
+    into a compile treadmill and an executable-memory leak. The key
+    must provably flow through a bounding helper (pow2/bucket/cap/
+    clamp — directly, or one call-hop up like `_warm_inc_fn(cap)`'s
+    callers passing `_frontier_bucket(...)`), or the memo must carry an
+    explicit size-cap guard (`len(cache) >= N` eviction).
+    """
+
+    rule_id = "TPL104"
+    title = "unbounded jit family (no bounding bucket on the memo key)"
+    incident = ("ISSUE 14 / ROADMAP item 4: _warm_inc_jits' pow2 caps "
+                "are the pattern; _topk_jits keyed by raw k was the "
+                "counterexample")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("tpusched/") and not is_test_path(relpath)
+
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
+        prog = ctx.program_view(relpath, src)
+        return [
+            Finding(relpath, s.line, self.rule_id,
+                    f"jit family {s.family} keyed by an unbounded value "
+                    "— route the key through a pow2/bucket/cap helper "
+                    "or add a size-cap eviction to the memo")
+            for s in prog.jit_sites
+            if s.path == relpath and s.kind == "family"
+            and s.bounded is False
+        ]
+
+
+class JitClosureOverMutableState(Rule):
+    """A function handed to jax.jit that reads `self.<attr>` bakes the
+    attribute's VALUE in at trace time: later mutation of the engine
+    state is silently ignored (stale compile) or, worse, flips the
+    traced branch and retraces per call. The repo's discipline is to
+    hoist instance state into locals at jit-construction time
+    (`cfg = self.config`) so the closure is immutable by construction
+    — this rule pins that discipline.
+    """
+
+    rule_id = "TPL105"
+    title = "jit-wrapped closure reads mutable self state"
+    incident = ("ISSUE 14: Engine's local-binding discipline (cfg/mesh "
+                "hoisted before the jit'd defs) encoded as a rule")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("tpusched/") and not is_test_path(relpath)
+
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: "LintContext",
+              parents: "dict[ast.AST, ast.AST]") -> "list[Finding]":
+        aliases = import_aliases(tree)
+        local_defs: "dict[str, list[ast.AST]]" = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs.setdefault(node.name, []).append(node)
+        findings = []
+        for call, arg_idx in interproc.iter_jit_calls(tree, aliases):
+            if len(call.args) <= arg_idx:
+                continue
+            fn_arg = call.args[arg_idx]
+            bodies: "list[ast.AST]" = []
+            if isinstance(fn_arg, ast.Lambda):
+                bodies = [fn_arg]
+            elif isinstance(fn_arg, ast.Name):
+                bodies = local_defs.get(fn_arg.id, [])
+            for body in bodies:
+                hit = self._self_read(body)
+                if hit is not None:
+                    findings.append(self.finding(
+                        relpath, call,
+                        f"jit-wrapped {getattr(fn_arg, 'id', 'lambda')} "
+                        f"reads self.{hit} — bind it to a local before "
+                        "constructing the jit (trace-time snapshot, "
+                        "documented)",
+                    ))
+                    break
+        return findings
+
+    @staticmethod
+    def _self_read(fn: ast.AST) -> "str | None":
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                return node.attr
+        return None
 
 
 RULES = (
@@ -853,6 +1115,11 @@ RULES = (
     CollectorDefaultDiscipline,
     TestCloseDiscipline,
     CarriedTableauDiscipline,
+    LockOrderCycle,
+    TransitiveWorkUnderLock,
+    PerCallJitConstruction,
+    UnboundedJitFamily,
+    JitClosureOverMutableState,
 )
 
 

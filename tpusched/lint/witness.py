@@ -1,0 +1,249 @@
+"""Runtime lock-order witness (round 19, ISSUE 14 tentpole, part 3).
+
+The static lock hierarchy (tools/lock_hierarchy.json, produced by
+tpusched/lint/interproc.py) is a MODEL: call-graph resolution is
+heuristic, so the model must be validated against reality rather than
+trusted. This module records the acquisition orders the process
+ACTUALLY exhibits and cross-checks them:
+
+  * a `violation` is an observed order (A held while B acquired) whose
+    INVERSE is reachable in the static order graph — the two disagree
+    about which lock comes first, which is exactly the state a
+    deadlock needs (tier-1 asserts zero via tests/conftest.py);
+  * an `unmodeled` edge is an observed order the static graph has no
+    opinion on — reported (it names a call path the analysis failed to
+    resolve) but not fatal: overapproximation gaps and third-party
+    callback paths land here.
+
+Design constraints, in the trace.py lineage (disabled by default, safe
+to ship in every path):
+
+  * installation REPLACES threading.Lock with a factory; locks whose
+    creation site (filename:lineno) matches a hierarchy LockDecl get a
+    recording wrapper, EVERYTHING else — stdlib, grpc, jax, test
+    helpers — gets a raw `_thread.allocate_lock()` exactly as before.
+    Zero overhead for foreign locks; one frame peek per construction.
+  * a wrapped acquire is: inner acquire, thread-local list append, and
+    (only while another witnessed lock is held) a set-membership probe
+    per held lock with a tiny critical section on first sight of a new
+    edge. Release is a reverse scan of the (nearly always 1-element)
+    held list. Measured at noise level next to the dispatch costs the
+    serving paths pay (bench note in tools/README.md).
+  * Condition/RLock creation is NOT wrapped: the repo's only Condition
+    (`_DispatchGate._cv`) stays static-only — the witness never has to
+    emulate the `_release_save`/`_is_owned` protocol.
+  * no threads, no ambient entropy; uninstall() restores threading.Lock
+    and keeps the observations for the report.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from pathlib import Path
+from typing import Any, Optional
+
+__all__ = ["LockWitness", "install", "uninstall", "active"]
+
+_REAL_LOCK = threading.Lock  # the builtin factory, captured at import
+
+
+class _WitnessLock:
+    """A recording wrapper around one hierarchy lock. Supports the
+    subset of the lock protocol the repo uses (`with`, acquire/release,
+    locked); deliberately NOT the Condition integration protocol."""
+
+    __slots__ = ("_inner", "name", "_witness")
+
+    def __init__(self, witness: "LockWitness", name: str):
+        self._inner = _REAL_LOCK()
+        self._witness = witness
+        self.name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._witness._note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._witness._note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: object) -> bool:
+        self.release()
+        return False
+
+
+class LockWitness:
+    """Observed-acquisition-order recorder (module docstring)."""
+
+    def __init__(self, hierarchy: "dict[str, Any] | None",
+                 root: "Path | None" = None):
+        self._tls = threading.local()
+        self._edges_mu = _REAL_LOCK()
+        #: (src lock_id, dst lock_id) -> first-seen count marker
+        self.observed: "dict[tuple[str, str], int]" = {}
+        self._seen: "set[tuple[str, str]]" = set()
+        #: (abs filename suffix, lineno) -> lock_id, for plain Locks only
+        self._by_site: dict[tuple[str, int], str] = {}
+        #: static forward reachability: lock_id -> set of lock_ids that
+        #: may be acquired while it is held (transitive closure)
+        self._after: "dict[str, set[str]]" = {}
+        self.installed = False
+        self.root = str(root) if root is not None else None
+        if hierarchy:
+            self._load(hierarchy)
+
+    def _load(self, doc: "dict[str, Any]") -> None:
+        for lk in doc.get("locks", ()):
+            if lk.get("kind") == "Lock":
+                self._by_site[(lk["path"], int(lk["line"]))] = lk["lock_id"]
+        adj: "dict[str, set[str]]" = {}
+        for e in doc.get("edges", ()):
+            adj.setdefault(e["src"], set()).add(e["dst"])
+        # Forward transitive closure (the graph is tiny: tens of locks).
+        for src in adj:
+            seen: "set[str]" = set()
+            stack = list(adj[src])
+            while stack:
+                n = stack.pop()
+                if n in seen:
+                    continue
+                seen.add(n)
+                stack.extend(adj.get(n, ()))
+            self._after[src] = seen
+
+    # -- construction-time site lookup -----------------------------------
+
+    def name_for(self, filename: str, lineno: int) -> Optional[str]:
+        """lock_id for a creation site, matching on repo-relative path
+        suffix (the hierarchy stores POSIX relpaths; the frame gives an
+        absolute path)."""
+        fn = filename.replace("\\", "/")
+        for (rel, line), lock_id in self._by_site.items():
+            if line == lineno and fn.endswith("/" + rel):
+                return lock_id
+        return None
+
+    # -- recording -------------------------------------------------------
+
+    def _held(self) -> "list[_WitnessLock]":
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _note_acquire(self, lock: _WitnessLock) -> None:
+        held = self._held()
+        if held:
+            seen = self._seen
+            for h in held:
+                key = (h.name, lock.name)
+                if key not in seen:
+                    with self._edges_mu:
+                        if key not in self._seen:
+                            self._seen.add(key)
+                            self.observed[key] = len(self.observed)
+        held.append(lock)
+
+    def _note_release(self, lock: _WitnessLock) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    # -- reporting -------------------------------------------------------
+
+    def report(self) -> "dict[str, Any]":
+        """{observed, violations, unmodeled}: violations are observed
+        orders whose inverse the static hierarchy derives (a deadlock-
+        shaped disagreement) AND pairs observed in BOTH orders at
+        runtime with the static graph endorsing NEITHER — the
+        strongest deadlock evidence there is, on exactly the edges the
+        heuristic call graph failed to model. A direction the static
+        hierarchy endorses is never flagged (when its inverse is also
+        observed, the INVERSE carries the violation — the diagnostic
+        must point at the wrong call site, not the right one).
+        unmodeled are one-direction orders the static graph does not
+        contain (self-edges between two INSTANCES of one static lock
+        are reported as unmodeled, not violations — same lock_id,
+        different runtime locks)."""
+        with self._edges_mu:
+            observed = sorted(self.observed, key=self.observed.get)
+        pairs = set(observed)
+        violations = []
+        unmodeled = []
+        for a, b in observed:
+            if a == b:
+                unmodeled.append((a, b))
+                continue
+            if a in self._after.get(b, ()):    # static says b before a
+                violations.append((a, b))
+            elif b in self._after.get(a, ()):  # static endorses a -> b
+                pass
+            elif (b, a) in pairs:   # unmodeled pair seen BOTH ways
+                violations.append((a, b))
+            else:
+                unmodeled.append((a, b))
+        return {
+            "observed": [list(e) for e in observed],
+            "violations": [list(e) for e in violations],
+            "unmodeled": [list(e) for e in unmodeled],
+        }
+
+
+_ACTIVE: "LockWitness | None" = None
+
+
+def active() -> "LockWitness | None":
+    return _ACTIVE
+
+
+def install(hierarchy_path: "Path | str | None" = None,
+            hierarchy: "dict[str, Any] | None" = None) -> LockWitness:
+    """Patch threading.Lock with the witness factory. Idempotent per
+    process (a second install returns the active witness). Locks
+    created BEFORE install stay raw and unobserved — install early
+    (tests/conftest.py does it at import, before product modules load)."""
+    global _ACTIVE
+    if _ACTIVE is not None and _ACTIVE.installed:
+        return _ACTIVE
+    if hierarchy is None and hierarchy_path is not None:
+        p = Path(hierarchy_path)
+        if p.exists():
+            hierarchy = json.loads(p.read_text())
+    witness = LockWitness(hierarchy)
+    if not witness._by_site:
+        # No hierarchy to key on: do not patch at all — an unkeyed
+        # witness would wrap nothing and observe nothing.
+        return witness
+
+    def _factory() -> Any:
+        frame = sys._getframe(1)
+        name = witness.name_for(frame.f_code.co_filename, frame.f_lineno)
+        if name is None:
+            return _REAL_LOCK()
+        return _WitnessLock(witness, name)
+
+    threading.Lock = _factory  # type: ignore[assignment]
+    witness.installed = True
+    _ACTIVE = witness
+    return witness
+
+
+def uninstall() -> None:
+    """Restore threading.Lock; the active witness keeps its
+    observations so a session-end report can still read them."""
+    global _ACTIVE
+    threading.Lock = _REAL_LOCK  # type: ignore[assignment]
+    if _ACTIVE is not None:
+        _ACTIVE.installed = False

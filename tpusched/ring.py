@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 try:  # jax >= 0.6: public top-level name, check_vma kwarg
@@ -179,11 +178,9 @@ def ring_sig_counts(
     return counts[:S]
 
 
-def ring_sig_counts_host(snap: ClusterSnapshot, member_sat_t, assigned,
-                         mesh: Mesh):
-    """Convenience wrapper: device_put with the ring layout and run."""
-    fn = jax.jit(
-        lambda s, m, a: ring_sig_counts(s, m, a, mesh),
-        static_argnums=(),
-    )
-    return np.asarray(fn(snap, member_sat_t, assigned))
+# ring_sig_counts_host, the old per-call-jit convenience wrapper, was
+# DELETED here (round 19, ISSUE 14): it had no callers anywhere in the
+# tree and re-jitted (so retraced) on every invocation — the exact
+# TPL103 hazard class. Callers wanting a host-side one-shot should go
+# through Engine (whose jit families are cached and bounded) or jit
+# `ring_sig_counts` themselves at module scope.

@@ -1031,7 +1031,7 @@ class SchedulerService:
                     session.device.tracer = self._trace
                 self.session_seeds += 1
             with session.lock:
-                session.apply_delta(base_id, delta, sid)
+                session.apply_delta(base_id, delta, sid)  # tpl: disable=TPL102(the apply IS the critical section: the lineage's device state must not advance past the base this op mirrors, and the H2D scatter is the apply itself)
             self._session_put(session)
         except Exception:
             logging.getLogger("tpusched.rpc.server").warning(
@@ -1052,7 +1052,7 @@ class SchedulerService:
             if self.role != "standby":
                 return
             try:
-                self._faults.fire("replica.takeover")
+                self._faults.fire("replica.takeover")  # tpl: disable=TPL102(a takeover delay shot must hold _role_lock: the simulated slow promotion has to block replication applies exactly like a real one would)
             except FaultError as e:
                 raise _Abort(
                     grpc.StatusCode.UNAVAILABLE,
@@ -1381,7 +1381,7 @@ class SchedulerService:
                         t_a = time.perf_counter()
                         with self._trace.span("delta.apply",
                                               cat="server") as sp:
-                            stats = session.apply_delta(
+                            stats = session.apply_delta(  # tpl: disable=TPL102(the apply IS the critical section: a concurrent apply moving the lineage past this request's base must fork, not interleave, and the H2D scatter is the apply itself)
                                 base_id, request.delta, sid)
                             sp.attrs.update(h2d_bytes=stats.h2d_bytes,
                                             path=stats.path)

@@ -81,6 +81,10 @@ def solve_many(cfg: EngineConfig, stacked: ClusterSnapshot):
 
 
 _JIT_CACHE: dict[str, object] = {}
+#: Distinct configs the memo holds before OLDEST-FIRST eviction kicks
+#: in (TPL104, ISSUE 14): repr-keyed means config churn would
+#: otherwise grow one compiled program per variant forever.
+_JIT_CACHE_CAP = 8
 
 
 def solve_many_jit(cfg: EngineConfig):
@@ -91,6 +95,17 @@ def solve_many_jit(cfg: EngineConfig):
     key = repr(cfg)
     fn = _JIT_CACHE.get(key)
     if fn is None:
+        while len(_JIT_CACHE) >= _JIT_CACHE_CAP:
+            # Evict oldest-first: a wholesale clear() would turn
+            # steady-state config diversity just past the cap into a
+            # periodic full-recompile storm. Race-tolerant: a
+            # concurrent miss may drain the dict between the len
+            # check and the pop (default-pop swallows the lost key;
+            # StopIteration/RuntimeError mean someone else evicted).
+            try:
+                _JIT_CACHE.pop(next(iter(_JIT_CACHE)), None)
+            except (StopIteration, RuntimeError):
+                break
         fn = jax.jit(lambda stacked: solve_many(cfg, stacked))
         _JIT_CACHE[key] = fn
     return fn

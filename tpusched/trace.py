@@ -49,6 +49,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
 
 # Process-wide span id mint: itertools.count.__next__ is atomic in
 # CPython, so span ids need no lock and stay unique across collectors.
@@ -65,10 +66,10 @@ class Span:
     t_wall: float       # epoch seconds at span start
     dur_s: float
     thread: str
-    attrs: dict = field(default_factory=dict)
+    attrs: "dict[str, Any]" = field(default_factory=dict)
 
 
-def span_dict(s: Span) -> dict:
+def span_dict(s: Span) -> "dict[str, Any]":
     return dict(
         trace_id=s.trace_id, span_id=s.span_id, parent_id=s.parent_id,
         name=s.name, cat=s.cat, t_wall=s.t_wall, dur_s=s.dur_s,
@@ -83,12 +84,12 @@ class _NoopSpan:
 
     __slots__ = ()
     span_id = 0
-    attrs: dict = {}  # writes land here and are discarded; shared is fine
+    attrs: "dict[str, Any]" = {}  # writes land here and are discarded; shared is fine
 
-    def __enter__(self):
+    def __enter__(self) -> "_NoopSpan":
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, *exc: object) -> bool:
         return False
 
 
@@ -104,7 +105,7 @@ class _LiveSpan:
 
     def __init__(self, col: "TraceCollector", name: str, cat: str,
                  trace_id: "str | None", parent_id: "int | None",
-                 attrs: dict):
+                 attrs: "dict[str, Any]"):
         self._col = col
         self.name = name
         self.cat = cat
@@ -113,7 +114,7 @@ class _LiveSpan:
         self.span_id = next(_SPAN_IDS)
         self.attrs = attrs
 
-    def __enter__(self):
+    def __enter__(self) -> "_LiveSpan":
         col = self._col
         stack = col._stack()
         if self.trace_id is None:
@@ -135,7 +136,7 @@ class _LiveSpan:
         self._t0 = time.perf_counter()
         return self
 
-    def __exit__(self, et, ev, tb):
+    def __exit__(self, et: Any, ev: Any, tb: Any) -> bool:
         dur = time.perf_counter() - self._t0
         stack = self._col._stack()
         if stack and stack[-1][1] == self.span_id:
@@ -157,7 +158,7 @@ class TraceCollector:
     def __init__(self, capacity: int = 4096, seed: "int | None" = None,
                  enabled: bool = True):
         self._lock = threading.Lock()
-        self._ring: deque = deque(maxlen=int(capacity))
+        self._ring: "deque[Span]" = deque(maxlen=int(capacity))
         self._tls = threading.local()
         self.enabled = enabled
         self._prefix = f"{random.Random(seed).getrandbits(32):08x}"
@@ -172,7 +173,7 @@ class TraceCollector:
 
     # -- recording -----------------------------------------------------------
 
-    def _stack(self) -> list:
+    def _stack(self) -> "list[tuple[str, int]]":
         st = getattr(self._tls, "stack", None)
         if st is None:
             st = self._tls.stack = []
@@ -184,7 +185,8 @@ class TraceCollector:
 
     def span(self, name: str, cat: str = "server",
              trace_id: "str | None" = None,
-             parent_id: "int | None" = None, **attrs):
+             parent_id: "int | None" = None,
+             **attrs: Any) -> "_LiveSpan | _NoopSpan":
         """Context manager timing a stage. trace_id=None inherits from
         the enclosing span on this thread (or records untraced)."""
         if not self.enabled:
@@ -192,14 +194,16 @@ class TraceCollector:
         return _LiveSpan(self, name, cat, trace_id, parent_id, attrs)
 
     def request(self, trace_id: str, parent_id: int = 0,
-                name: str = "request", cat: str = "server", **attrs):
+                name: str = "request", cat: str = "server",
+                **attrs: Any) -> "_LiveSpan | _NoopSpan":
         """Root span with explicit wire identity (server handlers)."""
         if not self.enabled:
             return _NOOP
         return _LiveSpan(self, name, cat, trace_id, int(parent_id), attrs)
 
     def record(self, name: str, dur_s: float = 0.0, cat: str = "event",
-               ctx: "tuple[str, int] | None" = None, **attrs) -> None:
+               ctx: "tuple[str, int] | None" = None,
+               **attrs: Any) -> None:
         """Retroactive span ending NOW with the given duration — for
         stages whose start wasn't wrapped (gate wait, cross-thread
         fetches). ctx: (trace_id, parent_span_id) captured earlier via
@@ -224,7 +228,7 @@ class TraceCollector:
 
     # -- reading -------------------------------------------------------------
 
-    def spans(self, trace_id: "str | None" = None) -> list:
+    def spans(self, trace_id: "str | None" = None) -> "list[Span]":
         """Snapshot of the ring, oldest first; optionally one trace."""
         with self._lock:
             out = list(self._ring)
@@ -243,14 +247,14 @@ class TraceCollector:
             out[s.name] = out.get(s.name, 0.0) + s.dur_s
         return out
 
-    def traces(self, last: int = 16) -> "dict[str, list]":
+    def traces(self, last: int = 16) -> "dict[str, list[Span]]":
         """The most recent `last` traces (trace_id -> spans, oldest
         span first within each), by recency of each trace's newest
         span. Untraced events ("") are excluded. last <= 0 returns
         nothing (a negative slice would invert the bound)."""
         if int(last) <= 0:
             return {}
-        groups: dict[str, list] = {}
+        groups: "dict[str, list[Span]]" = {}
         for s in self.spans():
             if s.trace_id:
                 # dict preserves insertion order; re-inserting on every
@@ -265,11 +269,12 @@ class TraceCollector:
             self._ring.clear()
 
 
-def to_chrome(spans, pid: int = 1) -> "list[dict]":
+def to_chrome(spans: "Iterable[Span | dict[str, Any]]",
+              pid: int = 1) -> "list[dict[str, Any]]":
     """Chrome/Perfetto trace-event list ("X" complete events, ts/dur in
     microseconds) from spans or span_dicts. Load via chrome://tracing
     or ui.perfetto.dev."""
-    events = []
+    events: "list[dict[str, Any]]" = []
     for s in spans:
         d = span_dict(s) if isinstance(s, Span) else s
         args = dict(d["attrs"])
@@ -293,18 +298,18 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = 8):
         self._lock = threading.Lock()
-        self._dumps: deque = deque(maxlen=int(capacity))
+        self._dumps: "deque[dict[str, Any]]" = deque(maxlen=int(capacity))
         self.trips = 0
         # Optional tpusched.explain.ExplainCollector (round 12): when
         # attached AND enabled, every dump also carries the last-N
         # decision records, so a watchdog trip / ladder demotion ships
         # the DECISIONS in flight alongside the causal trace.
-        self.decisions = None
+        self.decisions: Any = None
         self.decisions_last = 4
 
     def record(self, reason: str, collector: TraceCollector,
-               **extra) -> dict:
-        dump = dict(
+               **extra: Any) -> "dict[str, Any]":
+        dump: "dict[str, Any]" = dict(
             ts=time.time(), reason=reason, extra=extra,
             spans=[span_dict(s) for s in collector.spans()],
         )
@@ -321,7 +326,7 @@ class FlightRecorder:
             self.trips += 1
         return dump
 
-    def dumps(self) -> "list[dict]":
+    def dumps(self) -> "list[dict[str, Any]]":
         with self._lock:
             return list(self._dumps)
 
@@ -333,12 +338,12 @@ class StormDetector:
     for deterministic tests."""
 
     def __init__(self, n: int = 4, window_s: float = 5.0,
-                 clock=time.monotonic):
+                 clock: "Callable[[], float]" = time.monotonic):
         self._lock = threading.Lock()
         self._clock = clock
         self.n = int(n)
         self.window_s = float(window_s)
-        self._times: deque = deque(maxlen=self.n)
+        self._times: "deque[float]" = deque(maxlen=self.n)
         self.storms = 0
 
     def hit(self) -> bool:
