@@ -135,3 +135,14 @@ def test_improvements_do_not_flag(tmp_path):
     ])
     diff = benchdiff.diff_rounds([a, b], threshold=0.10)
     assert not any(m["regressed"] for m in diff["metrics"].values())
+
+
+def test_kernelflow_metric_directions_are_registered():
+    """ISSUE 15 satellite: the kernelflow/padcheck stage metrics
+    trend lower-better through the registered table (count is a unit
+    the inference rules do not cover — an analyzer-coverage regression
+    must not trend as an improvement)."""
+    for m in ("kernelflow_findings_total", "padcheck_sites_total",
+              "padcheck_divergences_total"):
+        assert benchdiff._EXPLICIT_DIRECTION[m] == "lower", m
+        assert benchdiff.lower_is_better(m, "count", None), m

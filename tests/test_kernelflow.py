@@ -1,0 +1,426 @@
+"""Kernel dataflow analysis tests (round 20, ISSUE 15).
+
+Three layers:
+
+  * UNIT — the lattice transfer functions of kernelflow's abstract
+    interpreter (astype promotion, identity-pad recognition, the
+    fixed-point idiom, width padding, the rank/perm and masked-segment
+    uniqueness proofs), pinned by classifying tiny injected programs.
+  * ARTIFACT — tools/reduction_ledger.json staleness (the tier-1 gate
+    mirroring lock_hierarchy.json) and the empty-unsuppressed-hazards
+    acceptance bar.
+  * REFUTER — tools/padcheck.py's differential executor catches the
+    deliberately hazardous two-op fixture (mean-threshold over a
+    zero-padded axis) and stays silent on an exact kernel; plus the
+    bitwise-parity twins for this round's two kernel conversions
+    (pairwise symmetric-anti int32 contraction, _preempt_rounds
+    plain-commit _node_add).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpusched.lint import kernelflow as kf
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def _spec_module(name: str, path: Path):
+    import sys
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves string annotations through sys.modules.
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def padcheck():
+    return _spec_module("tpusched_test_padcheck",
+                        REPO_ROOT / "tools" / "padcheck.py")
+
+
+def analyze(src: str, relpath: str = "tpusched/kernels/fixture.py"):
+    prog = kf.KernelProgram({relpath: src})
+    prog.classify_rules()
+    return prog.sites
+
+
+PRELUDE = "import jax\nimport jax.numpy as jnp\n\n\n"
+
+
+# ---------------------------------------------------------------------------
+# Lattice transfer units.
+# ---------------------------------------------------------------------------
+
+def test_bool_astype_sum_is_integer_exact():
+    sites = analyze(PRELUDE + (
+        "def f(mask):\n"
+        "    return jnp.sum(mask.astype(jnp.float32), axis=0)\n"
+    ))
+    (s,) = sites
+    assert s.exactness == "integer-exact"
+    assert s.padding == "exact"
+    assert s.rule is None
+
+
+def test_f32_sum_feeding_compare_is_tpl201():
+    sites = analyze(PRELUDE + (
+        "def f(scores, mask):\n"
+        "    total = jnp.sum(jnp.where(mask, scores, 0.0), axis=0)\n"
+        "    return total > 10.0\n"
+    ))
+    (s,) = sites
+    assert s.exactness == "f32-order-sensitive"
+    assert s.decision and s.rule == "TPL201"
+
+
+def test_fixed_point_idiom_with_clip_is_provable():
+    sites = analyze(PRELUDE + (
+        "def f(scores, mask):\n"
+        "    iq = jnp.clip(jnp.round(scores * 16.0), -32767.0,\n"
+        "                  32767.0).astype(jnp.int32)\n"
+        "    return jnp.sum(jnp.where(mask, iq, 0), axis=0)\n"
+    ))
+    (s,) = sites
+    assert s.exactness == "int32-fixed-point"
+    assert s.padding == "exact" and s.rule is None
+
+
+def test_fixed_point_without_clip_is_tpl204():
+    sites = analyze(PRELUDE + (
+        "def f(scores):\n"
+        "    iq = jnp.round(scores * 16.0).astype(jnp.int32)\n"
+        "    return jnp.sum(iq, axis=0)\n"
+    ))
+    (s,) = sites
+    assert s.exactness == "int32-fixed-point"
+    assert s.padding == "overflow-unproven" and s.rule == "TPL204"
+
+
+def test_min_identity_mask_vs_zero_mask():
+    inf_masked = analyze(PRELUDE + (
+        "def f(x, valid):\n"
+        "    return jnp.min(jnp.where(valid, x, jnp.inf), axis=1)\n"
+    ))
+    zero_masked = analyze(PRELUDE + (
+        "def f(x, valid):\n"
+        "    return jnp.min(jnp.where(valid, x, 0.0), axis=1)\n"
+    ))
+    assert inf_masked[0].padding == "identity-masked"
+    assert zero_masked[0].padding == "masked-select"
+    # select ops never carry a TPL2xx rule — they are order-free; the
+    # ledger's sharding column carries the mask warning instead.
+    assert zero_masked[0].rule is None
+    assert "mask" in zero_masked[0].sharding
+
+
+def test_wrong_direction_inf_fill_is_not_an_identity():
+    """+inf is min's identity but DOMINATES a max (and vice versa):
+    the proof must match the fill's sign to the op's direction, or the
+    ledger certifies as sharding-safe a site whose padded rows WIN the
+    reduction."""
+    wrong = analyze(PRELUDE + (
+        "def f(x, valid):\n"
+        "    return jnp.max(jnp.where(valid, x, jnp.inf), axis=1)\n"
+    ))
+    right = analyze(PRELUDE + (
+        "def f(x, valid):\n"
+        "    return jnp.max(jnp.where(valid, x, -jnp.inf), axis=1)\n"
+    ))
+    assert wrong[0].padding == "dominating-fill"
+    assert "WINS" in wrong[0].sharding
+    assert right[0].padding == "identity-masked"
+
+
+def test_width_padded_cumsum_is_safe():
+    concat = analyze(PRELUDE + (
+        "def f(req_s, width, P):\n"
+        "    req_pad = jnp.concatenate(\n"
+        "        [req_s, jnp.zeros((width - P, req_s.shape[1]),\n"
+        "                          req_s.dtype)])\n"
+        "    return jnp.cumsum(req_pad, axis=0)\n"
+    ))
+    scatter = analyze(PRELUDE + (
+        "def f(dem, rank, width):\n"
+        "    rm = jnp.zeros((width, dem.shape[1]), dem.dtype)"
+        ".at[rank].set(dem)\n"
+        "    return jnp.cumsum(rm, axis=0)\n"
+    ))
+    assert concat[0].padding == "safe-width-padded"
+    assert scatter[0].padding == "safe-width-padded"
+    assert concat[0].rule is None and scatter[0].rule is None
+
+
+def test_plain_f32_cumsum_on_compacted_path_is_tpl202():
+    sites = analyze(PRELUDE + (
+        "def _pods_view(snap, static, sel):\n"
+        "    return snap, static\n\n\n"
+        "def f(snap, static, sel, requests, mask):\n"
+        "    snap_v, static_v = _pods_view(snap, static, sel)\n"
+        "    dem = jnp.where(mask[:, None], requests, 0.0)\n"
+        "    return jnp.cumsum(dem, axis=0)\n"
+    ))
+    (s,) = sites
+    assert s.compact and not s.decision
+    assert s.rule == "TPL202"
+
+
+def test_scatter_add_uniqueness_proofs():
+    unproven = analyze(PRELUDE + (
+        "def f(used, node, requests):\n"
+        "    return used.at[node].add(requests)\n"
+    ))
+    perm = analyze(PRELUDE + (
+        "def f(used, requests, keys):\n"
+        "    perm = jnp.argsort(keys)\n"
+        "    return used.at[perm].add(requests)\n"
+    ))
+    masked_seg = analyze(PRELUDE + (
+        "def f(used, node_s, is_last, total):\n"
+        "    return used.at[jnp.where(is_last, node_s, 0)].add(\n"
+        "        jnp.where(is_last[:, None], total, 0.0))\n"
+    ))
+    intvals = analyze(PRELUDE + (
+        "def f(counts, dom, member):\n"
+        "    return counts.at[dom].add(member.astype(jnp.float32))\n"
+    ))
+    scatters = {
+        "unproven": [s for s in unproven if s.cls == "scatter"][0],
+        "perm": [s for s in perm if s.cls == "scatter"][0],
+        "masked": [s for s in masked_seg if s.cls == "scatter"][0],
+        "intf": [s for s in intvals if s.cls == "scatter"][0],
+    }
+    assert scatters["unproven"].rule == "TPL203"
+    assert scatters["perm"].unique == "unique-by-perm"
+    assert scatters["masked"].unique == "masked-segment"
+    assert scatters["intf"].exactness == "integer-exact"
+    for k in ("perm", "masked", "intf"):
+        assert scatters[k].rule is None, k
+
+
+def test_mean_is_always_a_padding_hazard():
+    sites = analyze(PRELUDE + (
+        "def f(x, mask):\n"
+        "    m = jnp.mean(jnp.where(mask, x, 0.0), axis=0)\n"
+        "    return x > m\n"
+    ))
+    (s,) = sites
+    assert s.padding == "hazard" and s.rule == "TPL201"
+
+
+def test_count_table_sum_bound_keeps_counts_exact():
+    # counts tables sum to <= the member count (the seed's sum_bound),
+    # so a direct axis-sum stays integer-exact even though per-entry
+    # bound * width would overflow 2**24.
+    sites = analyze(PRELUDE + (
+        "def f(st):\n"
+        "    return st.counts.sum(axis=1) > 0\n"
+    ))
+    (s,) = sites
+    assert s.exactness == "integer-exact" and s.rule is None
+
+
+# ---------------------------------------------------------------------------
+# Artifact: the checked-in reduction ledger.
+# ---------------------------------------------------------------------------
+
+def _fresh_ledger_doc():
+    from tpusched.lint.engine import parse_suppressions
+    from tpusched.lint.interproc import scan_product_sources
+    prog = kf.KernelProgram(
+        kf.kernel_sources(scan_product_sources(REPO_ROOT)))
+    suppressed = {p: parse_suppressions(s)[0]
+                  for p, s in prog.sources.items()}
+    return prog.ledger_doc(suppressed)
+
+
+def test_reduction_ledger_is_fresh_and_clean():
+    """THE staleness gate (acceptance criterion): the checked-in
+    tools/reduction_ledger.json matches a byte-for-byte regeneration,
+    and every hazard site is fixed or carries a reasoned suppression
+    (unsuppressed == 0)."""
+    path = REPO_ROOT / "tools" / "reduction_ledger.json"
+    assert path.exists(), "run `python tools/lint.py --write-ledger`"
+    fresh = json.dumps(_fresh_ledger_doc(), indent=2, sort_keys=True) + "\n"
+    assert path.read_text() == fresh, (
+        "tools/reduction_ledger.json is STALE — regenerate with "
+        "`python tools/lint.py --write-ledger` and commit it"
+    )
+    doc = json.loads(path.read_text())
+    assert doc["totals"]["unsuppressed"] == 0, [
+        r for r in doc["sites"]
+        if r.get("rule") and not r.get("suppressed")
+    ]
+    assert doc["totals"]["sites"] > 100  # the inventory is real
+    # Every site carries the three verdict columns item 1 consumes.
+    for rec in doc["sites"]:
+        assert rec["exactness"] and rec["padding"] and rec["sharding"]
+
+
+def test_ledger_round_trip(tmp_path):
+    doc = _fresh_ledger_doc()
+    p = tmp_path / "ledger.json"
+    kf.write_ledger(p, doc)
+    assert kf.load_ledger(p) == doc
+    assert kf.load_ledger(tmp_path / "nope.json") is None
+
+
+def test_padcheck_coverage_is_total(padcheck):
+    """Every ledger site's root is reachable from some harness's entry
+    set — statically, without running the harnesses (the full
+    differential run is the check.py padcheck stage)."""
+    from tpusched.lint.interproc import scan_product_sources
+    prog = kf.KernelProgram(
+        kf.kernel_sources(scan_product_sources(REPO_ROOT)))
+    prog.classify_rules()
+    ledger = prog.ledger_doc()
+    harnesses = padcheck._harnesses()
+    _per, uncovered = padcheck.coverage(prog, harnesses, ledger)
+    assert uncovered == [], [
+        f"{r['path']}:{r['line']} ({r['root']})" for r in uncovered]
+
+
+# ---------------------------------------------------------------------------
+# The refuter and the parity twins.
+# ---------------------------------------------------------------------------
+
+def test_refuter_catches_the_seeded_hazardous_fixture(padcheck):
+    """The differential executor must flag the two-op hazard kernel
+    (threshold against a mean whose denominator is the padded width) —
+    a refuter that cannot catch a planted bug validates nothing."""
+    res = padcheck.diff_run("seeded", padcheck.hazardous_fixture_run)
+    assert res.diverged, "padcheck missed the seeded hazardous fixture"
+
+    def exact_kernel(mult: int):
+        import jax.numpy as jnp
+        n = 8
+        vals = np.arange(1, n + 1, dtype=np.float32)
+        x = np.zeros(n * mult, np.float32)
+        x[:n] = vals
+        mask = np.zeros(n * mult, bool)
+        mask[:n] = True
+        s = jnp.sum(jnp.where(jnp.asarray(mask),
+                              jnp.asarray(x), 0.0).astype(jnp.int32))
+        return {"above": np.asarray(jnp.asarray(x) > s.astype(np.float32))[:n]}
+
+    assert not padcheck.diff_run("exact", exact_kernel).diverged
+
+
+def test_symmetric_anti_int32_matches_f32():
+    """Parity twin for this round's pairwise conversion: the int32
+    symmetric-anti contraction gives bitwise-identical verdicts to the
+    f32 form it replaced, across fuzz snapshots with running anti
+    holders, pending holders, and self-exclusion."""
+    import jax.numpy as jnp
+    from tpusched.config import EngineConfig
+    from tpusched.engine import _sat_tables
+    from tpusched.kernels import pairwise as kpair
+    from tpusched.synth import make_cluster
+
+    cfg = EngineConfig(mode="fast")
+    for seed in (3, 9, 27):
+        snap, _meta = make_cluster(
+            np.random.default_rng(seed), 24, 8, config=cfg,
+            interpod_frac=0.5, run_anti_frac=0.4, spread_frac=0.2,
+            namespace_count=2, n_running_per_node=2,
+        )
+        import jax
+        snap = jax.tree.map(jnp.asarray, snap)
+        _nst, mst = _sat_tables(snap)
+        sm = kpair.sig_member_match(snap, mst)
+        st = kpair.pair_state_init(snap, sm)
+        dom_s = kpair.sig_domains(snap)
+        M = snap.running.valid.shape[0]
+
+        def f32_reference(esn=None):
+            # The pre-conversion f32 math, op for op.
+            anti_at = jnp.take_along_axis(
+                st.anti, jnp.clip(dom_s, 0, None), axis=1)
+            anti_at = jnp.where(dom_s >= 0, anti_at, 0.0)
+            matchers = sm[:, M:].astype(jnp.float32)
+            blocked = matchers.T @ anti_at
+            if esn is not None:
+                pods = snap.pods
+                pod_idx = jnp.arange(pods.valid.shape[0])
+                for t in range(pods.ia_key.shape[1]):
+                    s = jnp.clip(pods.ia_sig[:, t], 0, None)
+                    own_dom = dom_s[s, jnp.clip(esn, 0, None)]
+                    self_match = sm[s, M + pod_idx]
+                    active = (kpair._pod_anti_holds(snap, t)
+                              & self_match & (esn >= 0) & (own_dom >= 0))
+                    sub = active[:, None] & (dom_s[s] == own_dom[:, None])
+                    blocked = blocked - sub.astype(jnp.float32)
+            return blocked > 0.5
+
+        got = kpair.symmetric_anti_block(snap, st, sm)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(f32_reference()),
+            err_msg=f"seed {seed} (no exclusion)")
+        P = snap.pods.valid.shape[0]
+        esn = jnp.asarray(
+            np.random.default_rng(seed + 1).integers(-1, 8, P),
+            jnp.int32)
+        got_x = kpair.symmetric_anti_block(snap, st, sm,
+                                           exclude_self_node=esn)
+        np.testing.assert_array_equal(
+            np.asarray(got_x), np.asarray(f32_reference(esn)),
+            err_msg=f"seed {seed} (self-exclusion)")
+
+
+def test_preempt_plain_commit_node_add_parity():
+    """Parity twin for this round's _preempt_rounds conversion: the
+    unique-per-node segment totals (_node_add) equal the legacy
+    duplicate-index scatter-add bitwise on the production request
+    dialect — integer-valued quantities at a shared granularity
+    (milli-cpu units; memory as multiples of one page size), where
+    EVERY summation order is exact so the two forms must agree to the
+    bit. (Off-dialect — mixed magnitudes whose sums round — the legacy
+    form was LAYOUT-DEPENDENT, i.e. not any single answer to pin;
+    that is the TPL203 hazard the conversion removes.)"""
+    import jax.numpy as jnp
+    from tpusched.kernels.assign import _node_add
+
+    rng = np.random.default_rng(42)
+    for trial in range(5):
+        C, N, R = 32, 6, 2
+        node = rng.integers(0, N, C).astype(np.int32)   # heavy duplicates
+        mask = rng.random(C) < 0.6
+        req = np.stack([
+            rng.integers(100, 4000, C).astype(np.float32),
+            (rng.integers(1, 64, C) * float(1 << 20)).astype(np.float32),
+        ], axis=1)
+        rank = rng.permutation(C).astype(np.int32)
+        used = np.stack([
+            (rng.integers(0, 100, N) * 16).astype(np.float32),
+            (rng.integers(0, 100, N) * float(1 << 20)).astype(np.float32),
+        ], axis=1)
+        legacy = jnp.asarray(used).at[
+            jnp.clip(jnp.asarray(node), 0, N - 1)
+        ].add(jnp.where(jnp.asarray(mask)[:, None], jnp.asarray(req), 0.0))
+        got = _node_add(jnp.asarray(used), jnp.asarray(node),
+                        jnp.asarray(mask), jnp.asarray(req),
+                        jnp.asarray(rank), C)
+        np.testing.assert_array_equal(
+            np.asarray(got).view(np.uint32),
+            np.asarray(legacy).view(np.uint32),
+            err_msg=f"trial {trial}")
+
+
+def test_rules_registered_and_scoped():
+    from tpusched.lint import RULES
+    ids = [cls.rule_id for cls in RULES]
+    for r in ("TPL201", "TPL202", "TPL203", "TPL204"):
+        assert r in ids
+    rule = next(cls() for cls in RULES if cls.rule_id == "TPL201")
+    assert rule.applies("tpusched/kernels/assign.py")
+    assert rule.applies("tpusched/ring.py")
+    assert not rule.applies("tpusched/engine.py")
+    assert not rule.applies("tests/test_fast.py")

@@ -306,6 +306,73 @@ FIXTURES = [
         "            return v * scale\n"
         "        self._jit = jax.jit(_fn)\n",
     ),
+    (
+        # TPL201 (ISSUE 15): an f32 sum feeding a compare is stable
+        # only at a fixed width/layout; the int32 fixed-point idiom
+        # (clip bounds the sum provably) is exact in any tree.
+        "TPL201", "tpusched/kernels/foo.py",
+        "import jax.numpy as jnp\n\n\ndef f(scores, mask):\n"
+        "    total = jnp.sum(jnp.where(mask, scores, 0.0), axis=0)\n"
+        "    return total > 10.0\n",
+        "import jax.numpy as jnp\n\n\ndef f(scores, mask):\n"
+        "    iq = jnp.clip(jnp.round(scores * 16.0), -32767.0,\n"
+        "                  32767.0).astype(jnp.int32)\n"
+        "    total = jnp.sum(jnp.where(mask, iq, 0), axis=0)\n"
+        "    return total > 160\n",
+    ),
+    (
+        # TPL202 (ISSUE 15): a plain f32 cumsum on a compacted-view
+        # path moves bitwise with the view width; the width-padded
+        # rank-major layout (PR 12's idiom) is byte-stable.
+        "TPL202", "tpusched/kernels/foo.py",
+        "import jax.numpy as jnp\n\n\n"
+        "def _pods_view(snap, static, sel):\n"
+        "    return snap, static\n\n\n"
+        "def f(snap, static, sel, requests, mask):\n"
+        "    snap_v, static_v = _pods_view(snap, static, sel)\n"
+        "    dem = jnp.where(mask[:, None], requests, 0.0)\n"
+        "    return jnp.cumsum(dem, axis=0)\n",
+        "import jax.numpy as jnp\n\n\n"
+        "def _pods_view(snap, static, sel):\n"
+        "    return snap, static\n\n\n"
+        "def f(snap, static, sel, requests, mask, rank, width):\n"
+        "    snap_v, static_v = _pods_view(snap, static, sel)\n"
+        "    dem = jnp.where(mask[:, None], requests, 0.0)\n"
+        "    rm = jnp.zeros((width, dem.shape[1]),"
+        " dem.dtype).at[rank].set(dem)\n"
+        "    return jnp.cumsum(rm, axis=0)\n",
+    ),
+    (
+        # TPL203 (ISSUE 15): duplicate-capable f32 scatter-add applies
+        # in unspecified order; an argsort perm index is duplicate-free.
+        "TPL203", "tpusched/kernels/foo.py",
+        "import jax.numpy as jnp\n\n\ndef f(used, node, requests):\n"
+        "    return used.at[node].add(requests)\n",
+        "import jax.numpy as jnp\n\n\ndef f(used, requests, keys):\n"
+        "    perm = jnp.argsort(keys)\n"
+        "    return used.at[perm].add(requests)\n",
+    ),
+    (
+        # TPL204 (ISSUE 15): a fixed-point sum without a clip on the
+        # quantized operand has no provable int32 bound.
+        "TPL204", "tpusched/kernels/foo.py",
+        "import jax.numpy as jnp\n\n\ndef f(scores):\n"
+        "    iq = jnp.round(scores * 16.0).astype(jnp.int32)\n"
+        "    return jnp.sum(iq, axis=0)\n",
+        "import jax.numpy as jnp\n\n\ndef f(scores):\n"
+        "    iq = jnp.clip(jnp.round(scores * 16.0), -32767.0,\n"
+        "                  32767.0).astype(jnp.int32)\n"
+        "    return jnp.sum(iq, axis=0)\n",
+    ),
+    (
+        # TPL2xx scope: the identical hazard outside the kernel scope
+        # is not this analysis's territory (engine/host orchestration
+        # is not an array program).
+        None, "tpusched/engine.py",
+        None,
+        "import jax.numpy as jnp\n\n\ndef f(used, node, requests):\n"
+        "    return used.at[node].add(requests)\n",
+    ),
 ]
 
 
@@ -426,7 +493,7 @@ def test_missing_baseline_is_empty(tmp_path):
 
 def test_rule_table_is_complete():
     ids = [cls.rule_id for cls in RULES]
-    assert len(ids) == len(set(ids)) == 16
+    assert len(ids) == len(set(ids)) == 20
     for cls in RULES:
         assert cls.incident, f"{cls.rule_id} must cite its incident"
         assert cls.title, f"{cls.rule_id} must carry a title"

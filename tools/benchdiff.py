@@ -51,6 +51,12 @@ _HIGHER_BETTER_NAME = re.compile(r"(attainment|goodput|qps)")
 _EXPLICIT_DIRECTION = {
     "ledger_overhead_pct": "lower",    # flight-ledger on-vs-off cost
     "compile_count_total": "lower",    # XLA cache misses per bench run
+    # Kernel dataflow analysis (round 20, ISSUE 15): hazard-class
+    # reduction sites shrink as int32/width-pad conversions land, and
+    # a padcheck divergence is always a regression.
+    "kernelflow_findings_total": "lower",
+    "padcheck_sites_total": "lower",
+    "padcheck_divergences_total": "lower",
 }
 
 

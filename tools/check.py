@@ -14,13 +14,23 @@ Chains, in order:
   jitlint  tools/lint.py --jit-report: every jax.jit/_traced_jit site
            enumerated; fails on any unbounded jit family (compile-
            cache treadmill — ROADMAP item 4's anomaly source)
+  kernelflow  tools/lint.py --check-ledger: the checked-in
+           tools/reduction_ledger.json (every cross-pod/cross-node
+           reduction site with its exactness class, padding verdict,
+           and sharding-safety note) matches a fresh regeneration and
+           every hazard site is fixed or reasoned-suppressed
+  padcheck  tools/padcheck.py: differentially execute the ledger
+           sites' enclosing kernels at two bucket widths — an
+           exact-marked site that diverges bitwise fails, and the
+           seeded hazardous fixture must be caught; SKIPPED gracefully
+           when jax is not installed (like warmaudit)
   syntax   byte-compile every tracked .py (pyflakes when the image
            has it; stdlib compile() otherwise — this image must not
            grow dependencies)
   mypy     mypy --strict over the typed beachhead (mypy.ini scopes
            it: config.py, qos.py, metrics.py, ledger.py, trace.py,
-           tpusched/lint/); SKIPPED gracefully when mypy is not
-           installed
+           tpusched/lint/, kernels/filter.py, kernels/score.py,
+           oracle.py); SKIPPED gracefully when mypy is not installed
   warmaudit  fast `divergence --warm-audit 5` smoke at a tiny shape,
            BOTH modes sharing one engine: the PR 10 bitwise warm
            contract (warm == cold byte-identical) and the ISSUE 12
@@ -49,7 +59,9 @@ LINT_PATHS = ("tpusched", "tools", "bench.py", "tests")
 SYNTAX_ROOTS = ("tpusched", "tools", "tests", "bench.py")
 MYPY_TARGETS = ("tpusched/config.py", "tpusched/qos.py",
                 "tpusched/metrics.py", "tpusched/ledger.py",
-                "tpusched/trace.py", "tpusched/lint")
+                "tpusched/trace.py", "tpusched/lint",
+                "tpusched/kernels/filter.py",
+                "tpusched/kernels/score.py", "tpusched/oracle.py")
 
 
 def _run(cmd: "list[str]") -> "tuple[int, str]":
@@ -76,6 +88,20 @@ def stage_lockgraph() -> "tuple[str, str]":
 
 def stage_jitlint() -> "tuple[str, str]":
     rc, out = _run([sys.executable, "tools/lint.py", "--jit-report"])
+    return ("ok" if rc == 0 else "FAIL"), out
+
+
+def stage_kernelflow() -> "tuple[str, str]":
+    rc, out = _run([sys.executable, "tools/lint.py", "--check-ledger"])
+    return ("ok" if rc == 0 else "FAIL"), out
+
+
+def stage_padcheck() -> "tuple[str, str]":
+    try:
+        import jax  # noqa: F401
+    except ImportError:
+        return "skip", "jax not installed on this image"
+    rc, out = _run([sys.executable, "tools/padcheck.py"])
     return ("ok" if rc == 0 else "FAIL"), out
 
 
@@ -220,9 +246,11 @@ STAGES = (
     ("lint", stage_lint),
     ("lockgraph", stage_lockgraph),
     ("jitlint", stage_jitlint),
+    ("kernelflow", stage_kernelflow),
     ("syntax", stage_syntax),
     ("mypy", stage_mypy),
     ("warmaudit", stage_warmaudit),
+    ("padcheck", stage_padcheck),
     ("statusz", stage_statusz),
 )
 

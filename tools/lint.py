@@ -27,6 +27,14 @@ Whole-program surfaces (round 19, ISSUE 14):
   python tools/lint.py --write-hierarchy  # regenerate tools/lock_hierarchy.json
   python tools/lint.py --check-hierarchy  # fail if the artifact is stale/cyclic
   python tools/lint.py --jit-report       # every jit site, families + bounds
+
+Kernel dataflow surfaces (round 20, ISSUE 15):
+
+  python tools/lint.py --kernel-report    # per-site exactness/padding dump
+  python tools/lint.py --write-ledger     # regenerate tools/reduction_ledger.json
+  python tools/lint.py --check-ledger     # fail if the ledger is stale or a
+                                          # hazard site lacks a reasoned
+                                          # suppression (the kernelflow gate)
 """
 
 from __future__ import annotations
@@ -47,15 +55,33 @@ from tpusched.lint import (  # noqa: E402
     write_baseline,
 )
 from tpusched.lint import interproc  # noqa: E402
-from tpusched.lint.engine import apply_baseline  # noqa: E402
+from tpusched.lint import kernelflow  # noqa: E402
+from tpusched.lint.engine import apply_baseline, parse_suppressions  # noqa: E402
 
 DEFAULT_BASELINE = REPO_ROOT / "tools" / "lint_baseline.json"
 DEFAULT_HIERARCHY = REPO_ROOT / "tools" / "lock_hierarchy.json"
+DEFAULT_LEDGER = REPO_ROOT / "tools" / "reduction_ledger.json"
 DEFAULT_PATHS = ("tpusched", "tools", "bench.py", "tests")
 
 
 def _program() -> "interproc.Program":
     return interproc.Program(interproc.scan_product_sources(REPO_ROOT))
+
+
+def _kernel_program() -> "kernelflow.KernelProgram":
+    return kernelflow.KernelProgram(kernelflow.kernel_sources(
+        interproc.scan_product_sources(REPO_ROOT)))
+
+
+def _kernel_ledger_doc(prog: "kernelflow.KernelProgram") -> dict:
+    """Fresh ledger doc with per-site suppression status read from the
+    live tree's `# tpl: disable=` comments (a suppressed hazard is a
+    REASONED entry in the ledger, not an absent one)."""
+    suppressed: "dict[str, dict[int, set[str]]]" = {}
+    for relpath, src in prog.sources.items():
+        by_line, _errors = parse_suppressions(src)
+        suppressed[relpath] = by_line
+    return prog.ledger_doc(suppressed)
 
 
 def cmd_graph() -> int:
@@ -100,6 +126,67 @@ def cmd_check_hierarchy() -> int:
     return 0 if ok else 1
 
 
+def cmd_kernel_report() -> int:
+    """Human-readable per-site dump of the kernel dataflow ledger."""
+    prog = _kernel_program()
+    for line in prog.report_lines():
+        print(line)
+    doc = _kernel_ledger_doc(prog)
+    t = doc["totals"]
+    print(f"kernelflow: {t['sites']} sites, {t['findings']} hazard "
+          f"finding(s), {t['unsuppressed']} unsuppressed")
+    return 0
+
+
+def cmd_write_ledger() -> int:
+    prog = _kernel_program()
+    doc = _kernel_ledger_doc(prog)
+    kernelflow.write_ledger(DEFAULT_LEDGER, doc)
+    t = doc["totals"]
+    print(f"kernelflow: wrote {t['sites']} sites "
+          f"({t['findings']} hazards, {t['unsuppressed']} unsuppressed) "
+          f"to {DEFAULT_LEDGER}")
+    return 0
+
+
+def cmd_check_ledger() -> int:
+    """The kernelflow gate: the checked-in reduction ledger must match
+    a fresh regeneration byte-for-byte (line numbers drift with edits —
+    a stale ledger lies to ROADMAP item 1 about which reductions are
+    sharding-safe), and every hazardous site must be fixed or carry a
+    reasoned suppression."""
+    prog = _kernel_program()
+    doc = _kernel_ledger_doc(prog)
+    fresh = json.dumps(doc, indent=2, sort_keys=True) + "\n"
+    ok = True
+    if not DEFAULT_LEDGER.exists():
+        print("kernelflow: tools/reduction_ledger.json missing — run "
+              "`python tools/lint.py --write-ledger`", file=sys.stderr)
+        ok = False
+    elif DEFAULT_LEDGER.read_text() != fresh:
+        print("kernelflow: tools/reduction_ledger.json is STALE — run "
+              "`python tools/lint.py --write-ledger` and commit it",
+              file=sys.stderr)
+        ok = False
+    t = doc["totals"]
+    if t["unsuppressed"]:
+        for rec in doc["sites"]:
+            if rec.get("rule") and not rec.get("suppressed"):
+                print(f"kernelflow: UNSUPPRESSED {rec['rule']} at "
+                      f"{rec['path']}:{rec['line']} ({rec['op']})",
+                      file=sys.stderr)
+        ok = False
+    # Trend metric for benchdiff (lower is better: hazards shrink as
+    # conversions land).
+    print(json.dumps({"metric": "kernelflow_findings_total",
+                      "value": float(t["findings"]), "unit": "count",
+                      "direction": "lower"}))
+    print(f"kernelflow: {t['sites']} sites, {t['findings']} hazards, "
+          f"{t['unsuppressed']} unsuppressed"
+          + (" — in sync" if ok else ""))
+    return 0 if ok else 1
+
+
 def cmd_jit_report() -> int:
     """The jitlint gate: enumerate every jax.jit/_traced_jit site with
     its caching classification; unbounded families fail (they are also
@@ -138,6 +225,13 @@ def main(argv=None) -> int:
                     help="fail when the hierarchy artifact is stale or cyclic")
     ap.add_argument("--jit-report", action="store_true",
                     help="enumerate jit sites; fail on unbounded families")
+    ap.add_argument("--kernel-report", action="store_true",
+                    help="dump the kernel dataflow ledger per site")
+    ap.add_argument("--write-ledger", action="store_true",
+                    help="regenerate tools/reduction_ledger.json")
+    ap.add_argument("--check-ledger", action="store_true",
+                    help="fail when the reduction ledger is stale or a "
+                         "hazard site lacks a reasoned suppression")
     args = ap.parse_args(argv)
 
     if args.graph:
@@ -148,6 +242,12 @@ def main(argv=None) -> int:
         return cmd_check_hierarchy()
     if args.jit_report:
         return cmd_jit_report()
+    if args.kernel_report:
+        return cmd_kernel_report()
+    if args.write_ledger:
+        return cmd_write_ledger()
+    if args.check_ledger:
+        return cmd_check_ledger()
     if args.list_rules:
         for cls in RULES:
             print(f"{cls.rule_id}  {cls.title}")

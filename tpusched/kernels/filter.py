@@ -9,7 +9,14 @@ the sequential parity scan).
 
 from __future__ import annotations
 
+from typing import Any
+
 import jax.numpy as jnp
+
+# jax ships no stubs on this image (mypy.ini: ignore_missing_imports),
+# so traced arrays type as Any; the alias keeps signatures legible and
+# becomes jax.Array the day stubs exist.
+Array = Any
 
 from tpusched.config import (
     EFFECT_NO_EXECUTE,
@@ -19,7 +26,7 @@ from tpusched.kernels.atoms import gather_term_sat
 from tpusched.snapshot import ClusterSnapshot
 
 
-def resource_fit(alloc, used, requests):
+def resource_fit(alloc: Array, used: Array, requests: Array) -> Array:
     """NodeResourcesFit: forall r: used + req <= alloc.
     alloc/used: [N, R]; requests: [P, R] -> [P, N] (or [R] -> [N])."""
     if requests.ndim == 1:
@@ -29,7 +36,8 @@ def resource_fit(alloc, used, requests):
     )
 
 
-def taint_mask(node_taint_ids, taint_effect, tolerated):
+def taint_mask(node_taint_ids: Array, taint_effect: Array,
+               tolerated: Array) -> Array:
     """TaintToleration filter: every NoSchedule/NoExecute taint tolerated.
     node_taint_ids: [N, TN] (-1 pad); taint_effect: [VT];
     tolerated: [P, VT] -> [P, N]  (or [VT] -> [N])."""
@@ -45,7 +53,8 @@ def taint_mask(node_taint_ids, taint_effect, tolerated):
     return jnp.all(~hard[None] | tol, axis=-1)
 
 
-def node_affinity_mask(node_sat_t, req_term_atoms, req_term_valid):
+def node_affinity_mask(node_sat_t: Array, req_term_atoms: Array,
+                       req_term_valid: Array) -> Array:
     """Required node affinity + nodeSelector: OR over terms, AND within.
     node_sat_t: [A, N]; req_term_atoms: [P, T, AT] or [T, AT];
     returns [P, N] or [N]. A pod with zero valid terms matches all."""
@@ -56,7 +65,7 @@ def node_affinity_mask(node_sat_t, req_term_atoms, req_term_valid):
     return jnp.where(has_req[..., None], any_term, True)
 
 
-def full_static_mask(snap: ClusterSnapshot, node_sat_t):
+def full_static_mask(snap: ClusterSnapshot, node_sat_t: Array) -> Array:
     """All non-pairwise, state-independent predicates for all pods:
     taints & node affinity & node validity -> [P, N]. Resource fit is
     state-dependent (used changes as pods commit) and pairwise terms are

@@ -293,15 +293,24 @@ def symmetric_anti_block(snap: ClusterSnapshot, st: PairState, sig_match,
                          exclude_self_node=None):
     """[P, N] bool: node n is in a domain containing a holder of a
     required anti-affinity term whose selector matches pod p (upstream
-    symmetric anti-affinity). One [P, S] x [S, N] matmul."""
+    symmetric anti-affinity). One [P, S] x [S, N] matmul.
+
+    The contraction runs in int32 (round 20, ISSUE 15 / TPL201): the
+    holder counts are integers, and an f32 matmul over the S axis is
+    tree-order-sensitive once partial sums leave the exact range —
+    integer adds are associativity-exact in any tree, which is what
+    sharding this contraction over the mesh requires. Bitwise-identical
+    verdicts to the f32 form on every existing suite (counts are far
+    below 2**24 there); pinned by
+    tests/test_kernelflow.py::test_symmetric_anti_int32_matches_f32."""
     dom_s = sig_domains(snap)                                # [S, N]
     M = snap.running.valid.shape[0]
     anti_at = jnp.take_along_axis(
         st.anti, jnp.clip(dom_s, 0, None), axis=1
     )                                                        # [S, N]
-    anti_at = jnp.where(dom_s >= 0, anti_at, 0.0)
-    matchers = sig_match[:, M:].astype(jnp.float32)          # [S, P]
-    blocked_cnt = matchers.T @ anti_at                       # [P, N]
+    anti_i = jnp.where(dom_s >= 0, anti_at, 0.0).astype(jnp.int32)
+    matchers = sig_match[:, M:].astype(jnp.int32)            # [S, P]
+    blocked_cnt = matchers.T @ anti_i                        # [P, N] int32
     if exclude_self_node is not None:
         pods = snap.pods
         esn = exclude_self_node
@@ -315,8 +324,8 @@ def symmetric_anti_block(snap: ClusterSnapshot, st: PairState, sig_match,
                 & (esn >= 0) & (own_dom >= 0)
             )
             sub = active[:, None] & (dom_s[s] == own_dom[:, None])
-            blocked_cnt = blocked_cnt - sub.astype(jnp.float32)
-    return blocked_cnt > 0.5
+            blocked_cnt = blocked_cnt - sub.astype(jnp.int32)
+    return blocked_cnt > 0
 
 
 def pairwise_from_counts(snap: ClusterSnapshot, st: PairState, aff_ok,
@@ -457,14 +466,17 @@ def ia_ok_at_choice(snap: ClusterSnapshot, st: PairState, sig_match,
         pos_ok = node_has | (all_zero & self_match & hk)
         ok_t = jnp.where(anti, ~node_has, pos_ok)
         ok &= jnp.where(valid_t & req, ok_t, True)
-    # Symmetric anti at the chosen node (symmetric_anti_block column).
+    # Symmetric anti at the chosen node (symmetric_anti_block column),
+    # contracted in int32 like symmetric_anti_block itself (TPL201:
+    # integer adds are tree-order-exact; the f32 sum was not once
+    # counts leave the exact range).
     d_all = dom_s[:, ch]                                     # [S, P]
     anti_at = st.anti[
         jnp.arange(S)[:, None], jnp.clip(d_all, 0, None)
     ]
-    anti_at = jnp.where(d_all >= 0, anti_at, 0.0)
-    match = sig_match[:, M:].astype(jnp.float32)             # [S, P]
-    blocked = jnp.sum(match * anti_at, axis=0)               # [P]
+    anti_i = jnp.where(d_all >= 0, anti_at, 0.0).astype(jnp.int32)
+    match = sig_match[:, M:].astype(jnp.int32)               # [S, P]
+    blocked = jnp.sum(match * anti_i, axis=0)                # [P] int32
     for t in range(pods.ia_key.shape[1]):
         s = jnp.clip(pods.ia_sig[:, t], 0, None)
         d = dom_s[s, ch]
@@ -474,8 +486,8 @@ def ia_ok_at_choice(snap: ClusterSnapshot, st: PairState, sig_match,
             _pod_anti_holds(snap, t) & self_match
             & (esn >= 0) & (own_dom >= 0) & (d == own_dom)
         )
-        blocked = blocked - active.astype(jnp.float32)
-    return ok & ~(blocked > 0.5)
+        blocked = blocked - active.astype(jnp.int32)
+    return ok & ~(blocked > 0)
 
 
 def pairwise_row(snap: ClusterSnapshot, st: PairState, sig_match, p, aff_ok_p):
@@ -534,12 +546,13 @@ def pairwise_row(snap: ClusterSnapshot, st: PairState, sig_match, p, aff_ok_p):
         w = jnp.where(anti, -pods.ia_weight[p, t], pods.ia_weight[p, t])
         ia_raw += jnp.where(valid_t & ~req & node_has, w, 0.0)
 
-    # Symmetric anti: [S] match vector x [S, N] holder counts.
+    # Symmetric anti: [S] match vector x [S, N] holder counts, in
+    # int32 (tree-order-exact; see symmetric_anti_block).
     anti_at = jnp.take_along_axis(
         st.anti, jnp.clip(dom_s, 0, None), axis=1
     )
-    anti_at = jnp.where(dom_s >= 0, anti_at, 0.0)
-    match_vec = sig_match[:, M + p].astype(jnp.float32)      # [S]
-    sym_blocked = (match_vec[:, None] * anti_at).sum(axis=0) > 0.5
+    anti_i = jnp.where(dom_s >= 0, anti_at, 0.0).astype(jnp.int32)
+    match_vec = sig_match[:, M + p].astype(jnp.int32)        # [S]
+    sym_blocked = (match_vec[:, None] * anti_i).sum(axis=0) > 0
     ia_ok &= ~sym_blocked
     return spread_ok, spread_pen, ia_ok, ia_raw

@@ -8,13 +8,19 @@ Normalization helpers implement the NormalizeScore extension point
 
 from __future__ import annotations
 
+from typing import Any
+
 import jax.numpy as jnp
+
+# Same Any-alias convention as kernels/filter.py (no jax stubs).
+Array = Any
 
 from tpusched.config import EFFECT_PREFER_NO_SCHEDULE, MAX_NODE_SCORE
 from tpusched.kernels.atoms import gather_term_sat
 
 
-def least_requested(alloc, used, requests, resource_weights):
+def least_requested(alloc: Array, used: Array, requests: Array,
+                    resource_weights: Array) -> Array:
     """NodeResourcesFit/LeastAllocated (C3):
     sum_r w_r * (alloc - used - req) * 100 / alloc / sum_r w_r.
     alloc/used: [N, R]; requests: [P, R] or [R]; resource_weights: [R]."""
@@ -28,7 +34,8 @@ def least_requested(alloc, used, requests, resource_weights):
     return jnp.sum(per_r * resource_weights, axis=-1) / wsum
 
 
-def balanced_allocation(alloc, used, requests, resource_weights):
+def balanced_allocation(alloc: Array, used: Array, requests: Array,
+                        resource_weights: Array) -> Array:
     """NodeResourcesBalancedAllocation (C4): (1 - stddev(fractions)) * 100
     over resources with positive score weight."""
     if requests.ndim == 1:
@@ -44,8 +51,9 @@ def balanced_allocation(alloc, used, requests, resource_weights):
     return (1.0 - jnp.sqrt(var)) * MAX_NODE_SCORE
 
 
-def node_affinity_raw(node_sat_t, pref_term_atoms, pref_term_valid,
-                      pref_weight):
+def node_affinity_raw(node_sat_t: Array, pref_term_atoms: Array,
+                      pref_term_valid: Array,
+                      pref_weight: Array) -> Array:
     """Pre-normalization preferred-affinity score: sum of satisfied term
     weights per (pod, node). CELL-LOCAL (each output cell depends only on
     its pod row and node sat column) — the cacheable half of
@@ -57,8 +65,9 @@ def node_affinity_raw(node_sat_t, pref_term_atoms, pref_term_valid,
     return jnp.sum(pref_weight[..., None] * term_ok, axis=-2)  # [..., N]
 
 
-def node_affinity_score(node_sat_t, pref_term_atoms, pref_term_valid,
-                        pref_weight, node_valid):
+def node_affinity_score(node_sat_t: Array, pref_term_atoms: Array,
+                        pref_term_valid: Array, pref_weight: Array,
+                        node_valid: Array) -> Array:
     """Preferred node affinity: sum of satisfied term weights, then
     DefaultNormalizeScore (max -> 100) per pod."""
     raw = node_affinity_raw(node_sat_t, pref_term_atoms, pref_term_valid,
@@ -66,7 +75,8 @@ def node_affinity_score(node_sat_t, pref_term_atoms, pref_term_valid,
     return default_normalize(raw, node_valid)
 
 
-def taint_intolerable_count(node_taint_ids, taint_effect, tolerated):
+def taint_intolerable_count(node_taint_ids: Array, taint_effect: Array,
+                            tolerated: Array) -> Array:
     """Intolerable PreferNoSchedule taints per (pod, node), as f32.
     Cell-local (see node_affinity_raw): the cacheable half of
     taint_toleration_score."""
@@ -79,7 +89,7 @@ def taint_intolerable_count(node_taint_ids, taint_effect, tolerated):
     return jnp.sum(intol, axis=-1).astype(jnp.float32)        # [..., N]
 
 
-def taint_toleration_from_count(count, node_valid):
+def taint_toleration_from_count(count: Array, node_valid: Array) -> Array:
     """Inverse-normalize the intolerable-taint counts (per-pod row max
     coupling — the non-cacheable half of taint_toleration_score)."""
     mx = jnp.max(jnp.where(node_valid, count, 0.0), axis=-1, keepdims=True)
@@ -88,7 +98,8 @@ def taint_toleration_from_count(count, node_valid):
     )
 
 
-def taint_toleration_score(node_taint_ids, taint_effect, tolerated, node_valid):
+def taint_toleration_score(node_taint_ids: Array, taint_effect: Array,
+                           tolerated: Array, node_valid: Array) -> Array:
     """Count intolerable PreferNoSchedule taints, inverse-normalized."""
     count = taint_intolerable_count(node_taint_ids, taint_effect, tolerated)
     return taint_toleration_from_count(count, node_valid)
@@ -97,14 +108,14 @@ def taint_toleration_score(node_taint_ids, taint_effect, tolerated, node_valid):
 # -- NormalizeScore helpers (C5) --------------------------------------------
 
 
-def default_normalize(raw, node_valid):
+def default_normalize(raw: Array, node_valid: Array) -> Array:
     """Upstream DefaultNormalizeScore: scale so the max becomes 100;
     all-zero (or no valid nodes) -> 0."""
     mx = jnp.max(jnp.where(node_valid, raw, 0.0), axis=-1, keepdims=True)
     return jnp.where(mx > 0, raw * MAX_NODE_SCORE / jnp.maximum(mx, 1e-9), 0.0)
 
 
-def inverse_normalize(penalty, node_valid):
+def inverse_normalize(penalty: Array, node_valid: Array) -> Array:
     """Lower penalty -> higher score; all-equal -> 100 (spread score)."""
     big = jnp.where(node_valid, penalty, -jnp.inf)
     sml = jnp.where(node_valid, penalty, jnp.inf)
@@ -117,7 +128,7 @@ def inverse_normalize(penalty, node_valid):
     )
 
 
-def minmax_normalize(raw, node_valid):
+def minmax_normalize(raw: Array, node_valid: Array) -> Array:
     """Upstream InterPodAffinity normalize: (raw-min)/(max-min)*100,
     max==min -> 0."""
     big = jnp.where(node_valid, raw, -jnp.inf)

@@ -26,6 +26,25 @@ tpusched/lint/witness.py):
     TPL104  unbounded jit family (no bounding bucket on the memo key)
     TPL105  jit-wrapped closure reads mutable self state
 
+Kernel dataflow analysis (round 20, ISSUE 15; abstract interpreter in
+tpusched/lint/kernelflow.py, runtime refuter in tools/padcheck.py):
+
+    TPL201  f32 order-sensitive reduction feeds a commit/compare
+            decision (tree shape = width/layout/sharding dependence)
+    TPL202  padding-hazardous reduction reachable from a compacted-view
+            (_pods_view/frontier) path
+    TPL203  scatter-add with non-unique indices and f32 values
+            (duplicates apply in unspecified order)
+    TPL204  int32 fixed-point sum without a provable overflow bound
+
+Every cross-pod/cross-node reduction site is inventoried in
+tools/reduction_ledger.json (exactness class, padding verdict,
+sharding-safety note — the artifact ROADMAP item 1 consumes;
+regenerate: ``python tools/lint.py --write-ledger``; staleness is a
+``tools/check.py`` kernelflow failure, and tools/padcheck.py
+differentially executes the sites' enclosing kernels at two bucket
+widths to refute bad exactness claims at runtime).
+
 The static lock order is checked in as tools/lock_hierarchy.json
 (regenerate: ``python tools/lint.py --write-hierarchy``; staleness is a
 ``tools/check.py`` lockgraph failure) and validated at runtime by the

@@ -81,6 +81,7 @@ class LintContext:
         self._program_sources = program_sources
         self._base_sources: "dict[str, str] | None" = None
         self._program: "Program | None" = None
+        self._kernel_program: Any = None
 
     @property
     def closeable_classes(self) -> "set[str]":
@@ -114,6 +115,32 @@ class LintContext:
         srcs = dict(self._program_sources or {})
         srcs[relpath] = src
         return interproc.Program(srcs)
+
+    def kernel_view(self, relpath: str, src: str) -> Any:
+        """The kernelflow KernelProgram the TPL2xx rules run against
+        when linting (relpath, src) — same caching/isolation contract
+        as program_view: real-tree runs share ONE cached index built
+        over the kernel-scope sources; a fixture snippet gets an
+        isolated program over the injected sources plus itself."""
+        from tpusched.lint import kernelflow  # tpl: disable=TPL001(lazy: keeps engine.py importable standalone without the analysis layer — same contract as the interproc import above)
+
+        if self._program_sources is not None:
+            base = self._program_sources
+        else:
+            if self._base_sources is None:
+                from tpusched.lint import interproc  # tpl: disable=TPL001(lazy: same engine-standalone contract as program_view)
+
+                self._base_sources = interproc.scan_product_sources(
+                    self.root)
+            base = self._base_sources
+        if base.get(relpath) == src:
+            if self._kernel_program is None:
+                self._kernel_program = kernelflow.KernelProgram(
+                    kernelflow.kernel_sources(base))
+            return self._kernel_program
+        srcs = kernelflow.kernel_sources(dict(self._program_sources or {}))
+        srcs[relpath] = src
+        return kernelflow.KernelProgram(srcs)
 
     @property
     def benchdiff(self) -> Any:

@@ -1,0 +1,1745 @@
+"""Kernel dataflow analysis (round 20, ISSUE 15): tpuschedlint v3.
+
+An AST-level abstract interpreter over the array programs in
+``tpusched/kernels/`` (plus ``ring.py``, ``mesh.py`` and the
+``device_state.py`` scatter entry points) that answers, per reduction
+site, the three questions ROADMAP item 1 (shard the serving path over
+the (p, n) mesh) needs answered BEFORE any reduction crosses a device
+boundary:
+
+  1. EXACTNESS — is the reduction invariant under the reduction tree's
+     shape? XLA reductions are tree-shaped and the tree changes with
+     width, layout, and sharding (the PR 12 finding), so only
+     bool/integer reductions, int32 fixed-point sums, and
+     integer-valued-f32 sums whose magnitude bound keeps every partial
+     sum below 2**24 are exact-in-any-tree. Everything else is
+     f32-order-sensitive: bitwise-stable only at a fixed width on a
+     fixed backend, and NOT stable under psum/partial-reduce
+     re-ordering.
+  2. PADDING — can the result change when the reduced axis is
+     zero/NEG_INF-padded? sum/cumsum of order-sensitive f32 can (tree
+     reshape); mean always can (the denominator is the width);
+     integer-class accumulations cannot; min/max/any/all cannot change
+     from tree shape, but a min/max whose mask fill is NOT the op's
+     identity (``where(valid, x, 0.0)`` under ``min``) changes when
+     padding adds masked rows — the pad value must flow from a
+     recognized identity constant to be proven safe. The recognized
+     safe construction for f32 prefix sums is PR 12's width padding:
+     cumsum over an array concatenated/scattered out to an explicit
+     fixed width, byte-identical at any view width.
+  3. SCATTER UNIQUENESS — ``.at[idx].add(v)`` with duplicate indices
+     applies the duplicates in unspecified order; for non-integer f32
+     values that makes the result layout-dependent. Recognized safe
+     patterns: integer-valued adds (any order is exact), idx provably
+     unique (the rank/perm idiom: argsort/lexsort permutations,
+     arange), scalar indices (argmax/argmin picks), and the
+     masked-segment idiom of ``_node_add`` (duplicates are masked rows
+     adding exact 0.0; see kernels/assign.py:536's "duplicate scatters
+     write identical content" note for the ``.set`` analogue).
+
+The lattice (per array value)::
+
+    BOOL < INT < INTF(bound) < F32        (+ FIXED flavor of INT)
+
+``INTF`` is an f32 array holding integer values with a tracked
+magnitude bound; a sum of INTF is exact while bound * WIDTH_CAP stays
+below 2**24, where WIDTH_CAP = 2**17 is the documented member-axis cap
+(100k pods/nodes per ROADMAP item 1's target shape). ``FIXED`` is the
+PR 12 int32 fixed-point idiom ``clip(round(x * S), -B, B).astype(int32)``;
+its sums are associativity-exact, and provably in-range iff
+B * WIDTH_CAP <= 2**31 - 1 (the "P * 2**15 fits int32" cap).
+
+Four rules ride the standard Finding/suppression/baseline machinery:
+
+    TPL201  f32 order-sensitive reduction feeding a commit/compare
+            decision (taint from the site to a Compare/argmax/argmin/
+            searchsorted/top_k/where-condition in the same function)
+    TPL202  padding-hazardous f32 accumulation reachable from a
+            compacted-view path (_pods_view/_top_by_rank frontier
+            gathers) that TPL201 does not already cover
+    TPL203  non-unique scatter-add of non-integer values
+    TPL204  int32 fixed-point accumulation whose overflow bound is not
+            provable from a clip on the quantized operand
+
+plus the checked-in artifact ``tools/reduction_ledger.json`` (the
+lock_hierarchy.json analog: every cross-pod/cross-node reduction site
+with its exactness class, padding verdict, and sharding-safety note;
+regenerate with ``python tools/lint.py --write-ledger``, staleness
+fails the check.py kernelflow stage) and the runtime refuter
+``tools/padcheck.py`` (differential execution of the ledger sites'
+enclosing kernels at two bucket widths; an exact-marked site that
+diverges bitwise fails the run).
+
+Heuristics, like the rest of tpuschedlint, are deliberate: parameter
+kinds seed from the repo's naming conventions (mask/valid/ok -> bool,
+rank/perm/idx -> int, counts/anti -> integer-valued f32, everything
+else f32), attribute kinds from the snapshot schema, and local call
+returns from a two-pass summary. Anything unprovable lands at the
+top of the lattice and must be fixed or suppressed with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any, Dict, FrozenSet, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "KERNEL_SCOPE_DIRS",
+    "KERNEL_SCOPE_FILES",
+    "KernelProgram",
+    "Site",
+    "in_kernel_scope",
+    "kernel_sources",
+    "ledger_doc",
+    "load_ledger",
+    "write_ledger",
+]
+
+# ---------------------------------------------------------------------------
+# Scope.
+# ---------------------------------------------------------------------------
+
+KERNEL_SCOPE_DIRS: Tuple[str, ...] = ("tpusched/kernels/",)
+KERNEL_SCOPE_FILES: Tuple[str, ...] = (
+    "tpusched/ring.py",
+    "tpusched/mesh.py",
+    "tpusched/device_state.py",
+)
+
+#: Documented width cap of the member/pod/node axes (ROADMAP item 1
+#: targets 100k x 50k; 2**17 covers both with headroom). INTF sums are
+#: exact while bound * WIDTH_CAP < 2**24.
+WIDTH_CAP = 2 ** 17
+#: The int32 fixed-point width cap is the PR 12 documented claim
+#: ("P * (2**15 - 1) fits int32, exact for P <= 64k" at the
+#: _deal_commit quantization): bound * 2**16 <= 2**31 - 1 is the
+#: provable envelope — note the -1: a sum reaching exactly 2**31 wraps.
+INT32_WIDTH_CAP = 2 ** 16
+F32_EXACT_INT = 2.0 ** 24
+INT32_MAX = 2.0 ** 31 - 1
+
+
+def in_kernel_scope(relpath: str) -> bool:
+    return (
+        any(relpath.startswith(d) for d in KERNEL_SCOPE_DIRS)
+        or relpath in KERNEL_SCOPE_FILES
+    )
+
+
+def kernel_sources(sources: Dict[str, str]) -> Dict[str, str]:
+    """The kernel-scope subset of a product-source map."""
+    return {p: s for p, s in sources.items() if in_kernel_scope(p)}
+
+
+# ---------------------------------------------------------------------------
+# The exactness lattice.
+# ---------------------------------------------------------------------------
+
+BOOL, INT, INTF, F32 = "bool", "int", "intf", "f32"
+_LEVEL = {BOOL: 0, INT: 1, INTF: 2, F32: 3}
+
+
+@dataclasses.dataclass(frozen=True)
+class AVal:
+    """Abstract array value."""
+
+    kind: str = F32
+    #: INTF magnitude bound (max |integer value| the array can hold).
+    bound: float = float("inf")
+    #: int32 fixed-point (the clip(round(x*S)).astype(int32) idiom).
+    fixed: bool = False
+    #: clip bound of the quantized operand, when provable.
+    fixed_bound: Optional[float] = None
+    #: built by the PR 12 width-pad idiom (concatenate-with-zeros /
+    #: scatter-into-zeros(width)) — f32 prefix sums over it are
+    #: byte-identical at any view width.
+    width_padded: bool = False
+    #: provably duplicate-free integer indices (argsort/lexsort/arange).
+    unique_idx: bool = False
+    #: a scalar (argmax/argmin pick, int() cast) — trivially unique as
+    #: a scatter index.
+    scalar: bool = False
+    #: where(mask, x, +-inf): which signed infinity fills the masked
+    #: rows ("pos_inf" | "neg_inf" | None). Whether that is the
+    #: reduction's IDENTITY depends on the op's direction — +inf is
+    #: min's identity but DOMINATES a max — so the fill is recorded
+    #: signed and matched against the op at the reduction site.
+    inf_fill: Optional[str] = None
+    #: where(mask, x, c) for a non-identity constant c (pad rows are
+    #: masked, but the fill is not the reduction identity).
+    masked: bool = False
+    #: bound on the SUM of all entries (count tables: counts/anti sum
+    #: to at most the member count, so any partial sum stays exact
+    #: even though per-entry bound * width would not).
+    sum_bound: Optional[float] = None
+    #: accumulation sites whose result flows into this value.
+    taints: FrozenSet[int] = frozenset()
+
+
+def _join(a: AVal, b: AVal) -> AVal:
+    kind = a.kind if _LEVEL[a.kind] >= _LEVEL[b.kind] else b.kind
+    bound = float("inf")
+    if kind == INTF:
+        ba = a.bound if a.kind in (INTF,) else (
+            1.0 if a.kind == BOOL else a.bound)
+        bb = b.bound if b.kind in (INTF,) else (
+            1.0 if b.kind == BOOL else b.bound)
+        bound = max(ba if ba == ba else 1.0, bb if bb == bb else 1.0)
+    def _zeroish(v: AVal) -> bool:
+        return v.kind in (INT, INTF) and v.bound == 0.0
+
+    sb = None
+    if a.sum_bound is not None and b.sum_bound is not None:
+        sb = a.sum_bound + b.sum_bound
+    elif a.sum_bound is not None and _zeroish(b):
+        sb = a.sum_bound
+    elif b.sum_bound is not None and _zeroish(a):
+        sb = b.sum_bound
+    return AVal(
+        kind=kind, bound=bound,
+        fixed=a.fixed or b.fixed,
+        fixed_bound=a.fixed_bound if a.fixed_bound is not None
+        else b.fixed_bound,
+        width_padded=a.width_padded and b.width_padded,
+        unique_idx=False, scalar=a.scalar and b.scalar,
+        inf_fill=a.inf_fill if a.inf_fill == b.inf_fill else None,
+        masked=a.masked and b.masked,
+        sum_bound=sb,
+        taints=a.taints | b.taints,
+    )
+
+
+def _intf(bound: float, **kw: Any) -> AVal:
+    return AVal(kind=INTF, bound=bound, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Name/attribute kind seeds (the repo's conventions; heuristic on
+# purpose — see module docstring).
+# ---------------------------------------------------------------------------
+
+_BOOL_TOKENS = frozenset({
+    "mask", "valid", "ok", "feasible", "feas", "elig", "eligible",
+    "fits", "keep", "kept", "pend", "pending", "active", "evicted",
+    "commit", "committed", "member", "real", "allowed", "relaxed",
+    "bad", "dns", "hk", "exists", "match", "tried", "drained", "taken",
+    "claimed", "want", "roll", "rolled", "placed", "hold", "can",
+    "has", "spent", "progress", "boundary", "on", "explain", "covered",
+    "carried", "viol", "stuck", "frontier0", "matched", "soft",
+    "intol", "excl", "evict", "ev", "hit", "use", "winner", "avail",
+    "released", "conservative", "cons",
+})
+_INTF_TOKENS = frozenset({
+    "cnt", "count", "counts", "tot", "anti", "usage", "consumed",
+    "contrib", "remaining0", "chosen?", "skew", "quorum",
+})
+_INT_TOKENS = frozenset({
+    "idx", "rank", "order", "perm", "pos", "ptr", "sel", "ids", "sig",
+    "dom", "node", "choice", "cand", "target", "slot", "key", "group",
+    "pdb", "vidx", "bk", "assigned", "carry", "p", "n", "r", "t", "c",
+    "s", "b", "i", "j", "tn", "tv", "gid", "round", "rounds", "esn",
+    "assignment", "pod", "lineage",
+})
+#: Full-name seeds that beat the token tables.
+_NAME_SEEDS: Dict[str, AVal] = {
+    "requests": AVal(F32), "req": AVal(F32), "used": AVal(F32),
+    "alloc": AVal(F32), "allocatable": AVal(F32), "req_s": AVal(F32),
+    "counts": _intf(WIDTH_CAP, sum_bound=WIDTH_CAP),
+    "anti": _intf(WIDTH_CAP, sum_bound=WIDTH_CAP),
+    "match_tot": _intf(WIDTH_CAP, sum_bound=WIDTH_CAP),
+    "pdb_allowed": _intf(WIDTH_CAP, sum_bound=WIDTH_CAP),
+    "resource_weights": _intf(128, sum_bound=1024),
+    "rw": _intf(128, sum_bound=1024),
+    "pref_weight": _intf(128),
+    "sign": _intf(1.0, scalar=True),
+    # `masked` is the convention name for NEG_INF-filled score rows
+    # (feasibility holes sink below every real score).
+    "masked": AVal(F32, inf_fill="neg_inf", masked=True),
+    "score": AVal(F32), "chosen": AVal(F32), "cost": AVal(F32),
+    "prio": AVal(F32), "freed": AVal(F32), "need": AVal(F32),
+    "rank": AVal(INT, unique_idx=True),
+}
+#: Snapshot-schema attribute kinds (terminal attribute name).
+_ATTR_SEEDS: Dict[str, AVal] = {
+    "valid": AVal(BOOL), "schedulable": AVal(BOOL),
+    "tolerates_unsched": AVal(BOOL), "tolerated": AVal(BOOL),
+    "ts_valid": AVal(BOOL), "ia_valid": AVal(BOOL),
+    "ia_anti": AVal(BOOL), "ia_required": AVal(BOOL),
+    "ns_all": AVal(BOOL), "vvalid": AVal(BOOL),
+    "sig_match": AVal(BOOL), "mask": AVal(BOOL), "aff_ok": AVal(BOOL),
+    "node_idx": AVal(INT), "group": AVal(INT), "domain": AVal(INT),
+    "taint_ids": AVal(INT), "ts_sig": AVal(INT), "ia_sig": AVal(INT),
+    "anti_sig": AVal(INT), "ts_when": AVal(INT), "ts_key": AVal(INT),
+    "ia_key": AVal(INT), "key": AVal(INT), "atoms": AVal(INT),
+    "ns": AVal(INT), "namespace": AVal(INT), "pdb_group": AVal(INT),
+    "op": AVal(INT), "pairs": AVal(INT), "label_pairs": AVal(INT),
+    "label_keys": AVal(INT), "pod_group": AVal(INT),
+    "perm": AVal(INT, unique_idx=True), "vidx": AVal(INT),
+    "vpdb": AVal(INT), "seg_start": AVal(INT), "node_s": AVal(INT),
+    "pdb_s": AVal(INT), "taint_effect": AVal(INT),
+    "counts": _intf(WIDTH_CAP, sum_bound=WIDTH_CAP),
+    "anti_counts": _intf(WIDTH_CAP, sum_bound=WIDTH_CAP),
+    "match_tot": _intf(WIDTH_CAP, sum_bound=WIDTH_CAP),
+    "pdb_allowed": _intf(WIDTH_CAP, sum_bound=WIDTH_CAP),
+    "group_min_member": _intf(WIDTH_CAP),
+    "ts_max_skew": _intf(WIDTH_CAP), "tt_count": _intf(64),
+    "req_term_valid": AVal(BOOL), "pref_term_valid": AVal(BOOL),
+    "req_term_atoms": AVal(INT), "pref_term_atoms": AVal(INT),
+}
+
+
+def _seed_name(name: str) -> AVal:
+    if name in _NAME_SEEDS:
+        return _NAME_SEEDS[name]
+    # Single-letter tokens only match single-letter NAMES (else
+    # `req_s` would read as an int through its "s").
+    toks = {t for t in name.split("_") if len(t) > 1 or len(name) == 1}
+    if toks & _BOOL_TOKENS:
+        return AVal(BOOL)
+    if toks & _INTF_TOKENS:
+        return _intf(WIDTH_CAP)
+    if toks & _INT_TOKENS:
+        return AVal(INT)
+    return AVal(F32)
+
+
+# ---------------------------------------------------------------------------
+# Sites.
+# ---------------------------------------------------------------------------
+
+#: Accumulation ops: result mixes many rows via +; exactness is the
+#: lattice question and padding/tree-shape matters.
+_ACCUM_OPS = frozenset({
+    "sum", "cumsum", "mean", "prod", "matmul", "einsum", "dot",
+    "tensordot", "associative_scan", "at_add",
+})
+#: Select-combine ops: order-free (min/max are associative and exact in
+#: any tree) — padding safety is about the mask fill, not the tree.
+_SELECT_OPS = frozenset({
+    "max", "min", "amax", "amin", "cummax", "cummin", "at_max",
+    "at_min", "nanquantile",
+})
+#: Ordering/selection ops: included in the ledger for the sharding
+#: inventory (cross-'n' top-k combine is ROADMAP item 1's own example)
+#: but never rule-bearing here.
+_ORDER_OPS = frozenset({
+    "argsort", "lexsort", "sort", "top_k", "argmax", "argmin",
+    "searchsorted",
+})
+_REDUCE_CALL_HEADS = frozenset({
+    "jnp", "np", "numpy", "lax", "jax",
+})
+#: Ops that mark their operands' taints as decision-feeding.
+_DECISION_OPS = frozenset({
+    "argmax", "argmin", "searchsorted", "top_k", "sort", "argsort",
+    "lexsort", "nanquantile",
+})
+
+
+@dataclasses.dataclass
+class Site:
+    path: str
+    line: int
+    col: int
+    func: str          # dotted def chain inside the module
+    root: str          # top-level enclosing function
+    op: str            # "sum", "cumsum", "at_add", "matmul", ...
+    cls: str           # "accum" | "select" | "order" | "scatter"
+    operand: str       # lattice kind of the reduced/added operand
+    axis: str          # "0", "1", "none", "-1", "(1, 3)", ...
+    exactness: str = ""
+    padding: str = ""
+    unique: Optional[str] = None   # scatter-index verdict
+    decision: bool = False         # taints a compare/argmax/...
+    compact: bool = False          # reachable from a compacted view
+    rule: Optional[str] = None
+    sharding: str = ""
+    suppressed: bool = False
+
+    def record(self) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "path": self.path, "line": self.line, "func": self.func,
+            "root": self.root, "op": self.op, "class": self.cls,
+            "operand": self.operand, "axis": self.axis,
+            "exactness": self.exactness, "padding": self.padding,
+            "decision": self.decision, "compact_reachable": self.compact,
+            "sharding": self.sharding,
+        }
+        if self.cls == "scatter":
+            rec["unique"] = self.unique
+        if self.rule:
+            rec["rule"] = self.rule
+            rec["suppressed"] = self.suppressed
+        return rec
+
+
+def _is_identity_const(node: ast.AST) -> Optional[str]:
+    """'pos_inf' | 'neg_inf' | 'zero' | 'other' for a mask fill."""
+    neg = False
+    while isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        neg = not neg
+        node = node.operand
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float)):
+        v = float(node.value)
+        if v == float("inf"):
+            return "neg_inf" if neg else "pos_inf"
+        if v == 0.0:
+            return "zero"
+        return "other"
+    name = None
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    if name == "inf":
+        return "neg_inf" if neg else "pos_inf"
+    if name == "NEG_INF":
+        return "neg_inf"
+    if name in ("LARGE", "BIG"):
+        return "other"
+    return None
+
+
+def _const_float(node: ast.AST) -> Optional[float]:
+    neg = False
+    while isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        neg = not neg
+        node = node.operand
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float)) and not isinstance(node.value, bool):
+        return -float(node.value) if neg else float(node.value)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Pow):
+        a, b = _const_float(node.left), _const_float(node.right)
+        if a is not None and b is not None:
+            try:
+                v = a ** b
+            except OverflowError:
+                return None
+            return -v if neg else v
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _axis_str(call: ast.Call) -> str:
+    for kw in call.keywords:
+        if kw.arg == "axis":
+            v = kw.value
+            if isinstance(v, ast.Constant):
+                return str(v.value)
+            if isinstance(v, ast.UnaryOp) and isinstance(v.op, ast.USub) \
+                    and isinstance(v.operand, ast.Constant):
+                return str(-v.operand.value)
+            if isinstance(v, ast.Tuple):
+                return "(" + ", ".join(
+                    str(e.value) for e in v.elts
+                    if isinstance(e, ast.Constant)) + ")"
+            return "?"
+    return "none"
+
+
+def _axis_cell_local(axis: str) -> bool:
+    """Negative axes are the repo's within-cell convention (resource,
+    term, and normalization axes); batch axes are written positive."""
+    return axis.startswith("-") or axis.startswith("(-")
+
+
+# ---------------------------------------------------------------------------
+# Per-function abstract interpretation.
+# ---------------------------------------------------------------------------
+
+
+class _FnAnalyzer:
+    """Walks one function body in statement order, maintaining a
+    name -> AVal environment, recording Sites, and marking the taints
+    of values that reach decisions (compares, arg-selections, where
+    conditions)."""
+
+    def __init__(self, prog: "KernelProgram", path: str, func: str,
+                 root: str, env: Dict[str, AVal],
+                 aliases: Dict[str, str]):
+        self.prog = prog
+        self.path = path
+        self.func = func
+        self.root = root
+        self.env = env
+        self.aliases = aliases
+        self.calls: List[str] = []
+        self.returns: List[Any] = []   # AVal or tuple of AVal
+
+    # -- entry ------------------------------------------------------------
+
+    def run(self, node: ast.AST) -> None:
+        body = getattr(node, "body", [])
+        for stmt in body:
+            self.stmt(stmt)
+
+    # -- statements -------------------------------------------------------
+
+    def stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.prog._analyze_function(
+                self.path, node, f"{self.func}.{node.name}", self.root,
+                dict(self.env), self.aliases, collector=self,
+            )
+            return
+        if isinstance(node, ast.Assign):
+            val = self.expr(node.value)
+            for tgt in node.targets:
+                self._bind(tgt, val)
+            return
+        if isinstance(node, ast.AugAssign):
+            cur = self._lookup_target(node.target)
+            val = self.expr(node.value)
+            if not isinstance(val, AVal):
+                val = AVal(F32)
+            joined = _join(cur, val)
+            if isinstance(node.op, (ast.Div,)):
+                joined = dataclasses.replace(joined, kind=F32)
+            self._bind(node.target, joined)
+            return
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            self._bind(node.target, self.expr(node.value))
+            return
+        if isinstance(node, ast.Return):
+            if node.value is not None:
+                if isinstance(node.value, ast.Tuple):
+                    self.returns.append(
+                        tuple(self.expr(e) for e in node.value.elts))
+                else:
+                    self.returns.append(self.expr(node.value))
+            return
+        if isinstance(node, ast.If):
+            self._mark_decision(self.expr(node.test))
+            before = dict(self.env)
+            for s in node.body:
+                self.stmt(s)
+            after_body = self.env
+            self.env = before
+            for s in node.orelse:
+                self.stmt(s)
+            # Join the branch environments so a value assigned in both
+            # arms carries both kinds AND both taint sets (the
+            # cum_width-vs-legacy cumsum branches of _deal_commit).
+            merged = dict(self.env)
+            for k, v in after_body.items():
+                if k in merged and isinstance(v, AVal) \
+                        and isinstance(merged[k], AVal) \
+                        and merged[k] is not v:
+                    merged[k] = _join(merged[k], v)
+                else:
+                    merged[k] = v
+            self.env = merged
+            return
+        if isinstance(node, (ast.For, ast.While)):
+            if isinstance(node, ast.For):
+                self._bind(node.target, AVal(INT))
+                self.expr(node.iter)
+            else:
+                self._mark_decision(self.expr(node.test))
+            for s in node.body:
+                self.stmt(s)
+            for s in node.orelse:
+                self.stmt(s)
+            return
+        if isinstance(node, ast.Expr):
+            self.expr(node.value)
+            return
+        if isinstance(node, (ast.With,)):
+            for s in node.body:
+                self.stmt(s)
+            return
+        if isinstance(node, ast.Assert):
+            self.expr(node.test)
+            return
+        # Pass/Raise/Import/...: nothing array-shaped to track.
+
+    def _bind(self, tgt: ast.AST, val: Any) -> None:
+        if isinstance(tgt, ast.Name):
+            self.env[tgt.id] = val if isinstance(val, AVal) \
+                else _seed_name(tgt.id)
+            return
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            elts = tgt.elts
+            if isinstance(val, tuple) and len(val) == len(elts):
+                for e, v in zip(elts, val):
+                    self._bind(e, v)
+            else:
+                for e in elts:
+                    # Unknown tuple: fall back to name heuristics so
+                    # `feasible, score, allowed = pod_cycle(...)` still
+                    # lands bool/f32/bool.
+                    if isinstance(e, ast.Name):
+                        self.env[e.id] = _seed_name(e.id)
+                    elif isinstance(e, ast.Starred) \
+                            and isinstance(e.value, ast.Name):
+                        self.env[e.value.id] = _seed_name(e.value.id)
+            return
+        # Attribute/subscript targets: ignore (no env entry).
+
+    def _lookup_target(self, tgt: ast.AST) -> AVal:
+        if isinstance(tgt, ast.Name):
+            return self.env.get(tgt.id, _seed_name(tgt.id))
+        return AVal(F32)
+
+    # -- expressions ------------------------------------------------------
+
+    def expr(self, node: ast.AST) -> Any:
+        if isinstance(node, ast.Constant):
+            return self._const(node)
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, _seed_name(node.id))
+        if isinstance(node, ast.Attribute):
+            return self._attr(node)
+        if isinstance(node, ast.Tuple):
+            return tuple(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.List):
+            vals = [self.expr(e) for e in node.elts]
+            out = AVal(BOOL)
+            for v in vals:
+                if isinstance(v, AVal):
+                    out = _join(out, v)
+            return out
+        if isinstance(node, ast.BinOp):
+            return self._binop(node)
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self.expr(v)
+            return AVal(BOOL)
+        if isinstance(node, ast.Compare):
+            ops = [self.expr(node.left)] + [
+                self.expr(c) for c in node.comparators]
+            for v in ops:
+                self._mark_decision(v)
+            return AVal(BOOL)
+        if isinstance(node, ast.UnaryOp):
+            v = self.expr(node.operand)
+            if isinstance(node.op, (ast.Not, ast.Invert)):
+                if isinstance(v, AVal) and v.kind == BOOL:
+                    return v
+                return AVal(BOOL) if isinstance(node.op, ast.Not) else v
+            return v
+        if isinstance(node, ast.Subscript):
+            base = self.expr(node.value)
+            sl = self.expr(node.slice)
+            if isinstance(base, AVal):
+                # A gather preserves the element kind but loses the
+                # positional guarantees (uniqueness, width padding,
+                # sum bounds); a scalar index yields a scalar pick.
+                scalar = isinstance(sl, AVal) and sl.scalar \
+                    and not isinstance(node.slice, ast.Slice)
+                return dataclasses.replace(
+                    base, unique_idx=False, width_padded=False,
+                    sum_bound=None, scalar=base.scalar or scalar)
+            return AVal(F32)
+        if isinstance(node, ast.IfExp):
+            self._mark_decision(self.expr(node.test))
+            a, b = self.expr(node.body), self.expr(node.orelse)
+            if isinstance(a, AVal) and isinstance(b, AVal):
+                return _join(a, b)
+            return a if isinstance(a, AVal) else b
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+            self.expr(node.elt)
+            return AVal(F32)
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        if isinstance(node, ast.Lambda):
+            # Walk the body (lax.cond branches are lambdas calling the
+            # real kernels — the call graph must see through them).
+            saved = dict(self.env)
+            for a in node.args.args:
+                self.env.setdefault(a.arg, _seed_name(a.arg))
+            self.expr(node.body)
+            self.env = saved
+            return AVal(F32)
+        if isinstance(node, ast.JoinedStr):
+            return AVal(F32)
+        if isinstance(node, ast.Slice):
+            return AVal(INT)
+        return AVal(F32)
+
+    def _const(self, node: ast.Constant) -> AVal:
+        v = node.value
+        if isinstance(v, bool):
+            return AVal(BOOL, scalar=True)
+        if isinstance(v, int):
+            return AVal(INT, bound=abs(float(v)), scalar=True)
+        if isinstance(v, float):
+            if v != v or v in (float("inf"), float("-inf")):
+                return AVal(F32, scalar=True)
+            if float(v).is_integer():
+                return _intf(abs(v), scalar=True)
+            return AVal(F32, scalar=True)
+        # Strings (einsum specs, mode flags) are lattice-neutral.
+        return AVal(BOOL, scalar=True)
+
+    def _attr(self, node: ast.Attribute) -> AVal:
+        d = _dotted(node)
+        if d in ("jnp.inf", "np.inf", "math.inf"):
+            return AVal(F32, scalar=True)
+        term = node.attr
+        if term in _ATTR_SEEDS:
+            return _ATTR_SEEDS[term]
+        base = None
+        if not isinstance(node.value, ast.Name) or \
+                node.value.id not in _REDUCE_CALL_HEADS:
+            base = self.expr(node.value) if not isinstance(
+                node.value, ast.Name) else self.env.get(node.value.id)
+        if term == "T" and isinstance(base, AVal):
+            return base
+        if term in ("shape", "ndim", "size", "dtype"):
+            return AVal(INT, scalar=True)
+        return _seed_name(term)
+
+    def _binop(self, node: ast.BinOp) -> AVal:
+        a, b = self.expr(node.left), self.expr(node.right)
+        if not isinstance(a, AVal):
+            a = AVal(F32)
+        if not isinstance(b, AVal):
+            b = AVal(F32)
+        if isinstance(node.op, ast.MatMult):
+            return self._accum_site(
+                node, "matmul", _join(a, b), axis="contract",
+                operands=(a, b))
+        out = _join(a, b)
+        if isinstance(node.op, ast.Div):
+            out = dataclasses.replace(out, kind=F32)
+        elif isinstance(node.op, (ast.Add, ast.Sub)) and out.kind == INTF:
+            out = dataclasses.replace(out, bound=a.bound + b.bound)
+        elif isinstance(node.op, ast.Mult) and out.kind == INTF:
+            out = dataclasses.replace(
+                out, bound=max(a.bound, 1.0) * max(b.bound, 1.0))
+        elif isinstance(node.op, (ast.FloorDiv, ast.Mod, ast.LShift,
+                                  ast.RShift, ast.BitAnd, ast.BitOr,
+                                  ast.BitXor)):
+            pass
+        elif isinstance(node.op, ast.Pow):
+            out = dataclasses.replace(out, kind=F32)
+        return out
+
+    # -- calls ------------------------------------------------------------
+
+    def _call(self, node: ast.Call) -> Any:
+        fn = node.func
+        # `.at[idx].<op>(v)` scatter chain.
+        if (isinstance(fn, ast.Attribute)
+                and fn.attr in ("add", "set", "max", "min", "mul", "get")
+                and isinstance(fn.value, ast.Subscript)
+                and isinstance(fn.value.value, ast.Attribute)
+                and fn.value.value.attr == "at"):
+            return self._scatter(node, fn)
+
+        name = _dotted(fn)
+        term = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if term in ("concatenate", "stack", "hstack", "vstack"):
+            # Handled before the generic arg sweep: _concat evaluates
+            # the element list itself (a second walk would double-
+            # record any reduction site inside it).
+            return self._concat(node, [])
+        args = [self.expr(a) for a in node.args]
+        for kw in node.keywords:
+            self.expr(kw.value)
+        avals = [a for a in args if isinstance(a, AVal)]
+        arg0 = avals[0] if avals else AVal(F32)
+
+        head = name.split(".", 1)[0] if name else None
+        is_module_call = head in _REDUCE_CALL_HEADS or (
+            head is not None and self.aliases.get(head, "").split(".")[0]
+            in ("jax", "numpy"))
+        is_method = isinstance(fn, ast.Attribute) and not is_module_call
+
+        if term in ("astype",) and is_method:
+            return self._astype(node, fn)
+        if is_method and term in ("sum", "cumsum", "mean", "prod",
+                                  "max", "min", "any", "all"):
+            base = self.expr(fn.value)
+            if not isinstance(base, AVal):
+                base = AVal(F32)
+            if term in ("any", "all"):
+                return AVal(BOOL)
+            if term in ("max", "min"):
+                return self._select_site(node, term, base)
+            return self._accum_site(node, term, base, operands=(base,))
+        if not is_module_call:
+            # Local/cross-module kernel call: use the summarized return
+            # kind when the callee is in scope.
+            resolved = self._resolve_call(name, term)
+            if resolved is not None:
+                self.calls.append(resolved)
+                ret = self.prog._returns.get(resolved)
+                if ret is not None:
+                    return ret
+            if term in ("int", "float", "len", "round", "bool", "abs",
+                        "range", "enumerate", "zip"):
+                if term == "float":
+                    return AVal(F32, scalar=True)
+                if term == "bool":
+                    return AVal(BOOL, scalar=True)
+                return AVal(INT, scalar=True)
+            # Unknown local call (nested def, helper without a
+            # summary): None makes _bind fall back to the target's
+            # NAME heuristic instead of poisoning it with F32.
+            return None
+
+        # jnp/lax/np builders and reductions.
+        if term in ("zeros", "ones", "empty", "zeros_like", "ones_like",
+                    "full", "full_like", "asarray", "array", "arange",
+                    "linspace"):
+            return self._builder(node, term, args)
+        if term == "where":
+            return self._where(node, args)
+        if term == "clip":
+            return arg0
+        if term in ("maximum", "minimum", "mod", "abs", "round",
+                    "floor", "ceil", "sign"):
+            out = arg0
+            for v in avals[1:]:
+                out = _join(out, v)
+            if term == "round" and out.kind == F32:
+                # round() makes the VALUES integral; the bound is the
+                # enclosing clip's job (see _astype / TPL204).
+                out = dataclasses.replace(out, kind=INTF,
+                                          bound=float("inf"))
+            return out
+        if term in ("sqrt", "exp", "log", "power", "divide",
+                    "true_divide", "reciprocal", "nan_to_num"):
+            return AVal(F32)
+        if term in ("isfinite", "isnan", "isinf", "logical_and",
+                    "logical_or", "logical_not", "isin"):
+            return AVal(BOOL)
+        if term in ("any", "all"):
+            return AVal(BOOL)
+        if term == "pad":
+            return dataclasses.replace(arg0, width_padded=True)
+        if term in ("broadcast_to", "reshape", "transpose", "squeeze",
+                    "expand_dims", "tile", "flip", "take_along_axis",
+                    "take", "select", "roll"):
+            if term == "select":
+                out = AVal(BOOL)
+                got = False
+                for v in avals[1:]:
+                    out = _join(out, v)
+                    got = True
+                return out if got else arg0
+            return dataclasses.replace(
+                arg0, unique_idx=False) if avals else AVal(F32)
+        if term in ("sum", "cumsum", "mean", "prod", "einsum", "dot",
+                    "tensordot", "matmul", "associative_scan"):
+            if term == "associative_scan":
+                operand = args[1] if len(args) > 1 else AVal(F32)
+                if isinstance(operand, tuple):
+                    o = AVal(BOOL)
+                    for v in operand:
+                        if isinstance(v, AVal):
+                            o = _join(o, v)
+                    operand = o
+                if not isinstance(operand, AVal):
+                    operand = AVal(F32)
+                return self._accum_site(node, term, operand,
+                                        operands=(operand,))
+            if term == "einsum":
+                op = AVal(BOOL)
+                for v in avals:
+                    op = _join(op, v)
+                return self._accum_site(node, term, op, operands=tuple(avals))
+            if term in ("dot", "tensordot", "matmul"):
+                op = arg0
+                for v in avals[1:]:
+                    op = _join(op, v)
+                return self._accum_site(node, term, op,
+                                        operands=tuple(avals))
+            return self._accum_site(node, term, arg0, operands=(arg0,))
+        if term in ("max", "min", "amax", "amin", "nanquantile"):
+            return self._select_site(node, term, arg0)
+        if term in ("cummax", "cummin"):
+            return self._select_site(node, term, arg0)
+        if term in ("argsort", "lexsort", "argmax", "argmin",
+                    "searchsorted", "top_k", "sort"):
+            for v in avals:
+                self._mark_decision(v)
+            key = arg0 if term != "lexsort" else (
+                avals[-1] if avals else AVal(F32))
+            self._order_site(node, term, key)
+            if term == "sort":
+                return arg0
+            if term == "top_k":
+                return (arg0, AVal(INT))
+            if term in ("argsort", "lexsort"):
+                return AVal(INT, unique_idx=True)
+            if term == "searchsorted":
+                return AVal(INT)
+            # argmax/argmin without axis give a scalar pick.
+            has_axis = any(kw.arg == "axis" for kw in node.keywords)
+            return AVal(INT, scalar=not has_axis)
+        if term in ("ppermute", "psum", "pmax", "pmin", "all_gather"):
+            # Cross-device collectives (ring.py): psum of f32 is the
+            # sharding hazard itself; record as accumulation.
+            if term == "psum":
+                return self._accum_site(node, term, arg0, operands=(arg0,))
+            return arg0
+        if term in ("int32", "int64", "float32", "float64", "uint32",
+                    "bool_", "int8"):
+            if term.startswith("int") or term.startswith("uint"):
+                return dataclasses.replace(arg0, kind=INT)
+            if term.startswith("float"):
+                if arg0.kind in (BOOL, INT):
+                    return dataclasses.replace(arg0, kind=INTF,
+                                               bound=arg0.bound)
+                return arg0
+            return AVal(BOOL)
+        if term in ("scan", "while_loop", "cond", "fori_loop", "map",
+                    "vmap", "jit", "tree", "tree_map", "debug", "print",
+                    "stop_gradient", "device_put"):
+            return AVal(F32)
+        return AVal(F32)
+
+    def _resolve_call(self, name: Optional[str],
+                      term: Optional[str]) -> Optional[str]:
+        if name is None and term is None:
+            return None
+        if name and "." in name:
+            head, rest = name.split(".", 1)
+            mod = self.aliases.get(head)
+            if mod:
+                cand = f"{mod}.{rest}"
+                if cand in self.prog._fn_index:
+                    return cand
+        if name and name in self.aliases:
+            cand = self.aliases[name]
+            if cand in self.prog._fn_index:
+                return cand
+        if term:
+            mod = self.path_module()
+            cand = f"{mod}.{term}"
+            if cand in self.prog._fn_index:
+                return cand
+        return None
+
+    def path_module(self) -> str:
+        return self.path[:-3].replace("/", ".")
+
+    def _builder(self, node: ast.Call, term: str,
+                 args: List[Any]) -> AVal:
+        dtype = None
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                dtype = _dotted(kw.value) or (
+                    kw.value.id if isinstance(kw.value, ast.Name) else None)
+        for a in node.args:
+            d = _dotted(a)
+            if d and d.split(".")[-1] in ("int32", "int64", "bool_",
+                                          "float32", "bool"):
+                dtype = d
+        if isinstance(node.args[-1] if node.args else None, ast.Name) \
+                and node.args[-1].id == "bool":
+            dtype = "bool"
+        kind = None
+        if dtype:
+            t = dtype.split(".")[-1]
+            if t in ("bool", "bool_"):
+                kind = BOOL
+            elif t.startswith("int") or t.startswith("uint"):
+                kind = INT
+            elif t.startswith("float"):
+                kind = F32
+        if term in ("zeros", "zeros_like", "empty"):
+            if kind in (BOOL, INT):
+                return AVal(kind)
+            return _intf(0.0)
+        if term in ("ones", "ones_like"):
+            if kind in (BOOL, INT):
+                return AVal(kind)
+            return _intf(1.0)
+        if term in ("full", "full_like"):
+            fill = self.expr(node.args[1]) if len(node.args) > 1 else \
+                AVal(F32)
+            if kind in (BOOL, INT):
+                return AVal(kind)
+            return fill if isinstance(fill, AVal) else AVal(F32)
+        if term == "arange":
+            if kind == F32:
+                return _intf(WIDTH_CAP, unique_idx=True)
+            return AVal(INT, unique_idx=True)
+        if term in ("asarray", "array"):
+            base = self.expr(node.args[0]) if node.args else AVal(F32)
+            if not isinstance(base, AVal):
+                base = AVal(F32)
+            if kind == INT:
+                return dataclasses.replace(base, kind=INT)
+            if kind == BOOL:
+                return AVal(BOOL)
+            if kind == F32 and base.kind in (BOOL, INT):
+                return _intf(max(base.bound, 1.0))
+            return base
+        if term == "linspace":
+            return AVal(F32)
+        return AVal(F32)
+
+    def _where(self, node: ast.Call, args: List[Any]) -> AVal:
+        if len(node.args) != 3:
+            return args[0] if args and isinstance(args[0], AVal) \
+                else AVal(F32)
+        cond = args[0] if isinstance(args[0], AVal) else AVal(BOOL)
+        self._mark_decision(cond)
+        a = args[1] if isinstance(args[1], AVal) else AVal(F32)
+        b = args[2] if isinstance(args[2], AVal) else AVal(F32)
+        out = _join(a, b)
+        out = dataclasses.replace(out, taints=out.taints | cond.taints)
+        fill = _is_identity_const(node.args[2])
+        if fill in ("pos_inf", "neg_inf"):
+            return dataclasses.replace(out, inf_fill=fill, masked=True)
+        if fill is not None:
+            return dataclasses.replace(out, masked=True, inf_fill=None)
+        return out
+
+    def _astype(self, node: ast.Call, fn: ast.Attribute) -> AVal:
+        base = self.expr(fn.value)
+        if not isinstance(base, AVal):
+            base = AVal(F32)
+        dt = None
+        if node.args:
+            dt = _dotted(node.args[0])
+            if dt is None and isinstance(node.args[0], ast.Name):
+                dt = node.args[0].id
+        t = (dt or "").split(".")[-1]
+        if t in ("bool", "bool_"):
+            return dataclasses.replace(base, kind=BOOL)
+        if t.startswith("int") or t.startswith("uint"):
+            if base.kind == F32 or (base.kind == INTF
+                                    and base.bound == float("inf")):
+                # The fixed-point idiom: clip(round(x*S), -B, B)
+                # .astype(int32). Provable bound only through the clip.
+                bound = self._clip_bound(fn.value)
+                return AVal(INT, fixed=True, fixed_bound=bound,
+                            taints=base.taints)
+            return dataclasses.replace(base, kind=INT)
+        if t.startswith("float"):
+            if base.kind in (BOOL,):
+                return dataclasses.replace(base, kind=INTF, bound=1.0,
+                                           sum_bound=base.sum_bound)
+            if base.kind == INT:
+                return dataclasses.replace(
+                    base, kind=INTF,
+                    bound=base.bound if base.bound == base.bound
+                    else WIDTH_CAP)
+            return base
+        return base
+
+    @staticmethod
+    def _clip_bound(node: ast.AST) -> Optional[float]:
+        """|bound| of a jnp.clip(..., -B, B) wrapping the quantized
+        operand; None when no clip (or unbounded) — the TPL204 case."""
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, (ast.Attribute, ast.Name))):
+            return None
+        term = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else node.func.id
+        if term != "clip":
+            return None
+        if len(node.args) < 3:
+            return None
+        lo = _const_float(node.args[1])
+        hi = _const_float(node.args[2])
+        if lo is None or hi is None:
+            return None
+        return max(abs(lo), abs(hi))
+
+    def _concat(self, node: ast.Call, args: List[Any]) -> AVal:
+        parts: List[AVal] = []
+        pad_zero = False
+        if node.args and isinstance(node.args[0], (ast.List, ast.Tuple)):
+            for e in node.args[0].elts:
+                v = self.expr(e)
+                if isinstance(v, AVal):
+                    parts.append(v)
+                if isinstance(e, ast.Call):
+                    d = _dotted(e.func)
+                    if d and d.split(".")[-1] in ("zeros", "zeros_like",
+                                                  "ones"):
+                        pad_zero = True
+        out = AVal(BOOL)
+        for v in parts:
+            out = _join(out, v)
+        if pad_zero:
+            # The PR 12 width-pad idiom: concatenate real rows with an
+            # explicit zero block out to a fixed width.
+            out = dataclasses.replace(out, width_padded=True)
+        return out
+
+    # -- site recording ---------------------------------------------------
+
+    def _new_site(self, node: ast.AST, op: str, cls: str,
+                  operand: AVal, axis: str) -> Site:
+        site = Site(
+            path=self.path, line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0), func=self.func,
+            root=self.root, op=op, cls=cls, operand=operand.kind,
+            axis=axis,
+        )
+        self.prog.sites.append(site)
+        return site
+
+    def _accum_site(self, node: ast.AST, op: str, operand: AVal,
+                    axis: Optional[str] = None,
+                    operands: Tuple[AVal, ...] = ()) -> AVal:
+        if axis is None:
+            axis = _axis_str(node) if isinstance(node, ast.Call) else "none"
+        if _axis_cell_local(axis):
+            # Within-cell accumulation (resource/term axes): excluded
+            # from the cross-pod/cross-node inventory.
+            out_kind = INTF if operand.kind in (BOOL, INT, INTF) \
+                else F32
+            if op == "mean":
+                out_kind = F32
+            return AVal(out_kind,
+                        bound=operand.bound * 8 if out_kind == INTF
+                        else float("inf"),
+                        taints=operand.taints)
+        site = self._new_site(node, op, "accum", operand, axis)
+        idx = len(self.prog.sites) - 1
+        self._classify_accum(site, operand, op)
+        out_taints = operand.taints | {idx}
+        if op == "mean":
+            return AVal(F32, taints=out_taints)
+        if operand.kind == BOOL:
+            return AVal(INT, bound=WIDTH_CAP, taints=out_taints)
+        if operand.kind == INT:
+            return AVal(INT, bound=operand.bound * WIDTH_CAP,
+                        fixed=operand.fixed,
+                        fixed_bound=operand.fixed_bound,
+                        taints=out_taints)
+        if operand.kind == INTF:
+            return AVal(INTF, bound=operand.bound * WIDTH_CAP,
+                        taints=out_taints)
+        return AVal(F32, taints=out_taints)
+
+    def _classify_accum(self, site: Site, operand: AVal, op: str) -> None:
+        if op == "mean":
+            site.exactness = ("integer-exact"
+                              if operand.kind in (BOOL, INT)
+                              or (operand.kind == INTF
+                                  and operand.bound * WIDTH_CAP
+                                  < F32_EXACT_INT)
+                              else "f32-order-sensitive")
+            site.padding = "hazard"
+            site.sharding = ("denominator is the axis width — recompute "
+                             "from a mask count, never from shape, "
+                             "before sharding")
+            return
+        if operand.fixed:
+            site.exactness = "int32-fixed-point"
+            ok = (operand.fixed_bound is not None
+                  and operand.fixed_bound * INT32_WIDTH_CAP <= INT32_MAX)
+            site.padding = "exact" if ok else "overflow-unproven"
+            site.sharding = (
+                f"safe-any-tree (int32 adds; |q| <= "
+                f"{operand.fixed_bound:g}, the documented P*2^15 cap)"
+                if ok else
+                "int32 sum bound unproven — clip the quantized operand")
+            return
+        if operand.kind in (BOOL, INT):
+            site.exactness = "integer-exact"
+            site.padding = "exact"
+            site.sharding = "safe-any-tree (integer adds)"
+            return
+        if operand.kind == INTF:
+            if operand.bound * WIDTH_CAP < F32_EXACT_INT or (
+                    operand.sum_bound is not None
+                    and operand.sum_bound < F32_EXACT_INT):
+                site.exactness = "integer-exact"
+                site.padding = "exact"
+                site.sharding = (
+                    "safe-any-tree (integer-valued f32; "
+                    + (f"table sums to <= {operand.sum_bound:g}"
+                       if operand.bound * WIDTH_CAP >= F32_EXACT_INT
+                       else f"bound {operand.bound:g} * 2^17 < 2^24")
+                    + ")")
+                return
+            site.exactness = "f32-order-sensitive"
+            site.padding = "hazard"
+            site.sharding = ("integer-valued but bound exceeds f32 "
+                             "exact range — convert to int32 before "
+                             "sharding")
+            return
+        if operand.width_padded:
+            site.exactness = "f32-order-sensitive"
+            site.padding = "safe-width-padded"
+            site.sharding = ("byte-stable at the padded width; pad to "
+                             "the GLOBAL width before sharding this "
+                             "axis")
+            return
+        site.exactness = "f32-order-sensitive"
+        site.padding = "hazard"
+        site.sharding = ("tree/layout-sensitive — needs int32 "
+                        "conversion, width padding, or an ordered "
+                        "segmented reduce before sharding")
+
+    def _select_site(self, node: ast.AST, op: str, operand: AVal) -> AVal:
+        axis = _axis_str(node) if isinstance(node, ast.Call) else "none"
+        site = self._new_site(node, op, "select", operand, axis)
+        idx = len(self.prog.sites) - 1
+        site.exactness = ("integer-exact"
+                          if operand.kind in (BOOL, INT)
+                          or (operand.kind == INTF
+                              and operand.bound < F32_EXACT_INT)
+                          else "order-free-select")
+        # The identity must match the op's DIRECTION: +inf is min's
+        # identity but DOMINATES a max (and vice versa) — a
+        # wrong-signed infinity fill makes every padded row the
+        # reduction's winner, the worst possible pad value.
+        identity = {"min": "pos_inf", "amin": "pos_inf",
+                    "cummin": "pos_inf", "at_min": "pos_inf",
+                    "max": "neg_inf", "amax": "neg_inf",
+                    "cummax": "neg_inf", "at_max": "neg_inf",
+                    "nanquantile": None}.get(op)
+        if operand.inf_fill is not None and operand.inf_fill == identity:
+            site.padding = "identity-masked"
+            site.sharding = "safe-any-tree (min/max, identity mask)"
+        elif operand.inf_fill is not None:
+            site.padding = "dominating-fill"
+            site.sharding = (f"{operand.inf_fill} fill WINS a {op} — "
+                             "padded/sharded rows dominate the result; "
+                             "flip the fill to the op's identity")
+        elif operand.masked:
+            site.padding = "masked-select"
+            site.sharding = ("min/max over a non-identity mask fill — "
+                             "mask must cover every padded row on "
+                             "every shard")
+        else:
+            site.padding = "unmasked-select"
+            site.sharding = ("min/max with no mask — padded rows "
+                             "participate; mask with the op identity "
+                             "before sharding")
+        return dataclasses.replace(operand, taints=operand.taints | {idx},
+                                   inf_fill=None, masked=False,
+                                   width_padded=False, unique_idx=False)
+
+    def _order_site(self, node: ast.AST, op: str, key: AVal) -> None:
+        site = self._new_site(node, op, "order", key, "key")
+        site.exactness = ("integer-exact"
+                          if key.kind in (BOOL, INT)
+                          or (key.kind == INTF
+                              and key.bound < F32_EXACT_INT)
+                          else "f32-keyed-select")
+        site.padding = "key-order"
+        site.sharding = ("stable for integer keys; f32 keys need a "
+                         "globally-unique tiebreak before a cross-"
+                         "shard merge" if site.exactness != "integer-exact"
+                         else "safe with a cross-shard merge by key")
+
+    def _scatter(self, node: ast.Call, fn: ast.Attribute) -> AVal:
+        base = self.expr(fn.value.value.value)
+        if not isinstance(base, AVal):
+            base = AVal(F32)
+        idx_node = fn.value.slice
+        idx = self.expr(idx_node)
+        idxs: List[AVal] = []
+        idx_nodes: List[ast.AST] = []
+        if isinstance(idx_node, ast.Tuple):
+            idx_nodes = list(idx_node.elts)
+            idxs = [v if isinstance(v, AVal) else AVal(INT)
+                    for v in (idx if isinstance(idx, tuple) else [idx])]
+        else:
+            idx_nodes = [idx_node]
+            idxs = [idx if isinstance(idx, AVal) else AVal(INT)]
+        val = self.expr(node.args[0]) if node.args else AVal(F32)
+        if not isinstance(val, AVal):
+            val = AVal(F32)
+        out = _join(base, val)
+        # Scatter into an explicitly-built zeros buffer is the PR 12
+        # rank-major width-pad idiom (absent rows stay exact zero at a
+        # declared width): prefix sums over it are width-invariant.
+        base_node = fn.value.value.value
+        zeros_base = (isinstance(base_node, ast.Call)
+                      and isinstance(base_node.func, (ast.Attribute,
+                                                      ast.Name))
+                      and (_dotted(base_node.func) or "").split(".")[-1]
+                      in ("zeros", "zeros_like"))
+        out = dataclasses.replace(
+            out, width_padded=base.width_padded or zeros_base)
+        if fn.attr in ("set", "get", "mul"):
+            # .set duplicates ride the documented identical-content
+            # idiom (kernels/assign.py:536); not a reduction.
+            return out
+        op = f"at_{fn.attr}"
+        if fn.attr in ("max", "min"):
+            site = self._new_site(node, op, "select", val, "scatter")
+            site.exactness = ("integer-exact"
+                              if val.kind in (BOOL, INT)
+                              or (val.kind == INTF
+                                  and val.bound < F32_EXACT_INT)
+                              else "order-free-select")
+            site.padding = "exact"
+            site.sharding = "safe-any-tree (scatter-combine by min/max)"
+            return out
+        # at_add: the duplicate-index question.
+        site = self._new_site(node, op, "scatter", val, "scatter")
+        sidx = len(self.prog.sites) - 1
+        unique, why = self._scatter_unique(idx_nodes, idxs, node)
+        site.unique = why
+        if val.fixed:
+            site.exactness = "int32-fixed-point"
+        elif val.kind in (BOOL, INT) or (
+                val.kind == INTF and val.bound * WIDTH_CAP < F32_EXACT_INT):
+            site.exactness = "integer-exact"
+        else:
+            site.exactness = "f32-order-sensitive"
+        if site.exactness != "f32-order-sensitive":
+            site.padding = "exact"
+            site.sharding = ("safe-any-order (integer-valued adds "
+                             "commute exactly)")
+        elif unique:
+            site.padding = "exact"
+            site.sharding = ("duplicate-free indices (" + why + ") — "
+                             "one add per slot in any order")
+        else:
+            site.padding = "hazard"
+            site.sharding = ("duplicate f32 adds apply in unspecified "
+                             "order — convert to unique-per-segment "
+                             "totals (_node_add) before sharding")
+        return dataclasses.replace(out, taints=out.taints | {sidx})
+
+    def _scatter_unique(self, idx_nodes: List[ast.AST],
+                        idxs: List[AVal],
+                        call: ast.Call) -> Tuple[bool, str]:
+        if any(v.unique_idx for v in idxs):
+            return True, "unique-by-perm"
+        if all(v.scalar for v in idxs):
+            return True, "scalar-index"
+        # The _node_add masked-segment idiom: idx = where(mask, x, c)
+        # and the added value = where(mask', y, 0) — duplicates add
+        # exact 0.0 at a parked slot; real rows are the caller-proven
+        # unique segment ends.
+        def _where_mask(n: ast.AST) -> Optional[str]:
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "where" and len(n.args) == 3):
+                for sub in ast.walk(n.args[0]):
+                    if isinstance(sub, ast.Name):
+                        return sub.id
+                return "?"
+            return None
+
+        idx_mask = None
+        for n in idx_nodes:
+            m = _where_mask(n)
+            if m is not None:
+                idx_mask = m
+        val_mask = _where_mask(call.args[0]) if call.args else None
+        if idx_mask is not None and val_mask is not None:
+            return True, "masked-segment"
+        return False, "unproven"
+
+    def _mark_decision(self, val: Any) -> None:
+        if isinstance(val, AVal):
+            for s in val.taints:
+                if s < len(self.prog.sites):
+                    self.prog.sites[s].decision = True
+        elif isinstance(val, tuple):
+            for v in val:
+                self._mark_decision(v)
+
+
+# ---------------------------------------------------------------------------
+# Whole-kernel-scope program.
+# ---------------------------------------------------------------------------
+
+
+def _file_aliases(tree: ast.Module) -> Dict[str, str]:
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and not node.level:
+            for a in node.names:
+                if a.name != "*":
+                    aliases[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+    return aliases
+
+
+class KernelProgram:
+    """The kernel-scope dataflow index: every reduction/scatter Site,
+    the call graph over the scope, compacted-view reachability, and the
+    ledger/report/rule surfaces."""
+
+    #: Functions that GATHER a compacted pod-axis view; everything they
+    #: reach runs (also) on view-width arrays.
+    COMPACT_GATHERS = ("_pods_view", "_top_by_rank")
+
+    def __init__(self, sources: Dict[str, str]):
+        self.sources = {p: s for p, s in sources.items()
+                        if in_kernel_scope(p)}
+        self.sites: List[Site] = []
+        #: qualname ("tpusched.kernels.assign.fn") -> relpath
+        self._fn_index: Dict[str, str] = {}
+        self._fn_nodes: Dict[str, ast.AST] = {}
+        self._fn_aliases: Dict[str, Dict[str, str]] = {}
+        self._returns: Dict[str, Any] = {}
+        self.calls: Dict[str, List[str]] = {}
+        self._trees: Dict[str, ast.Module] = {}
+        for path in sorted(self.sources):
+            try:
+                tree = ast.parse(self.sources[path], filename=path)
+            except SyntaxError:
+                continue
+            self._trees[path] = tree
+            mod = path[:-3].replace("/", ".")
+            aliases = _file_aliases(tree)
+            for node in tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    q = f"{mod}.{node.name}"
+                    self._fn_index[q] = path
+                    self._fn_nodes[q] = node
+                    self._fn_aliases[q] = aliases
+                elif isinstance(node, ast.ClassDef):
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            q = f"{mod}.{node.name}.{item.name}"
+                            self._fn_index[q] = path
+                            self._fn_nodes[q] = item
+                            self._fn_aliases[q] = aliases
+        # Two passes: pass 1 summarizes return kinds, pass 2 re-runs
+        # with cross-function returns resolved (and keeps its sites).
+        for _ in range(2):
+            self.sites = []
+            self.calls = {}
+            for q in sorted(self._fn_nodes):
+                self._analyze_top(q)
+        self._mark_compact_reachable()
+
+    # -- analysis ---------------------------------------------------------
+
+    def _param_env(self, node: ast.AST) -> Dict[str, AVal]:
+        env: Dict[str, AVal] = {}
+        args = node.args
+        for a in list(args.posonlyargs) + list(args.args) \
+                + list(args.kwonlyargs):
+            env[a.arg] = _seed_name(a.arg)
+        return env
+
+    def _analyze_top(self, qualname: str) -> None:
+        node = self._fn_nodes[qualname]
+        path = self._fn_index[qualname]
+        root = qualname[len(path[:-3].replace("/", ".")) + 1:]
+        self._analyze_function(
+            path, node, root, root.split(".")[0] if "." in root else root,
+            self._param_env(node), self._fn_aliases[qualname],
+            collector=None, qualname=qualname,
+        )
+
+    def _analyze_function(self, path: str, node: ast.AST, func: str,
+                          root: str, env: Dict[str, AVal],
+                          aliases: Dict[str, str],
+                          collector: Optional[_FnAnalyzer],
+                          qualname: Optional[str] = None) -> None:
+        for a in list(node.args.posonlyargs) + list(node.args.args) \
+                + list(node.args.kwonlyargs):
+            env.setdefault(a.arg, _seed_name(a.arg))
+        an = _FnAnalyzer(self, path, func, root, env, aliases)
+        an.run(node)
+        mod = path[:-3].replace("/", ".")
+        key = qualname or f"{mod}.{root}"
+        self.calls.setdefault(key, []).extend(an.calls)
+        if collector is not None:
+            collector.calls.extend(an.calls)
+        if qualname is not None:
+            ret: Any = None
+            for r in an.returns:
+                if ret is None:
+                    ret = r
+                elif isinstance(ret, AVal) and isinstance(r, AVal):
+                    ret = _join(ret, r)
+            if ret is not None:
+                # Taints are site indices of the CURRENT pass; a
+                # summarized return must not leak them across passes.
+                if isinstance(ret, AVal):
+                    ret = dataclasses.replace(ret, taints=frozenset())
+                elif isinstance(ret, tuple):
+                    ret = tuple(
+                        dataclasses.replace(v, taints=frozenset())
+                        if isinstance(v, AVal) else v for v in ret)
+                self._returns[qualname] = ret
+
+    def _mark_compact_reachable(self) -> None:
+        roots = set()
+        for q, callees in self.calls.items():
+            names = {c.rsplit(".", 1)[-1] for c in callees}
+            if names & set(self.COMPACT_GATHERS):
+                roots.add(q)
+        reached = set(roots)
+        frontier = list(roots)
+        while frontier:
+            q = frontier.pop()
+            for c in self.calls.get(q, ()):
+                if c in self._fn_index and c not in reached:
+                    reached.add(c)
+                    frontier.append(c)
+        reach_roots = {q.rsplit(".", 1)[-1] for q in reached}
+        for s in self.sites:
+            if s.root in reach_roots and in_kernel_scope(s.path):
+                s.compact = True
+
+    # -- reachability for padcheck ---------------------------------------
+
+    def reachable_from(self, entry_names: Iterable[str]) -> "set[str]":
+        """Top-level function ROOT names (module-unqualified) reachable
+        from the given entry function names, used by tools/padcheck.py
+        to map harnesses to covered ledger sites."""
+        wanted = set(entry_names)
+        starts = [q for q in self._fn_index
+                  if q.rsplit(".", 1)[-1] in wanted]
+        seen = set(starts)
+        frontier = list(starts)
+        while frontier:
+            q = frontier.pop()
+            for c in self.calls.get(q, ()):
+                if c in self._fn_index and c not in seen:
+                    seen.add(c)
+                    frontier.append(c)
+        return {q.rsplit(".", 1)[-1] for q in seen} | wanted
+
+    # -- rule surfaces ----------------------------------------------------
+
+    def classify_rules(self) -> None:
+        """Assign rule ids to the hazardous sites (idempotent)."""
+        for s in self.sites:
+            s.rule = None
+            if s.cls == "accum" and s.exactness == "int32-fixed-point" \
+                    and s.padding == "overflow-unproven":
+                s.rule = "TPL204"
+            elif s.cls == "scatter" and s.padding == "hazard":
+                s.rule = "TPL203"
+            elif s.cls == "accum" \
+                    and s.exactness == "f32-order-sensitive" \
+                    and s.padding == "hazard":
+                if s.decision:
+                    s.rule = "TPL201"
+                elif s.compact:
+                    s.rule = "TPL202"
+
+    def sites_for(self, relpath: str) -> List[Site]:
+        return [s for s in self.sites if s.path == relpath]
+
+    # -- artifacts --------------------------------------------------------
+
+    def ledger_doc(self,
+                   suppressed: Optional[Dict[str, Dict[int, "set[str]"]]]
+                   = None) -> Dict[str, Any]:
+        self.classify_rules()
+        if suppressed:
+            for s in self.sites:
+                if s.rule:
+                    s.suppressed = s.rule in suppressed.get(
+                        s.path, {}).get(s.line, set())
+        recs = sorted(
+            (s.record() for s in self.sites),
+            key=lambda r: (r["path"], r["line"], r["op"], r["axis"]),
+        )
+        counts: Dict[str, int] = {}
+        for r in recs:
+            counts[r["exactness"]] = counts.get(r["exactness"], 0) + 1
+        findings = [r for r in recs if r.get("rule")]
+        return {
+            "version": 1,
+            "scope": sorted(self.sources),
+            "sites": recs,
+            "totals": {
+                "sites": len(recs),
+                "by_exactness": dict(sorted(counts.items())),
+                "findings": len(findings),
+                "unsuppressed": len(
+                    [r for r in findings if not r.get("suppressed")]),
+            },
+        }
+
+    def report_lines(self) -> List[str]:
+        self.classify_rules()
+        out = []
+        for s in sorted(self.sites,
+                        key=lambda s: (s.path, s.line, s.op)):
+            tag = f" {s.rule}" + ("(suppressed)" if s.suppressed else "") \
+                if s.rule else ""
+            flags = []
+            if s.decision:
+                flags.append("decision")
+            if s.compact:
+                flags.append("compact")
+            fl = f" [{','.join(flags)}]" if flags else ""
+            out.append(
+                f"{s.path}:{s.line}: {s.op}({s.operand}, axis={s.axis}) "
+                f"in {s.func} — {s.exactness} / {s.padding}{fl}{tag}\n"
+                f"    sharding: {s.sharding}"
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Artifact I/O (the lock_hierarchy.json pattern).
+# ---------------------------------------------------------------------------
+
+
+def ledger_doc(program: KernelProgram,
+               suppressed: Optional[Dict[str, Dict[int, "set[str]"]]]
+               = None) -> Dict[str, Any]:
+    return program.ledger_doc(suppressed)
+
+
+def write_ledger(path: Path, doc: Dict[str, Any]) -> None:
+    Path(path).write_text(
+        json.dumps(doc, indent=2, sort_keys=True) + "\n")
+
+
+def load_ledger(path: Path) -> Optional[Dict[str, Any]]:
+    p = Path(path)
+    if not p.exists():
+        return None
+    return json.loads(p.read_text())
+
+
+# ---------------------------------------------------------------------------
+# The TPL201-204 rules (duck-typed against lint.rules.Rule so this
+# module never imports rules.py — rules.py imports KERNEL_RULES from
+# here and appends them to RULES).
+# ---------------------------------------------------------------------------
+
+from tpusched.lint.engine import Finding  # noqa: E402  (bottom import: Finding only — engine never imports kernelflow at module top, so no cycle)
+
+
+class _KernelRule:
+    rule_id = "TPL2xx"
+    title = ""
+    incident = ""
+
+    def applies(self, relpath: str) -> bool:
+        return in_kernel_scope(relpath)
+
+    def check(self, tree: ast.Module, src: str, relpath: str,
+              ctx: Any, parents: Dict[ast.AST, ast.AST]) -> List[Finding]:
+        prog = ctx.kernel_view(relpath, src)
+        prog.classify_rules()
+        return [
+            Finding(relpath, s.line, self.rule_id, self.message(s))
+            for s in prog.sites_for(relpath) if s.rule == self.rule_id
+        ]
+
+    def message(self, site: Site) -> str:
+        raise NotImplementedError
+
+
+class OrderSensitiveDecisionReduction(_KernelRule):
+    """An f32 sum/cumsum/contraction whose result flows into a
+    commit/compare decision (Compare, argmax/argmin, searchsorted,
+    top_k, a where condition) is bitwise-stable only at a fixed width
+    on a fixed backend: XLA reductions are tree-shaped, the tree
+    changes with width/layout/sharding, and a flipped last-ulp compare
+    moves a placement. PR 12 hit exactly this converting the commit
+    rounds to compacted views; ROADMAP item 1's psum boundaries re-open
+    it for every site left unconverted.
+    """
+
+    rule_id = "TPL201"
+    title = "f32 order-sensitive reduction feeds a commit/compare decision"
+    incident = ("PR 12 construction notes: XLA f32 tree reductions are "
+                "not invariant under zero-padding or layout changes — "
+                "desirability sums had to become int32 fixed point")
+
+    def message(self, site: Site) -> str:
+        return (f"f32 order-sensitive {site.op} feeds a commit/compare "
+                "decision — the result depends on the reduction tree "
+                "(width/layout/sharding); convert to int32 fixed point, "
+                "an integer-valued form, or a width-padded layout "
+                "(ledger: tools/reduction_ledger.json)")
+
+
+class PaddingHazardOnCompactedPath(_KernelRule):
+    """A padding-hazardous f32 accumulation in a function reachable
+    from a compacted-view gather (_pods_view/_top_by_rank) runs on
+    view-width arrays: zero-padding or a view-width change can move
+    its result bitwise, silently violating the frontier-compaction
+    contract. TPL201 covers the decision-feeding subset; this rule
+    covers the rest of the compacted surface.
+    """
+
+    rule_id = "TPL202"
+    title = "padding-hazardous reduction reachable from a compacted view"
+    incident = ("ISSUE 12 bitwise contract: compacted [cap, N] rounds "
+                "must equal full-width rounds byte-for-byte; the "
+                "width-padded cumsum idiom exists because plain f32 "
+                "cumsums do not")
+
+    def message(self, site: Site) -> str:
+        return (f"padding-hazardous f32 {site.op} on a compacted-view "
+                "path — pad the operand to an explicit fixed width "
+                "(the _node_add/_deal_commit cum_width idiom) or move "
+                "it to an exact class")
+
+
+class NonUniqueScatterAdd(_KernelRule):
+    """``.at[idx].add(v)`` with duplicate-capable indices and
+    non-integer f32 values applies the duplicates in UNSPECIFIED order,
+    so the result depends on the pod-axis layout. Recognized safe
+    forms: integer-valued adds (commute exactly), provably unique
+    indices (argsort/lexsort perms, arange, scalar picks), and the
+    masked-segment idiom (_node_add: duplicates add exact 0.0).
+    """
+
+    rule_id = "TPL203"
+    title = "scatter-add with non-unique indices and f32 values"
+    incident = ("PR 12: _node_add replaced the order-unspecified "
+                "duplicate f32 scatter-add that made `used` depend on "
+                "the pod-axis layout")
+
+    def message(self, site: Site) -> str:
+        return ("duplicate-capable f32 scatter-add applies in "
+                "unspecified order (layout-dependent result) — use "
+                "unique-per-segment totals (_node_add), a perm/arange "
+                "index, or integer-valued adds")
+
+
+class FixedPointOverflowUnproven(_KernelRule):
+    """An int32 fixed-point accumulation whose quantized operand is not
+    clipped to a bound B with B * 2**16 <= 2**31 (the documented
+    "P * 2**15 fits int32" cap) can silently wrap at scale; wrapping is
+    deterministic nonsense, which is worse than noise.
+    """
+
+    rule_id = "TPL204"
+    title = "int32 fixed-point sum without a provable overflow bound"
+    incident = ("PR 12 _deal_commit quantization: clip(round(x*16), "
+                "-2^15, 2^15) is the pattern that makes the bound "
+                "provable")
+
+    def message(self, site: Site) -> str:
+        return ("int32 fixed-point accumulation without a provable "
+                "bound — clip the quantized operand to +-B with "
+                "B * 2^16 <= 2^31 before astype(int32)")
+
+
+KERNEL_RULES: Tuple[type, ...] = (
+    OrderSensitiveDecisionReduction,
+    PaddingHazardOnCompactedPath,
+    NonUniqueScatterAdd,
+    FixedPointOverflowUnproven,
+)

@@ -21,6 +21,7 @@ from typing import TYPE_CHECKING, Iterator
 
 from tpusched.lint import interproc
 from tpusched.lint.engine import Finding
+from tpusched.lint.kernelflow import KERNEL_RULES
 
 if TYPE_CHECKING:
     from tpusched.lint.engine import LintContext
@@ -1120,6 +1121,9 @@ RULES = (
     PerCallJitConstruction,
     UnboundedJitFamily,
     JitClosureOverMutableState,
+    # Kernel dataflow analysis (round 20, ISSUE 15) — defined in
+    # kernelflow.py next to the abstract interpreter they read.
+    *KERNEL_RULES,
 )
 
 

@@ -51,6 +51,7 @@ pick_node_batch), so parity holds for any seed in both engine modes.
 from __future__ import annotations
 
 import dataclasses
+from typing import Any, Callable
 
 import numpy as np
 
@@ -89,17 +90,18 @@ class OracleResult:
     evicted: np.ndarray | None = None  # [M] bool preemption victims
 
 
-def _np(x) -> np.ndarray:
+def _np(x: Any) -> np.ndarray:
     return np.asarray(x)
 
 
 class Oracle:
-    def __init__(self, snap: ClusterSnapshot, config: EngineConfig):
+    def __init__(self, snap: ClusterSnapshot,
+                 config: EngineConfig) -> None:
         self.snap = snap
         self.cfg = config
         self.nodes = snap.nodes
         self.pods = snap.pods
-        self._atom_sat_nodes = None
+        self._atom_sat_nodes: np.ndarray | None = None
         # Preemption state: evicted running pods stop counting as
         # members everywhere (capacity, pairwise counts, anti holders).
         self._evicted = np.zeros(_np(snap.running.valid).shape[0], bool)
@@ -807,7 +809,8 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
                     cands.add(int(m))
         return sorted(cands)[:_TAG_CAND_CAP]
 
-    def _gang_tag(p: int, n: int, others: list, check) -> str:
+    def _gang_tag(p: int, n: int, others: "list[tuple[int, int]]",
+                  check: "Callable[[list[int], list[int]], Any]") -> str:
         """' [gang-optimism]' iff some tried hypothetical restoration
         of the unplaced gang members satisfies the constraint."""
         if not restorable:
@@ -837,7 +840,8 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
     if evicted is not None and evicted.any() and snap.sigs.key.shape[0]:
         ora_noev = Oracle(snap, cfg)
 
-    def _both(check_fn, p, on, op, n):
+    def _both(check_fn: "Callable[..., np.ndarray]", p: int,
+              on: "list[int]", op: "list[int]", n: int) -> bool:
         """True iff the check FAILS under both eviction timings."""
         if check_fn(ora, p, on, op)[n]:
             return False
@@ -912,7 +916,9 @@ def validate_assignment(snap: ClusterSnapshot, cfg: EngineConfig,
 # ---------------------------------------------------------------------------
 
 
-def _atom_sat_row(key, op, pairs, num, lp, lk, ln) -> np.ndarray:
+def _atom_sat_row(key: int, op: int, pairs: np.ndarray, num: float,
+                  lp: np.ndarray, lk: np.ndarray,
+                  ln: np.ndarray) -> np.ndarray:
     """Satisfaction of one atom over label arrays lp/lk/ln of shape [X, L]."""
     pair_set = pairs[pairs >= 0]
     any_pair = np.isin(lp, pair_set).any(axis=1) if pair_set.size else np.zeros(lp.shape[0], bool)
