@@ -21,7 +21,10 @@ Usage: python bench.py [--pods N] [--nodes N] [--iters N] [--only NAME]
        [--serve-clients K] [--serve-cycles N]
        [--serve-what both|assign|score]
 NAME in {headline, pairwise, gangs, preemption, pipeline, e2e, wire,
-serving, divergence, warm, ledger}.
+serving, divergence, warm, ledger, multichip}. The multichip bench
+(sharded serving over the (p,n) device mesh, incl. the 100k x 50k
+sharded headline) runs only when >1 device is visible and skips with a
+stderr note otherwise.
 """
 
 from __future__ import annotations
@@ -1330,6 +1333,114 @@ def bench_warm(args):
         engine.close()
 
 
+def bench_multichip(args):
+    """MULTICHIP: sharded serving across the (p,n) device mesh (round
+    22, ISSUE 17). Runs only when the backend exposes >1 device —
+    skipped gracefully (one stderr line, no metric) otherwise, so the
+    default single-device run is unchanged.
+
+    Three phases:
+      1. serve_qps_sharded_<shape> + solve_p99_latency_<shape>_sharded:
+         the packed serving solve on a mesh engine consuming a
+         canonically-sharded snapshot (Engine.put) — the
+         pipeline.solve_stream cycle, measured end to end.
+      2. shard_combine_ms_<shape>: the cross-shard combine in
+         isolation — a [P, N] PS('p','n')-sharded tableau reduced to a
+         per-pod vector and pinned replicated (the reduce+broadcast
+         every sharded commit round pays, per the ledger's
+         safe-any-tree routing).
+      3. The 100k x 50k headline solve, sharded — the one-engine-serves
+         -the-cluster claim. Accelerator backends only: the [P, N]
+         working set at that shape is ~10^10 cells, far past what the
+         forced-host-device CPU mesh (a debugging topology) can hold,
+         so on cpu it logs the skip instead of thrashing.
+    """
+    import jax
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        log("[multichip] 1 jax device — sharded serving bench skipped")
+        return
+    from tpusched import Engine, EngineConfig
+    from tpusched.mesh import make_mesh, matrix_sharding
+    from tpusched.shardctx import constrain_replicated
+    from tpusched.synth import config2_scale
+
+    mesh_shape = (ndev // 2, 2) if ndev % 2 == 0 else (ndev, 1)
+    mesh = make_mesh(mesh_shape)
+    on_cpu = jax.default_backend() == "cpu"
+    log(f"[multichip] mesh {mesh_shape} over {ndev} "
+        f"{jax.default_backend()} device(s)")
+
+    # Phase 1+2 shape: the headline serving shape on accelerators; a
+    # small stand-in on a forced-device CPU mesh (where 10k x 5k fast
+    # solves take minutes and measure the host, not the sharding).
+    pods, nodes = (2000, 1000) if on_cpu else (args.pods, args.nodes)
+    shape = f"{pods}x{nodes}"
+    cfg = EngineConfig(mode="fast", compact_cap=8)
+    eng = Engine(cfg, mesh=mesh)
+    try:
+        snap, _meta = _build(config2_scale, np.random.default_rng(21),
+                             pods, nodes, with_qos=True)
+        dev = eng.put(snap)
+        fn = lambda: eng._solve_packed_jit(dev)  # noqa: E731
+        t0 = time.perf_counter()
+        materialize(fn())
+        log(f"  compile+first-run {time.perf_counter() - t0:.1f}s")
+        iters = min(args.iters, 30 if on_cpu else args.iters)
+        stats = bench_fn(fn, iters, label=f"multichip {shape}")
+        emit(f"solve_p99_latency_{shape}_sharded", stats,
+             {"mesh": list(mesh_shape), "mode": "fast"})
+        qline = {"metric": f"serve_qps_sharded_{shape}",
+                 "value": round(1.0 / stats["mean"], 3), "unit": "qps",
+                 "direction": "higher", "mesh": list(mesh_shape),
+                 "iters": stats["iters"]}
+        if TRANSPORT:
+            qline["rtt_ms"] = TRANSPORT["rtt_ms"]
+        log(f"serve_qps_sharded_{shape}: {qline['value']}")
+        print(json.dumps(qline), flush=True)
+
+        # Phase 2: the combine tree in isolation, at the engine's real
+        # bucket widths.
+        Pb = int(np.asarray(dev.pods.valid).shape[0])
+        Nb = int(np.asarray(dev.nodes.valid).shape[0])
+        mat = jax.device_put(
+            np.random.default_rng(3).random((Pb, Nb)).astype(np.float32),
+            matrix_sharding(mesh))
+        combine = jax.jit(
+            lambda m: constrain_replicated(m.sum(axis=1), mesh))
+        materialize(combine(mat))  # compile
+        cstats = bench_fn(lambda: combine(mat), iters,
+                          label=f"combine {shape}")
+        emit(f"shard_combine_ms_{shape}", cstats,
+             {"mesh": list(mesh_shape), "matrix": [Pb, Nb]})
+    finally:
+        eng.close()
+
+    # Phase 3: the 100k x 50k headline.
+    if on_cpu:
+        log("[multichip] cpu backend — the 100000x50000 sharded "
+            "headline runs on accelerator meshes only (skipped)")
+        return
+    bp, bn = 100_000, 50_000
+    eng = Engine(cfg, mesh=mesh)
+    try:
+        snap, _meta = _build(config2_scale, np.random.default_rng(22),
+                             bp, bn, with_qos=True)
+        dev = eng.put(snap)
+        fn = lambda: eng._solve_packed_jit(dev)  # noqa: E731
+        t0 = time.perf_counter()
+        materialize(fn())
+        log(f"  compile+first-run {time.perf_counter() - t0:.1f}s")
+        stats = bench_fn(fn, max(5, min(args.iters, 20)), warmup=1,
+                         label=f"multichip {bp}x{bn}")
+        emit(f"solve_p99_latency_{bp}x{bn}_sharded", stats,
+             {"mesh": list(mesh_shape), "mode": "fast",
+              "placements_per_sec": round(bp / stats["p50"], 1)})
+    finally:
+        eng.close()
+
+
 def bench_ledger(args):
     """Cycle flight-ledger overhead (round 18, ISSUE 13 acceptance):
     the same 2000x1000 fast solve loop run with the ledger OFF (the
@@ -1683,6 +1794,7 @@ BENCHES = {
     "explain": bench_explain,
     "warm": bench_warm,
     "ledger": bench_ledger,
+    "multichip": bench_multichip,
     # headline runs last so the final stdout line is the headline metric
     # (parity mode last within it — the stock-semantics north-star claim)
     "headline": bench_headline,

@@ -24,6 +24,12 @@ def main() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # Cross-process CPU collectives (ROADMAP item 1): without an
+    # implementation selected BEFORE backend init, this jaxlib's CPU
+    # client hard-refuses multiprocess computations ("Multiprocess
+    # computations aren't implemented on the CPU backend"). gloo/TCP
+    # rides the same distributed coordinator the TPU path uses for DCN.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     import numpy as np
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(

@@ -146,3 +146,25 @@ def test_kernelflow_metric_directions_are_registered():
               "padcheck_divergences_total"):
         assert benchdiff._EXPLICIT_DIRECTION[m] == "lower", m
         assert benchdiff.lower_is_better(m, "count", None), m
+
+
+def test_sharded_serving_metric_directions_are_registered():
+    """ISSUE 17 satellite: the multichip bench's sharded-serving
+    families are direction-pinned through the registered glob tier —
+    a sharded-qps drop or a combine/solve latency rise must always
+    trend as the regression it is, at every shape suffix."""
+    assert dict(benchdiff._EXPLICIT_DIRECTION_GLOBS) == {
+        "serve_qps_sharded_*": "higher",
+        "shard_combine_ms_*": "lower",
+        "solve_p99_latency_*_sharded": "lower",
+    }
+    assert not benchdiff.lower_is_better(
+        "serve_qps_sharded_100000x50000", "qps", None)
+    assert benchdiff.lower_is_better(
+        "shard_combine_ms_10000x5000", "ms", None)
+    assert benchdiff.lower_is_better(
+        "solve_p99_latency_100000x50000_sharded", "ms", None)
+    assert benchdiff._EXPLICIT_DIRECTION[
+        "padcheck_mesh_divergences_total"] == "lower"
+    assert benchdiff.lower_is_better(
+        "padcheck_mesh_divergences_total", "count", None)

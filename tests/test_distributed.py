@@ -26,15 +26,11 @@ def _free_port() -> int:
     return port
 
 
-@pytest.mark.xfail(
-    reason="pre-existing: this CPU backend refuses multiprocess "
-           "computations (XlaRuntimeError: 'Multiprocess computations "
-           "aren't implemented on the CPU backend'); quarantined "
-           "pending ROADMAP item 1 (make multichip real) so tier-1 "
-           "keeps a binary exit signal",
-    strict=False,
-)
 def test_two_process_mesh_solve_matches_single():
+    """ROADMAP item 1: the workers select the gloo CPU collectives
+    implementation (jax_cpu_collectives_implementation) before backend
+    init — without it this jaxlib's CPU client refuses multiprocess
+    computations outright."""
     here = os.path.dirname(os.path.abspath(__file__))
     worker = os.path.join(here, "dist_worker.py")
     port = _free_port()

@@ -2,7 +2,18 @@
 2D device meshes over the 8 virtual CPU devices provisioned by conftest
 and assert the sharded result is identical to the single-device result.
 The SPMD partitioner must insert collectives (cross-'n' argmax
-reductions, cross-'p' gathers) without changing semantics."""
+reductions, cross-'p' gathers) without changing semantics.
+
+ROADMAP item 1 (the PR 6 quarantine, removed here): true-2D meshes —
+both axes > 1 — diverged because this jax version's partitioner
+mis-routes the replicated|'p'-sharded member-merge concatenates. The
+kernels now thread the mesh down to explicit sharding constraints at
+those merges (tpusched/shardctx.py), and every shape is bit-exact.
+Each sharded step is a FRESH closure over its mesh: jax caches traced
+jaxprs per (function identity, avals) — shardings only enter at
+lowering — so reusing one function object across meshes would silently
+reuse the first trace's constraints (shardctx module docstring).
+"""
 
 import numpy as np
 import pytest
@@ -14,25 +25,10 @@ from tpusched.kernels.assign import score_batch, solve_rounds, solve_sequential
 from tpusched.mesh import make_mesh, matrix_sharding, shard_snapshot, snapshot_shardings
 from tpusched.synth import make_cluster
 
-
-# Quarantine (ROADMAP item 5, first slice): these exact cases have
-# failed identically since the seed — sharded solves diverge from the
-# single-device reference on meshes that split the node axis (and the
-# two-process CPU backend can't run collectives at all). ROADMAP item 1
-# ("shard the serving path over the (p,n) mesh") owns the real fix;
-# until then they are xfail(strict=False) so tier-1 regains a binary
-# exit signal — a fix flips them to XPASS without breaking the run,
-# and any NEW failure elsewhere is no longer drowned in these six.
-_ROADMAP1_XFAIL = pytest.mark.xfail(
-    reason="pre-existing sharded-solve divergence; quarantined pending "
-           "ROADMAP item 1 (make multichip real)",
-    strict=False,
-)
-
 MESH_SHAPES = [
     (8, 1),
-    pytest.param((4, 2), marks=_ROADMAP1_XFAIL),
-    pytest.param((2, 4), marks=_ROADMAP1_XFAIL),
+    (4, 2),
+    (2, 4),
     (1, 8),
 ]
 
@@ -57,19 +53,23 @@ def test_snapshot_shardings_builds(rng):
     assert len(flat_snap) == len(flat_spec)
 
 
+def _seq_step(cfg, mesh=None):
+    def step(s):
+        node_sat_t, member_sat_t = _sat_tables(s, mesh)
+        return solve_sequential(cfg, s, node_sat_t, member_sat_t,
+                                mesh=mesh)
+    return step
+
+
 @pytest.mark.parametrize("shape", MESH_SHAPES)
 def test_sharded_sequential_matches_single(rng, shape):
     snap, _ = _snap(rng)
     cfg = EngineConfig()
 
-    def step(s):
-        node_sat_t, member_sat_t = _sat_tables(s)
-        return solve_sequential(cfg, s, node_sat_t, member_sat_t)
-
-    single = jax.jit(step)(snap)
+    single = jax.jit(_seq_step(cfg))(snap)
     mesh = make_mesh(shape, devices=jax.devices()[: shape[0] * shape[1]])
     sharded_in = shard_snapshot(mesh, snap)
-    sharded = jax.jit(step)(sharded_in)
+    sharded = jax.jit(_seq_step(cfg, mesh))(sharded_in)
     np.testing.assert_array_equal(np.asarray(single[0]), np.asarray(sharded[0]))
     np.testing.assert_allclose(
         np.asarray(single[2]), np.asarray(sharded[2]), rtol=1e-6
@@ -77,38 +77,44 @@ def test_sharded_sequential_matches_single(rng, shape):
 
 
 @pytest.mark.parametrize("shape", [
-    pytest.param((4, 2), marks=_ROADMAP1_XFAIL),
+    (4, 2),
     (1, 8),
 ])
 def test_sharded_fast_matches_single(rng, shape):
     snap, _ = _snap(rng)
     cfg = EngineConfig(mode="fast")
 
-    def step(s):
-        node_sat_t, member_sat_t = _sat_tables(s)
-        return solve_rounds(cfg, s, node_sat_t, member_sat_t)
+    def mk(mesh=None):
+        def step(s):
+            node_sat_t, member_sat_t = _sat_tables(s, mesh)
+            return solve_rounds(cfg, s, node_sat_t, member_sat_t,
+                                mesh=mesh)
+        return step
 
-    single = jax.jit(step)(snap)
+    single = jax.jit(mk())(snap)
     mesh = make_mesh(shape, devices=jax.devices()[: shape[0] * shape[1]])
-    sharded = jax.jit(step)(shard_snapshot(mesh, snap))
+    sharded = jax.jit(mk(mesh))(shard_snapshot(mesh, snap))
     np.testing.assert_array_equal(np.asarray(single[0]), np.asarray(sharded[0]))
 
 
 @pytest.mark.parametrize("shape", [
-    pytest.param((2, 4), marks=_ROADMAP1_XFAIL),
+    (2, 4),
 ])
 def test_sharded_score_batch_matches_single(rng, shape):
     snap, _ = _snap(rng)
     cfg = EngineConfig()
 
-    def step(s):
-        node_sat_t, member_sat_t = _sat_tables(s)
-        return score_batch(cfg, s, node_sat_t, member_sat_t)
+    def mk(mesh=None):
+        def step(s):
+            node_sat_t, member_sat_t = _sat_tables(s, mesh)
+            return score_batch(cfg, s, node_sat_t, member_sat_t,
+                               mesh=mesh)
+        return step
 
-    f1, s1 = jax.jit(step)(snap)
+    f1, s1 = jax.jit(mk())(snap)
     mesh = make_mesh(shape, devices=jax.devices()[:8])
     jitted = jax.jit(
-        step, out_shardings=(matrix_sharding(mesh), matrix_sharding(mesh))
+        mk(mesh), out_shardings=(matrix_sharding(mesh), matrix_sharding(mesh))
     )
     f2, s2 = jitted(shard_snapshot(mesh, snap))
     np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
@@ -120,7 +126,6 @@ def test_default_mesh_uses_all_devices():
     assert mesh.devices.size == len(jax.devices())
 
 
-@_ROADMAP1_XFAIL
 def test_dryrun_multichip_entry():
     """The driver-facing dryrun must pass in-process (8 devices here)."""
     import __graft_entry__ as g

@@ -1,0 +1,244 @@
+"""Sharded serving end-to-end (ROADMAP item 1, the PR 17 tentpole):
+one engine + one device-resident lineage spanning the (p,n) mesh.
+
+Contracts pinned here:
+  * DELTA PARITY — a mesh-sharded DeviceSnapshot fed the same
+    full_load + delta applies as an unsharded lineage holds
+    bit-identical arrays (value churn, row-reorder insertions,
+    removals, node_idx remaps all ride O(churn) scatters on SHARDED
+    arrays), and the final layout is the canonical one
+    (mesh.snapshot_shardings) after every apply.
+  * WARM == COLD, SHARDED — the warm-tableau path (dirty-row refresh,
+    reorder perms) on a sharded lineage places bitwise-identically to
+    a cold solve of the same sharded snapshot AND to a single-device
+    engine on the unsharded twin, every churn cycle. This is the
+    tests/test_warm.py twin contract lifted onto a true-2D mesh, where
+    the partitioner needs the shardctx constraint pins (member merges,
+    the packed-result concat) to stay correct at all.
+  * FRONTIER COMPACTION, SHARDED — compacted commit rounds
+    (compact_cap) on sharded snapshots == full-width sharded solve,
+    byte for byte; the incremental path's in-kernel audit stays clean.
+  * ONE-DEVICE PARITY PIN — an engine on a trivial 1-device mesh is
+    BITWISE the single-device engine on solve, packed solve, and
+    score: the sharded serving stack degrades to exactly the old
+    engine when there is nothing to shard over.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+import pytest
+import jax
+
+from tpusched import Engine, EngineConfig
+from tpusched.device_state import DeviceSnapshot
+from tpusched.divergence import warm_churn_stream
+from tpusched.mesh import make_mesh, snapshot_shardings
+from tpusched.synth import make_cluster
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8 virtual CPU devices"
+)
+
+
+def _records(rng, n_pods=14, n_nodes=6, n_running=4):
+    nodes, pods, running = make_cluster(
+        rng, n_pods, n_nodes, as_records=True, spread_frac=0.3,
+        interpod_frac=0.3, run_anti_frac=0.15, namespace_count=2,
+        selector_frac=0.2, taint_frac=0.15, toleration_frac=0.2,
+        n_running_per_node=max(1, n_running // n_nodes),
+    )
+    return list(nodes), list(pods), list(running)
+
+
+def _assert_bitwise(a, b, context: str):
+    np.testing.assert_array_equal(
+        np.asarray(a.assignment), np.asarray(b.assignment),
+        err_msg=f"assignment diverged {context}")
+    np.testing.assert_array_equal(
+        np.asarray(a.chosen_score), np.asarray(b.chosen_score),
+        err_msg=f"chosen_score diverged {context}")
+    np.testing.assert_array_equal(
+        np.asarray(a.evicted), np.asarray(b.evicted),
+        err_msg=f"evicted diverged {context}")
+
+
+def _canonical_layout(ds: DeviceSnapshot) -> bool:
+    want = snapshot_shardings(ds.mesh, ds.snap)
+    for leaf, sh in zip(
+            jax.tree.leaves(ds.snap),
+            jax.tree.leaves(want, is_leaf=lambda x: hasattr(x, "spec"))):
+        if not leaf.sharding.is_equivalent_to(sh, leaf.ndim):
+            return False
+    return True
+
+
+def test_sharded_device_snapshot_delta_parity(rng):
+    """Sharded lineage == unsharded lineage through value churn, an
+    insertion reorder, a removal + running move, and a node insertion
+    (node_idx remap) — every apply staying on the delta path and the
+    layout staying canonical."""
+    from tpusched.config import Buckets
+
+    mesh = make_mesh((2, 4), devices=jax.devices()[:8])
+    cfg = EngineConfig()
+    nodes, pods, running = _records(rng)
+    buckets = Buckets.fit(len(pods) + 4, len(nodes) + 4, len(running) + 4)
+
+    ref = DeviceSnapshot(cfg, buckets)
+    ref.full_load(copy.deepcopy(nodes), copy.deepcopy(pods),
+                  copy.deepcopy(running))
+    ds = DeviceSnapshot(cfg, buckets, mesh=mesh)
+    ds.full_load(nodes, pods, running)
+    assert _canonical_layout(ds)
+
+    def both(**kw):
+        s1 = ref.apply(**copy.deepcopy(kw))
+        s2 = ds.apply(**kw)
+        assert s2.path == s1.path, (s1, s2)
+        return s2
+
+    pods[3] = dict(pods[3]); pods[3]["priority"] = 777.0
+    nodes[2] = dict(nodes[2])
+    nodes[2]["allocatable"] = {"cpu": 5000.0, "memory": float(24 << 30)}
+    s = both(upsert_pods=[pods[3]], upsert_nodes=[nodes[2]])
+    assert s.path == "delta" and not s.reordered
+
+    newp = dict(name="a-new-pod", requests={"cpu": 100.0, "memory": 1e8},
+                priority=5.0, labels={"app": "web"})
+    s = both(upsert_pods=[newp])
+    assert s.reordered  # name sorts first: insertion perm ran sharded
+
+    running[1] = dict(running[1]); running[1]["node"] = nodes[0]["name"]
+    both(remove_pods=[pods[1]["name"]], upsert_running=[running[1]])
+
+    newn = dict(name="a-node", allocatable={"cpu": 8000.0,
+                "memory": float(32 << 30)},
+                labels={"zone": "a"}, taints=[])
+    s = both(upsert_nodes=[newn])  # node reorder -> node_idx remap
+    assert s.path == "delta"
+
+    assert _canonical_layout(ds)
+    for g, w in zip(jax.tree.leaves(ds.snap), jax.tree.leaves(ref.snap)):
+        g, w = np.asarray(g), np.asarray(w)
+        eq = (g == w)
+        if np.issubdtype(g.dtype, np.floating):
+            eq = eq | (np.isnan(g) & np.isnan(w))
+        assert eq.all()
+
+
+def test_sharded_warm_twin_parity(rng):
+    """Warm (carried tableau + dirty-row refresh) on a (2,4)-sharded
+    lineage == cold sharded solve == single-device engine, bitwise,
+    across churn cycles with structural reorders."""
+    mesh = make_mesh((2, 4), devices=jax.devices()[:8])
+    cfg = EngineConfig(mode="fast")
+    eng = Engine(cfg, mesh=mesh)
+    ref = Engine(cfg)
+    try:
+        nodes, pods, running = _records(rng)
+        ds = DeviceSnapshot(cfg, mesh=mesh)
+        ds.full_load(nodes, pods, running)
+        ds_ref = DeviceSnapshot(cfg)
+        ds_ref.full_load(copy.deepcopy(nodes), copy.deepcopy(pods),
+                         copy.deepcopy(running))
+        for cyc, delta in enumerate(warm_churn_stream(
+                rng, nodes, pods, running, 6, churn_frac=0.2,
+                structural_every=3)):
+            ds_ref.apply(**copy.deepcopy(delta))
+            ds.apply(**delta)
+            warm = eng.solve_warm(ds)
+            cold = eng.solve(ds.snap)
+            single = ref.solve(ds_ref.snap)
+            _assert_bitwise(warm, cold, f"warm-vs-cold at cycle {cyc}")
+            _assert_bitwise(cold, single,
+                            f"sharded-vs-single at cycle {cyc}")
+        assert ds.warm_solves >= 4  # the refresh path actually served
+    finally:
+        eng.close()
+        ref.close()
+
+
+def test_sharded_frontier_compaction_and_incremental(rng):
+    """Frontier-compacted commit rounds on sharded snapshots ==
+    full-width sharded solve bitwise; the incremental warm path's
+    in-kernel audit is clean every cycle on the sharded lineage."""
+    mesh = make_mesh((4, 2), devices=jax.devices()[:8])
+    full = Engine(EngineConfig(mode="fast", compact_cap=0), mesh=mesh)
+    cmp_ = Engine(EngineConfig(mode="fast", compact_cap=8), mesh=mesh)
+    try:
+        nodes, pods, running = _records(rng, n_pods=16)
+        ds = DeviceSnapshot(full.config, mesh=mesh)
+        ds.full_load(nodes, pods, running)
+        for cyc, delta in enumerate(warm_churn_stream(
+                rng, nodes, pods, running, 4, churn_frac=0.2,
+                structural_every=2)):
+            ds.apply(**delta)
+            a = full.solve(ds.snap)
+            b = cmp_.solve(ds.snap)
+            _assert_bitwise(a, b, f"(compact) at cycle {cyc}")
+            inc = cmp_.solve_warm(ds, incremental=True)
+            if inc.inc_info is not None:
+                assert inc.inc_info["audit_violations"] == 0, inc.inc_info
+        assert ds.incremental_solves >= 3
+    finally:
+        full.close()
+        cmp_.close()
+
+
+def test_one_device_mesh_bitwise_parity_pin(rng):
+    """THE degenerate-mesh pin: Engine on a 1-device mesh is bitwise
+    the plain single-device engine on solve, the packed serving path,
+    and score — the sharded stack adds nothing when the mesh is
+    trivial (shardctx constraints gate themselves off)."""
+    mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+    cfg = EngineConfig(mode="fast")
+    sharded = Engine(cfg, mesh=mesh)
+    plain = Engine(cfg)
+    try:
+        snap, _ = make_cluster(
+            rng, 18, 6, taint_frac=0.2, selector_frac=0.2,
+            spread_frac=0.3, interpod_frac=0.3,
+        )
+        a = sharded.solve(sharded.put(snap))
+        b = plain.solve(plain.put(snap))
+        _assert_bitwise(a, b, "(1-device mesh solve)")
+        pa = np.asarray(sharded._solve_packed_jit(snap))
+        pb = np.asarray(plain._solve_packed_jit(snap))
+        np.testing.assert_array_equal(pa, pb)
+        ra = sharded.score(snap)
+        rb = plain.score(snap)
+        np.testing.assert_array_equal(np.asarray(ra.feasible),
+                                      np.asarray(rb.feasible))
+        np.testing.assert_array_equal(np.asarray(ra.scores),
+                                      np.asarray(rb.scores))
+    finally:
+        sharded.close()
+        plain.close()
+
+
+def test_engine_put_shards_and_solves_in_place(rng):
+    """Engine.put on a mesh engine lands the snapshot in the canonical
+    layout; the packed async serving path consumes it and matches the
+    single-device engine bitwise (the pipeline.solve_stream contract)."""
+    mesh = make_mesh((2, 4), devices=jax.devices()[:8])
+    cfg = EngineConfig(mode="fast")
+    eng = Engine(cfg, mesh=mesh)
+    ref = Engine(cfg)
+    try:
+        snap, _ = make_cluster(rng, 16, 6, spread_frac=0.3,
+                               interpod_frac=0.3)
+        sharded = eng.put(snap)
+        want = snapshot_shardings(mesh, snap)
+        for leaf, sh in zip(
+                jax.tree.leaves(sharded),
+                jax.tree.leaves(want, is_leaf=lambda x: hasattr(x, "spec"))):
+            assert leaf.sharding.is_equivalent_to(sh, leaf.ndim)
+        res = eng.solve_async(sharded).result()
+        single = ref.solve_async(ref.put(snap)).result()
+        _assert_bitwise(res, single, "(sharded put serving path)")
+    finally:
+        eng.close()
+        ref.close()

@@ -57,7 +57,20 @@ _EXPLICIT_DIRECTION = {
     "kernelflow_findings_total": "lower",
     "padcheck_sites_total": "lower",
     "padcheck_divergences_total": "lower",
+    # Sharded serving (round 22, ISSUE 17): any mesh-parity divergence
+    # in padcheck's forced-2-device differential is a regression.
+    "padcheck_mesh_divergences_total": "lower",
 }
+# Registered direction GLOBS (round 22, ISSUE 17): the sharded-serving
+# metric families from bench.py's multichip section. Consulted after
+# the exact-name table, before the always-higher-better names —
+# pinned here (and in tests/test_benchdiff.py) so a rename that slips
+# past the unit inference cannot silently flip a family's direction.
+_EXPLICIT_DIRECTION_GLOBS = (
+    ("serve_qps_sharded_*", "higher"),
+    ("shard_combine_ms_*", "lower"),
+    ("solve_p99_latency_*_sharded", "lower"),
+)
 
 
 def round_key(path: Path) -> str:
@@ -104,6 +117,9 @@ def lower_is_better(metric: str, unit: str,
         return direction == "lower"
     if metric in _EXPLICIT_DIRECTION:
         return _EXPLICIT_DIRECTION[metric] == "lower"
+    for glob, d in _EXPLICIT_DIRECTION_GLOBS:
+        if fnmatch.fnmatch(metric, glob):
+            return d == "lower"
     if _HIGHER_BETTER_NAME.search(metric):
         return False
     return (unit in _LOWER_BETTER_UNITS

@@ -19,11 +19,22 @@ Chains, in order:
            reduction site with its exactness class, padding verdict,
            and sharding-safety note) matches a fresh regeneration and
            every hazard site is fixed or reasoned-suppressed
+  shardcheck  tools/shardcheck.py: the implemented sharding vs the
+           ledger's SHARDING verdicts (round 22) — every verdict
+           string routes to an implemented combine tree, decision-path
+           keyed-merge/mask-cover/width-pad sites are reached by
+           padcheck's mesh differential, decision-path order-sensitive
+           sites carry reasoned suppressions, and the shardctx
+           constraint pins are still in place; fails on any mismatch
+           or a stale verdict
   padcheck  tools/padcheck.py: differentially execute the ledger
            sites' enclosing kernels at two bucket widths — an
-           exact-marked site that diverges bitwise fails, and the
-           seeded hazardous fixture must be caught; SKIPPED gracefully
-           when jax is not installed (like warmaudit)
+           exact-marked site that diverges bitwise fails, the seeded
+           hazardous fixture must be caught, and the mesh differential
+           (a forced-2-device subprocess) re-runs the ledger-covered
+           kernels on the (2,1)/(1,2) meshes demanding bitwise parity
+           with dense; SKIPPED gracefully when jax is not installed
+           (like warmaudit)
   syntax   byte-compile every tracked .py (pyflakes when the image
            has it; stdlib compile() otherwise — this image must not
            grow dependencies)
@@ -93,6 +104,11 @@ def stage_jitlint() -> "tuple[str, str]":
 
 def stage_kernelflow() -> "tuple[str, str]":
     rc, out = _run([sys.executable, "tools/lint.py", "--check-ledger"])
+    return ("ok" if rc == 0 else "FAIL"), out
+
+
+def stage_shardcheck() -> "tuple[str, str]":
+    rc, out = _run([sys.executable, "tools/shardcheck.py"])
     return ("ok" if rc == 0 else "FAIL"), out
 
 
@@ -247,6 +263,7 @@ STAGES = (
     ("lockgraph", stage_lockgraph),
     ("jitlint", stage_jitlint),
     ("kernelflow", stage_kernelflow),
+    ("shardcheck", stage_shardcheck),
     ("syntax", stage_syntax),
     ("mypy", stage_mypy),
     ("warmaudit", stage_warmaudit),

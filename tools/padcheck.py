@@ -23,17 +23,32 @@ kernelflow call graph closes over everything they reach, so eight
 harnesses cover every site in the ledger. A site whose root no harness
 reaches fails the run (no silent coverage holes).
 
+Round 22 (ISSUE 17, sharded serving) adds the MESH differential: the
+ledger's SHARDING column claims, per site, which reduction trees stay
+exact once an axis is device-sharded. The mesh harness runs the same
+ledger-covered kernels through a mesh engine on the (2,1) and (1,2)
+device meshes — each snapshot axis actually split across devices, one
+at a time — and the real rows must agree BITWISE with the dense
+single-device run. It executes in a subprocess with a forced
+2-virtual-device CPU platform (the parent may have initialised jax
+with one device, and platforms cannot be swapped after init — the same
+re-exec trick as __graft_entry__.dryrun_multichip).
+
 Run it:
 
   python tools/padcheck.py            # all harnesses + coverage gate
+                                      # + the mesh differential
   python tools/padcheck.py --self-test  # prove the refuter CAN catch a
                                         # seeded hazardous kernel
   python tools/padcheck.py --list     # harness -> covered roots table
+  python tools/padcheck.py --mesh-only  # just the mesh differential
+                                        # (needs >= 2 jax devices)
 
-Exits non-zero on any divergence-in-exact, uncovered site, or
-self-test miss. Emits bench-style metric lines
-(padcheck_sites_total / padcheck_divergences_total, both lower-better)
-so benchdiff trend-tracks analyzer coverage next to perf.
+Exits non-zero on any divergence-in-exact, uncovered site, mesh
+divergence, or self-test miss. Emits bench-style metric lines
+(padcheck_sites_total / padcheck_divergences_total /
+padcheck_mesh_divergences_total, all lower-better) so benchdiff
+trend-tracks analyzer coverage next to perf.
 """
 
 from __future__ import annotations
@@ -355,6 +370,208 @@ def _harnesses() -> List[Harness]:
 
 
 # ---------------------------------------------------------------------------
+# The mesh differential (--mesh-only; ISSUE 17). Each case runs once
+# dense (mesh=None) and once per MESH_SHAPES through the sharded
+# serving stack; real rows must be bitwise-identical. (2,1) splits the
+# pod axis across the two devices, (1,2) splits the node axis — so
+# every sharded snapshot axis crosses a real device boundary at least
+# once, which is exactly the regime the ledger's SHARDING verdicts are
+# about. The case entry lists feed tools/shardcheck.py: their
+# kernelflow closure must reach every decision-path ledger site whose
+# verdict is not safe-any-tree.
+# ---------------------------------------------------------------------------
+
+MESH_SHAPES = ((2, 1), (1, 2))
+
+#: Mesh-case -> entry kernels. Module-level (no jax needed) so
+#: tools/shardcheck.py can close over the kernelflow call graph from
+#: here without executing anything: together these entries must reach
+#: every decision-path ledger site whose SHARDING verdict is not
+#: safe-any-tree — shardcheck fails otherwise.
+MESH_CASE_ENTRIES: Dict[str, Tuple[str, ...]] = {
+    "mesh_solve_fast_sig": ("solve_rounds", "precompute_static",
+                            "atom_sat"),
+    "mesh_solve_fast_preempt": ("solve_rounds",),
+    "mesh_solve_parity_preempt": ("solve_sequential",),
+    "mesh_score_batch": ("score_batch",),
+    "mesh_solve_incremental": ("solve_incremental", "build_tableau"),
+}
+
+
+def mesh_entry_kernels() -> Tuple[str, ...]:
+    """Union of mesh-case entry kernels, declaration order, deduped."""
+    names: List[str] = []
+    for entries in MESH_CASE_ENTRIES.values():
+        names.extend(entries)
+    return tuple(dict.fromkeys(names))
+
+
+def _mesh_cases() -> List[Harness]:
+    """Mesh cases reuse the Harness shape, but run() takes a MESH
+    (None = dense single-device reference), not a pad multiplier."""
+    from tpusched import Engine
+    from tpusched.engine import _sat_tables
+
+    out: List[Harness] = []
+
+    def solve_case(name, kind, cfg_kw):
+        def run(mesh) -> Dict[str, np.ndarray]:
+            from tpusched.config import EngineConfig as EC
+            cfg = EC(**cfg_kw)
+            snap, _meta, P, M = _build(kind, 1, cfg)
+            eng = Engine(cfg, mesh=mesh)
+            try:
+                res = eng.solve(eng.put(snap))
+            finally:
+                eng.close()
+            return _solve_outputs(res, P, M, 0)
+        out.append(Harness(name, MESH_CASE_ENTRIES[name], run))
+
+    # 1/2/3: the three solve programs of the pad harness, now through a
+    # mesh engine + Engine.put (the pipeline.solve_stream serving path).
+    solve_case("mesh_solve_fast_sig", "sig",
+               dict(mode="fast", compact_cap=8))
+    solve_case("mesh_solve_fast_preempt", "preempt",
+               dict(mode="fast", preemption=True, compact_cap=8))
+    solve_case("mesh_solve_parity_preempt", "preempt",
+               dict(mode="parity", preemption=True))
+
+    # 4: the [P, N] score surface on a sharded snapshot (the matrix is
+    # PS('p','n') — both mesh axes live in one output).
+    def run_score(mesh) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+        from tpusched.config import EngineConfig as EC
+        from tpusched.kernels import assign as kassign
+        from tpusched.mesh import shard_snapshot
+        cfg = EC(mode="fast")
+        snap, _meta, P, _M = _build("sig", 1, cfg)
+        snap = (shard_snapshot(mesh, snap) if mesh is not None
+                else jax.tree.map(jnp.asarray, snap))
+        nst, mst = _sat_tables(snap, mesh)
+        feasible, score = kassign.score_batch(cfg, snap, nst, mst,
+                                              mesh=mesh)
+        return {"feasible": np.asarray(feasible)[:P, :10],
+                "score": np.asarray(score)[:P, :10]}
+
+    out.append(Harness("mesh_score_batch",
+                       MESH_CASE_ENTRIES["mesh_score_batch"], run_score))
+
+    # 5: the incremental warm rounds on a sharded snapshot (reaches
+    # _capacity_prefix_keep, the carried-placement revalidation).
+    def run_inc(mesh) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+        from tpusched.config import EngineConfig as EC
+        from tpusched.kernels import assign as kassign
+        from tpusched.mesh import shard_snapshot
+        cfg = EC(mode="fast", compact_cap=8)
+        snap, _meta, P, _M = _build("sig", 1, cfg)
+        eng = Engine(cfg, mesh=mesh)
+        try:
+            cold = eng.solve(eng.put(snap))
+        finally:
+            eng.close()
+        snap = (shard_snapshot(mesh, snap) if mesh is not None
+                else jax.tree.map(jnp.asarray, snap))
+        nst, mst = _sat_tables(snap, mesh)
+        tab = kassign.build_tableau(cfg, snap, nst, mst, mesh=mesh)
+        Pb = snap.pods.valid.shape[0]
+        carry = np.full(Pb, -1, np.int32)
+        carry[:P] = np.asarray(cold.assignment)[:P]
+        chosen = np.full(Pb, -np.inf, np.float32)
+        chosen[:P] = np.asarray(cold.chosen_score)[:P]
+        frontier = np.zeros(Pb, bool)
+        frontier[: max(2, P // 8)] = True
+        if mesh is not None:
+            # replicated commit: a single-device-committed carry mixed
+            # with mesh-sharded snapshot leaves is a placement error.
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(mesh, PartitionSpec())
+            ship = lambda x: jax.device_put(jnp.asarray(x), rep)  # noqa: E731
+        else:
+            ship = jnp.asarray
+        res = kassign.solve_incremental(
+            cfg, snap, tab, ship(carry), ship(chosen), ship(frontier),
+            None, cap=8, mesh=mesh,
+        )
+        assigned, chosen_o, _used, _order, _ro, _r, _ev, audit = res
+        return {"assignment": np.asarray(assigned)[:P],
+                "chosen_score": np.asarray(chosen_o)[:P],
+                "audit": np.asarray(audit)}
+
+    out.append(Harness("mesh_solve_incremental",
+                       MESH_CASE_ENTRIES["mesh_solve_incremental"],
+                       run_inc))
+    assert [c.name for c in out] == list(MESH_CASE_ENTRIES)
+    return out
+
+
+def mesh_main() -> int:
+    """--mesh-only body (runs inside the forced-2-device subprocess)."""
+    import jax
+    ndev = len(jax.devices())
+    if ndev < 2:
+        print(f"padcheck --mesh-only: {ndev} jax device(s); needs 2 "
+              "(run under XLA_FLAGS=--xla_force_host_platform_"
+              "device_count=2)", file=sys.stderr)
+        return 1
+    from tpusched.mesh import make_mesh
+
+    failures: List[str] = []
+    for case in _mesh_cases():
+        try:
+            base = {k: np.asarray(v) for k, v in case.run(None).items()}
+            for shape in MESH_SHAPES:
+                mesh = make_mesh(shape, devices=jax.devices()[:2])
+                got = case.run(mesh)
+                bad = [k for k, want in base.items()
+                       if not bitwise_equal(want, np.asarray(got[k]))]
+                for k in bad:
+                    failures.append(
+                        f"{case.name}@{shape}: output {k!r} diverged "
+                        "from the dense single-device run")
+                if not bad:
+                    print(f"[+] {case.name}@{shape}: bitwise-identical "
+                          "to dense")
+        except Exception as e:  # a broken case must not pass silently
+            failures.append(f"{case.name}: case crashed: {e!r}")
+    for f in failures:
+        print(f"[!] {f}", file=sys.stderr)
+    print(json.dumps({"mesh_cases": len(_mesh_cases()) * len(MESH_SHAPES),
+                      "mesh_divergences": len(failures)}))
+    return 1 if failures else 0
+
+
+def _mesh_subprocess() -> Tuple[Optional[int], str]:
+    """Dispatch --mesh-only under a forced 2-virtual-device CPU
+    platform; returns (divergence count | None on crash, output)."""
+    import os
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=2")
+    env["XLA_FLAGS"] = " ".join(flags)
+    proc = subprocess.run(
+        [sys.executable, str(Path(__file__).resolve()), "--mesh-only"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+    out = (proc.stdout + proc.stderr).strip()
+    div: Optional[int] = None
+    for line in proc.stdout.splitlines():
+        try:
+            doc = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(doc, dict) and "mesh_divergences" in doc:
+            div = int(doc["mesh_divergences"])
+    if proc.returncode != 0 and div == 0:
+        div = None  # exit code and summary disagree: treat as crash
+    return div, out
+
+
+# ---------------------------------------------------------------------------
 # The seeded hazardous fixture (--self-test): a two-op kernel whose
 # result provably moves under zero-padding — threshold against the
 # MEAN, whose denominator is the padded width. The refuter must catch
@@ -408,6 +625,9 @@ def main(argv=None) -> int:
                          "hazardous fixture")
     ap.add_argument("--list", action="store_true",
                     help="print the harness -> covered roots table")
+    ap.add_argument("--mesh-only", action="store_true",
+                    help="run only the mesh differential in-process "
+                         "(needs >= 2 jax devices)")
     args = ap.parse_args(argv)
 
     try:
@@ -416,6 +636,9 @@ def main(argv=None) -> int:
         print("padcheck: jax not installed — skipping (the static "
               "ledger gate still runs via lint.py --check-ledger)")
         return 0
+
+    if args.mesh_only:
+        return mesh_main()
 
     if args.self_test:
         ok = self_test()
@@ -434,6 +657,9 @@ def main(argv=None) -> int:
     if args.list:
         for h in harnesses:
             print(f"{h.name}: {', '.join(per_harness[h.name])}")
+        for case in _mesh_cases():
+            roots = prog.reachable_from(case.entries)
+            print(f"{case.name} [mesh]: {', '.join(sorted(roots))}")
         return 0
 
     # Which roots hold only exact-marked sites? A divergence there
@@ -483,6 +709,20 @@ def main(argv=None) -> int:
         failures.append("self-test: the refuter MISSED the seeded "
                         "hazardous fixture — a green run proves nothing")
 
+    # The mesh differential, in its own forced-2-device subprocess.
+    mesh_div, mesh_out = _mesh_subprocess()
+    for ln in mesh_out.splitlines():
+        if ln.startswith(("[+]", "[~]")):
+            print(ln)
+    if mesh_div is None:
+        failures.append("mesh differential crashed:\n" +
+                        "\n".join(mesh_out.splitlines()[-8:]))
+        mesh_div = 0
+    elif mesh_div:
+        for ln in mesh_out.splitlines():
+            if ln.startswith("[!]"):
+                failures.append(f"mesh: {ln[4:]}")
+
     total = len(ledger["sites"])
     print(json.dumps({"metric": "padcheck_sites_total",
                       "value": float(total), "unit": "count",
@@ -490,11 +730,14 @@ def main(argv=None) -> int:
     print(json.dumps({"metric": "padcheck_divergences_total",
                       "value": float(divergences), "unit": "count",
                       "direction": "lower"}))
+    print(json.dumps({"metric": "padcheck_mesh_divergences_total",
+                      "value": float(mesh_div), "unit": "count",
+                      "direction": "lower"}))
     for f in failures:
         print(f"[!] {f}", file=sys.stderr)
     print(f"padcheck: {len(harnesses)} harnesses, {total} ledger sites "
-          f"covered, {divergences} divergence(s), "
-          f"{len(failures)} failure(s)")
+          f"covered, {divergences} pad + {mesh_div} mesh "
+          f"divergence(s), {len(failures)} failure(s)")
     return 1 if failures else 0
 
 

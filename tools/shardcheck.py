@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""shardcheck: the implemented sharding vs the ledger's SHARDING column
+(round 22, ISSUE 17 — the static half of the sharded-serving gate).
+
+tools/reduction_ledger.json carries, per cross-pod/cross-node reduction
+site, a SHARDING verdict: which combine trees stay exact once the
+reduced data is split across devices, and what must happen first when
+none do. PR 17 made the serving stack mesh-native (DeviceSnapshot and
+the delta/solve path run on NamedSharding over the (p,n) mesh), so
+those verdicts are now load-bearing: a new order-sensitive reduction on
+the decision path, a removed constraint pin, or a stale verdict string
+silently un-proves the bitwise parity the sharded engine is pinned to.
+
+This tool cross-references three things and fails on any mismatch or
+staleness — without executing a single kernel (the runtime half is
+padcheck's mesh differential):
+
+  1. VERDICT FRESHNESS — every checked-in site's `sharding` string
+     matches a fresh kernelflow regeneration, and the site sets match.
+     (lint.py --check-ledger diffs the whole document; this stage names
+     the sharding-verdict drift specifically.)
+  2. ROUTE TABLE TOTALITY — every verdict string classifies into one of
+     the implemented combine routes below. A verdict the table cannot
+     place means the analyzer grew a new sharding class the serving
+     stack has no routing decision for.
+  3. ROUTE DISCHARGE — per route, the implementation witness holds:
+       any-tree     nothing needed: any reduction tree is exact.
+       width-pad    discharged structurally: sharding happens AFTER the
+                    global bucket pad (DeviceSnapshot/_put and
+                    Engine.put shard the already-padded snapshot via
+                    mesh.snapshot_shardings), so every shard sees the
+                    GLOBAL padded width. Witness: those call sites.
+       keyed-merge /
+       mask-cover   decision-path, unsuppressed sites must be reached
+                    by padcheck's mesh differential (MESH_CASE_ENTRIES
+                    closure over the kernelflow call graph) — the
+                    harness that actually splits each axis across two
+                    devices and demands bitwise parity with dense.
+       pre-reduce   order-sensitive f32 combines: exactness cannot be
+                    promised under ANY cross-device tree, so a
+                    decision-path site must carry a reasoned
+                    suppression in the ledger (= acknowledged latent
+                    hazard, kept off the sharded axes) — an
+                    unsuppressed one fails.
+     Plus the constraint-pin witnesses: the files that keep the 2D-mesh
+     partitioner honest (tpusched/shardctx.py pins at the member-merge
+     and packed-result concats) must still use them — removing a pin
+     only breaks true-2D meshes, which single-device CI cannot see.
+
+Run it:  python tools/shardcheck.py          (wired as the check.py
+`shardcheck` stage). Exits non-zero on any failure; prints the per-
+route census so drift is visible in the stage output.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import Counter
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+sys.path.insert(0, str(REPO_ROOT / "tools"))
+
+from tpusched.lint import kernelflow  # noqa: E402
+from tpusched.lint.engine import parse_suppressions  # noqa: E402
+from tpusched.lint.interproc import scan_product_sources  # noqa: E402
+
+import padcheck  # noqa: E402  (tools/padcheck.py — MESH_CASE_ENTRIES)
+
+LEDGER_PATH = REPO_ROOT / "tools" / "reduction_ledger.json"
+
+#: verdict string -> implemented combine route. Substring rules, first
+#: match wins; a verdict no rule places fails the run (rule 2).
+ROUTE_RULES: Tuple[Tuple[str, str], ...] = (
+    ("safe-any-tree", "any-tree"),
+    ("safe-any-order", "any-tree"),
+    ("duplicate-free indices", "any-tree"),
+    ("pad to the GLOBAL width", "width-pad"),
+    ("merge by key", "keyed-merge"),
+    ("tiebreak before a cross-shard merge", "keyed-merge"),
+    ("mask must cover", "mask-cover"),
+    ("mask with the op identity", "mask-cover"),
+    ("recompute from a mask count", "mask-cover"),
+    ("convert to unique-per-segment totals", "pre-reduce"),
+    ("convert to int32 before sharding", "pre-reduce"),
+    ("ordered segmented reduce before sharding", "pre-reduce"),
+)
+
+#: (file, required token) — the constraint pins and shard call sites
+#: whose removal un-proves sharded parity without any single-device
+#: test noticing (rule 3's witnesses).
+PIN_WITNESSES: Tuple[Tuple[str, str], ...] = (
+    # the member-merge concat + label-sat pin (2D-mesh partitioner
+    # mis-routes mixed-sharding concats without them)
+    ("tpusched/kernels/pairwise.py", "constrain_replicated"),
+    # the packed-result concat pin on the serving path
+    ("tpusched/engine.py", "constrain_replicated"),
+    # the gate itself
+    ("tpusched/shardctx.py", "def constrain_replicated"),
+    # width-pad discharge: sharding happens after the global bucket pad
+    ("tpusched/device_state.py", "snapshot_shardings"),
+    ("tpusched/mesh.py", "def snapshot_shardings"),
+)
+
+
+def classify(verdict: str) -> Optional[str]:
+    for token, route in ROUTE_RULES:
+        if token in verdict:
+            return route
+    return None
+
+
+def _site_key(s: Dict[str, Any]) -> Tuple[Any, ...]:
+    return (s["path"], s["line"], s["op"], s["root"], s["func"])
+
+
+def main() -> int:
+    failures: List[str] = []
+
+    prog = kernelflow.KernelProgram(kernelflow.kernel_sources(
+        scan_product_sources(REPO_ROOT)))
+    prog.classify_rules()
+    # per-site suppression status comes from the live tree's tpl
+    # disable comments, same as lint.py's ledger commands — without
+    # it every reasoned hazard reads as unsuppressed.
+    supp: Dict[str, Dict[int, Any]] = {}
+    for relpath, src in prog.sources.items():
+        by_line, _errors = parse_suppressions(src)
+        supp[relpath] = by_line
+    fresh = prog.ledger_doc(supp)
+
+    # 1. verdict freshness vs the checked-in ledger.
+    try:
+        checked = json.loads(LEDGER_PATH.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"shardcheck: cannot read {LEDGER_PATH}: {e!r}",
+              file=sys.stderr)
+        return 1
+    fresh_map = {_site_key(s): s for s in fresh["sites"]}
+    checked_map = {_site_key(s): s for s in checked["sites"]}
+    for k in sorted(set(fresh_map) - set(checked_map)):
+        failures.append(
+            f"stale ledger: site {k[0]}:{k[1]} ({k[2]} in {k[3]}) is "
+            "missing from the checked-in ledger — regenerate it "
+            "(tools/lint.py --emit-ledger)")
+    for k in sorted(set(checked_map) - set(fresh_map)):
+        failures.append(
+            f"stale ledger: checked-in site {k[0]}:{k[1]} ({k[2]} in "
+            f"{k[3]}) no longer exists in the sources")
+    for k in sorted(set(fresh_map) & set(checked_map)):
+        want, got = fresh_map[k]["sharding"], checked_map[k]["sharding"]
+        if want != got:
+            failures.append(
+                f"stale SHARDING verdict at {k[0]}:{k[1]} ({k[3]}): "
+                f"checked-in {got!r} vs fresh {want!r}")
+
+    # 2 + 3. route every fresh site and check its discharge.
+    mesh_entries = padcheck.mesh_entry_kernels()
+    covered = prog.reachable_from(mesh_entries)
+    census: Counter = Counter()
+    for s in fresh["sites"]:
+        route = classify(s["sharding"])
+        if route is None:
+            failures.append(
+                f"unrouted SHARDING verdict at {s['path']}:{s['line']} "
+                f"({s['root']}): {s['sharding']!r} — extend "
+                "shardcheck's ROUTE_RULES with the combine route the "
+                "serving stack implements for it")
+            continue
+        census[route] += 1
+        on_decision = bool(s["decision"]) and not s.get("suppressed")
+        if route in ("keyed-merge", "mask-cover", "width-pad") \
+                and on_decision and s["root"] not in covered:
+            failures.append(
+                f"{route} site {s['path']}:{s['line']} ({s['root']}) is "
+                "on the decision path but unreached by padcheck's mesh "
+                "differential — extend MESH_CASE_ENTRIES so the claim "
+                "is executed under a real device split")
+        if route == "pre-reduce" and on_decision:
+            failures.append(
+                f"pre-reduce site {s['path']}:{s['line']} ({s['root']}) "
+                "is order-sensitive on the decision path with NO "
+                "suppression: implement the pre-reduce (int32 / "
+                "segmented totals) or suppress with a reason before "
+                "this ships sharded")
+
+    # 3b. the constraint-pin witnesses.
+    for rel, token in PIN_WITNESSES:
+        try:
+            text = (REPO_ROOT / rel).read_text()
+        except OSError:
+            failures.append(f"pin witness file {rel} is gone")
+            continue
+        if token not in text:
+            failures.append(
+                f"pin witness missing: {rel} no longer contains "
+                f"{token!r} — the 2D-mesh partitioner pins / global-"
+                "width shard discharge moved; re-audit the SHARDING "
+                "column and update shardcheck")
+
+    dec = Counter(classify(s["sharding"]) for s in fresh["sites"]
+                  if s["decision"] and not s.get("suppressed"))
+    print("shardcheck: %d sites routed: %s" % (
+        sum(census.values()),
+        ", ".join(f"{r}={census[r]}" for r in
+                  ("any-tree", "width-pad", "keyed-merge", "mask-cover",
+                   "pre-reduce"))))
+    print("shardcheck: decision-path unsuppressed: %s; mesh entries: %s"
+          % (", ".join(f"{r}={n}" for r, n in sorted(dec.items())),
+             ", ".join(mesh_entries)))
+    for f in failures:
+        print(f"[!] {f}", file=sys.stderr)
+    print(f"shardcheck: {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

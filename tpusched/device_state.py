@@ -50,10 +50,12 @@ from typing import Iterable, Mapping
 
 import jax
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 from tpusched import trace as tracing
 from tpusched.config import Buckets, EngineConfig
 from tpusched.kernels.assign import permute_rows, scatter_rows
+from tpusched.mesh import snapshot_shardings
 from tpusched.qos import pressure_of
 from tpusched.snapshot import (
     ClusterSnapshot,
@@ -141,9 +143,17 @@ class DeviceSnapshot:
     """
 
     def __init__(self, config: EngineConfig | None = None,
-                 buckets: Buckets | None = None):
+                 buckets: Buckets | None = None, mesh=None):
         self.config = config or EngineConfig()
         self._floor_buckets = buckets
+        # Optional jax.sharding.Mesh (ROADMAP item 1): when set, the
+        # lineage's device arrays live SHARDED in the canonical layout
+        # (mesh.snapshot_shardings: pods over 'p', nodes over 'n', vocab
+        # replicated) so one lineage can hold a cluster no single
+        # device fits. Delta scatters/permutes run on the sharded
+        # arrays; _repin() restores the canonical layout afterwards in
+        # case the partitioner chose a different output sharding.
+        self.mesh = mesh
         # Span collector for device.rebuild events; None = the process
         # default at emit time (the sidecar points this at its own
         # collector when one was injected).
@@ -283,6 +293,26 @@ class DeviceSnapshot:
             for name in members:
                 self._run_pdb_key[name] = key
 
+    def _put(self, snap_np: ClusterSnapshot) -> ClusterSnapshot:
+        """Upload a full snapshot — sharded in the canonical mesh layout
+        when this lineage has one, single (default) device otherwise."""
+        if self.mesh is not None and self.mesh.devices.size > 1:
+            return jax.device_put(
+                snap_np, snapshot_shardings(self.mesh, snap_np)
+            )
+        return jax.device_put(snap_np)
+
+    def _repin(self, dev: ClusterSnapshot) -> ClusterSnapshot:
+        """Restore the canonical mesh layout after delta scatters (the
+        partitioner may emit a different output sharding for the
+        scattered/permuted groups). Leaves already laid out canonically
+        are untouched (device_put with a matching sharding is a no-op);
+        drifted leaves move device-to-device, never back through the
+        host — delta applies stay O(churn) on the H2D wire."""
+        if self.mesh is None or self.mesh.devices.size <= 1:
+            return dev
+        return jax.device_put(dev, snapshot_shardings(self.mesh, dev))
+
     def _rebuild(self, reason: str) -> ApplyStats:
         """Full host rebuild + device re-upload (the fallback path).
         Buckets floor at the PREVIOUS state's buckets so a lineage's
@@ -316,7 +346,7 @@ class DeviceSnapshot:
         self._pod_pc = {}
         self._run_anti = {}
         self._refresh_prev_maps()
-        self._device = jax.device_put(snap_np)
+        self._device = self._put(snap_np)
         # A rebuild replaces every device array: any carried warm
         # tableau is built on the OLD arrays (and possibly old buckets/
         # vocab) — drop it so the next warm solve goes cold and
@@ -697,9 +727,18 @@ class DeviceSnapshot:
             h2d += run_perm.nbytes
         if node_reorder:
             # Ship the remapped node_idx column wholesale (int32 [M]).
-            run_dev = dataclasses.replace(
-                run_dev, node_idx=jax.device_put(st.run_np.node_idx)
-            )
+            # On a mesh it must land replicated across the mesh devices
+            # (the canonical running layout) — a plain device_put would
+            # commit it to the default device only, and the scatter jit
+            # below rejects committed inputs on mismatched device sets.
+            if self.mesh is not None and self.mesh.devices.size > 1:
+                ni_dev = jax.device_put(
+                    st.run_np.node_idx,
+                    NamedSharding(self.mesh, PartitionSpec()),
+                )
+            else:
+                ni_dev = jax.device_put(st.run_np.node_idx)
+            run_dev = dataclasses.replace(run_dev, node_idx=ni_dev)
             h2d += st.run_np.node_idx.nbytes
 
         def scatter(dev_tree, mirror_tree, rows):
@@ -734,11 +773,11 @@ class DeviceSnapshot:
         pdb_dev = scatter(dev.pdb_allowed, mirror.pdb_allowed,
                           [st.pdb_idx[k] for k in touched_pdbs])
 
-        self._device = dataclasses.replace(
+        self._device = self._repin(dataclasses.replace(
             dev, nodes=nodes_dev, pods=pods_dev, running=run_dev,
             atoms=atoms_dev, sigs=sigs_dev, group_min_member=group_dev,
             pdb_allowed=pdb_dev,
-        )
+        ))
         self._node_order = new_node_order
         self._pod_order = new_pod_order
         self._run_order = new_run_order

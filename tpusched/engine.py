@@ -34,7 +34,9 @@ from tpusched.kernels.assign import (_PREEMPT_MAX_ROUNDS, INC_AUDIT_LEN,
                                      solve_rounds, solve_sequential)
 from tpusched.kernels.atoms import atom_sat
 from tpusched.kernels.pairwise import member_label_sat_t
+from tpusched.mesh import shard_snapshot
 from tpusched.ring import ring_sig_counts
+from tpusched.shardctx import constrain_replicated
 from tpusched.snapshot import ClusterSnapshot
 
 
@@ -193,13 +195,13 @@ class PendingFetch:
         return self._unpack(raw, done_t - self._t0)
 
 
-def _sat_tables(snap: ClusterSnapshot):
+def _sat_tables(snap: ClusterSnapshot, mesh=None):
     node_sat_t = atom_sat(
         snap.atoms, snap.nodes.label_pairs, snap.nodes.label_keys,
         snap.nodes.label_nums,
     ).T
     member_sat_t = member_label_sat_t(
-        snap, lambda lp, lk: atom_sat(snap.atoms, lp, lk, None)
+        snap, lambda lp, lk: atom_sat(snap.atoms, lp, lk, None), mesh
     )
     return node_sat_t, member_sat_t
 
@@ -225,7 +227,7 @@ def solve_core(cfg: EngineConfig, snap: ClusterSnapshot, mesh=None,
     `member_sat_t` (the tableau's, needed only by the ring-counts init)
     must ride along."""
     if static is None:
-        node_sat_t, member_sat_t = _sat_tables(snap)
+        node_sat_t, member_sat_t = _sat_tables(snap, mesh)
     else:
         node_sat_t = None  # precompute skipped; solve paths take static
     init_counts = None
@@ -237,10 +239,10 @@ def solve_core(cfg: EngineConfig, snap: ClusterSnapshot, mesh=None,
     if cfg.mode == "fast":
         return solve_rounds(cfg, snap, node_sat_t, member_sat_t,
                             init_counts=init_counts, explain=explain,
-                            static=static)
+                            static=static, mesh=mesh)
     seq = solve_sequential(cfg, snap, node_sat_t, member_sat_t,
                            init_counts=init_counts, explain=explain,
-                           static=static)
+                           static=static, mesh=mesh)
     if explain:
         a, c, u, o, ev, extras = seq
     else:
@@ -254,18 +256,24 @@ def solve_core(cfg: EngineConfig, snap: ClusterSnapshot, mesh=None,
     return base + ((extras,) if explain else ())
 
 
-def _pack_solve(out):
+def _pack_solve(out, mesh=None):
     """Flatten a solve_core output tuple into the ONE f32 result buffer
     (layout authority: Engine.unpack). Shared by the plain, warm, and
     cold-refresh packed programs so the packing cannot drift between
-    them. Indices are exact in f32 (< 2^24)."""
+    them. Indices are exact in f32 (< 2^24).
+
+    mesh: the pack concatenates 'p'-sharded pod vectors with replicated
+    scalars — the mixed-sharding concat class this jax version's 2D-mesh
+    partitioner mis-routes (tpusched/shardctx.py) — so on a mesh the
+    result is pinned replicated (it is about to be fetched to the host
+    wholesale anyway)."""
     assigned, chosen, used, order, commit_key, rounds, ev = out
-    return jnp.concatenate([
+    return constrain_replicated(jnp.concatenate([
         assigned.astype(jnp.float32), chosen,
         order.astype(jnp.float32), commit_key.astype(jnp.float32),
         used.reshape(-1), ev.astype(jnp.float32),
         rounds.astype(jnp.float32)[None],
-    ])
+    ]), mesh)
 
 
 # Per-Engine nonce for compile-watcher keys: jit caches are
@@ -339,10 +347,10 @@ class Engine:
             # (axon tunnel here, gRPC in deployment) pays a fixed round
             # trip per fetched buffer, which dwarfs the payload cost —
             # same lesson as SURVEY.md §7 hard part 6.
-            return _pack_solve(_solve(snap))
+            return _pack_solve(_solve(snap), mesh)
 
         def _score(snap: ClusterSnapshot):
-            node_sat_t, member_sat_t = _sat_tables(snap)
+            node_sat_t, member_sat_t = _sat_tables(snap, mesh)
             ic = None
             if cfg.ring_counts and snap.sigs.key.shape[0]:
                 ic = ring_sig_counts(
@@ -351,7 +359,7 @@ class Engine:
                     mesh,
                 )
             return score_batch(cfg, snap, node_sat_t, member_sat_t,
-                               init_counts=ic)
+                               init_counts=ic, mesh=mesh)
 
         def _score_top1(snap: ClusterSnapshot):
             feasible, scores = _score(snap)
@@ -574,23 +582,23 @@ class Engine:
         cfg, mesh = self.config, self.mesh
 
         def _cold(snap: ClusterSnapshot):
-            node_sat_t, member_sat_t = _sat_tables(snap)
-            tab = build_tableau(cfg, snap, node_sat_t, member_sat_t)
+            node_sat_t, member_sat_t = _sat_tables(snap, mesh)
+            tab = build_tableau(cfg, snap, node_sat_t, member_sat_t, mesh)
             static = finalize_static(cfg, snap, tab)
             out = solve_core(cfg, snap, mesh=mesh, static=static,
                              member_sat_t=tab.member_sat_t)
-            return _pack_solve(out), tab
+            return _pack_solve(out, mesh), tab
 
         def _warm(snap: ClusterSnapshot, tab, dp, dn, dm, pperm, nperm,
                   mperm):
             tab = refresh_tableau(cfg, snap, tab, dirty_pods=dp,
                                   dirty_nodes=dn, dirty_members=dm,
                                   pod_perm=pperm, node_perm=nperm,
-                                  member_perm=mperm)
+                                  member_perm=mperm, mesh=mesh)
             static = finalize_static(cfg, snap, tab)
             out = solve_core(cfg, snap, mesh=mesh, static=static,
                              member_sat_t=tab.member_sat_t)
-            return _pack_solve(out), tab
+            return _pack_solve(out, mesh), tab
 
         self._cold_refresh_jit = self._traced_jit("warm_cold_refresh",
                                                   _cold)
@@ -601,7 +609,7 @@ class Engine:
         bucket (compile-time constant; see _warm_inc_jits)."""
         fn = self._warm_inc_jits.get(cap)
         if fn is None:
-            cfg = self.config
+            cfg, mesh = self.config, self.mesh
 
             def _inc(snap: ClusterSnapshot, tab, dp, dn, dm, pperm,
                      nperm, mperm, carry, carry_chosen, frontier, dnode,
@@ -609,11 +617,12 @@ class Engine:
                 tab = refresh_tableau(cfg, snap, tab, dirty_pods=dp,
                                       dirty_nodes=dn, dirty_members=dm,
                                       pod_perm=pperm, node_perm=nperm,
-                                      member_perm=mperm)
+                                      member_perm=mperm, mesh=mesh)
                 out = solve_incremental(cfg, snap, tab, carry,
                                         carry_chosen, frontier, dnode,
-                                        _cap)
-                return jnp.concatenate([_pack_solve(out[:7]), out[7]]), tab
+                                        _cap, mesh=mesh)
+                return constrain_replicated(jnp.concatenate(
+                    [_pack_solve(out[:7], mesh), out[7]]), mesh), tab
 
             fn = self._warm_inc_jits[cap] = self._traced_jit(
                 f"warm_incremental_cap{cap}", _inc)
@@ -866,7 +875,7 @@ class Engine:
         probe_fn = self._explain_probe_jits.get(kb)
         if probe_fn is None:
             def _probe(s: ClusterSnapshot, _k=kb):
-                node_sat_t, member_sat_t = _sat_tables(s)
+                node_sat_t, member_sat_t = _sat_tables(s, mesh)
                 ic = None
                 if cfg.ring_counts and s.sigs.key.shape[0]:
                     ic = ring_sig_counts(
@@ -875,7 +884,8 @@ class Engine:
                         mesh,
                     )
                 return kexplain.explain_probe(
-                    cfg, s, node_sat_t, member_sat_t, _k, init_counts=ic
+                    cfg, s, node_sat_t, member_sat_t, _k, init_counts=ic,
+                    mesh=mesh,
                 )
 
             probe_fn = self._explain_probe_jits[kb] = self._traced_jit(
@@ -1007,7 +1017,13 @@ class Engine:
         self._score_top1_jit(snap)
 
     def put(self, snap: ClusterSnapshot) -> ClusterSnapshot:
-        """Explicit host->device transfer (otherwise implicit on call)."""
+        """Explicit host->device transfer (otherwise implicit on call).
+        On a mesh-backed engine the snapshot lands SHARDED in the
+        canonical layout (pods over 'p', nodes over 'n', vocab
+        replicated) so the solve consumes it in place — one engine
+        serves a cluster no single device holds (ROADMAP item 1)."""
+        if self.mesh is not None and self.mesh.devices.size > 1:
+            return shard_snapshot(self.mesh, snap)
         return jax.device_put(snap)
 
     def close(self, wait: bool = True) -> None:

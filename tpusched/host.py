@@ -531,7 +531,10 @@ class HostScheduler:
                     len(pods_r), len(nodes_r),
                     len(running_r) + backlog,
                 )
-            ds = DeviceSnapshot(self.config, buckets)
+            # The lineage shards over the engine's mesh (if any) so the
+            # warm dispatch reads the device arrays in place.
+            ds = DeviceSnapshot(self.config, buckets,
+                                mesh=self._engine.mesh)
             ds.full_load(nodes_r, pods_r, running_r)
             self._warm_ds = ds
         else:

@@ -140,14 +140,14 @@ def _tableau_cells(snap: ClusterSnapshot, pods_v, nodes_v, node_sat_v):
 
 
 def build_tableau(cfg: EngineConfig, snap: ClusterSnapshot,
-                  node_sat_t, member_sat_t) -> WarmTableau:
+                  node_sat_t, member_sat_t, mesh=None) -> WarmTableau:
     """Full (cold) tableau build from the snapshot's sat tables."""
     mask, aff_ok, na_raw, tt_count = _tableau_cells(
         snap, snap.pods, snap.nodes, node_sat_t
     )
     return WarmTableau(
         node_sat_t=node_sat_t, member_sat_t=member_sat_t,
-        sig_match=kpair.sig_member_match(snap, member_sat_t),
+        sig_match=kpair.sig_member_match(snap, member_sat_t, mesh),
         mask=mask, aff_ok=aff_ok, na_raw=na_raw, tt_count=tt_count,
     )
 
@@ -155,7 +155,7 @@ def build_tableau(cfg: EngineConfig, snap: ClusterSnapshot,
 def refresh_tableau(cfg: EngineConfig, snap: ClusterSnapshot,
                     tab: WarmTableau, dirty_pods=None, dirty_nodes=None,
                     dirty_members=None, pod_perm=None, node_perm=None,
-                    member_perm=None) -> WarmTableau:
+                    member_perm=None, mesh=None) -> WarmTableau:
     """O(churn) tableau maintenance: reorder gathers (when record
     insertion/removal shifted the name-sorted row order — exactly the
     permutations device_state applies to the snapshot arrays), then
@@ -193,14 +193,14 @@ def refresh_tableau(cfg: EngineConfig, snap: ClusterSnapshot,
                             nv.label_nums)                   # [D, A]
         nst = nst.at[:, dirty_nodes].set(sat_rows.T)
     if dirty_members is not None:
-        lp = jnp.concatenate(
-            [snap.running.label_pairs, snap.pods.label_pairs]
+        lp = kpair.merge_members(
+            snap.running.label_pairs, snap.pods.label_pairs, mesh
         )[dirty_members]
-        lk = jnp.concatenate(
-            [snap.running.label_keys, snap.pods.label_keys]
+        lk = kpair.merge_members(
+            snap.running.label_keys, snap.pods.label_keys, mesh
         )[dirty_members]
-        mns = jnp.concatenate(
-            [snap.running.namespace, snap.pods.namespace]
+        mns = kpair.merge_members(
+            snap.running.namespace, snap.pods.namespace, mesh
         )[dirty_members]
         sat_cols = atom_sat(snap.atoms, lp, lk, None).T      # [A, D]
         mst = mst.at[:, dirty_members].set(sat_cols)
@@ -256,9 +256,9 @@ def finalize_static(cfg: EngineConfig, snap: ClusterSnapshot,
 
 
 def precompute_static(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
-                      member_sat_t) -> StaticCtx:
+                      member_sat_t, mesh=None) -> StaticCtx:
     return finalize_static(
-        cfg, snap, build_tableau(cfg, snap, node_sat_t, member_sat_t)
+        cfg, snap, build_tableau(cfg, snap, node_sat_t, member_sat_t, mesh)
     )
 
 
@@ -425,7 +425,7 @@ def _preempt_branch(cfg: EngineConfig, snap: ClusterSnapshot, static,
 
 def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
                      node_sat_t, member_sat_t, init_counts=None,
-                     explain: bool = False, static=None):
+                     explain: bool = False, static=None, mesh=None):
     """Exact sequential commit: stock scheduleOne semantics on device,
     including inline PostFilter preemption (cfg.preemption) at the exact
     point upstream runs it — immediately after a pod fails Filter.
@@ -438,11 +438,13 @@ def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
     StaticCtx (the warm path's finalize_static output); None computes
     it from the sat tables."""
     if static is None:
-        static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
+        static = precompute_static(cfg, snap, node_sat_t, member_sat_t,
+                                   mesh)
     P = snap.pods.valid.shape[0]
     M = snap.running.valid.shape[0]
     order = pop_order(cfg, snap)
-    st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts)
+    st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts,
+                                mesh=mesh)
     do_preempt = cfg.preemption and M > 0
     if do_preempt:
         pctx = kpreempt.precompute(cfg, snap)
@@ -519,12 +521,14 @@ def solve_sequential(cfg: EngineConfig, snap: ClusterSnapshot,
 
 
 def score_batch(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
-                member_sat_t, init_counts=None, static=None):
+                member_sat_t, init_counts=None, static=None, mesh=None):
     """One-shot [P, N] feasibility + scores against the current snapshot
     (no commits): the ScoreBatch gRPC surface (SURVEY.md C12)."""
     if static is None:
-        static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
-    st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts)
+        static = precompute_static(cfg, snap, node_sat_t, member_sat_t,
+                                   mesh)
+    st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts,
+                                mesh=mesh)
     return batched_cycle(cfg, snap, static, snap.nodes.used, st0)
 
 
@@ -2137,7 +2141,7 @@ def _solve_rounds_sig(cfg: EngineConfig, snap: ClusterSnapshot,
 
 def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
                  node_sat_t, member_sat_t, init_counts=None,
-                 explain: bool = False, static=None):
+                 explain: bool = False, static=None, mesh=None):
     """Fast mode: optimistic batched rounds with validate-and-rollback.
     Returns (assigned, chosen, used, order, round_of, rounds, evicted);
     with explain=True (decision provenance, round 12) an extra trailing
@@ -2148,13 +2152,15 @@ def solve_rounds(cfg: EngineConfig, snap: ClusterSnapshot,
     when requested, so the default program is unchanged. static:
     optional precomputed StaticCtx (the warm path)."""
     if static is None:
-        static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
+        static = precompute_static(cfg, snap, node_sat_t, member_sat_t,
+                                   mesh)
     pods, nodes = snap.pods, snap.nodes
     P = pods.valid.shape[0]
     N = nodes.valid.shape[0]
     order = pop_order(cfg, snap)
     rank = jnp.zeros(P, jnp.int32).at[order].set(jnp.arange(P, dtype=jnp.int32))
-    st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts)
+    st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts,
+                                mesh=mesh)
     S = snap.sigs.key.shape[0]
     invol, has_pair = _sig_involvement(snap, static, st0)
     BIG = jnp.int32(2**31 - 1)
@@ -2262,7 +2268,7 @@ INC_AUDIT_LEN = 5
 
 def solve_incremental(cfg: EngineConfig, snap: ClusterSnapshot, tab,
                       carry, carry_chosen, frontier0, dirty_node_mask,
-                      cap: int):
+                      cap: int, mesh=None):
     """Bounded-divergence warm commit rounds (ISSUE 12, tentpole 2):
     seed the round loop with the previous cycle's assignment for clean
     pods and run commit rounds only over the pending FRONTIER, so solve
@@ -2313,7 +2319,7 @@ def solve_incremental(cfg: EngineConfig, snap: ClusterSnapshot, tab,
     rank = jnp.zeros(P, jnp.int32).at[order].set(
         jnp.arange(P, dtype=jnp.int32)
     )
-    st0 = kpair.pair_state_init(snap, static.sig_match)
+    st0 = kpair.pair_state_init(snap, static.sig_match, mesh=mesh)
     S = snap.sigs.key.shape[0]
     invol, has_pair = _sig_involvement(snap, static, st0)
     max_rounds = cfg.max_rounds if cfg.max_rounds > 0 else 2 * P + 8
@@ -2417,7 +2423,7 @@ def solve_incremental(cfg: EngineConfig, snap: ClusterSnapshot, tab,
     s_viol = jnp.sum((final_carried & ~ok_static_f).astype(jnp.float32))
     if S:
         st_car = kpair.pair_state_seed(
-            snap, static.sig_match, carry, final_carried
+            snap, static.sig_match, carry, final_carried, mesh=mesh
         )
         ia_f = kpair.ia_ok_at_choice(
             snap, st_car, static.sig_match, carry,

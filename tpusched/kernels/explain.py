@@ -92,11 +92,12 @@ class ScoreExplain:
 
 
 def explain_probe(cfg: EngineConfig, snap: ClusterSnapshot, node_sat_t,
-                  member_sat_t, k: int, init_counts=None):
+                  member_sat_t, k: int, init_counts=None, mesh=None):
     """One flat f32 buffer of the provenance arrays (module docstring).
     `k` is a trace-time constant clipped to [1, N] by the caller."""
-    static = precompute_static(cfg, snap, node_sat_t, member_sat_t)
-    st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts)
+    static = precompute_static(cfg, snap, node_sat_t, member_sat_t, mesh)
+    st0 = kpair.pair_state_init(snap, static.sig_match, counts=init_counts,
+                                mesh=mesh)
     nodes, pods = snap.nodes, snap.pods
     P = pods.valid.shape[0]
     N = nodes.valid.shape[0]

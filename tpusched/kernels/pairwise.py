@@ -40,7 +40,17 @@ from flax import struct
 
 from tpusched.config import DO_NOT_SCHEDULE
 from tpusched.kernels.atoms import gather_term_sat
+from tpusched.shardctx import constrain_replicated
 from tpusched.snapshot import ClusterSnapshot
+
+
+def merge_members(run_arr, pod_arr, mesh=None):
+    """[M+P] (or [M+P, ...]) member-axis merge of a replicated running
+    array with a 'p'-sharded pending array, pinned REPLICATED under
+    `mesh` (shardctx module docstring: the 2D-mesh partitioner
+    mis-routes mixed-sharding concatenates; every device needs every
+    member column for the [S, M+P] signature contraction anyway)."""
+    return constrain_replicated(jnp.concatenate([run_arr, pod_arr]), mesh)
 
 
 @struct.dataclass
@@ -50,12 +60,12 @@ class PairState:
     match_tot: Any  # [S] f32 selector-match counts over all members
 
 
-def member_label_sat_t(snap: ClusterSnapshot, sat_fn):
+def member_label_sat_t(snap: ClusterSnapshot, sat_fn, mesh=None):
     """[A, M+P] atom satisfaction over member pod labels; static across a
     solve (labels never change), so computed once and closed over."""
-    lp = jnp.concatenate([snap.running.label_pairs, snap.pods.label_pairs])
-    lk = jnp.concatenate([snap.running.label_keys, snap.pods.label_keys])
-    return sat_fn(lp, lk).T
+    lp = merge_members(snap.running.label_pairs, snap.pods.label_pairs, mesh)
+    lk = merge_members(snap.running.label_keys, snap.pods.label_keys, mesh)
+    return constrain_replicated(sat_fn(lp, lk).T, mesh)
 
 
 def ns_scope_ok(sigs_ns, sigs_ns_all, member_ns):
@@ -72,15 +82,15 @@ def ns_scope_ok(sigs_ns, sigs_ns_all, member_ns):
     )
 
 
-def sig_member_match(snap: ClusterSnapshot, member_sat_t):
+def sig_member_match(snap: ClusterSnapshot, member_sat_t, mesh=None):
     """[S, M+P] bool: does member x match signature s — label selector
     satisfied AND member namespace in the sig's scope (upstream
     podAffinityTerm.namespaces / same-namespace spread counting). A
     signature with zero atoms matches every namespace-eligible member
     (upstream empty label selector)."""
     match = gather_term_sat(member_sat_t, snap.sigs.atoms)   # [S, M+P]
-    member_ns = jnp.concatenate(
-        [snap.running.namespace, snap.pods.namespace]
+    member_ns = merge_members(
+        snap.running.namespace, snap.pods.namespace, mesh
     )                                                        # [M+P]
     ns_ok = ns_scope_ok(snap.sigs.ns, snap.sigs.ns_all, member_ns)
     return match & ns_ok & snap.sigs.valid[:, None]
@@ -97,11 +107,11 @@ def sig_domains(snap: ClusterSnapshot):
     return jnp.where(snap.sigs.valid[:, None], dom_s, -1)
 
 
-def sig_counts(snap: ClusterSnapshot, sig_match, assigned):
+def sig_counts(snap: ClusterSnapshot, sig_match, assigned, mesh=None):
     """[S, N] f32 domain counts from scratch for the given assignment
     state (used at loop init and in tests; loops update incrementally)."""
-    node = jnp.concatenate([snap.running.node_idx, assigned])
-    valid = jnp.concatenate([snap.running.valid, assigned >= 0])
+    node = merge_members(snap.running.node_idx, assigned, mesh)
+    valid = merge_members(snap.running.valid, assigned >= 0, mesh)
     dom_s = sig_domains(snap)                                # [S, N]
     S, N = dom_s.shape
     mdom = jnp.where(
@@ -133,7 +143,7 @@ def _anti_counts_running(snap: ClusterSnapshot, dom_s):
 
 
 def pair_state_init(snap: ClusterSnapshot, sig_match,
-                    counts=None) -> PairState:
+                    counts=None, mesh=None) -> PairState:
     """State with no pending pods committed: counts from running pods.
     `counts`: optional precomputed [S, N] initial domain counts (the
     ring path, tpusched.ring.ring_sig_counts, is bit-identical to the
@@ -146,7 +156,8 @@ def pair_state_init(snap: ClusterSnapshot, sig_match,
         axis=1,
     )
     if counts is None:
-        counts = sig_counts(snap, sig_match, jnp.full(P, -1, jnp.int32))
+        counts = sig_counts(snap, sig_match, jnp.full(P, -1, jnp.int32),
+                            mesh)
     return PairState(
         counts=counts,
         anti=_anti_counts_running(snap, dom_s),
@@ -212,7 +223,7 @@ def pair_state_add_pod(snap: ClusterSnapshot, st: PairState, sig_match,
 
 
 def pair_state_seed(snap: ClusterSnapshot, sig_match, choice, mask,
-                    counts=None) -> PairState:
+                    counts=None, mesh=None) -> PairState:
     """State with a PRE-COMMITTED pending assignment: running members
     plus every pending pod p with mask[p] counted at choice[p]. The
     incremental warm path (ISSUE 12) seeds its round loop with this —
@@ -220,7 +231,7 @@ def pair_state_seed(snap: ClusterSnapshot, sig_match, choice, mask,
     just committed them, so frontier commits validate against the same
     state a cold solve would have reached — and its in-kernel audit
     recounts the final carried set through the same helper."""
-    st = pair_state_init(snap, sig_match, counts=counts)
+    st = pair_state_init(snap, sig_match, counts=counts, mesh=mesh)
     if snap.sigs.key.shape[0] == 0:
         return st
     return pair_state_commit(snap, st, sig_match, choice, mask)

@@ -617,10 +617,13 @@ class DeviceSession:
     @classmethod
     def from_base_store(cls, store: SnapshotStore, base_id: str,
                         config: EngineConfig,
-                        buckets: Buckets | None) -> "DeviceSession":
+                        buckets: Buckets | None,
+                        mesh=None) -> "DeviceSession":
         """Seed from the BASE (pre-delta) byte store so the pin matches
         what pipelined clients keep diffing against (the one-time
-        O(cluster) conversion; every later delta is O(churn))."""
+        O(cluster) conversion; every later delta is O(churn)). mesh:
+        shard the lineage arrays in the canonical layout so warm
+        dispatches on a mesh-backed engine read them in place."""
         def parse(cls_pb, raw):
             return cls_pb.FromString(raw) if isinstance(raw, bytes) else raw
 
@@ -630,7 +633,7 @@ class DeviceSession:
                 for v in store.pods.values()]
         running = [codec.running_kwargs(parse(pb.RunningPod, v))
                    for v in store.running.values()]
-        device = DeviceSnapshot(config, buckets)
+        device = DeviceSnapshot(config, buckets, mesh=mesh)
         stats = device.full_load(nodes, pods, running)
         session = cls(device, pin_sid=base_id)
         session.last_stats = stats
@@ -781,6 +784,11 @@ class SchedulerService:
             shape = tuple(self.config.mesh_shape)
             mesh = make_mesh(None if shape == (1, 1) else shape)
         self._faults = faults if faults is not None else NO_FAULTS
+        # Device sessions shard their lineage arrays over the same mesh
+        # the engine solves on (ROADMAP item 1: the snapshot a solve
+        # reads and the lineage the deltas scatter into share one
+        # canonical layout — no per-dispatch reshard).
+        self._mesh = mesh
         self._engine = Engine(self.config, mesh=mesh, faults=self._faults)
         self._log = log_stream if log_stream is not None else sys.stderr
         self._audit = audit_stream
@@ -1026,7 +1034,8 @@ class SchedulerService:
                 with self._trace.span("session.seed", cat="replica",
                                       base_id=base_id):
                     session = DeviceSession.from_base_store(
-                        base, base_id, self.config, self.buckets
+                        base, base_id, self.config, self.buckets,
+                        mesh=self._mesh,
                     )
                     session.device.tracer = self._trace
                 self.session_seeds += 1
@@ -1343,7 +1352,8 @@ class SchedulerService:
                     with self._trace.span("session.seed", cat="server",
                                           base_id=base_id):
                         session = DeviceSession.from_base_store(
-                            base, base_id, self.config, self.buckets
+                            base, base_id, self.config, self.buckets,
+                            mesh=self._mesh,
                         )
                         session.device.tracer = self._trace
                     self.session_seeds += 1

@@ -1,0 +1,70 @@
+"""Explicit sharding constraints for kernel interiors (ROADMAP item 1,
+"make multichip real").
+
+WHY THIS EXISTS. The kernels merge the replicated running-pod tables
+with the 'p'-sharded pending-pod tables (`members = [running | pending]`
+concatenations in kernels/pairwise.py and the dirty-member refresh in
+kernels/assign.py). On a TRUE 2D mesh — both 'p' and 'n' axes > 1 —
+this jax/jaxlib's SPMD partitioner materializes such mixed-sharding
+concatenates with wrong element routing: a minimal
+`jnp.concatenate([replicated, PS('p')-sharded])` under a (2, 4) mesh
+returns permuted garbage, while the same program under any 1D mesh is
+bit-exact. An explicit `with_sharding_constraint` on the result (pinning
+it replicated) removes the partitioner's freedom to pick the broken
+layout and restores bitwise parity with the single-device program —
+verified by tests/test_mesh.py across (8,1)/(4,2)/(2,4)/(1,8).
+
+Pinning the member-merge results REPLICATED is also the semantically
+right layout: every device needs every member column for signature
+matching (the [S, M+P] contraction), and the member axis is small next
+to the [P, N] tableaux that carry the real memory weight.
+
+MECHANISM. The mesh is threaded EXPLICITLY (`mesh=None` kwargs) from
+Engine/solve_core down through the precompute/pairwise helpers to each
+merge site; `constrain_replicated(x, mesh)` is the identity for
+mesh=None or a 1-device mesh, so single-device traces are byte-for-byte
+the programs they were before this module existed.
+
+WHY EXPLICIT AND NOT AMBIENT. jax caches the traced jaxpr per
+(function identity, avals) — input SHARDINGS only enter at lowering.
+An ambient-context constraint (contextvar read at trace time) therefore
+silently vanishes whenever the same function object was first traced
+without the mesh at the same shapes: the constraint-free jaxpr is
+reused and only re-lowered (observed: the reference solve traced first,
+the sharded call reused its jaxpr, the divergence stayed). With the
+mesh as an explicit argument, callers close over it per mesh (Engine:
+per-instance closures over a construction-fixed self.mesh; tests: a
+fresh closure per mesh), so different meshes are different function
+identities and can never share a trace.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+
+def _active(mesh: Mesh | None) -> Mesh | None:
+    if mesh is None or mesh.devices.size <= 1:
+        return None
+    return mesh
+
+
+def constrain_replicated(x, mesh: Mesh | None):
+    """Pin `x` fully replicated under `mesh`; identity when mesh is None
+    or single-device. Apply to every merge of replicated running-member
+    data with 'p'-sharded pending-pod data — the op class the 2D-mesh
+    partitioner mis-routes (module docstring)."""
+    m = _active(mesh)
+    if m is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(m, PS()))
+
+
+def constrain_spec(x, mesh: Mesh | None, *axes):
+    """Pin `x` to PartitionSpec(*axes) under `mesh`; identity when mesh
+    is None or single-device."""
+    m = _active(mesh)
+    if m is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(m, PS(*axes)))
