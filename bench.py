@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 import time
@@ -1584,6 +1585,17 @@ def bench_robustness(args):
 
       chaos_goodput_frac_r{1,2,3}   availability under the kill
       failover_recovery_ms_r{2,3}   kill -> next completed cycle
+
+    PR 18 (ROADMAP item 3): fleet arms boot with the shape-class
+    registry prewarm (explicit synthetic buckets), which makes the
+    old warmup_arm redundant AND turns compile-freeness into harness
+    assertions (zero serve-cause compiles in the fault-free twin;
+    zero compiles after the kill at r>=2). New headline numbers:
+
+      cold_start_s              fleet boot -> every replica prewarmed
+      prewarm_s                 slowest replica's registry prewarm
+      failover_first_request_ms kill -> next completed cycle, with a
+                                compile-free promotion (no XLA term)
     """
     import importlib.util
     import os
@@ -1637,15 +1649,17 @@ def bench_robustness(args):
     # STRICTLY above the 1-replica number (acceptance criterion).
     # outage_s=6: failover recovery is outage-INDEPENDENT (one retry
     # lands on the standby), so a long outage only degrades r1 —
-    # keeping the separation structural, above the per-arm compile/
-    # contention noise (~1-2s) of these ~15s runs.
+    # keeping the separation structural, above the per-arm contention
+    # noise of these ~15s runs. prewarm=True replaces the old
+    # warmup_arm: every arm is born warm (and asserts it), so compile
+    # noise is gone from BOTH sides of the goodput fraction.
     goodput_by_r = {}
     for replicas in (1, 2, 3):
         rep = chaos.run_chaos_fleet(
             n_pods=min(args.pods, 120), n_nodes=min(args.nodes, 12),
             batch_size=max(min(args.pods, 120) // 10, 1),
             replicas=replicas, outage_s=6.0, kill_after_cycle=2,
-            warmup_arm=(replicas == 1),
+            prewarm=True,
             log=log,
         )
         if not rep["end_state"]["identical"]:
@@ -1681,6 +1695,27 @@ def bench_robustness(args):
                 "goodput_frac": rep["goodput_frac"],
             }
             print(json.dumps(line), flush=True)
+        if replicas == 2:
+            # The r2 run is the headline failover story: surface its
+            # boot cost and compile-free first-request latency as
+            # first-class metrics (benchdiff trends them lower-better).
+            sc = rep["serve_compiles"]
+            for metric, value, unit in (
+                ("cold_start_s", rep["cold_start_s"], "s"),
+                ("prewarm_s", rep["prewarm_s"], "s"),
+                ("failover_first_request_ms",
+                 rep["failover_first_request_ms"], "ms"),
+            ):
+                line = {
+                    "metric": metric, "value": value, "unit": unit,
+                    "vs_baseline": None, "direction": "lower",
+                    "serve_compiles_baseline": sc["baseline"],
+                    "serve_compiles_after_takeover": sc["after_takeover"],
+                }
+                print(json.dumps(line), flush=True)
+                log(f"{metric}: {value} {unit} (serve compiles "
+                    f"baseline={sc['baseline']} "
+                    f"after_takeover={sc['after_takeover']})")
     if goodput_by_r[2] <= goodput_by_r[1]:
         log(f"WARNING: goodput at 2 replicas ({goodput_by_r[2]}) did "
             f"not beat 1 replica ({goodput_by_r[1]}) — HA acceptance "
@@ -1855,11 +1890,27 @@ def main():
                          "benches; 'off' measures the disabled "
                          "zero-overhead path (ISSUE 4 acceptance: "
                          "serve_qps within noise of traced runs)")
+    ap.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="persist XLA executables under DIR (default: "
+                         "$TPUSCHED_COMPILE_CACHE when set) so bench "
+                         "round N+1 reuses round N's compiles — the "
+                         "compile_count_total / *_compile_* metrics "
+                         "then measure trace+cache-load, not "
+                         "recompilation (PR 18)")
     args = ap.parse_args()
 
     from tpusched import trace as _tr
 
     _tr.set_enabled(args.trace == "on")
+
+    # BEFORE any jit: cache config must precede the first compile.
+    cache_dir = args.compile_cache or os.environ.get(
+        "TPUSCHED_COMPILE_CACHE")
+    if cache_dir:
+        from tpusched import shapeclass as _sc
+
+        log(f"persistent compile cache: "
+            f"{_sc.enable_persistent_cache(cache_dir)}")
 
     import jax
 

@@ -52,6 +52,21 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache (round 23, ISSUE 18): tier-1 is COMPILE-
+# dominated on small CPU hosts — the suite's wall time is mostly XLA
+# re-building the same programs every run. Keying jax's persistent
+# compilation cache into CI means run N+1 reuses run N's binaries
+# (measured: a 3s first-call drops to ~0.35s in a fresh process).
+# Cache keys include the full HLO + compile options, so edited kernels
+# simply miss and recompile — stale hits are not possible. The dir
+# lives in-repo (gitignored) so it survives as long as the checkout
+# does; TPUSCHED_COMPILE_CACHE overrides the location, =0 disables.
+_cache = os.environ.get("TPUSCHED_COMPILE_CACHE")
+if _cache != "0":
+    from tpusched.shapeclass import enable_persistent_cache
+
+    enable_persistent_cache(_cache or str(_REPO_ROOT / ".xla_cache"))
+
 # Sanitizer modes (SURVEY.md §5 "Race detection / sanitizers"): CI can
 # run the whole suite with NaN checking / de-optimized XLA:
 #   TPUSCHED_DEBUG_NANS=1 pytest tests/

@@ -168,3 +168,13 @@ def test_sharded_serving_metric_directions_are_registered():
         "padcheck_mesh_divergences_total"] == "lower"
     assert benchdiff.lower_is_better(
         "padcheck_mesh_divergences_total", "count", None)
+
+
+def test_prewarm_metric_directions_are_registered():
+    """PR 18 satellite: the compile-free-failover headline metrics are
+    direction-pinned through the registered table — a cold-start or
+    failover-latency rise must trend as a regression even if a later
+    round changes their units out from under the inference rules."""
+    for m in ("cold_start_s", "prewarm_s", "failover_first_request_ms"):
+        assert benchdiff._EXPLICIT_DIRECTION[m] == "lower", m
+        assert benchdiff.lower_is_better(m, "count", None), m

@@ -60,6 +60,13 @@ _EXPLICIT_DIRECTION = {
     # Sharded serving (round 22, ISSUE 17): any mesh-parity divergence
     # in padcheck's forced-2-device differential is a regression.
     "padcheck_mesh_divergences_total": "lower",
+    # Compile-free failover (PR 18, ROADMAP item 3): boot cost and the
+    # promoted standby's first-request latency. Units alone would get
+    # these right today, but the direction must survive a unit rename
+    # (e.g. cold_start reported in cycles or fractions later).
+    "cold_start_s": "lower",
+    "prewarm_s": "lower",
+    "failover_first_request_ms": "lower",
 }
 # Registered direction GLOBS (round 22, ISSUE 17): the sharded-serving
 # metric families from bench.py's multichip section. Consulted after

@@ -55,7 +55,19 @@ import numpy as np
 from tpusched.config import EngineConfig
 from tpusched.faults import FaultPlan, FaultRule
 from tpusched.host import Conflict, FakeApiServer, HostScheduler, \
-    build_synthetic_cluster
+    build_synthetic_cluster, synthetic_buckets
+from tpusched.ledger import COMPILES
+from tpusched.shapeclass import CAUSE_PREWARM
+
+
+def _serve_compiles() -> int:
+    """Compiles paid OUTSIDE prewarm so far, process-wide. Prewarm-
+    cause traces are boot work by construction (Engine.prewarm tags
+    them); everything else — 'serve' — is a request-path cache miss,
+    exactly what a prewarmed fleet must never pay. Arms diff this
+    around their measured window."""
+    return sum(v for cause, v in COMPILES.cause_counts().items()
+               if cause != CAUSE_PREWARM)
 
 
 class _CountingApi(FakeApiServer):
@@ -356,6 +368,7 @@ def run_chaos_fleet(
     poll_s: float = 0.05,
     plan: FaultPlan | None = None,
     warmup_arm: bool = False,
+    prewarm: bool = False,
     log=print,
 ) -> dict:
     """Kill-the-leader twin run over an N-replica fleet (ISSUE 6).
@@ -382,12 +395,31 @@ def run_chaos_fleet(
     shapes (later arms hit the in-process compile caches); without a
     warmup, a cold fault-free twin can lose to a warm chaos arm and
     invert the goodput fraction. Callers comparing goodput across
-    replica counts set it on their FIRST run (bench.py does)."""
+    replica counts set it on their FIRST run (bench.py does).
+
+    prewarm (PR 18): boot every replica with explicit synthetic
+    buckets + the shape-class registry prewarm, and make the compile-
+    free claims ASSERTIONS: the fault-free twin's measured window pays
+    zero serve-cause compiles (so warmup_arm is unnecessary — the arm
+    is born warm), and at replicas >= 2 the window from kill to end of
+    run pays zero too (the promoted standby prewarmed before
+    wait_caught_up let the kill proceed). The report gains
+    cold_start_s (fleet construction -> every replica prewarmed),
+    prewarm_s (slowest replica's prewarm), and
+    failover_first_request_ms (kill -> next COMPLETED cycle, which a
+    compile-free promotion keeps free of any XLA component)."""
     from tpusched.replicate import ReplicaSet
     from tpusched.rpc.client import SchedulerClient
 
     cfg = EngineConfig(mode="fast")
     batch = batch_size or max(n_pods // 4, 1)
+    make_kw: dict = dict(config=cfg, watchdog_s=watchdog_s)
+    if prewarm:
+        # Explicit buckets pin ONE solve_packed shape class for the
+        # whole run (running-bucket growth included), so prewarm can
+        # compile it once at boot and nothing retraces mid-experiment.
+        make_kw.update(buckets=synthetic_buckets(n_pods, n_nodes),
+                       prewarm=True)
 
     def fresh_api():
         api = _CountingApi()
@@ -400,13 +432,26 @@ def run_chaos_fleet(
         # fleets must stay genuinely fault-free (and a plan's pinned
         # invocation indices must not be burned in the wrong arm); the
         # single-sidecar run_chaos follows the same discipline.
-        fleet = ReplicaSet(replicas, poll_s=poll_s, config=cfg,
-                           watchdog_s=watchdog_s, faults=faults)
+        t_boot = time.perf_counter()
+        fleet = ReplicaSet(replicas, poll_s=poll_s, faults=faults,
+                           **make_kw)
+        if prewarm:
+            # Cold start ends when EVERY replica has compiled its
+            # registry — the standbys' warmness is the failover claim.
+            for svc in fleet.services:
+                if not svc.wait_prewarmed(timeout=120.0):
+                    raise RuntimeError(
+                        "replica prewarm did not complete within 120s"
+                        + (f": {svc.prewarm_error}" if svc.prewarm_error
+                           else "")
+                    )
+        cold_start_s = time.perf_counter() - t_boot
         client = SchedulerClient(fleet.addresses(), retry_seed=seed)
         api = fresh_api()
         host = HostScheduler(api, cfg, client=client, batch_size=batch)
         timers: list = []
         try:
+            serve0 = _serve_compiles()
             t0 = time.perf_counter()
             drive = _drive(host, events_fn(fleet, timers), max_cycles=400)
             wall = time.perf_counter() - t0
@@ -420,6 +465,13 @@ def run_chaos_fleet(
                 fallbacks=host._delta.fallbacks if host._delta else 0,
                 takeovers=fleet.takeovers(),
                 serving_role=health.role,
+                cold_start_s=cold_start_s,
+                prewarm_s=max(
+                    (svc.prewarm_s or 0.0 for svc in fleet.services),
+                    default=0.0,
+                ) if prewarm else 0.0,
+                serve_compiles=_serve_compiles() - serve0,
+                serve_compiles_end=_serve_compiles(),
                 replication=[
                     dict(role=svc.role,
                          applied=svc.replication_applied,
@@ -440,6 +492,8 @@ def run_chaos_fleet(
     def no_events(fleet, timers):
         return {}
 
+    kill_marks: dict = {}
+
     def kill_events(fleet, timers):
         def kill_leader():
             # Deterministic warmness: standbys catch up BEFORE the kill.
@@ -454,6 +508,9 @@ def run_chaos_fleet(
                     "precondition not met"
                 )
             idx = fleet.kill_leader()
+            # Everything traced from here to end-of-run is failover
+            # work: a prewarmed promotion must add ZERO to this.
+            kill_marks["serve_compiles_at_kill"] = _serve_compiles()
 
             def resurrect():
                 fleet.restart(idx, role="leader" if replicas == 1
@@ -469,6 +526,12 @@ def run_chaos_fleet(
 
         return {kill_after_cycle: [("leader_kill", kill_leader)]}
 
+    if warmup_arm and prewarm:
+        # Prewarm makes the warmup arm's one job (paying the compiles
+        # off the measured clock) redundant: every arm is born warm.
+        log(f"[chaos-fleet r{replicas}] --prewarm: skipping the "
+            f"warmup arm (prewarmed fleets are born warm)")
+        warmup_arm = False
     if warmup_arm:
         t0 = time.perf_counter()
         run_arm(no_events)
@@ -477,7 +540,14 @@ def run_chaos_fleet(
     base = run_arm(no_events)
     log(f"[chaos-fleet r{replicas}] fault-free: "
         f"{base['drive']['cycles']} cycles, {base['placed']} placed "
-        f"in {base['wall']:.2f}s")
+        f"in {base['wall']:.2f}s (cold start {base['cold_start_s']:.2f}s, "
+        f"serve compiles {base['serve_compiles']})")
+    if prewarm and base["serve_compiles"] != 0:
+        raise RuntimeError(
+            f"prewarmed fault-free arm paid {base['serve_compiles']} "
+            f"serve-cause compile(s): the shape-class registry missed "
+            f"a program this workload dispatches"
+        )
     chaos = run_arm(kill_events, faults=plan)
     log(f"[chaos-fleet r{replicas}] kill-the-leader: "
         f"{chaos['drive']['cycles']} cycles "
@@ -496,9 +566,31 @@ def run_chaos_fleet(
     base_pps = base["placed"] / max(base["wall"], 1e-9)
     chaos_pps = chaos["placed"] / max(chaos["wall"], 1e-9)
     rec = chaos["drive"]["recovery_s"]
+    takeover_compiles = None
+    if "serve_compiles_at_kill" in kill_marks:
+        takeover_compiles = (chaos["serve_compiles_end"]
+                             - kill_marks["serve_compiles_at_kill"])
+        if prewarm and replicas >= 2 and takeover_compiles != 0:
+            # The headline claim of PR 18: wait_caught_up only let the
+            # kill proceed once the standby was prewarmed, so the
+            # promotion must serve without tracing anything new. (At
+            # replicas == 1 the resurrected leader may legitimately
+            # race its own boot prewarm, so no assertion there.)
+            raise RuntimeError(
+                f"promoted standby paid {takeover_compiles} compile(s) "
+                f"after the leader kill: failover was not compile-free"
+            )
+    failover_ms = (round(rec["leader_kill"] * 1000.0, 1)
+                   if rec.get("leader_kill") is not None else None)
     report = dict(
         pods=n_pods, nodes=n_nodes, seed=seed, batch_size=batch,
-        replicas=replicas, outage_s=outage_s,
+        replicas=replicas, outage_s=outage_s, prewarm=prewarm,
+        cold_start_s=round(base["cold_start_s"], 3),
+        prewarm_s=round(base["prewarm_s"], 3),
+        serve_compiles=dict(baseline=base["serve_compiles"],
+                            chaos=chaos["serve_compiles"],
+                            after_takeover=takeover_compiles),
+        failover_first_request_ms=failover_ms,
         baseline=dict(cycles=base["drive"]["cycles"],
                       placed=base["placed"],
                       wall_s=round(base["wall"], 3),
@@ -529,6 +621,13 @@ def run_chaos_fleet(
         f"end state identical: {identical} "
         f"(lost={len(lost)} extra={len(extra)} moved={len(moved)} "
         f"conflicts={chaos['conflicts']})")
+    if prewarm:
+        log(f"[chaos-fleet r{replicas}] prewarm: cold start "
+            f"{report['cold_start_s']:.2f}s (prewarm "
+            f"{report['prewarm_s']:.2f}s), serve compiles "
+            f"baseline={base['serve_compiles']} "
+            f"after-takeover={takeover_compiles}, failover first "
+            f"request {failover_ms} ms")
     return report
 
 
@@ -548,6 +647,10 @@ def main() -> int:
                          "sidecar fault plan")
     ap.add_argument("--kill-after-cycle", type=int, default=2)
     ap.add_argument("--outage-s", type=float, default=0.4)
+    ap.add_argument("--prewarm", action="store_true",
+                    help="fleet experiment only: boot replicas with "
+                         "explicit buckets + shape-class prewarm and "
+                         "ASSERT compile-free serving and failover")
     ap.add_argument("--json", default=None,
                     help="write the full report to this path")
     args = ap.parse_args()
@@ -557,7 +660,7 @@ def main() -> int:
             n_pods=args.pods, n_nodes=args.nodes, seed=args.seed,
             batch_size=args.batch, replicas=args.replicas,
             kill_after_cycle=args.kill_after_cycle,
-            outage_s=args.outage_s,
+            outage_s=args.outage_s, prewarm=args.prewarm,
             watchdog_s=max(args.watchdog_s, 30.0), log=err,
         )
     else:

@@ -257,6 +257,68 @@ def stage_statusz() -> "tuple[str, str]":
     return ("ok" if rc == 0 else "FAIL"), out
 
 
+_PREWARM_CODE = """
+import ast, json, os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import tpusched
+from tpusched import shapeclass
+from tpusched.config import Buckets, EngineConfig
+
+bk = Buckets.fit(16, 8, n_running=16)
+reg = shapeclass.build_registry(EngineConfig(mode="fast"), bk,
+                                explain=True, explain_k=3,
+                                warm="incremental")
+# 1) The registry survives its wire format exactly (a standby rebuilds
+# its leader's class set from this JSON).
+back = shapeclass.ShapeClassRegistry.from_json(reg.to_json())
+assert back == reg, "registry JSON round-trip drifted"
+assert back.to_json() == reg.to_json()
+# 2) Cross-check against engine.py's ACTUAL jit families: every
+# Engine._traced_jit call site names its family with a constant (or a
+# constant-prefixed f-string, which TPL104 proves is bucket-bounded).
+# The registry must stay inside that set, and must cover all of it
+# except the eager "solve" no serving path dispatches.
+path = os.path.join(os.path.dirname(tpusched.__file__), "engine.py")
+names = []
+for node in ast.walk(ast.parse(open(path).read())):
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "_traced_jit" and node.args):
+        a = node.args[0]
+        if isinstance(a, ast.Constant):
+            names.append((a.value, False))
+        elif isinstance(a, ast.JoinedStr):
+            assert a.values and isinstance(a.values[0], ast.Constant), (
+                "f-string jit family without a constant prefix: "
+                + ast.dump(a))
+            names.append((a.values[0].value, True))
+assert names, "no _traced_jit call sites found in engine.py"
+fams = set(reg.families())
+bad = [f for f in fams
+       if not any(f.startswith(n) if pre else f == n
+                  for n, pre in names)]
+assert not bad, f"registry families unknown to engine.py: {bad}"
+missing = [n for n, pre in names if n != "solve"
+           and not (any(f.startswith(n) for f in fams) if pre
+                    else n in fams)]
+assert not missing, (
+    f"engine jit families missing from the registry: {missing}")
+print(json.dumps(dict(classes=len(reg), families=sorted(fams),
+                      engine_sites=len(names))))
+"""
+
+
+def stage_prewarm() -> "tuple[str, str]":
+    # shapeclass itself is stdlib-only, but reaching it goes through
+    # the tpusched package import (flax/jax) — gate like warmaudit.
+    try:
+        import jax  # noqa: F401
+    except ImportError:
+        return "skip", "jax not installed on this image"
+    rc, out = _run([sys.executable, "-c", _PREWARM_CODE])
+    return ("ok" if rc == 0 else "FAIL"), out
+
+
 STAGES = (
     ("regen", stage_regen),
     ("lint", stage_lint),
@@ -269,6 +331,7 @@ STAGES = (
     ("warmaudit", stage_warmaudit),
     ("padcheck", stage_padcheck),
     ("statusz", stage_statusz),
+    ("prewarm", stage_prewarm),
 )
 
 

@@ -49,6 +49,8 @@ SCHEMA_EDITS = {
         ("role", 9, F.TYPE_STRING, "role"),
         ("replication_lag_seq", 10, F.TYPE_UINT64, "replicationLagSeq"),
         ("takeovers", 11, F.TYPE_INT64, "takeovers"),
+        # PR 18 (ISSUE 18): shape-class prewarm visibility.
+        ("prewarm_complete", 12, F.TYPE_BOOL, "prewarmComplete"),
     ],
     # Round 9 (ISSUE 4): cross-wire trace stitching — the client stamps
     # its trace id and active span id; absent id => server-minted.

@@ -166,6 +166,20 @@ class Buckets:
             pdb_groups=0,
         )
 
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "Buckets":
+        """Inverse of dataclasses.asdict for serialized bucket sets (the
+        shape-class registry round-trips buckets through JSON). Unknown
+        keys are rejected loudly: a registry written by a build with more
+        axes must not silently deserialize into smaller shapes."""
+        fields = {f.name for f in dataclasses.fields(Buckets)}
+        extra = set(d) - fields
+        if extra:
+            raise ValueError(
+                f"Buckets.from_dict: unknown bucket axes {sorted(extra)}"
+            )
+        return Buckets(**{k: int(v) for k, v in d.items()})
+
 
 @dataclasses.dataclass(frozen=True)
 class PluginWeights:

@@ -36,8 +36,11 @@ from tpusched.kernels.atoms import atom_sat
 from tpusched.kernels.pairwise import member_label_sat_t
 from tpusched.mesh import shard_snapshot
 from tpusched.ring import ring_sig_counts
+from tpusched.shapeclass import (CAUSE_PREWARM, CAUSE_SERVE,
+                                 ShapeClassRegistry, incremental_unassignable,
+                                 prewarm_records)
 from tpusched.shardctx import constrain_replicated
-from tpusched.snapshot import ClusterSnapshot
+from tpusched.snapshot import ClusterSnapshot, SnapshotBuilder
 
 
 @dataclasses.dataclass
@@ -378,6 +381,17 @@ class Engine:
         # ledger.COMPILES — the per-cycle retrace visibility the cycle
         # ledger's sentinel keys "compile" anomalies off.
         self._jit_nonce = next(_ENGINE_IDS)
+        # Shape-class registry hook (ROADMAP item 3): prewarm() fills
+        # `families` with the registered family set and dispatch then
+        # counts (and warns on) any family traced OUTSIDE it; `cause`
+        # labels compile events for the ledger ("prewarm" during boot
+        # tracing, "serve" otherwise). A plain dict — NOT self — so the
+        # dispatch closures hold no strong ref to the engine (the fetch
+        # worker's GC finalizer relies on that).
+        self._prewarm_meta: dict[str, Any] = {
+            "families": None, "unregistered": {}, "cause": CAUSE_SERVE,
+        }
+        self.registry: ShapeClassRegistry | None = None
         self._solve_jit = self._traced_jit("solve", _solve)
         self._solve_packed_jit = self._traced_jit("solve_packed",
                                                   _solve_packed)
@@ -430,6 +444,7 @@ class Engine:
         disabled watcher: one attribute read)."""
         jf = jax.jit(fn)  # tpl: disable=TPL103(the _traced_jit factory IS the cache: every call site stores the wrapper in an attr or bounded memo family, which TPL103/TPL104 enforce at those sites)
         nonce = self._jit_nonce
+        meta = self._prewarm_meta  # no self capture (see __init__)
 
         def dispatch(*args):
             watcher = ledgering.COMPILES
@@ -442,7 +457,21 @@ class Engine:
             t0 = time.perf_counter()
             out = jf(*args)
             watcher.note(key, name, _shape_label(args),
-                         time.perf_counter() - t0)
+                         time.perf_counter() - t0, cause=meta["cause"])
+            fams = meta["families"]
+            if fams is not None and name not in fams:
+                # Registry strictness (counted, not fatal): a family the
+                # registry missed still serves — but a prewarmed server
+                # was promised zero request-path traces, so the miss is
+                # loud and countable (Engine.unregistered_compiles).
+                meta["unregistered"][name] = (
+                    meta["unregistered"].get(name, 0) + 1)
+                logging.getLogger("tpusched.engine").warning(
+                    "jit family %r (%s) traced outside the attached "
+                    "shape-class registry — add it to "
+                    "shapeclass.build_registry so prewarm covers it",
+                    name, _shape_label(args),
+                )
             return out
 
         return dispatch
@@ -1008,6 +1037,125 @@ class Engine:
         return (
             buf[0].astype(np.int32), buf[1], buf[2] > 0,
             time.perf_counter() - t0,
+        )
+
+    @property
+    def unregistered_compiles(self) -> dict[str, int]:
+        """Per-family count of compiles traced OUTSIDE the attached
+        shape-class registry (empty until prewarm() attaches one).
+        Counted + warned, never fatal — the miss list is the work item
+        for shapeclass.build_registry."""
+        return dict(self._prewarm_meta["unregistered"])
+
+    class _PrewarmStop(Exception):
+        """Raised between shape classes when a prewarm's should_stop
+        callable fires — cooperative cancellation, never an error."""
+
+    def prewarm(self, registry: ShapeClassRegistry,
+                should_stop=None) -> dict:
+        """Trace every shape class in `registry` (ROADMAP item 3): after
+        this returns, a request at the registry's buckets through any
+        registered family dispatches an already-compiled program — the
+        compile-free failover a promoted standby needs. Also ATTACHES
+        the registry: later compiles outside its family set are counted
+        in `unregistered_compiles` and logged (not fatal).
+
+        Leaf shapes are a pure function of explicit Buckets, so the tiny
+        canonical clusters from shapeclass.prewarm_records compile the
+        exact programs real traffic at those buckets hits. Warm families
+        are driven through a real DeviceSnapshot lineage with the
+        canonical smallest delta (one upserted pod); the incremental
+        family needs one lineage per frontier cap, steered by
+        unassignable filler pods (shapeclass.incremental_unassignable).
+
+        Compile events recorded during this call carry cause="prewarm"
+        in ledger.COMPILES so boot work never reads as a serving
+        regression. Returns a report dict (classes / families /
+        compiles / compile_s / prewarm_s / cancelled).
+
+        should_stop: optional zero-arg callable polled BETWEEN shape
+        classes; returning True abandons the remaining classes (the
+        report comes back cancelled=True). A closing server uses this
+        so a boot prewarm racing shutdown stops after the in-flight
+        compile instead of keeping a thread inside XLA while the
+        interpreter tears down."""
+        from tpusched.device_state import DeviceSnapshot  # tpl: disable=TPL001(boot-time only: prewarm runs once per process; a top-level import would tax every engine import with the device-state layer it otherwise never needs)
+
+        t0 = time.perf_counter()
+        bk = registry.buckets
+        fams = frozenset(registry.families())
+        self.registry = registry
+        self._prewarm_meta["families"] = fams
+        before = ledgering.COMPILES.counters()
+        prev_cause = self._prewarm_meta["cause"]
+        self._prewarm_meta["cause"] = CAUSE_PREWARM
+        cancelled = False
+
+        def _ck() -> None:
+            if should_stop is not None and should_stop():
+                raise Engine._PrewarmStop
+
+        try:
+            nodes, pods, running = prewarm_records(self.config)
+            b = SnapshotBuilder(self.config, buckets=bk)
+            for n in nodes:
+                b.add_node(**n)
+            for p in pods:
+                b.add_pod(**p)
+            for r in running:
+                b.add_running_pod(**{k: v for k, v in r.items()
+                                     if k != "name"})
+            snap, _meta = b.build()
+            snap = self.put(snap)
+            if "solve_packed" in fams:
+                _ck()
+                self.solve_async(snap).result()
+            if "score" in fams:
+                _ck()
+                self.score_async(snap).result()
+            if "score_top1" in fams:
+                _ck()
+                self.score_top1(snap)
+            for cls in registry:
+                if cls.family.startswith("score_topk_k"):
+                    _ck()
+                    self.score_topk_async(
+                        snap, dict(cls.params)["k"]).result()
+            if registry.explain:
+                _ck()
+                p_solve, p_probe = self.solve_explained_async(
+                    snap, registry.explain_k)
+                p_solve.result()
+                p_probe.result()
+            if registry.warm is not None:
+                caps = ([dict(c.params)["cap"] for c in registry
+                         if c.family.startswith("warm_incremental_cap")]
+                        if registry.warm == "incremental" else [None])
+                for cap in caps:
+                    _ck()
+                    filler = (0 if cap is None else
+                              incremental_unassignable(cap, bk.pods))
+                    wn, wp, wr = prewarm_records(self.config, filler)
+                    ds = DeviceSnapshot(self.config, bk, mesh=self.mesh)
+                    ds.full_load(wn, wp, wr)
+                    self.solve_warm(ds)                # warm_cold_refresh
+                    ds.apply(upsert_pods=[wp[0]])
+                    self.solve_warm(ds)                # warm_refresh
+                    if cap is not None:
+                        ds.apply(upsert_pods=[wp[0]])
+                        self.solve_warm(ds, incremental=True)
+        except Engine._PrewarmStop:
+            cancelled = True
+        finally:
+            self._prewarm_meta["cause"] = prev_cause
+        after = ledgering.COMPILES.counters()
+        return dict(
+            classes=len(registry),
+            families=sorted(fams),
+            compiles=after[0] - before[0],
+            compile_s=round(after[1] - before[1], 6),
+            prewarm_s=round(time.perf_counter() - t0, 6),
+            cancelled=cancelled,
         )
 
     def warmup(self, snap: ClusterSnapshot) -> None:

@@ -951,19 +951,43 @@ def build_synthetic_cluster(api: FakeApiServer, rng, n_pods: int, n_nodes: int):
         )
 
 
+def synthetic_buckets(n_pods: int, n_nodes: int) -> Buckets:
+    """Explicit floor buckets covering a build_synthetic_cluster
+    workload through a FULL run: running_pods floors at n_pods (every
+    pending pod eventually binds), feature axes at the Buckets defaults
+    (the synthetic content's labels fit under them). Pinning these on a
+    fleet's servers makes every cycle ONE shape class — the finite set
+    a prewarmed replica compiles at boot (PR 18: chaos kill-the-leader
+    asserts a promoted standby's compile delta is 0), where
+    content-derived buckets would grow as pods bind and recompile
+    mid-run."""
+    return Buckets.fit(n_pods, n_nodes, n_running=n_pods)
+
+
 def run_e2e_benchmark(n_pods: int = 100, n_nodes: int = 10, iters: int = 10,
-                      use_grpc: bool = True):
+                      use_grpc: bool = True, prewarm: bool = False):
     """Full-boundary E2E: fake API server -> host shim -> gRPC sidecar
     -> engine -> binds. Returns bench.py-style percentile stats of the
-    complete cycle latency plus placements/sec."""
+    complete cycle latency plus placements/sec. prewarm=True boots the
+    sidecar with pinned synthetic_buckets and the full shape-class
+    registry traced (and reports the boot cost as cold_start_s /
+    prewarm_s), so the "+1 warmup" iteration pays no compile."""
     from tpusched.rpc.client import SchedulerClient  # tpl: disable=TPL001(grpc transport is optional; the in-process host must import without grpc)
     from tpusched.rpc.server import make_server  # tpl: disable=TPL001(grpc transport is optional; the in-process host must import without grpc)
 
     cfg = EngineConfig(mode="fast")
     server = client = shared_engine = svc = None
+    boot = dict(cold_start_s=0.0, prewarm_s=0.0)
     if use_grpc:
-        server, port, svc = make_server("127.0.0.1:0", config=cfg)
+        t_boot = time.perf_counter()
+        server, port, svc = make_server(
+            "127.0.0.1:0", config=cfg,
+            buckets=synthetic_buckets(n_pods, n_nodes) if prewarm else None,
+            prewarm=prewarm)
         server.start()
+        svc.wait_prewarmed()
+        boot["cold_start_s"] = round(time.perf_counter() - t_boot, 6)
+        boot["prewarm_s"] = svc.prewarm_s
         client = SchedulerClient(f"127.0.0.1:{port}")
     else:
         shared_engine = Engine(cfg)  # one jit cache across iterations
@@ -999,4 +1023,5 @@ def run_e2e_benchmark(n_pods: int = 100, n_nodes: int = 10, iters: int = 10,
         mean=float(times.mean()),
         iters=len(times),
         placements_per_sec=round(placed_total / times.sum(), 1),
+        **boot,
     )

@@ -18,9 +18,12 @@ Three pieces:
     class runs trace+lower+compile synchronously, so its wall time IS
     the compile cost; later dispatches are one set-membership check.
     Cycle emitters read `COMPILES.counters()` before/after a cycle to
-    attribute retraces to the cycle that paid them — the visibility
-    ROADMAP item 4 (persistent compile cache, shape-class prewarm) is
-    blocked on.
+    attribute retraces to the cycle that paid them. Events carry a
+    `cause` ("serve" by default; `Engine.prewarm` tags its boot-time
+    traces "prewarm" via tpusched.shapeclass.CAUSE_PREWARM) so the
+    shape-class prewarm + persistent-cache layer (ROADMAP item 3) never
+    reads as a serving regression — a prewarm runs before any cycle, and
+    the timeline still shows the split for forensics.
   * `CycleLedger` — the ring + rolling-window aggregation, reusing
     metrics.Histogram buckets plus the bucket-interpolated
     `Histogram.quantile()` for the rolling p50/p99 per stage, churn
@@ -178,6 +181,7 @@ class CompileWatcher:
         self._seen: "dict[Any, None]" = {}  # insertion-ordered key set
         self._seen_cap = int(seen_cap)
         self._events: "deque[dict[str, Any]]" = deque(maxlen=int(capacity))
+        self._by_cause: "dict[str, int]" = {}
         self.total = 0
         self.compile_s_total = 0.0
         self.enabled = True
@@ -186,11 +190,16 @@ class CompileWatcher:
         with self._lock:
             return key in self._seen
 
-    def note(self, key: Any, fn: str, shape: str, dur_s: float) -> bool:
+    def note(self, key: Any, fn: str, shape: str, dur_s: float,
+             cause: str = "serve") -> bool:
         """Record one first-dispatch (compile) event; False when a
-        racing first caller already recorded this key."""
+        racing first caller already recorded this key. `cause` labels
+        WHY the program was traced ("serve" for a request-path cache
+        miss, "prewarm" for Engine.prewarm boot work) — the split the
+        cycle sentinel's "compile" attribution and the prewarm tests
+        read back through cause_counts()/timeline()."""
         ev = dict(ts=time.time(), fn=fn, shape=shape,
-                  compile_s=round(float(dur_s), 6))
+                  compile_s=round(float(dur_s), 6), cause=str(cause))
         with self._lock:
             if key in self._seen:
                 return False
@@ -199,6 +208,7 @@ class CompileWatcher:
                 self._seen.pop(next(iter(self._seen)))
             self.total += 1
             self.compile_s_total += float(dur_s)
+            self._by_cause[str(cause)] = self._by_cause.get(str(cause), 0) + 1
             self._events.append(ev)
             return True
 
@@ -207,6 +217,13 @@ class CompileWatcher:
         read this before/after a cycle to attribute retraces."""
         with self._lock:
             return self.total, self.compile_s_total
+
+    def cause_counts(self) -> "dict[str, int]":
+        """Monotonic per-cause compile totals (unlike the capped event
+        timeline): {"prewarm": boot traces, "serve": request-path
+        cache misses, ...}."""
+        with self._lock:
+            return dict(self._by_cause)
 
     def timeline(self) -> "list[dict[str, Any]]":
         with self._lock:

@@ -211,6 +211,17 @@ class StandbyFollower:
     def lag(self) -> int:
         return max(0, self.known_end - self.applied_seq)
 
+    @property
+    def prewarmed(self) -> bool:
+        """True once this standby's shape-class prewarm finished (or
+        was never configured): the compiled-program half of "warm
+        standby", next to the replicated-state half `lag()` measures.
+        The standby prewarms the same registry its leader derived —
+        ReplicaSet hands every replica identical make_kw (config,
+        buckets, prewarm), restarts included — so promotion serves its
+        first request compile-free (PR 18)."""
+        return bool(getattr(self.svc, "prewarm_complete", True))
+
     def _run(self) -> None:
         import grpc
 
@@ -365,9 +376,15 @@ class ReplicaSet:
 
     def wait_caught_up(self, timeout: float = 10.0) -> bool:
         """Block until every live standby's applied seq reaches the
-        current leader's log end (True) or timeout (False). Chaos runs
-        call this before a kill so 'warm standby' is a property the
-        harness controls, not a race it hopes to win."""
+        current leader's log end AND every live replica's shape-class
+        prewarm — the leader's own boot prewarm included — is complete
+        (True), or timeout (False). make_kw's `prewarm=` reaches every
+        replica, restarts included, so a standby's registry mirrors its
+        leader's. Chaos runs call this before a kill so 'warm standby'
+        — replicated state AND compiled programs — is a property the
+        harness controls, not a race it hopes to win: after True, the
+        leader serves without compiling and a promotion serves its
+        first Assign with zero new compiles (PR 18)."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             li = self.leader_index()
@@ -378,9 +395,10 @@ class ReplicaSet:
                 f for i, f in enumerate(self.followers)
                 if f is not None and i not in self._dead
                 and self.services[i].role == "standby"
-                and f.applied_seq < end
+                and (f.applied_seq < end
+                     or not self.services[i].prewarm_complete)
             ]
-            if not lagging:
+            if not lagging and self.services[li].prewarm_complete:
                 return True
             time.sleep(min(self._poll_s / 2, 0.05))
         return False

@@ -135,6 +135,7 @@ import grpc
 from tpusched import explain as explaining
 from tpusched import ledger as ledgering
 from tpusched import metrics as pm
+from tpusched import shapeclass
 from tpusched import trace as tracing
 from tpusched.faults import NO_FAULTS
 from tpusched.mesh import make_mesh
@@ -706,6 +707,7 @@ class SchedulerService:
         warm: "str | None" = None,
         ledger: "ledgering.CycleLedger | None" = None,
         ledger_jsonl: "str | None" = None,
+        prewarm: bool = False,
     ):
         """audit_stream: optional file-like; when set, every Assign
         emits one JSON record PER POD (pod, node, score, commit_key —
@@ -769,7 +771,16 @@ class SchedulerService:
         commit rounds, and the XLA cache misses the request paid —
         served by the Statusz rpc / tools/statusz.py. ledger_jsonl:
         optional path for the JSONL black box (every record appended;
-        ignored when `ledger` is injected)."""
+        ignored when `ledger` is injected).
+
+        prewarm (PR 18, ROADMAP item 3): True traces EVERY shape class
+        in the registry derived from (config, buckets, explain, warm) on
+        a background boot thread — requires explicit `buckets` (no
+        finite shape set exists otherwise). `prewarm_complete` flips
+        when done (Health field 12; ReplicaSet.wait_caught_up blocks on
+        it for standbys, so a promotion serves its first Assign with
+        zero new compiles). Compiles traced during boot land in
+        ledger.COMPILES with cause="prewarm"."""
         self.config = config or EngineConfig()
         # Floor buckets pin compile shapes across requests (a feature
         # first appearing mid-serving would otherwise trigger a full
@@ -902,6 +913,74 @@ class SchedulerService:
         self.replication_lag = 0      # updated by StandbyFollower
         self.replication_applied = 0  # ops applied as a standby
         self.replication_skipped = 0  # delta ops whose base was gone
+        # Shape-class prewarm (PR 18, ROADMAP item 3): boot-time tracing
+        # of the full registry on a daemon thread, so construction stays
+        # fast and a fleet boots its replicas' compiles in parallel.
+        # prewarm_complete is True for non-prewarming servers too ("as
+        # warm as it will get") — wait_caught_up can gate uniformly.
+        self.registry = None
+        self.registry_classes = 0
+        self.prewarm_classes_done = 0
+        self.prewarm_s = 0.0
+        self.prewarm_error: "str | None" = None
+        self.prewarm_complete = not prewarm
+        self._prewarm_thread: "threading.Thread | None" = None
+        # close() sets this so a boot prewarm racing shutdown abandons
+        # its remaining classes after the in-flight compile — a daemon
+        # thread left inside XLA at interpreter exit aborts the process.
+        self._prewarm_stop = threading.Event()
+        if prewarm:
+            if self.buckets is None:
+                raise ValueError(
+                    "prewarm=True needs explicit buckets=: shape classes "
+                    "are a function of pinned bucket sizes "
+                    "(tpusched.shapeclass.build_registry)"
+                )
+            self.registry = shapeclass.build_registry(
+                self.config, self.buckets,
+                explain=self.explain.enabled, explain_k=self._explain_k,
+                warm=self._warm,
+            )
+            self.registry_classes = len(self.registry)
+            self._prewarm_thread = threading.Thread(
+                target=self._run_prewarm, name="tpusched-prewarm",
+                daemon=True)
+            self._prewarm_thread.start()
+
+    def _run_prewarm(self) -> None:
+        try:
+            report = self._engine.prewarm(
+                self.registry, should_stop=self._prewarm_stop.is_set)
+            if report["cancelled"]:
+                logging.getLogger("tpusched.rpc.server").info(
+                    "shape-class prewarm cancelled by close() after "
+                    "%.2fs", report["prewarm_s"])
+                return
+            self.prewarm_classes_done = report["classes"]
+            self.prewarm_s = report["prewarm_s"]
+            self._trace.record(
+                "server.prewarm", dur_s=report["prewarm_s"], cat="server",
+                classes=report["classes"], compiles=report["compiles"])
+        except Exception:
+            # A failed prewarm must not wedge wait_caught_up or boot —
+            # the server still serves (compiling on demand); the error
+            # is loud here and visible via prewarm_error/statusz.
+            self.prewarm_error = traceback.format_exc(limit=5)
+            logging.getLogger("tpusched.rpc.server").error(
+                "shape-class prewarm failed; serving will compile on "
+                "demand:\n%s", self.prewarm_error)
+        finally:
+            self.prewarm_complete = True
+
+    def wait_prewarmed(self, timeout: "float | None" = None) -> bool:
+        """Block until the boot prewarm finishes (immediately True when
+        prewarm is off). The chaos/bench harnesses call this before
+        measuring so cold-start compile time never leaks into serving
+        metrics."""
+        t = self._prewarm_thread
+        if t is not None:
+            t.join(timeout)
+        return self.prewarm_complete
 
     def _store_put_locked(self, sid: str, store: SnapshotStore) -> None:
         """Insert + evict under _store_lock (caller holds it). The ONE
@@ -1469,6 +1548,15 @@ class SchedulerService:
         with self._store_lock:
             already = self._closed
             self._closed = True
+        # Stop a still-running boot prewarm FIRST (before the engine's
+        # fetch worker drains — prewarm dispatches through it): it
+        # abandons remaining classes after its in-flight compile. The
+        # bounded join keeps close() from hanging on a pathological
+        # compile; the thread is a daemon either way.
+        self._prewarm_stop.set()
+        t = self._prewarm_thread
+        if t is not None:
+            t.join(timeout=60.0)
         self._gate.close()
         self._engine.close(wait=True)
         self.ledger.close()  # releases the JSONL black box, if any
@@ -1997,6 +2085,7 @@ class SchedulerService:
             role=self.role,
             replication_lag_seq=self.replication_lag,
             takeovers=self.takeovers,
+            prewarm_complete=self.prewarm_complete,
         )
 
     def Replicate(self, request: pb.ReplicateRequest,
@@ -2082,6 +2171,14 @@ class SchedulerService:
             f"{self.replication_applied}",
             f'scheduler_replication_ops_total{{op="skipped"}} '
             f"{self.replication_skipped}",
+            # Shape-class prewarm surface (PR 18, ROADMAP item 3): how
+            # many of the registry's classes are traced vs registered —
+            # done < registry on a scrape means a half-warm standby
+            # whose promotion would still pay compiles.
+            "# TYPE scheduler_registry_classes gauge",
+            f"scheduler_registry_classes {self.registry_classes}",
+            "# TYPE scheduler_prewarmed_classes gauge",
+            f"scheduler_prewarmed_classes {self.prewarm_classes_done}",
         ]
         return pb.MetricsResponse(
             prometheus_text=self.metrics.render() + "\n".join(extra) + "\n"
@@ -2177,6 +2274,7 @@ def make_server(
     warm: "str | None" = None,
     ledger: "ledgering.CycleLedger | None" = None,
     ledger_jsonl: "str | None" = None,
+    prewarm: bool = False,
 ):
     """Build (grpc.Server, bound_port, service). Unlimited message size:
     a 10k-pod snapshot exceeds the 4 MB default. max_workers default 8:
@@ -2194,7 +2292,10 @@ def make_server(
     None | "bitwise" | "incremental"; SchedulerService docstring);
     ledger/ledger_jsonl: the cycle flight ledger + its optional JSONL
     black box (round 18, ISSUE 13 — served by the Statusz rpc /
-    tools/statusz.py)."""
+    tools/statusz.py); prewarm: boot-time tracing of the full
+    shape-class registry (PR 18 — needs explicit buckets; the service's
+    prewarm_complete / Health field 12 flips when every class is
+    compiled, and ReplicaSet.wait_caught_up blocks on it)."""
     svc = SchedulerService(config, buckets, log_stream=log_stream,
                            audit_stream=audit_stream,
                            device_sessions=device_sessions,
@@ -2203,7 +2304,7 @@ def make_server(
                            role=role, replication_log=replication_log,
                            explain=explain, explain_k=explain_k,
                            warm=warm, ledger=ledger,
-                           ledger_jsonl=ledger_jsonl)
+                           ledger_jsonl=ledger_jsonl, prewarm=prewarm)
 
     def handler(fn, req_cls):
         return grpc.unary_unary_rpc_method_handler(
@@ -2238,12 +2339,20 @@ def make_server(
 
 def serve(address: str = "127.0.0.1:50051", config: EngineConfig | None = None,
           audit_path: str | None = None, watchdog_s: float = WATCHDOG_S,
-          explain: bool = False, ledger_jsonl: str | None = None):
+          explain: bool = False, ledger_jsonl: str | None = None,
+          buckets: Buckets | None = None, prewarm: bool = False,
+          compile_cache: str | None = None):
     """Blocking entry point: python -m tpusched.rpc.server"""
+    # Persistent XLA cache first (PR 18): a restarted sidecar then
+    # reloads its programs instead of recompiling them — prewarm still
+    # traces each class, but the trace hits the on-disk cache.
+    shapeclass.enable_persistent_cache(compile_cache)
     audit = open(audit_path, "a") if audit_path else None
-    server, port, svc = make_server(address, config, audit_stream=audit,
+    server, port, svc = make_server(address, config, buckets=buckets,
+                                    audit_stream=audit,
                                     watchdog_s=watchdog_s, explain=explain,
-                                    ledger_jsonl=ledger_jsonl)
+                                    ledger_jsonl=ledger_jsonl,
+                                    prewarm=prewarm)
     server.start()
     print(f"tpusched sidecar listening on port {port}", file=sys.stderr)
     try:
@@ -2270,12 +2379,32 @@ if __name__ == "__main__":
                     help="append every cycle flight-ledger record to "
                          "this JSONL black box (round 18; the Statusz "
                          "rpc serves the in-memory ring either way)")
+    ap.add_argument("--buckets", default=None, metavar="PODSxNODES[xRUN]",
+                    help="explicit floor buckets, e.g. 256x64 or "
+                         "256x64x512 (Buckets.fit) — pins compile "
+                         "shapes; required by --prewarm")
+    ap.add_argument("--prewarm", action="store_true",
+                    help="trace the full shape-class registry at boot "
+                         "(PR 18: zero request-path compiles afterward; "
+                         "needs --buckets)")
+    ap.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="persistent XLA compilation cache directory "
+                         "(default: $TPUSCHED_COMPILE_CACHE when set) — "
+                         "a restarted sidecar reloads programs instead "
+                         "of recompiling")
     args = ap.parse_args()
     cfg = None
     if args.config:
         from tpusched.config import load_config
 
         cfg = load_config(args.config)
+    bk = None
+    if args.buckets:
+        dims = [int(x) for x in args.buckets.lower().split("x")]
+        if len(dims) not in (2, 3):
+            ap.error("--buckets wants PODSxNODES or PODSxNODESxRUNNING")
+        bk = Buckets.fit(*dims)
     serve(args.address, cfg, audit_path=args.audit,
           watchdog_s=args.watchdog_s, explain=args.explain,
-          ledger_jsonl=args.ledger_jsonl)
+          ledger_jsonl=args.ledger_jsonl, buckets=bk,
+          prewarm=args.prewarm, compile_cache=args.compile_cache)
