@@ -73,6 +73,7 @@ def measure_transport(iters: int = 12) -> dict:
         np.asarray(f(x))
         ts.append(time.perf_counter() - t0)
     rtt_ms = float(np.percentile(ts, 50) * 1e3)
+    rtt_p99_ms = float(np.percentile(ts, 99) * 1e3)
     big = jax.jit(
         lambda k: jax.random.uniform(k, (1024, 2048))  # 8 MB fresh result
     )
@@ -83,10 +84,12 @@ def measure_transport(iters: int = 12) -> dict:
     a = np.asarray(out)
     dt = time.perf_counter() - t0
     d2h = a.nbytes / 1e6 / max(dt - rtt_ms / 1e3, 1e-6)
-    TRANSPORT.update(rtt_ms=round(rtt_ms, 2), d2h_mbps=round(d2h, 1))
-    log(f"transport: result-fetch RTT {rtt_ms:.1f}ms, "
-        f"D2H ~{d2h:.0f} MB/s (subtract RTT from any p50 below to "
-        f"estimate device compute)")
+    TRANSPORT.update(rtt_ms=round(rtt_ms, 2),
+                     rtt_p99_ms=round(rtt_p99_ms, 2),
+                     d2h_mbps=round(d2h, 1))
+    log(f"transport: result-fetch RTT p50 {rtt_ms:.1f}ms / "
+        f"p99 {rtt_p99_ms:.1f}ms, D2H ~{d2h:.0f} MB/s (subtract RTT "
+        f"from any p50 below to estimate device compute)")
     return TRANSPORT
 
 
@@ -163,6 +166,7 @@ def emit(metric: str, stats: dict, extra: dict | None = None,
     }
     if TRANSPORT:
         line["rtt_ms"] = TRANSPORT["rtt_ms"]
+        line["rtt_p99_ms"] = TRANSPORT.get("rtt_p99_ms")
     if extra:
         line.update(extra)
     print(json.dumps(line), flush=True)
@@ -578,7 +582,10 @@ def bench_wire(args):
     for mode in _modes(args):
         server, port, svc = make_server(config=EngineConfig(mode=mode))
         server.start()
-        client = SchedulerClient(f"127.0.0.1:{port}")
+        # wire=svc.wire (round 19): the client assembles a WireRecord
+        # per cycle into the SERVER's ledger, so the breakdown section
+        # below can read component percentiles off svc.wire directly.
+        client = SchedulerClient(f"127.0.0.1:{port}", wire=svc.wire)
         sess = DeltaSession(client)
         try:
             log(f"[wire] Assign@{pods}x{nodes} mode={mode} "
@@ -684,6 +691,11 @@ def bench_wire(args):
                 against_budget=(pods == 10_000 and nodes == 5_000),
             )
             if mode == _modes(args)[-1]:
+                # Wire-ledger breakdown + ledger cost (round 19):
+                # measured once, on the last server, before ScoreBatch
+                # repoints `sess` traffic at a different RPC.
+                _wire_ledger_section(svc, sess, msg, mutate,
+                                     pods, nodes, iters)
                 # ScoreBatch top-k wire cycle (mode-independent scores;
                 # measured once, on the last server).
                 k = 8
@@ -830,6 +842,107 @@ def bench_wire(args):
             client.close()
             server.stop(None)
             svc.close()
+
+
+def _wire_ledger_section(svc, sess, msg, mutate, pods, nodes, iters):
+    """Wire-ledger breakdown + serve-path cost (round 19, ISSUE 19).
+
+    Three acceptance numbers fall out of one OFF/ON pair of
+    steady-state delta arms on the ledgered client:
+
+      * ``wire_breakdown_{component}_ms_{p50,p99}`` — per-component
+        percentiles of the clock-stitched round-trip decomposition
+        (client serialize, one-way send/reply gaps, every server
+        stage, D2H fetch.join, server residue);
+      * ``wire_breakdown_coverage_frac`` — the sum-vs-wall check: the
+        components must explain >= 90% of the measured cycle wall
+        over real gRPC (gap clamping + unstitched cycles eat the
+        rest, so a low number means the clock-offset estimator or
+        span pairing regressed);
+      * ``wire_ledger_overhead_pct`` — what ledgering costs the serve
+        path (the extra client serialize pass + span assembly): OFF
+        p50 vs ON p50, budget <= 1%.
+    """
+    led = svc.wire
+    arm = max(20, iters // 2)
+    log(f"[wire] ledger OFF arm ({arm} cycles)")
+    led.enabled = False  # client skips serialize span + assembly
+    try:
+        off_ts = []
+        for _ in range(arm):
+            changed = mutate()
+            t0 = time.perf_counter()
+            sess.assign(msg, packed_ok=True, changed=changed)
+            off_ts.append(time.perf_counter() - t0)
+    finally:
+        led.enabled = True
+    n_before = len(led.records())
+    log(f"[wire] ledger ON arm ({arm} cycles)")
+    on_ts = []
+    for _ in range(arm):
+        changed = mutate()
+        t0 = time.perf_counter()
+        sess.assign(msg, packed_ok=True, changed=changed)
+        on_ts.append(time.perf_counter() - t0)
+    recs = led.records()[n_before:]
+    off_p50 = float(np.percentile(np.asarray(off_ts), 50))
+    on_p50 = float(np.percentile(np.asarray(on_ts), 50))
+    overhead_pct = (on_p50 - off_p50) / max(off_p50, 1e-9) * 100.0
+    log(f"  ledger overhead: OFF p50 {off_p50 * 1e3:.1f}ms, "
+        f"ON p50 {on_p50 * 1e3:.1f}ms -> {overhead_pct:+.2f}% "
+        f"(budget <= 1%)")
+    print(json.dumps({
+        "metric": "wire_ledger_overhead_pct",
+        "value": round(overhead_pct, 2),
+        "unit": "pct", "direction": "lower",
+        "off_p50_ms": round(off_p50 * 1e3, 2),
+        "on_p50_ms": round(on_p50 * 1e3, 2),
+        "iters": arm, "shape": f"{pods}x{nodes}",
+    }), flush=True)
+    if not recs:
+        log("[wire] ledger produced no records on the ON arm; "
+            "skipping breakdown")
+        return
+    walls = sum(r.wall_s for r in recs)
+    staged = sum(sum(r.stages.values()) for r in recs)
+    coverage = staged / max(walls, 1e-12)
+    stitched = sum(1 for r in recs if r.stitched)
+    best = led.clock.best()
+    off_ms = round(best[0] * 1e3, 3) if best else None
+    unc_ms = round(best[1] * 1e3, 3) if best else None
+    ok = coverage >= 0.90
+    log(f"  breakdown: {len(recs)} cycles ({stitched} stitched), "
+        f"components cover {coverage:.1%} of cycle wall "
+        f"({'OK' if ok else 'BELOW the 90% acceptance bar'}), "
+        f"clock offset {off_ms}ms +/- {unc_ms}ms")
+    print(json.dumps({
+        "metric": "wire_breakdown_coverage_frac",
+        "value": round(coverage, 4),
+        "unit": "frac", "direction": "higher",
+        "cycles": len(recs), "stitched": stitched,
+        "clock_offset_ms": off_ms, "clock_uncertainty_ms": unc_ms,
+        "bytes_up": sum(r.bytes_up for r in recs),
+        "bytes_down": sum(r.bytes_down for r in recs),
+        "shape": f"{pods}x{nodes}",
+    }), flush=True)
+    comps: dict = {}
+    for r in recs:
+        for name, v in r.stages.items():
+            comps.setdefault(name, []).append(v * 1e3)
+    for name in sorted(comps):
+        arr = np.asarray(comps[name])
+        slug = name.replace(".", "_")
+        p50 = float(np.percentile(arr, 50))
+        p99 = float(np.percentile(arr, 99))
+        log(f"    {name:<12s} p50 {p50:8.3f}ms  p99 {p99:8.3f}ms "
+            f"({arr.size} cycles)")
+        for tag, val in (("p50", p50), ("p99", p99)):
+            print(json.dumps({
+                "metric": f"wire_breakdown_{slug}_ms_{tag}",
+                "value": round(val, 3),
+                "unit": "ms", "iters": int(arr.size),
+                "shape": f"{pods}x{nodes}",
+            }), flush=True)
 
 
 def _session_h2d(svc) -> dict:
@@ -1922,8 +2035,17 @@ def main():
     if args.only:
         BENCHES[args.only](args)
         return
+    first = next(iter(BENCHES))
     for name, fn in BENCHES.items():
         try:
+            if name != first:
+                # Stale-RTT fix (round 19, ISSUE 19 satellite): the
+                # tunnel RTT drifts tens of ms as the link warms, so
+                # a startup-only measurement mis-stamps every later
+                # section's device_ms estimate. Re-characterize per
+                # section; the stamped rtt then belongs to the lines
+                # it contextualizes.
+                measure_transport()
             fn(args)
         except Exception as e:  # one bench failing must not mask the rest
             log(f"[{name}] FAILED: {type(e).__name__}: {e}")

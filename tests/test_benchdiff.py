@@ -157,6 +157,7 @@ def test_sharded_serving_metric_directions_are_registered():
         "serve_qps_sharded_*": "higher",
         "shard_combine_ms_*": "lower",
         "solve_p99_latency_*_sharded": "lower",
+        "wire_*": "lower",
     }
     assert not benchdiff.lower_is_better(
         "serve_qps_sharded_100000x50000", "qps", None)
@@ -168,6 +169,83 @@ def test_sharded_serving_metric_directions_are_registered():
         "padcheck_mesh_divergences_total"] == "lower"
     assert benchdiff.lower_is_better(
         "padcheck_mesh_divergences_total", "count", None)
+
+
+def test_wire_metric_directions_are_registered(tmp_path):
+    """ISSUE 19 satellite: every wire_* metric bench.py emits is
+    direction-pinned. The family glob makes all wire breakdown /
+    latency / byte metrics lower-better at every component and shape
+    suffix; the two metrics whose direction the glob or the unit
+    inference would get WRONG — coverage (higher-better fraction) and
+    overhead (pct, a unit inference ignores) — are pinned in the
+    exact-name table, which is consulted before the globs."""
+    assert benchdiff._EXPLICIT_DIRECTION[
+        "wire_ledger_overhead_pct"] == "lower"
+    assert benchdiff._EXPLICIT_DIRECTION[
+        "wire_breakdown_coverage_frac"] == "higher"
+    # the family: breakdown components, assign/scorebatch latencies,
+    # pipelined cycle walls — lower-better regardless of suffix.
+    for m in ("wire_breakdown_gate_wait_ms_p99",
+              "wire_breakdown_send_gap_ms_p50",
+              "wire_breakdown_server_other_ms_p99",
+              "wire_assign_p99_latency_10000x5000",
+              "wire_pipelined_cycle_ms_10000x5000"):
+        assert benchdiff.lower_is_better(m, "ms", None), m
+    # the exceptions resolve through the exact table, not the glob:
+    assert benchdiff.lower_is_better("wire_ledger_overhead_pct",
+                                     "pct", None)
+    assert not benchdiff.lower_is_better("wire_breakdown_coverage_frac",
+                                         "frac", None)
+    # end to end: a coverage drop + an overhead rise both flag, even
+    # with the bench-line annotation stripped (hand-built snapshots).
+    a = _snap(tmp_path, 9, [
+        dict(metric="wire_breakdown_coverage_frac", value=0.97,
+             unit="frac"),
+        dict(metric="wire_ledger_overhead_pct", value=0.3, unit="pct"),
+        dict(metric="wire_breakdown_decode_ms_p99", value=4.0,
+             unit="ms"),
+    ])
+    b = _snap(tmp_path, 10, [
+        dict(metric="wire_breakdown_coverage_frac", value=0.55,
+             unit="frac"),
+        dict(metric="wire_ledger_overhead_pct", value=3.0, unit="pct"),
+        dict(metric="wire_breakdown_decode_ms_p99", value=9.0,
+             unit="ms"),
+    ])
+    diff = benchdiff.diff_rounds([a, b], threshold=0.10)
+    assert all(m["regressed"] for m in diff["metrics"].values()), \
+        {k: v["regressed"] for k, v in diff["metrics"].items()}
+
+
+def test_bench_wire_lines_resolve_under_tpl006():
+    """The TPL006 lens over bench.py's wire-section emissions: the two
+    annotated literals (overhead, coverage) must keep their direction
+    keys, and they must agree with the registered table — no dynamic-
+    name escapes."""
+    import ast
+    import pathlib
+
+    bench_src = pathlib.Path(benchdiff.__file__).parent.parent / "bench.py"
+    tree = ast.parse(bench_src.read_text())
+    found = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        keys = {k.value: v for k, v in zip(node.keys, node.values)
+                if isinstance(k, ast.Constant)}
+        metric = keys.get("metric")
+        if (isinstance(metric, ast.Constant)
+                and metric.value in ("wire_ledger_overhead_pct",
+                                     "wire_breakdown_coverage_frac")):
+            direction = keys.get("direction")
+            assert isinstance(direction, ast.Constant), (
+                f"{metric.value} bench line lost its direction key")
+            found[metric.value] = direction.value
+    assert found == {"wire_ledger_overhead_pct": "lower",
+                     "wire_breakdown_coverage_frac": "higher"}
+    assert found == {
+        m: benchdiff._EXPLICIT_DIRECTION[m] for m in found
+    }, "bench-line annotations drifted from the registered table"
 
 
 def test_prewarm_metric_directions_are_registered():

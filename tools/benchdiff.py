@@ -67,6 +67,12 @@ _EXPLICIT_DIRECTION = {
     "cold_start_s": "lower",
     "prewarm_s": "lower",
     "failover_first_request_ms": "lower",
+    # Wire ledger (round 19, ISSUE 19): the ledger's serve-path cost
+    # and the components-vs-wall coverage check. Overhead is a pct
+    # (unit inference would call it higher-better); coverage is a
+    # fraction the wire_* lower-better glob below would flip.
+    "wire_ledger_overhead_pct": "lower",
+    "wire_breakdown_coverage_frac": "higher",
 }
 # Registered direction GLOBS (round 22, ISSUE 17): the sharded-serving
 # metric families from bench.py's multichip section. Consulted after
@@ -77,6 +83,11 @@ _EXPLICIT_DIRECTION_GLOBS = (
     ("serve_qps_sharded_*", "higher"),
     ("shard_combine_ms_*", "lower"),
     ("solve_p99_latency_*_sharded", "lower"),
+    # Wire ledger (round 19, ISSUE 19): every wire_* metric is a
+    # latency, byte count, or stall breakdown — lower is better. The
+    # two higher-better exceptions (coverage_frac) and the pct metric
+    # live in the exact-name table above, which is consulted first.
+    ("wire_*", "lower"),
 )
 
 

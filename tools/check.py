@@ -70,7 +70,8 @@ LINT_PATHS = ("tpusched", "tools", "bench.py", "tests")
 SYNTAX_ROOTS = ("tpusched", "tools", "tests", "bench.py")
 MYPY_TARGETS = ("tpusched/config.py", "tpusched/qos.py",
                 "tpusched/metrics.py", "tpusched/ledger.py",
-                "tpusched/trace.py", "tpusched/lint",
+                "tpusched/trace.py", "tpusched/wire.py",
+                "tpusched/lint",
                 "tpusched/kernels/filter.py",
                 "tpusched/kernels/score.py", "tpusched/oracle.py")
 
@@ -257,6 +258,70 @@ def stage_statusz() -> "tuple[str, str]":
     return ("ok" if rc == 0 else "FAIL"), out
 
 
+_WIREZ_CODE = """
+import json, os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from tpusched import wire as wiring
+from tpusched.config import EngineConfig
+from tpusched.rpc.client import SchedulerClient
+from tpusched.rpc.codec import snapshot_to_proto
+from tpusched.rpc.server import make_server
+
+server, port, svc = make_server("127.0.0.1:0",
+                                config=EngineConfig(mode="fast"))
+server.start()
+try:
+    # wire=svc.wire: the client assembles each cycle's WireRecord into
+    # the SERVER's ledger, so the Statusz wire panel below is fed.
+    with SchedulerClient(f"127.0.0.1:{port}", wire=svc.wire) as client:
+        msg = snapshot_to_proto(
+            [dict(name="n0", allocatable={"cpu": 4000.0,
+                                          "memory": float(16 << 30)})],
+            [dict(name="p0", requests={"cpu": 500.0,
+                                       "memory": float(1 << 30)})],
+            [],
+        )
+        client.assign(msg, packed_ok=True)
+        sz = json.loads(client.statusz().statusz_json)
+        metrics_text = client.metrics_text()
+finally:
+    server.stop(0)
+    svc.close()
+assert client.wire_errors == 0, client.wire_errors
+panel = sz.get("wire")
+assert panel, "Statusz payload has no wire panel"
+assert panel["cycles"] >= 1, panel
+recs = panel["records"]
+assert recs, "wire ledger observed no cycle"
+for rec in recs:
+    wiring.validate_record(rec)
+    assert rec["rpc"] == "Assign" and rec["stitched"], rec
+    assert rec["bytes_up"] > 0 and rec["bytes_down"] > 0, rec
+assert panel["wall"]["p50_ms"] is not None, panel["wall"]
+# Exposition smoke: the wire families render in THIS server's registry
+# (the strict format checker lives in tests/).
+assert "# TYPE scheduler_wire_wall_seconds histogram" in metrics_text
+assert "# TYPE scheduler_wire_bytes counter" in metrics_text
+assert ('scheduler_wire_bytes{direction="up",rpc="Assign"}'
+        in metrics_text)
+assert ('scheduler_wire_cycles_total{rpc="Assign",source="call"}'
+        in metrics_text)
+print(json.dumps(dict(cycles=panel["cycles"],
+                      coverage=panel["coverage_frac"],
+                      offset_ms=panel["offset_ms"])))
+"""
+
+
+def stage_wirez() -> "tuple[str, str]":
+    try:
+        import grpc  # noqa: F401
+        import jax  # noqa: F401
+    except ImportError:
+        return "skip", "jax/grpc not installed on this image"
+    rc, out = _run([sys.executable, "-c", _WIREZ_CODE])
+    return ("ok" if rc == 0 else "FAIL"), out
+
+
 _PREWARM_CODE = """
 import ast, json, os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -331,6 +396,7 @@ STAGES = (
     ("warmaudit", stage_warmaudit),
     ("padcheck", stage_padcheck),
     ("statusz", stage_statusz),
+    ("wirez", stage_wirez),
     ("prewarm", stage_prewarm),
 )
 

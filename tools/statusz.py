@@ -5,9 +5,10 @@ ISSUE 13).
 Scrapes the Statusz rpc of one or more sidecars and renders the joined
 per-cycle telemetry — rolling p50/p99 per serving stage, warm-path mix,
 churn/round aggregates, the compile/retrace timeline (per shape-class,
-with compile wall time), sentinel anomaly counts by cause, and the
-last-N CycleRecords — as a text dashboard, optionally as a standalone
-HTML page, or as raw JSON.
+with compile wall time), sentinel anomaly counts by cause, the last-N
+CycleRecords, and (round 19) the WIRE panel — per-component round-trip
+breakdown, clock offset, byte totals, coverage — as a text dashboard,
+optionally as a standalone HTML page, or as raw JSON.
 
 With several addresses (the PR-6 replicated fleet) a MERGED fleet view
 is appended: cycle/anomaly/warm-mix counts sum, and stage/solve
@@ -119,6 +120,55 @@ def merge_fleet(payloads: "list[dict]") -> dict:
         total=compile_total, compile_s_total=round(compile_s, 3),
         timeline=sorted(timeline, key=lambda e: float(e.get("ts", 0.0))),
     )
+    wire = _merge_wire(payloads)
+    if wire is not None:
+        merged["wire"] = wire
+    return merged
+
+
+def _merge_wire(payloads: "list[dict]") -> "dict | None":
+    """Fleet view of the round-19 wire panel: counts and byte totals
+    sum, wall/component quantiles re-derive from summed bucket counts.
+    None-propagating — replicas predating the panel just don't
+    contribute, and a fleet with no panel at all gets none."""
+    wires = [p["wire"] for p in payloads if p.get("wire")]
+    if not wires:
+        return None
+    merged: dict = dict(
+        cycles=sum(int(w.get("cycles", 0)) for w in wires),
+        anomalies={}, rpcs={},
+        anomalies_total=sum(int(w.get("anomalies_total", 0))
+                            for w in wires),
+        bytes=dict(up=0, down=0),
+        # Per-replica clock offsets pair each server with ITS clients;
+        # a fleet-level offset has no referent, so none is reported.
+        offset_ms=None, uncertainty_ms=None,
+        records=[],
+    )
+    for w in wires:
+        _sum_into(merged["anomalies"], w.get("anomalies", {}))
+        _sum_into(merged["rpcs"], w.get("rpcs", {}))
+        b = w.get("bytes", {})
+        merged["bytes"]["up"] += int(b.get("up", 0))
+        merged["bytes"]["down"] += int(b.get("down", 0))
+    cov = [(float(w["coverage_frac"]), max(int(w.get("cycles", 0)), 1))
+           for w in wires if w.get("coverage_frac") is not None]
+    merged["coverage_frac"] = (
+        round(sum(c * n for c, n in cov) / sum(n for _, n in cov), 4)
+        if cov else None)
+    wall_hist = None
+    comp_hists: "dict[str, dict | None]" = {}
+    for w in wires:
+        wall_hist = _merge_hist(wall_hist, w.get("wall", {}).get("hist"))
+        for comp, agg in w.get("components", {}).items():
+            comp_hists[comp] = _merge_hist(comp_hists.get(comp),
+                                           agg.get("hist"))
+    p50, p99 = _hist_quantiles(wall_hist)
+    merged["wall"] = dict(p50_ms=_ms(p50), p99_ms=_ms(p99))
+    merged["components"] = {}
+    for comp in sorted(comp_hists):
+        p50, p99 = _hist_quantiles(comp_hists[comp])
+        merged["components"][comp] = dict(p50_ms=_ms(p50), p99_ms=_ms(p99))
     return merged
 
 
@@ -165,6 +215,28 @@ def render_text(p: dict) -> str:
             agg = stages[stage]
             lines.append(f"{stage:<16} {_fmt(agg.get('p50_ms'))} "
                          f"{_fmt(agg.get('p99_ms'))}")
+    wire = p.get("wire")
+    if wire:
+        wall = wire.get("wall", {})
+        by = wire.get("bytes", {})
+        lines.append(
+            f"wire: {wire.get('cycles', 0)} cycles "
+            f"({_mix_line(wire.get('rpcs', {}))}), wall p50/p99 "
+            f"{_fmt(wall.get('p50_ms'), 1).strip()}"
+            f"/{_fmt(wall.get('p99_ms'), 1).strip()} ms, coverage "
+            f"{wire.get('coverage_frac')}, clock offset "
+            f"{wire.get('offset_ms')} ms (+/- "
+            f"{wire.get('uncertainty_ms')}), bytes up/down "
+            f"{by.get('up', 0)}/{by.get('down', 0)}, anomalies: "
+            f"{_mix_line(wire.get('anomalies', {}))}")
+        comps = wire.get("components", {})
+        if comps:
+            lines.append(f"{'wire component':<16} {'p50_ms':>10} "
+                         f"{'p99_ms':>10}")
+            for comp_name in sorted(comps):
+                agg = comps[comp_name]
+                lines.append(f"{comp_name:<16} {_fmt(agg.get('p50_ms'))} "
+                             f"{_fmt(agg.get('p99_ms'))}")
     comp = p.get("compiles", {})
     lines.append(f"compiles: {comp.get('total', 0)} "
                  f"({comp.get('compile_s_total', 0.0):.2f}s wall)")
@@ -213,7 +285,8 @@ def _table(headers, rows) -> str:
         for cell in row:
             cls = ' class="anom"' if isinstance(cell, str) and cell and \
                 cell in ("compile", "round_growth", "churn_burst",
-                         "preemption", "unknown") else ""
+                         "preemption", "unknown", "bytes_burst",
+                         "queue", "decode", "transfer") else ""
             out.append(f"<td{cls}>{html.escape(str(cell))}</td>")
         out.append("</tr>")
     out.append("</table>")
@@ -243,6 +316,27 @@ def render_html(payloads: "list[dict]") -> str:
                 [[s, stages[s].get("p50_ms"), stages[s].get("p99_ms")]
                  for s in sorted(stages)],
             ))
+        wire = p.get("wire")
+        if wire:
+            parts.append("<h3>wire ledger</h3>")
+            parts.append(_table(
+                ["cycles", "wall p50 ms", "wall p99 ms", "coverage",
+                 "offset ms", "bytes up", "bytes down", "anomalies"],
+                [[wire.get("cycles", 0),
+                  wire.get("wall", {}).get("p50_ms"),
+                  wire.get("wall", {}).get("p99_ms"),
+                  wire.get("coverage_frac"), wire.get("offset_ms"),
+                  wire.get("bytes", {}).get("up", 0),
+                  wire.get("bytes", {}).get("down", 0),
+                  _mix_line(wire.get("anomalies", {}))]],
+            ))
+            wcomps = wire.get("components", {})
+            if wcomps:
+                parts.append(_table(
+                    ["component", "p50 ms", "p99 ms"],
+                    [[c, wcomps[c].get("p50_ms"), wcomps[c].get("p99_ms")]
+                     for c in sorted(wcomps)],
+                ))
         comp = p.get("compiles", {})
         if comp.get("timeline"):
             parts.append("<h3>compile timeline</h3>")

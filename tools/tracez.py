@@ -24,6 +24,14 @@ the DecisionRecord's cycle id — so a slow cycle found here joins its
 decision chain via ``tools/explainz.py`` by cycle id, or by the shared
 request_id (records carry ``rid``). ``--demo --explain`` shows it.
 
+Wire breakdown track (round 19): when the sidecar carries a wire
+ledger, each cycle's WireRecord is additionally rendered as ONE row of
+back-to-back component slices (serialize | send.gap | server stages |
+server.other | reply.gap) on a dedicated ``wire:<rpc>`` track — the
+per-cycle round-trip decomposition laid out against the raw spans it
+was stitched from. In ``--address`` mode the records ride the Statusz
+``wire`` panel (Debugz ships spans only).
+
 Usage:
   python tools/tracez.py --demo --clients 4 --cycles 6 --out /tmp/t.json
   python tools/tracez.py --demo --trip-watchdog --flight-out /tmp/f.json
@@ -41,6 +49,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from tpusched import trace  # noqa: E402
+from tpusched import wire as wiring  # noqa: E402
 
 
 def chrome_doc(events) -> dict:
@@ -59,7 +68,8 @@ def spans_from_debugz(resp) -> list:
 def run_demo(clients: int, cycles: int, trip_watchdog: bool,
              explain: bool = False):
     """In-process multi-client serving demo; returns (span_dicts,
-    flight_dumps). Small shapes — this is about the trace, not load."""
+    flight_dumps, wire_records). Small shapes — this is about the
+    trace, not load."""
     import threading
 
     from tpusched.faults import FaultPlan, FaultRule
@@ -89,7 +99,8 @@ def run_demo(clients: int, cycles: int, trip_watchdog: bool,
         pods = [dict(name=f"p{i}-{j}",
                      requests={"cpu": 500.0, "memory": float(1 << 30)})
                 for j in range(6)]
-        with SchedulerClient(f"127.0.0.1:{port}", timeout=30.0) as c:
+        with SchedulerClient(f"127.0.0.1:{port}", timeout=30.0,
+                             wire=svc.wire) as c:
             sess = DeltaSession(c)
             for k in range(cycles):
                 nodes[0]["allocatable"] = {
@@ -109,9 +120,10 @@ def run_demo(clients: int, cycles: int, trip_watchdog: bool,
         t.join()
     spans = [trace.span_dict(s) for s in trace.DEFAULT.spans()]
     flight = svc.flight.dumps()
+    wire_recs = svc.wire.records()
     server.stop(0)
     svc.close()
-    return spans, flight
+    return spans, flight, wire_recs
 
 
 def main() -> int:
@@ -138,22 +150,35 @@ def main() -> int:
     args = ap.parse_args()
 
     if args.demo:
-        spans, flight = run_demo(args.clients, args.cycles,
-                                 args.trip_watchdog, args.explain)
+        spans, flight, wire_recs = run_demo(args.clients, args.cycles,
+                                            args.trip_watchdog,
+                                            args.explain)
     else:
         from tpusched.rpc.client import SchedulerClient
 
         with SchedulerClient(args.address) as c:
             resp = c.debugz(max_traces=args.last,
                             include_flight=bool(args.flight_out))
+            # Wire records ride the Statusz panel (Debugz ships spans
+            # only); a pre-round-19 sidecar just has no panel.
+            try:
+                sz = json.loads(
+                    c.statusz(max_records=args.last).statusz_json)
+                wire_recs = [wiring.WireRecord(**d) for d in
+                             sz.get("wire", {}).get("records", [])]
+            except Exception as e:  # noqa: BLE001 — panel is optional
+                print(f"[tracez] no wire panel: {e}", file=sys.stderr)
+                wire_recs = []
         spans = spans_from_debugz(resp)
         flight = json.loads(resp.flight_json) if resp.flight_json else []
 
-    doc = chrome_doc(trace.to_chrome(spans))
+    events = trace.to_chrome(spans) + wiring.to_chrome(wire_recs)
+    doc = chrome_doc(events)
     Path(args.out).write_text(json.dumps(doc))
     n_traces = len({s["trace_id"] for s in spans if s["trace_id"]})
     print(f"wrote {args.out}: {len(spans)} spans across "
-          f"{n_traces} traces", file=sys.stderr)
+          f"{n_traces} traces + {len(wire_recs)} wire cycles",
+          file=sys.stderr)
     if args.flight_out:
         Path(args.flight_out).write_text(json.dumps(flight))
         print(f"wrote {args.flight_out}: {len(flight)} flight dumps "

@@ -23,6 +23,10 @@ contract the chaos harness and tests rely on):
   ``server.decode``    before a snapshot/delta decodes (rpc/server.py)
   ``server.session``   before a device-session delta apply; ``drop``
                        evicts the lineage's DeviceSession first
+  ``server.reply``     after every server stage completed, before the
+                       reply leaves (rpc/server.py _serve) — ``delay``
+                       is an injected WIRE stall the wire sentinel
+                       must attribute to "transfer" (round 19)
   ``engine.fetch``     inside the engine's background fetch worker —
                        ``delay`` is a hung solve (the watchdog's prey)
   ``kube.watch``       top of each informer watch-stream attempt

@@ -43,6 +43,7 @@ import grpc
 import numpy as np
 
 from tpusched import trace as tracing
+from tpusched import wire as wiring
 from tpusched.rpc import codec
 from tpusched.rpc import tpusched_pb2 as pb
 from tpusched.rpc.server import SERVICE
@@ -183,7 +184,7 @@ class SchedulerClient:
     def __init__(self, address, timeout: float = 120.0,
                  retry: RetryPolicy | None = None,
                  retry_seed: int | None = None,
-                 tracer=None):
+                 tracer=None, wire=None):
         """address: one endpoint, or an ORDERED list of replica
         endpoints (round 11, ISSUE 6) — the client talks to the first
         and FAILS OVER to the next on UNAVAILABLE (a dead/restarting
@@ -197,7 +198,13 @@ class SchedulerClient:
         the SAME budget, they don't extend it. retry: RetryPolicy for
         RETRYABLE statuses (None = defaults; pass NO_RETRY to surface
         first errors). retry_seed pins the backoff jitter for
-        deterministic tests/chaos runs."""
+        deterministic tests/chaos runs.
+
+        wire: the WireLedger every completed Score/Assign cycle is
+        ledgered into (round 19, ISSUE 19) — pass the SIDECAR's own
+        ledger (svc.wire) when client and server share a process, so
+        the cycles land in the server's Statusz wire panel; None falls
+        back to the process-default tpusched.wire.DEFAULT."""
         self.timeout = timeout
         self.retry = retry if retry is not None else RetryPolicy()
         self.retries = 0          # observability: attempts beyond the first
@@ -208,6 +215,11 @@ class SchedulerClient:
         # span (parent_span); the sidecar roots its stage spans there,
         # so the client and server rings merge into one causal trace.
         self.tracer = tracer if tracer is not None else tracing.DEFAULT
+        # Wire ledger (round 19, ISSUE 19): every completed Score/
+        # Assign cycle is assembled from the shared span ring into one
+        # WireRecord. Best-effort — assembly must never fail a call.
+        self._wire = wire if wire is not None else wiring.DEFAULT
+        self.wire_errors = 0
         self.addresses = ([address] if isinstance(address, str)
                           else list(address))
         if not self.addresses:
@@ -343,8 +355,24 @@ class SchedulerClient:
         _BasePipeline._join_entry is this loop's future-shaped twin —
         keep their retry discipline in lockstep."""
         rid = ""
+        ledger = None
+        bytes_up = 0
         if "request_id" in type(request).DESCRIPTOR.fields_by_name:
             rid = self._stamp(request)
+            if rpc and self._wire.enabled:
+                ledger = self._wire
+        if ledger is not None:
+            # The wire ledger's serialize component: one timed pass
+            # over the request (gRPC's own serializer hits protobuf's
+            # warmed path right after). Only paid while ledgering —
+            # the OFF arm of bench.py's wire overhead check skips it.
+            t_ser = time.perf_counter()
+            bytes_up = len(request.SerializeToString())
+            self.tracer.record(
+                "client.serialize", dur_s=time.perf_counter() - t_ser,
+                cat="client", ctx=(rid, int(request.parent_span)),
+                rpc=rpc, bytes=bytes_up,
+            )
         deadline = time.monotonic() + self.timeout
         attempt = 0
         while True:
@@ -357,7 +385,11 @@ class SchedulerClient:
                                       trace_id=rid,
                                       parent_id=int(request.parent_span),
                                       rpc=rpc, attempt=attempt):
-                    return method(request, timeout=max(remaining, 1e-3))
+                    resp = method(request, timeout=max(remaining, 1e-3))
+                if ledger is not None:
+                    self._wire_observe(ledger, rpc, rid, bytes_up,
+                                       resp.ByteSize())
+                return resp
             except grpc.RpcError as e:
                 attempt += 1
                 if (e.code() not in self.retry.codes
@@ -380,6 +412,22 @@ class SchedulerClient:
                         ctx=(rid, int(request.parent_span)),
                         rpc=rpc, code=e.code().name, attempt=attempt,
                     )
+
+    def _wire_observe(self, ledger, rpc: str, rid: str, bytes_up: int,
+                      bytes_down: int, source: str = "call") -> None:
+        """Assemble + ledger one completed cycle from the shared span
+        ring (tpusched.wire.assemble). Best-effort by contract: a
+        ledger bug must never fail a call that already succeeded —
+        failures count in self.wire_errors instead of raising."""
+        try:
+            rec = wiring.assemble(
+                rid, rpc, self.tracer.spans(rid), ledger.clock,
+                bytes_up=bytes_up, bytes_down=bytes_down, source=source,
+            )
+            if rec is not None:
+                ledger.observe(rec)
+        except Exception:
+            self.wire_errors += 1
 
     def health(self) -> pb.HealthResponse:
         return self._call(self._health, pb.HealthRequest())
@@ -715,6 +763,9 @@ class _BasePipeline:
     cycles). One cluster's strictly serial feedback cycles cannot be
     pipelined — same limit as pipeline.solve_stream documents."""
 
+    # Wire-ledger rpc label (subclasses bind the real method pair).
+    _rpc = ""
+
     def __init__(self, client: SchedulerClient, depth: int = 2,
                  refresh_frac: float = 0.25, auto_resync: bool = True):
         self.client = client
@@ -775,7 +826,14 @@ class _BasePipeline:
             try:
                 with tracer.span("client.join", cat="client",
                                  trace_id=rid, attempt=attempt):
-                    return entry["fut"].result()
+                    resp = entry["fut"].result()
+                if rid and self.client._wire.enabled:
+                    self.client._wire_observe(
+                        self.client._wire, self._rpc, rid,
+                        entry["delta"].ByteSize(), resp.ByteSize(),
+                        source="pipeline",
+                    )
+                return resp
             except grpc.RpcError as e:
                 code = e.code()
                 if code in policy.codes and attempt < policy.max_attempts - 1:
@@ -910,6 +968,8 @@ class _BasePipeline:
 class AssignPipeline(_BasePipeline):
     """Pipelined Assign cycles (see _BasePipeline)."""
 
+    _rpc = "Assign"
+
     def _send_full(self, snapshot, packed_ok):
         return self.client.assign(snapshot, packed_ok=packed_ok)
 
@@ -928,6 +988,8 @@ class ScorePipeline(_BasePipeline):
     the per-cycle wall approaches max(decode, rank + fetch) instead of
     their sum. Coalescer interplay: identical deltas submitted by MANY
     such clients fuse server-side into one dispatch."""
+
+    _rpc = "ScoreBatch"
 
     def __init__(self, client: SchedulerClient, depth: int = 2,
                  refresh_frac: float = 0.25, top_k: int = 8,

@@ -137,6 +137,7 @@ from tpusched import ledger as ledgering
 from tpusched import metrics as pm
 from tpusched import shapeclass
 from tpusched import trace as tracing
+from tpusched import wire as wiring
 from tpusched.faults import NO_FAULTS
 from tpusched.mesh import make_mesh
 from tpusched.config import Buckets, EngineConfig
@@ -243,6 +244,18 @@ class _Metrics:
             "scheduler_h2d_bytes",
             "host->device bytes shipped per delta cycle",
             buckets=pm.BYTE_BUCKETS, labelnames=("path",), registry=r)
+        # Wire ledger (round 19, ISSUE 19): per-direction bytes at the
+        # serving boundary plus a reply-size histogram — before this,
+        # only H2D bytes had a family and the reply/D2H direction was
+        # entirely unaccounted.
+        self.wire_bytes = pm.Counter(
+            "scheduler_wire_bytes",
+            "serialized request/reply bytes at the serving boundary",
+            ("direction", "rpc"), registry=r)
+        self.reply_bytes = pm.Histogram(
+            "scheduler_reply_bytes",
+            "serialized reply payload per served request",
+            buckets=pm.BYTE_BUCKETS, labelnames=("rpc",), registry=r)
         self.fuse = pm.Histogram(
             "scheduler_coalesced_fuse_size",
             "callers sharing one coalesced ScoreBatch dispatch",
@@ -708,6 +721,8 @@ class SchedulerService:
         ledger: "ledgering.CycleLedger | None" = None,
         ledger_jsonl: "str | None" = None,
         prewarm: bool = False,
+        wire: "wiring.WireLedger | None" = None,
+        wire_profile_dir: "str | None" = None,
     ):
         """audit_stream: optional file-like; when set, every Assign
         emits one JSON record PER POD (pod, node, score, commit_key —
@@ -780,7 +795,18 @@ class SchedulerService:
         when done (Health field 12; ReplicaSet.wait_caught_up blocks on
         it for standbys, so a promotion serves its first Assign with
         zero new compiles). Compiles traced during boot land in
-        ledger.COMPILES with cause="prewarm"."""
+        ledger.COMPILES with cause="prewarm".
+
+        wire (round 19, ISSUE 19): injectable tpusched.wire.WireLedger;
+        by default the service builds its own, registered in ITS
+        metrics registry and wired to its flight recorder / span ring
+        — the server HOLDS the ledger (Statusz `wire` panel, anomaly
+        counters) while clients FEED it: an in-process or loopback
+        client constructed with wire=svc.wire assembles each cycle's
+        WireRecord from the shared span ring. wire_profile_dir: when
+        set, a wire anomaly arms a one-shot jax.profiler device-trace
+        capture of the next serving cycle (WireLedger.maybe_profile),
+        written under this directory."""
         self.config = config or EngineConfig()
         # Floor buckets pin compile shapes across requests (a feature
         # first appearing mid-serving would otherwise trigger a full
@@ -886,6 +912,16 @@ class SchedulerService:
             self.ledger = ledgering.CycleLedger(
                 registry=self.metrics.registry, flight=self.flight,
                 tracer=self._trace, jsonl=ledger_jsonl)
+        # Wire ledger (round 19, ISSUE 19): the per-cycle round-trip
+        # decomposition's home — same discipline as the cycle ledger
+        # above (families in THIS registry, anomaly dumps into THIS
+        # flight recorder). Clients observe INTO it (wire=svc.wire).
+        if wire is not None:
+            self.wire = wire
+        else:
+            self.wire = wiring.WireLedger(
+                registry=self.metrics.registry, flight=self.flight,
+                tracer=self._trace, profile_dir=wire_profile_dir)
         # Live device/store memory surface (ROADMAP item 1 feeds on
         # this): rendered at scrape time from the authoritative maps.
         pm.CallbackGauge(
@@ -1560,6 +1596,7 @@ class SchedulerService:
         self._gate.close()
         self._engine.close(wait=True)
         self.ledger.close()  # releases the JSONL black box, if any
+        self.wire.close()
         with self._store_lock:
             self._sessions.clear()
         if not already:
@@ -1640,12 +1677,18 @@ class SchedulerService:
             if replay is not None:
                 root.attrs["replayed"] = True
                 self.metrics.count_request(rpc, "OK")
+                self._count_wire_bytes(rpc, request, replay)
                 return replay
             try:
                 # A serving request reaching a standby IS the failover
                 # signal: promote (or refuse — split-brain guard site).
                 self._maybe_takeover(rpc)
                 resp = inner(request, context)
+                # Chaos site for the reply path (round 19): a delay
+                # here stalls the response AFTER every server stage
+                # completed — the injected wire stall the wire
+                # sentinel must attribute to "transfer".
+                self._faults.fire("server.reply")
             except _Abort as e:
                 self._count_abort(rpc, e.code, root)
                 self._abort(context, e.code, e.details)
@@ -1665,7 +1708,19 @@ class SchedulerService:
                 self.metrics.count_request(rpc, "OK")
                 self._replay_record(rpc, request, resp)
                 self._record_ladder_success(request)
+                self._count_wire_bytes(rpc, request, resp)
                 return resp
+
+    def _count_wire_bytes(self, rpc: str, request, resp) -> None:
+        """Per-direction byte accounting at the serving boundary
+        (round 19, ISSUE 19): serialized request bytes up, serialized
+        reply bytes down, plus the reply-size histogram. ByteSize() is
+        the serialized length protobuf already computed (cached) for
+        the transport — no second serialization."""
+        down = resp.ByteSize()
+        self.metrics.wire_bytes.labels("up", rpc).inc(request.ByteSize())
+        self.metrics.wire_bytes.labels("down", rpc).inc(down)
+        self.metrics.reply_bytes.labels(rpc).observe(down)
 
     def _count_abort(self, rpc: str, code, root) -> None:
         name = getattr(code, "name", str(code))
@@ -1684,7 +1739,12 @@ class SchedulerService:
                 )
 
     def ScoreBatch(self, request: pb.ScoreRequest, context) -> pb.ScoreResponse:
-        return self._serve("ScoreBatch", request, context, self._score_batch)
+        # maybe_profile: a no-op unless the PREVIOUS cycle's wire
+        # anomaly armed a one-shot jax.profiler device-trace capture
+        # (WireLedger docstring) — two attribute reads when unarmed.
+        with self.wire.maybe_profile():
+            return self._serve("ScoreBatch", request, context,
+                               self._score_batch)
 
     def _score_batch(self, request: pb.ScoreRequest, context) -> pb.ScoreResponse:
         key = self._score_key(request)
@@ -1818,7 +1878,9 @@ class SchedulerService:
         return resp, solve_s
 
     def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
-        return self._serve("Assign", request, context, self._assign)
+        # See ScoreBatch: one-shot armed device-trace capture site.
+        with self.wire.maybe_profile():
+            return self._serve("Assign", request, context, self._assign)
 
     def _record_ladder_success(self, request) -> None:
         """Probe discipline: a success arms/confirms recovery only when
@@ -2218,6 +2280,11 @@ class SchedulerService:
         n = int(request.max_records)
         n = 32 if n <= 0 else min(n, 256)
         payload = self.ledger.statusz(last=n)
+        # Wire panel (round 19, ISSUE 19): the per-cycle round-trip
+        # decomposition — component quantiles, byte totals, the clock
+        # offset, coverage, and last-N WireRecords (tpusched.wire
+        # SCHEMA). Raw bucket counts ride along for the fleet merge.
+        payload["wire"] = self.wire.statusz(last=n)
         lad = self._ladder.snapshot()
         payload["role"] = self.role
         payload["serving_path"] = lad["level"]
@@ -2275,6 +2342,8 @@ def make_server(
     ledger: "ledgering.CycleLedger | None" = None,
     ledger_jsonl: "str | None" = None,
     prewarm: bool = False,
+    wire: "wiring.WireLedger | None" = None,
+    wire_profile_dir: "str | None" = None,
 ):
     """Build (grpc.Server, bound_port, service). Unlimited message size:
     a 10k-pod snapshot exceeds the 4 MB default. max_workers default 8:
@@ -2295,7 +2364,11 @@ def make_server(
     tools/statusz.py); prewarm: boot-time tracing of the full
     shape-class registry (PR 18 — needs explicit buckets; the service's
     prewarm_complete / Health field 12 flips when every class is
-    compiled, and ReplicaSet.wait_caught_up blocks on it)."""
+    compiled, and ReplicaSet.wait_caught_up blocks on it);
+    wire/wire_profile_dir: the wire ledger + its optional anomaly-armed
+    device-trace capture directory (round 19, ISSUE 19 — clients
+    constructed with wire=svc.wire feed the server's Statusz `wire`
+    panel; SchedulerService docstring)."""
     svc = SchedulerService(config, buckets, log_stream=log_stream,
                            audit_stream=audit_stream,
                            device_sessions=device_sessions,
@@ -2304,7 +2377,8 @@ def make_server(
                            role=role, replication_log=replication_log,
                            explain=explain, explain_k=explain_k,
                            warm=warm, ledger=ledger,
-                           ledger_jsonl=ledger_jsonl, prewarm=prewarm)
+                           ledger_jsonl=ledger_jsonl, prewarm=prewarm,
+                           wire=wire, wire_profile_dir=wire_profile_dir)
 
     def handler(fn, req_cls):
         return grpc.unary_unary_rpc_method_handler(

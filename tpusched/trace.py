@@ -68,6 +68,12 @@ class Span:
     thread: str
     attrs: "dict[str, Any]" = field(default_factory=dict)
 
+    @property
+    def end_wall(self) -> float:
+        """Epoch seconds at span end (the wire ledger stitches cycle
+        bounds and one-way gaps from span endpoints — round 19)."""
+        return self.t_wall + self.dur_s
+
 
 def span_dict(s: Span) -> "dict[str, Any]":
     return dict(
