@@ -1928,6 +1928,202 @@ def bench_sim(args):
         log(f"{metric}: {value}")
 
 
+class _HostSortedQueue:
+    """The baseline arm's pending store: the CLASSIC host path the
+    device queue replaces — records in a dict, and every window() pays
+    the O(pending) Python recompute (availability decay per pod, the
+    host.py `_with_avail` + sort shape) plus a full re-sort. Duck-types
+    the DeviceQueue surface IngestGate needs, so BOTH bench arms run
+    behind the identical admission gate and differ only in who ranks
+    the backlog."""
+
+    def __init__(self, bound=None, qos_gain: float = 1000.0):
+        self.bound = bound
+        self.qos_gain = float(qos_gain)
+        self._recs: dict[str, dict] = {}
+        self._seq = 0
+
+    @property
+    def capacity(self):
+        return self.bound or len(self._recs)
+
+    @property
+    def depth(self):
+        return len(self._recs)
+
+    def __contains__(self, name):
+        return name in self._recs
+
+    def upsert(self, name, *, base_priority=0.0, slo_target=0.0,
+               submitted=0.0, run_seconds=0.0, parked_until=0.0,
+               tenant=0, seq=None):
+        if name not in self._recs and self.bound is not None \
+                and len(self._recs) >= self.bound:
+            return False
+        self._seq += 1
+        self._recs[name] = dict(
+            priority=float(base_priority), slo_target=float(slo_target),
+            submitted=float(submitted), run_seconds=float(run_seconds),
+            parked_until=float(parked_until), seq=self._seq)
+        return True
+
+    def remove(self, names):
+        n = 0
+        for nm in names:
+            n += self._recs.pop(nm, None) is not None
+        return n
+
+    def window(self, now, w):
+        # O(pending) every cycle: the cost model under indictment.
+        scored = []
+        for nm, r in self._recs.items():
+            if r["parked_until"] > now:
+                continue
+            age = now - r["submitted"]
+            avail = 1.0 if age < 1e-9 else min(  # tpl: disable=TPL004(baseline arm mirrors the kernel clip op-for-op on bench-generated finite inputs; bench.py defers every tpusched import so the bare CLI stays light)
+                max(r["run_seconds"] / age, 0.0), 1.0)
+            pressure = min(max(r["slo_target"] - avail, 0.0), 1.0)  # tpl: disable=TPL004(same baseline-arm rationale as avail above)
+            scored.append(
+                (-(r["priority"] + self.qos_gain * pressure),
+                 r["seq"], nm))
+        scored.sort()
+        return [nm for _, _, nm in scored[:w]], len(scored), \
+            len(self._recs)
+
+
+def bench_ingest(args):
+    """Arrival-storm ingest bench (ISSUE 20): an open-loop storm at a
+    million-pod-per-sim-day arrival rate, arriving at 2x the drain
+    capacity — the firehose regime the admission gate exists for. The
+    two arms differ ONLY in how pending pods are held and ranked:
+
+      device arm    IngestGate (token bucket, bounded DeviceQueue):
+                    host work is O(arrivals) — dict upserts plus one
+                    dirty-row scatter — and the availability-decay
+                    rank runs in-kernel over the bounded table; the
+                    overflow half of the storm is SHED with a
+                    retry-after (re-offered once, then dropped: open
+                    loop)
+      hostsort arm  the pre-admission-control world: every arrival
+                    lands in an UNBOUNDED pending dict and every cycle
+                    pays the classic O(pending) Python recompute + full
+                    re-sort (_HostSortedQueue). Under sustained
+                    overload pending grows without bound and the cycle
+                    cost grows with it.
+
+    Both arms are rated on their TERMINAL cycles (the last fifth of
+    their run): an open-loop storm has no steady state for the
+    hostsort arm — its sustainable arrival rate is wherever it has
+    degraded to, not its warm-start average. The hostsort arm is
+    cycle-capped (--ingest-host-cycles, logged loudly) because running
+    it to the full million is exactly the quadratic meltdown under
+    indictment; the cap UNDERSTATES the speedup.
+
+    Emits ingest_pods_per_sec_{device,hostsort} (terminal drain
+    throughput), ingest_speedup_x (the >= 10x acceptance ratio),
+    queue_depth_{p50,p99} read back from the gate's source="ingest"
+    ledger records, admission_latency_ms_{p50,p99} (virtual-clock
+    first-offer -> admit, so shed-then-retry waits are priced in), and
+    ingest_shed_frac — each stamped with an explicit direction for
+    tools/benchdiff.py."""
+    from tpusched import ledger as ledgering
+    from tpusched.device_state import DeviceQueue
+    from tpusched.ingest import IngestGate
+
+    n_pods = int(args.ingest_pods)
+    w = 256                     # drain window per cycle
+    batch = 2 * w               # arrivals per cycle: 2x overload
+    qcap = 16384                # device arm's bounded pending table
+    day_s = 86400.0
+    n_cycles = max(n_pods // batch, 1)
+    dt = day_s / n_cycles       # virtual seconds per cycle
+    rng = np.random.default_rng(0)
+    prio = rng.uniform(10.0, 100.0, n_pods).astype(np.float32)
+    slo = rng.uniform(0.5, 0.999, n_pods).astype(np.float32)
+    log(f"[ingest] storm: {n_pods} pods over a virtual day "
+        f"({n_pods / day_s * 86400:.0f} pods/sim-day), {n_cycles} "
+        f"cycles, {batch} arrivals vs {w} drains per cycle")
+
+    def run_arm(queue, gate, max_cycles):
+        # Every offer/drain passes `now` explicitly (the virtual
+        # clock); the gate's own clock only seeds the buckets at t=0.
+        queue.window(0.0, w)      # compile/warm before the clock starts
+        cycle_s, drained = [], []
+        retry: list[int] = []
+        for c in range(max_cycles):
+            vnow = (c + 1) * dt
+            lo = c * batch
+            offer = retry + list(range(lo, min(lo + batch, n_pods)))
+            pods = [dict(name=f"p{i}", priority=float(prio[i]),
+                         slo_target=float(slo[i]), submitted=vnow)
+                    for i in offer]
+            t0 = time.perf_counter()
+            res = gate.offer(pods, now=vnow)
+            got = gate.take_window(vnow, w=w)
+            cycle_s.append(time.perf_counter() - t0)
+            # Open loop: one retry round, then the shed pod is dropped
+            # (a shed index < lo already had its retry last cycle).
+            retry = [i for i in (int(nm[1:]) for nm in res["shed"])
+                     if i >= lo]
+            drained.append(len(got))
+        tail = max(len(cycle_s) // 5, 1)
+        rate = sum(drained[-tail:]) / sum(cycle_s[-tail:])
+        return rate, sum(cycle_s), sum(drained)
+
+    lg = ledgering.CycleLedger(capacity=n_cycles + 1)
+    dev_q = DeviceQueue(capacity=qcap, bound=qcap)
+    dev_gate = IngestGate(dev_q, rate=1.05 * w / dt, burst=2.0 * w,
+                          clock=lambda: 0.0, ledger=lg)
+    host_cycles = min(int(args.ingest_host_cycles), n_cycles)
+    host_q = _HostSortedQueue(bound=None)
+    host_gate = IngestGate(host_q, rate=1e12, burst=1e12,
+                           clock=lambda: 0.0)
+
+    dev_rate, dev_wall, dev_drained = run_arm(dev_q, dev_gate, n_cycles)
+    if host_cycles < n_cycles:
+        log(f"[ingest] hostsort arm capped at {host_cycles}/{n_cycles} "
+            f"cycles — unbounded O(pending) per cycle; its terminal "
+            f"rate only falls further with every additional cycle")
+    host_rate, host_wall, host_drained = run_arm(
+        host_q, host_gate, host_cycles)
+    speedup = dev_rate / host_rate if host_rate > 0 else float("inf")
+
+    depths = np.asarray([r.queue_depth for r in lg.records()], float)
+    lat_ms = np.asarray(dev_gate.admission_latency_s, float) * 1e3
+    stats = dev_gate.stats()
+    log(f"[ingest] device {dev_rate:,.0f} pods/s terminal "
+        f"({dev_drained} drained in {dev_wall:.2f}s) vs hostsort "
+        f"{host_rate:,.0f} pods/s terminal ({host_drained} drained in "
+        f"{host_wall:.2f}s, end depth {host_q.depth}) -> "
+        f"{speedup:.1f}x; device depth p50/p99 "
+        f"{np.percentile(depths, 50):.0f}/{np.percentile(depths, 99):.0f}"
+        f"; shed_frac {stats['shed_frac']}")
+    common = dict(pods=n_pods, cycles=n_cycles, batch=batch, window=w,
+                  queue_capacity=qcap, host_cycles=host_cycles,
+                  host_end_depth=host_q.depth,
+                  scatters=getattr(dev_gate.queue, "scatters", None))
+    for metric, value, unit, direction in (
+        ("ingest_pods_per_sec_device", round(dev_rate, 1), "pods/s",
+         "higher"),
+        ("ingest_pods_per_sec_hostsort", round(host_rate, 1), "pods/s",
+         "higher"),
+        ("ingest_speedup_x", round(speedup, 2), "x", "higher"),
+        ("queue_depth_p50", float(np.percentile(depths, 50)), "pods",
+         "lower"),
+        ("queue_depth_p99", float(np.percentile(depths, 99)), "pods",
+         "lower"),
+        ("admission_latency_ms_p50",
+         round(float(np.percentile(lat_ms, 50)), 3), "ms", "lower"),
+        ("admission_latency_ms_p99",
+         round(float(np.percentile(lat_ms, 99)), 3), "ms", "lower"),
+        ("ingest_shed_frac", stats["shed_frac"], "frac", "lower"),
+    ):
+        line = {"metric": metric, "value": value, "unit": unit,
+                "vs_baseline": None, "direction": direction}
+        line.update(common)
+        print(json.dumps(line), flush=True)
+
+
 BENCHES = {
     "divergence": bench_divergence,
     "pairwise": bench_pairwise,
@@ -1943,6 +2139,7 @@ BENCHES = {
     "warm": bench_warm,
     "ledger": bench_ledger,
     "multichip": bench_multichip,
+    "ingest": bench_ingest,
     # headline runs last so the final stdout line is the headline metric
     # (parity mode last within it — the stock-semantics north-star claim)
     "headline": bench_headline,
@@ -1996,6 +2193,14 @@ def main():
                          "SCENARIOS), or 'all' for the twin-run "
                          "matrix across MATRIX_SCENARIOS")
     ap.add_argument("--sim-seed", type=int, default=0)
+    ap.add_argument("--ingest-pods", type=int, default=1_000_000,
+                    help="arrival-storm size for --only ingest (the "
+                         "storm spans one virtual day, so the default "
+                         "is the million-pod/sim-day regime)")
+    ap.add_argument("--ingest-host-cycles", type=int, default=300,
+                    help="cycle cap for the hostsort baseline arm "
+                         "(O(pending) Python per cycle; its rate is "
+                         "measured on its own window)")
     ap.add_argument("--sim-horizon", type=float, default=None,
                     help="override the scenario's virtual horizon (s)")
     ap.add_argument("--trace", choices=["on", "off"], default="on",

@@ -158,6 +158,10 @@ def test_sharded_serving_metric_directions_are_registered():
         "shard_combine_ms_*": "lower",
         "solve_p99_latency_*_sharded": "lower",
         "wire_*": "lower",
+        "ingest_pods_per_sec_*": "higher",
+        "queue_depth_*": "lower",
+        "admission_latency_ms_*": "lower",
+        "ingest_shed_*": "lower",
     }
     assert not benchdiff.lower_is_better(
         "serve_qps_sharded_100000x50000", "qps", None)
@@ -246,6 +250,46 @@ def test_bench_wire_lines_resolve_under_tpl006():
     assert found == {
         m: benchdiff._EXPLICIT_DIRECTION[m] for m in found
     }, "bench-line annotations drifted from the registered table"
+
+
+def test_ingest_metric_directions_are_registered(tmp_path):
+    """ISSUE 20 satellite: every metric the arrival-storm ingest bench
+    emits is direction-pinned. Throughput up is better; queue depth,
+    admission latency, and shed fraction down are better; the
+    device-vs-hostsort speedup ratio (unit "x" — inference has no
+    rule) is pinned in the exact-name table."""
+    assert benchdiff._EXPLICIT_DIRECTION["ingest_speedup_x"] == "higher"
+    assert not benchdiff.lower_is_better("ingest_speedup_x", "x", None)
+    for m in ("ingest_pods_per_sec_device",
+              "ingest_pods_per_sec_hostsort"):
+        assert not benchdiff.lower_is_better(m, "pods/s", None), m
+    for m in ("queue_depth_p50", "queue_depth_p99",
+              "admission_latency_ms_p50", "admission_latency_ms_p99",
+              "ingest_shed_frac"):
+        assert benchdiff.lower_is_better(m, "pods", None), m
+    # End to end under TPL006: a throughput/speedup drop and a
+    # depth/latency/shed rise all flag, annotations stripped.
+    a = _snap(tmp_path, 11, [
+        dict(metric="ingest_pods_per_sec_device", value=25000.0,
+             unit="pods/s"),
+        dict(metric="ingest_speedup_x", value=12.0, unit="x"),
+        dict(metric="queue_depth_p99", value=9000.0, unit="pods"),
+        dict(metric="admission_latency_ms_p99", value=1000.0,
+             unit="ms"),
+        dict(metric="ingest_shed_frac", value=0.2, unit="frac"),
+    ])
+    b = _snap(tmp_path, 12, [
+        dict(metric="ingest_pods_per_sec_device", value=11000.0,
+             unit="pods/s"),
+        dict(metric="ingest_speedup_x", value=4.0, unit="x"),
+        dict(metric="queue_depth_p99", value=16000.0, unit="pods"),
+        dict(metric="admission_latency_ms_p99", value=9000.0,
+             unit="ms"),
+        dict(metric="ingest_shed_frac", value=0.6, unit="frac"),
+    ])
+    diff = benchdiff.diff_rounds([a, b], threshold=0.10)
+    assert all(m["regressed"] for m in diff["metrics"].values()), \
+        {k: v["regressed"] for k, v in diff["metrics"].items()}
 
 
 def test_prewarm_metric_directions_are_registered():

@@ -1,0 +1,284 @@
+"""Device-resident pending queue (ISSUE 20 tentpole part 1): the
+in-kernel availability-decay ranking must match the host-sorted numpy
+oracle BIT FOR BIT under the ordering contract
+
+    (eligible first, effective_priority DESC, arrival seq ASC)
+
+— including the FMA contraction XLA CPU applies to the priority
+mul+add (rank_reference emulates the single rounding in f64). On top
+of the kernels, DeviceQueue's host-mirror semantics (growth, bounded
+shed, park/unpark, idempotent removal, O(churn) scatter traffic) and
+the end-to-end sim parity: with the device queue choosing batch
+membership, the pressure_skew run is event-for-event identical to the
+host-sorted path whenever every eligible pod fits the batch."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusched.device_state import DeviceQueue
+from tpusched.kernels import queue as kq
+from tpusched.sim import workloads
+from tpusched.sim.driver import effective_config, run_scenario, twin_run
+
+
+# ---------------------------------------------------------------------------
+# Kernel <-> numpy-oracle bit parity.
+# ---------------------------------------------------------------------------
+
+
+def test_sortable_u32_is_monotone_and_backend_identical():
+    rng = np.random.default_rng(1)
+    x = np.unique(np.concatenate([
+        rng.uniform(-1e6, 1e6, 256).astype(np.float32),
+        np.float32([0.0, -0.0, 1e-38, -1e-38, 3.0e38, -3.0e38]),
+    ]))
+    u = kq.sortable_u32(x)
+    assert u.dtype == np.uint32
+    # Strictly increasing floats -> strictly increasing uint keys.
+    assert np.all(u[:-1] < u[1:])
+    # jnp and np paths share one definition (host oracle contract).
+    assert np.array_equal(np.asarray(kq.sortable_u32(jnp.asarray(x))), u)
+
+
+def test_k_bucket_pow2_clamp():
+    assert kq.k_bucket(1, 1024) == 1
+    assert kq.k_bucket(3, 1024) == 4
+    assert kq.k_bucket(256, 1024) == 256
+    assert kq.k_bucket(257, 1024) == 512
+    assert kq.k_bucket(5000, 1024) == 1024  # clamped to the table
+
+
+def _rand_table(rng, q=64, fill=0.8, now=60.0):
+    """Random table with deliberate priority TIES (integer-ish bases,
+    a common slo bucket) so the seq tie-break leg is actually
+    exercised, plus parked / never-observed / invalid slots."""
+    t = kq.empty_table(q)
+    n = int(q * fill)
+    slots = rng.choice(q, size=n, replace=False)
+    t.valid[slots] = True
+    t.base_priority[slots] = rng.integers(0, 6, n).astype(np.float32)
+    t.slo_target[slots] = rng.choice(
+        np.float32([0.0, 0.9, 0.99]), size=n)
+    t.submitted[slots] = rng.uniform(0.0, now, n).astype(np.float32)
+    t.run_seconds[slots] = rng.uniform(0.0, 30.0, n).astype(np.float32)
+    parked = slots[rng.random(n) < 0.25]
+    t.parked_until[parked] = rng.uniform(
+        0.0, 2.0 * now, parked.size).astype(np.float32)
+    # Unique arrival stamps on valid slots (the api-server contract).
+    t.seq[slots] = rng.permutation(n).astype(np.uint32)
+    return t
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_rank_full_matches_host_reference_bit_for_bit(seed):
+    rng = np.random.default_rng(seed)
+    now, gain = 60.0, 1000.0
+    t = _rand_table(rng, q=64)
+    order_d, prio_d, ne_d, dep_d = kq.rank_full(
+        t, np.float32(now), np.float32(gain))
+    order_h, prio_h, ne_h, dep_h = kq.rank_reference(t, now, gain)
+    np.testing.assert_array_equal(np.asarray(order_d), order_h)
+    # Priorities bit-identical, not approx — the sort keys are the
+    # raw f32 bits, so any ULP drift would reorder ties.
+    np.testing.assert_array_equal(
+        np.asarray(prio_d).view(np.uint32), prio_h.view(np.uint32))
+    assert int(ne_d) == ne_h and int(dep_d) == dep_h
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_window_select_is_prefix_of_full_ranking(seed):
+    rng = np.random.default_rng(100 + seed)
+    now, gain = 45.0, 1000.0
+    t = _rand_table(rng, q=32)
+    order_h, _, _, _ = kq.rank_reference(t, now, gain)
+    for kb in (1, 4, 16, 32):
+        win, prio, ne, dep = kq.window_select(t, now, gain, kb)
+        np.testing.assert_array_equal(np.asarray(win), order_h[:kb])
+
+
+def test_ordering_contract_directed():
+    """Eligible first; within eligible, priority DESC; ties pop in
+    arrival order; parked/invalid slots rank after every eligible one
+    (parked among themselves still by priority)."""
+    t = kq.empty_table(8)
+    now = 50.0
+    # Three equal-priority pods, arrival seqs 3, 1, 2 (slots 0,1,2):
+    # slo 0 and zero run -> pressure 0 -> priority == base == 5.
+    for slot, seq in ((0, 3), (1, 1), (2, 2)):
+        t.valid[slot] = True
+        t.base_priority[slot] = 5.0
+        t.submitted[slot] = 10.0
+        t.seq[slot] = seq
+    # Slot 3: lower base but under SLO pressure -> outranks the ties.
+    t.valid[3] = True
+    t.base_priority[3] = 1.0
+    t.slo_target[3] = 0.9
+    t.submitted[3] = 10.0
+    t.seq[3] = 7
+    # Slot 4: highest base but parked past `now` -> ineligible.
+    t.valid[4] = True
+    t.base_priority[4] = 999.0
+    t.submitted[4] = 10.0
+    t.parked_until[4] = 100.0
+    t.seq[4] = 0
+    order, prio, ne, dep = kq.rank_reference(t, now, 1000.0)
+    assert dep == 5 and ne == 4
+    # Pressured pod first, then the tie group in seq order.
+    assert list(order[:4]) == [3, 1, 2, 0]
+    # Parked slot leads the ineligible tail (highest priority there).
+    assert order[4] == 4
+    order_d, *_ = kq.rank_full(t, np.float32(now), np.float32(1000.0))
+    np.testing.assert_array_equal(np.asarray(order_d), order)
+
+
+# ---------------------------------------------------------------------------
+# DeviceQueue host-mirror semantics.
+# ---------------------------------------------------------------------------
+
+
+def _expected_window(dq: DeviceQueue, now: float, w: int):
+    """The host-sorted oracle applied to the queue's own mirror."""
+    order, _prio, ne, dep = kq.rank_reference(
+        dq._host, now - dq._epoch, dq.qos_gain)
+    take = min(w, ne)
+    return [dq._names[int(s)] for s in order[:take]], ne, dep
+
+
+def test_device_queue_upsert_remove_park_semantics():
+    dq = DeviceQueue(capacity=8)
+    assert dq.window(0.0, 4) == ([], 0, 0), "empty queue, empty window"
+    assert dq.upsert("a", base_priority=5.0, submitted=0.0)
+    assert dq.upsert("b", base_priority=9.0, submitted=1.0)
+    assert "a" in dq and dq.depth == 2
+    names, ne, dep = dq.window(10.0, 4)
+    assert names == ["b", "a"] and ne == 2 and dep == 2
+    # Upsert of a resident name UPDATES in place (depth unchanged).
+    assert dq.upsert("a", base_priority=99.0, submitted=0.0)
+    assert dq.depth == 2
+    assert dq.window(10.0, 4)[0] == ["a", "b"]
+    # Park masks eligibility only; time passing unparks.
+    assert dq.park("a", until=20.0)
+    names, ne, dep = dq.window(15.0, 4)
+    assert names == ["b"] and ne == 1 and dep == 2
+    assert dq.window(25.0, 4)[0] == ["a", "b"]
+    assert not dq.park("ghost", until=20.0)
+    # Removal is idempotent; unknown names are ignored.
+    assert dq.remove(["a", "ghost"]) == 1
+    assert dq.remove(["a"]) == 0
+    assert dq.window(25.0, 4)[0] == ["b"] and dq.depth == 1
+
+
+def test_device_queue_bounded_sheds_new_names_only():
+    dq = DeviceQueue(capacity=8, bound=2)
+    assert dq.upsert("a", submitted=0.0)
+    assert dq.upsert("b", submitted=0.0)
+    # Full: a NEW name sheds, an UPDATE of a resident name does not.
+    assert not dq.upsert("c", submitted=0.0)
+    assert dq.upsert("a", base_priority=3.0, submitted=0.0)
+    assert dq.depth == 2 and "c" not in dq
+    # Draining frees admission.
+    dq.remove(["a"])
+    assert dq.upsert("c", submitted=0.0)
+
+
+def test_device_queue_growth_preserves_rows():
+    dq = DeviceQueue(capacity=4)
+    for i in range(9):     # forces two pow2 doublings (4 -> 8 -> 16)
+        assert dq.upsert(f"p{i}", base_priority=float(i),
+                         submitted=float(i))
+    assert dq.capacity == 16 and dq.depth == 9
+    names, ne, dep = dq.window(100.0, 16)
+    assert ne == dep == 9
+    assert names == [f"p{i}" for i in range(8, -1, -1)]
+    assert names == _expected_window(dq, 100.0, 16)[0]
+
+
+def test_device_queue_scatter_traffic_is_o_churn():
+    dq = DeviceQueue(capacity=64)
+    for i in range(40):
+        dq.upsert(f"p{i:02d}", base_priority=float(i), submitted=0.0)
+    dq.window(10.0, 8)          # first flush: full upload, no scatter
+    assert dq.scatters == 0
+    dq.upsert("p00", base_priority=50.0, submitted=0.0)
+    dq.upsert("new", base_priority=1.0, submitted=10.0)
+    dq.window(11.0, 8)
+    assert dq.scatters == 1 and dq.scatter_rows_total == 2
+    dq.window(12.0, 8)          # clean cycle: nothing to ship
+    assert dq.scatters == 1
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_device_queue_window_matches_oracle_under_churn(seed):
+    """Random upsert/update/remove/park churn across cycles: every
+    window must equal the numpy oracle ranking of the queue's own
+    mirror — pop order, eligible count, and depth."""
+    rng = np.random.default_rng(200 + seed)
+    dq = DeviceQueue(capacity=16)          # small: growth happens live
+    live: set = set()
+    t = 0.0
+    for _ in range(6):
+        t += 7.0
+        for _ in range(int(rng.integers(4, 14))):
+            nm = f"p{int(rng.integers(0, 40)):03d}"
+            dq.upsert(nm,
+                      base_priority=float(rng.integers(0, 6)),
+                      slo_target=float(rng.choice([0.0, 0.9, 0.99])),
+                      submitted=t - float(rng.uniform(0.0, 20.0)),
+                      run_seconds=float(rng.uniform(0.0, 10.0)))
+            live.add(nm)
+        if live and rng.random() < 0.6:
+            drop = sorted(live)[: int(rng.integers(1, 4))]
+            dq.remove(drop)
+            live -= set(drop)
+        if live and rng.random() < 0.5:
+            dq.park(sorted(live)[0], until=t + float(rng.uniform(0, 15)))
+        names, ne, dep = dq.window(t, w=8)
+        exp_names, exp_ne, exp_dep = _expected_window(dq, t, 8)
+        assert dep == exp_dep == len(live)
+        assert ne == exp_ne
+        assert names == exp_names
+
+
+# ---------------------------------------------------------------------------
+# End-to-end sim parity: membership-not-order contract.
+# ---------------------------------------------------------------------------
+
+
+def test_pressure_skew_device_queue_event_parity():
+    """With every eligible pod fitting the batch, the device-queue run
+    is EVENT-FOR-EVENT identical to the host-sorted path: the queue
+    chooses batch membership only, and the window is re-ordered by
+    arrival before the solve (host.py's bit-parity contract)."""
+    from tpusched.engine import Engine
+
+    sc = dataclasses.replace(workloads.SCENARIOS["pressure_skew"],
+                             horizon_s=100.0)
+    cfg = effective_config(sc, None)
+    eng = Engine(cfg)
+    try:
+        a = run_scenario(sc, 0, config=cfg, engine=eng,
+                         device_queue=False)
+        b = run_scenario(sc, 0, config=cfg, engine=eng,
+                         device_queue=True)
+    finally:
+        eng.close()
+    assert a.event_log_hash == b.event_log_hash, (
+        "device-queue batch membership diverged from the host-sorted "
+        "path on a fits-in-batch run"
+    )
+    assert a.completions == b.completions
+    assert [p.name for p in a.pods] == [p.name for p in b.pods]
+
+
+@pytest.mark.slow
+def test_pressure_skew_headline_gain_holds_on_device_queue():
+    """ISSUE 20 acceptance: the PR 16 headline (+0.476 attainment gain
+    vs static priority, seed 0) reproduces with the device queue
+    feeding the batches — full horizon, both twin arms."""
+    rep = twin_run(workloads.SCENARIOS["pressure_skew"], seed=0,
+                   device_queue=True)
+    assert rep["attainment_gain_vs_static"] == pytest.approx(
+        0.476191, abs=1e-3)

@@ -1,0 +1,324 @@
+"""Admission-controlled ingest (ISSUE 20 tentpole part 2): token-bucket
+math, the IngestGate's shed taxonomy (rate / capacity / fault) and
+exactly-once dedup across shed-then-retry, per-tenant rate shares on
+tenants.zipf_weights, the gated sim driver's convergence to the
+ungated arrival set, and the Enqueue rpc boundary — partial sheds ride
+an OK response, FULL sheds surface as RESOURCE_EXHAUSTED and the PR 3
+client retry contract re-drives them to convergence."""
+
+import dataclasses
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from tpusched import ledger as ledgering
+from tpusched.device_state import DeviceQueue
+from tpusched.faults import FaultError, FaultPlan, FaultRule
+from tpusched.ingest import MAX_RETRY_AFTER_S, IngestGate, TokenBucket
+from tpusched.rpc import SchedulerClient, make_server
+from tpusched.sim import workloads
+from tpusched.sim.driver import SimDriver, effective_config, run_scenario
+
+
+def _pods(*names, prio=1.0, slo=0.0):
+    return [dict(name=n, priority=prio, slo_target=slo, submitted=0.0)
+            for n in names]
+
+
+# ---------------------------------------------------------------------------
+# TokenBucket.
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_take_refill_and_cap():
+    b = TokenBucket(rate=2.0, burst=3.0, now=0.0)
+    assert [b.take(0.0) for _ in range(4)] == [True, True, True, False]
+    # Refill at `rate`, capped at `burst`.
+    assert b.take(0.5)                 # 0.5s * 2/s = 1 token
+    assert not b.take(0.5)
+    b._refill(100.0)
+    assert b.tokens == pytest.approx(3.0), "refill saturates at burst"
+    # Time never runs backwards inside the bucket.
+    b.take(100.0)
+    b._refill(50.0)
+    assert b._last == 100.0
+
+
+def test_token_bucket_retry_after():
+    b = TokenBucket(rate=2.0, burst=1.0, now=0.0)
+    assert b.retry_after(0.0) == 0.0   # a token exists right now
+    assert b.take(0.0)
+    # Empty: one token at 2/s is 0.5s out.
+    assert b.retry_after(0.0) == pytest.approx(0.5)
+    dead = TokenBucket(rate=0.0, burst=1.0, now=0.0)
+    dead.take(0.0)
+    assert dead.retry_after(10.0) == MAX_RETRY_AFTER_S
+
+
+# ---------------------------------------------------------------------------
+# IngestGate admission semantics (virtual clock throughout).
+# ---------------------------------------------------------------------------
+
+
+def _gate(bound=None, capacity=16, **kw):
+    q = DeviceQueue(capacity=capacity, bound=bound)
+    kw.setdefault("clock", lambda: 0.0)
+    return IngestGate(q, **kw), q
+
+
+def test_gate_rate_shed_and_refill_admission():
+    gate, q = _gate(rate=1.0, burst=2.0)
+    res = gate.offer(_pods("a", "b", "c"), now=0.0)
+    assert res["admitted"] == ["a", "b"] and res["shed"] == ["c"]
+    assert 0.0 < res["retry_after_s"] <= MAX_RETRY_AFTER_S
+    assert res["queue_depth"] == 2
+    # The retry converges once the bucket refills.
+    res = gate.offer(_pods("c"), now=2.0)
+    assert res["admitted"] == ["c"] and not res["shed"]
+    assert gate.shed_rate == 1 and gate.shed_capacity == 0
+    # A resident name UPDATES without spending a token (bucket is
+    # empty again at the same instant).
+    res = gate.offer(_pods("a", prio=9.0), now=2.0)
+    assert res["admitted"] == ["a"] and q.depth == 3
+
+
+def test_gate_capacity_shed_hints_a_drain_cadence():
+    gate, q = _gate(bound=2, rate=1000.0, burst=1000.0)
+    res = gate.offer(_pods("a", "b", "c"), now=0.0)
+    assert res["shed"] == ["c"] and gate.shed_capacity == 1
+    # Capacity frees on DRAIN, not on token refill: the hint is at
+    # least one solve cadence, not the bucket's (zero) drought.
+    assert res["retry_after_s"] >= 1.0
+    gate.take_window(now=0.0, w=2)
+    res = gate.offer(_pods("c"), now=0.0)
+    assert res["admitted"] == ["c"]
+
+
+def test_gate_dedup_acks_drained_names_idempotently():
+    gate, q = _gate(rate=1000.0, burst=1000.0, dedup=True)
+    gate.offer(_pods("a", "b"), now=0.0)
+    assert gate.take_window(now=0.0, w=8) == ["a", "b"]
+    # A retry of the already-acked batch: idempotent success, nothing
+    # re-enqueued, no token spent.
+    res = gate.offer(_pods("a", "b"), now=0.0)
+    assert res["admitted"] == ["a", "b"] and q.depth == 0
+    assert gate.drained == 2
+    # Without dedup the same retry would re-enqueue.
+    g2, q2 = _gate(rate=1000.0, burst=1000.0, dedup=False)
+    g2.offer(_pods("a"), now=0.0)
+    g2.take_window(now=0.0, w=8)
+    g2.offer(_pods("a"), now=0.0)
+    assert q2.depth == 1
+
+
+def test_gate_tenant_shares_follow_zipf_and_clamp():
+    from tpusched.tenants import zipf_weights
+
+    gate, _ = _gate(rate=100.0, burst=40.0, tenants=4, skew=1.0)
+    w = zipf_weights(4, 1.0)
+    assert [b.rate for b in gate.buckets] == pytest.approx(
+        [100.0 * float(x) for x in w])
+    assert gate.buckets[0].rate > gate.buckets[3].rate
+    # An out-of-range tenant id clamps onto the coldest share (gets
+    # throttled, not crashed).
+    before = gate.buckets[3].tokens
+    res = gate.offer(_pods("x"), tenant=99, now=0.0)
+    assert res["admitted"] == ["x"]
+    assert gate.buckets[3].tokens == pytest.approx(before - 1.0)
+
+
+def test_gate_fault_site_drop_and_error():
+    plan = FaultPlan([
+        FaultRule("ingest.enqueue", "drop", at={0}),
+        FaultRule("ingest.enqueue", "error", at={1}),
+    ])
+    gate, q = _gate(rate=1000.0, burst=1000.0, faults=plan)
+    res = gate.offer(_pods("a", "b"), now=0.0)        # drop shot
+    assert res["admitted"] == [] and res["shed"] == ["a", "b"]
+    assert res["retry_after_s"] > 0 and gate.shed_fault == 2
+    assert q.depth == 0
+    with pytest.raises(FaultError):                   # error shot
+        gate.offer(_pods("a", "b"), now=0.0)
+    res = gate.offer(_pods("a", "b"), now=1.0)        # plan exhausted
+    assert res["admitted"] == ["a", "b"]
+    assert plan.count("ingest.enqueue") == 3
+
+
+def test_gate_admission_latency_spans_shed_retries():
+    gate, _ = _gate(rate=1.0, burst=1.0)
+    res = gate.offer(_pods("a", "b"), now=0.0)
+    assert res["shed"] == ["b"]
+    gate.offer(_pods("b"), now=5.0)
+    # a admitted on first offer; b waited 5s through its shed.
+    assert gate.admission_latency_s == pytest.approx([0.0, 5.0])
+
+
+def test_gate_take_window_ledgers_ingest_cycles():
+    lg = ledgering.CycleLedger(capacity=8)
+    gate, _ = _gate(rate=1000.0, burst=1000.0, ledger=lg)
+    gate.offer(_pods("a", "b", "c"), now=0.0)
+    names = gate.take_window(now=1.0, w=2)
+    assert len(names) == 2
+    rec = lg.records()[-1]
+    assert rec.source == "ingest" and rec.pods == 2
+    assert rec.queue_depth == 3, "depth at window time, before removal"
+    assert rec.ts == 1.0
+    st = gate.stats()
+    assert st["drained"] == 2 and st["queue_depth"] == 1
+    assert st["shed_frac"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Gated sim driver: convergence to the ungated arrival set.
+# ---------------------------------------------------------------------------
+
+
+def test_gated_sim_converges_with_zero_lost_or_duplicated_pods():
+    """pressure_skew under a tight front door (burst far below the
+    prefill burst, bounded queue) plus an injected enqueue fault: every
+    arrival is shed-then-retried until admitted, passes the gate
+    EXACTLY once, and the arrival set matches the ungated twin."""
+    sc = dataclasses.replace(workloads.SCENARIOS["pressure_skew"],
+                             horizon_s=100.0)
+    cfg = effective_config(sc, None)
+    plan = FaultPlan([FaultRule("ingest.enqueue", "error", at={3})])
+    # burst 40 over bound 4: the 30-pod prefill burst has tokens but
+    # not queue slots (capacity sheds); the tail of the horizon has
+    # slots but not tokens (rate sheds) — both shed reasons retry.
+    gate, q = _gate(capacity=64, bound=4, rate=1.5, burst=40.0,
+                    dedup=True, faults=plan)
+    drv = SimDriver(sc, seed=0, config=cfg, ingest=gate)
+    res = drv.run()
+    names = [p.name for p in res.pods]
+    assert len(names) == len(set(names)), "no duplicated arrivals"
+    # Exactly-once through the gate: every arrival drained once —
+    # shed retries were acked by dedup, never re-enqueued.
+    assert gate.drained == len(names)
+    assert q.depth == 0 and drv._shed_retry == []
+    # The storm actually overloaded the front door and the injected
+    # fault fired (the retry loop did real work).
+    assert gate.shed_rate > 0 and gate.shed_capacity > 0
+    assert plan.count("ingest.enqueue") > 3
+    assert res.completions > 0
+    # Same arrivals as the ungated twin (timelines legitimately
+    # diverge under admission delay; membership must not).
+    ref = run_scenario(sc, 0, config=cfg)
+    assert set(names) == {p.name for p in ref.pods}
+
+
+# ---------------------------------------------------------------------------
+# The Enqueue rpc boundary.
+# ---------------------------------------------------------------------------
+
+
+def _serve(ingest):
+    server, port, svc = make_server("127.0.0.1:0", ingest=ingest)
+    server.start()
+    return server, svc, f"127.0.0.1:{port}"
+
+
+def test_enqueue_partial_shed_rides_ok_response():
+    server, svc, addr = _serve(dict(capacity=16, bound=8,
+                                    rate=1000.0, burst=2.0))
+    client = SchedulerClient(addr)
+    try:
+        resp = client.enqueue(_pods("p0", "p1", "p2", "p3", "p4"))
+        assert resp.admitted == 2 and resp.shed == 3
+        assert set(resp.shed_pods) == {"p2", "p3", "p4"}
+        assert resp.queue_depth == 2
+        assert resp.retry_after_s > 0.0
+        assert svc.ingest.stats()["admitted"] == 2
+    finally:
+        client.close()
+        server.stop(0)
+        svc.close()
+
+
+def test_enqueue_full_shed_is_resource_exhausted_and_retried():
+    # rate 0.4/s: after the burst token goes, the next token is 2.5s
+    # out — far past the client's 0.25s deadline budget, so its
+    # automatic RESOURCE_EXHAUSTED retries exhaust and surface.
+    server, svc, addr = _serve(dict(capacity=16, bound=8,
+                                    rate=0.4, burst=1.0))
+    client = SchedulerClient(addr, timeout=0.25)
+    try:
+        assert client.enqueue(_pods("p0")).admitted == 1
+        with pytest.raises(grpc.RpcError) as ei:
+            client.enqueue(_pods("p1", "p2"))
+        assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert client.retries >= 1, "the retry contract engaged"
+        assert "retry after" in ei.value.details()
+    finally:
+        client.close()
+        server.stop(0)
+        svc.close()
+
+
+def test_enqueue_retry_contract_converges_once_tokens_refill():
+    server, svc, addr = _serve(dict(capacity=16, bound=8,
+                                    rate=20.0, burst=1.0))
+    client = SchedulerClient(addr, timeout=5.0)
+    try:
+        assert client.enqueue(_pods("p0")).admitted == 1
+        # Bucket empty NOW -> first attempt aborts RESOURCE_EXHAUSTED;
+        # at 20 tokens/s the client's backoff outlives the drought and
+        # the SAME call returns the admission.
+        resp = client.enqueue(_pods("p1"))
+        assert resp.admitted == 1 and resp.shed == 0
+        assert client.retries >= 1
+    finally:
+        client.close()
+        server.stop(0)
+        svc.close()
+
+
+def test_enqueue_dedup_is_exactly_once_across_rpc_retries():
+    server, svc, addr = _serve(dict(capacity=16, bound=8,
+                                    rate=1000.0, burst=64.0))
+    client = SchedulerClient(addr)
+    try:
+        assert client.enqueue(_pods("a", "b")).admitted == 2
+        assert svc.ingest.take_window(now=time.time(), w=8) == ["a", "b"]
+        # A duplicate of an acked batch (a lost-response client retry):
+        # idempotent success, nothing re-enqueued.
+        resp = client.enqueue(_pods("a", "b"))
+        assert resp.admitted == 2 and resp.queue_depth == 0
+        assert svc.ingest.drained == 2
+    finally:
+        client.close()
+        server.stop(0)
+        svc.close()
+
+
+def test_enqueue_without_gate_is_unimplemented():
+    server, port, svc = make_server("127.0.0.1:0")
+    server.start()
+    client = SchedulerClient(f"127.0.0.1:{port}")
+    try:
+        with pytest.raises(grpc.RpcError) as ei:
+            client.enqueue(_pods("p0"))
+        assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    finally:
+        client.close()
+        server.stop(0)
+        svc.close()
+
+
+def test_enqueue_fault_error_maps_to_unavailable():
+    plan = FaultPlan([FaultRule("ingest.enqueue", "error", at={0, 1, 2, 3})])
+    server, port, svc = make_server(
+        "127.0.0.1:0", faults=plan,
+        ingest=dict(capacity=16, rate=1000.0, burst=64.0))
+    server.start()
+    client = SchedulerClient(f"127.0.0.1:{port}", timeout=0.25)
+    try:
+        with pytest.raises(grpc.RpcError) as ei:
+            client.enqueue(_pods("p0"))
+        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert client.retries >= 1, "UNAVAILABLE rides the retry loop"
+    finally:
+        client.close()
+        server.stop(0)
+        svc.close()

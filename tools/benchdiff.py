@@ -73,6 +73,9 @@ _EXPLICIT_DIRECTION = {
     # fraction the wire_* lower-better glob below would flip.
     "wire_ledger_overhead_pct": "lower",
     "wire_breakdown_coverage_frac": "higher",
+    # Admission-controlled ingest (PR 20, ISSUE 20): the device-vs-
+    # hostsort speedup ratio ("x" unit inference has no opinion).
+    "ingest_speedup_x": "higher",
 }
 # Registered direction GLOBS (round 22, ISSUE 17): the sharded-serving
 # metric families from bench.py's multichip section. Consulted after
@@ -88,6 +91,13 @@ _EXPLICIT_DIRECTION_GLOBS = (
     # two higher-better exceptions (coverage_frac) and the pct metric
     # live in the exact-name table above, which is consulted first.
     ("wire_*", "lower"),
+    # Admission-controlled ingest (PR 20, ISSUE 20): drain throughput
+    # up is better; backlog depth, front-door wait, and shed fraction
+    # down are better. The speedup ratio is in the exact table above.
+    ("ingest_pods_per_sec_*", "higher"),
+    ("queue_depth_*", "lower"),
+    ("admission_latency_ms_*", "lower"),
+    ("ingest_shed_*", "lower"),
 )
 
 

@@ -35,10 +35,19 @@ promotes, and END placements must still be identical with zero
 lost/duplicated binds. goodput_frac at replica counts 1/2/3 under the
 SAME kill is the high-availability claim as a bench number.
 
+Round 25 (ISSUE 20) adds the FRONT-DOOR experiment (`run_chaos_ingest`,
+--ingest): a shed-heavy Enqueue storm through client -> gRPC ->
+IngestGate -> bounded DeviceQueue, twin-run with drop/error shots at
+the ``ingest.enqueue`` fault site. Full sheds surface as
+RESOURCE_EXHAUSTED and ride the SAME client retry contract as every
+other rpc; gate-side dedup makes retries idempotent — the chaos arm
+must drain the identical pod set with zero lost/duplicated pods.
+
 Usage:
     JAX_PLATFORMS=cpu python tools/chaos.py --pods 120 --nodes 12
     python tools/chaos.py --seed 7 --json report.json
     python tools/chaos.py --replicas 2 --json fleet.json
+    python tools/chaos.py --ingest --pods 240 --json ingest.json
 """
 
 from __future__ import annotations
@@ -356,6 +365,176 @@ def run_chaos(
     return report
 
 
+def make_ingest_plan(seed: int | None = None, window: int = 10) -> FaultPlan:
+    """The canonical front-door chaos plan (ISSUE 20 satellite): two
+    drop shots (the gate sheds the whole batch -> the rpc surfaces
+    RESOURCE_EXHAUSTED -> the client's retry contract re-drives it) and
+    two error shots (FaultError -> UNAVAILABLE -> same contract). All
+    four ride the SAME client machinery production retries ride; no
+    harness-only recovery path. seed=None pins the indices."""
+    if seed is None:
+        return FaultPlan([
+            FaultRule("ingest.enqueue", "drop", at={1, 4}),
+            FaultRule("ingest.enqueue", "error", at={2, 6},
+                      message="chaos: injected enqueue failure"),
+        ])
+    return FaultPlan.seeded(seed, {
+        "ingest.enqueue": [
+            dict(kind="drop", n=2, window=window),
+            dict(kind="error", n=2, window=window,
+                 message="chaos: injected enqueue failure"),
+        ],
+    })
+
+
+def run_chaos_ingest(
+    n_pods: int = 120,
+    batch: int = 24,
+    seed: int = 0,
+    rate: float = 500.0,
+    burst: float = 48.0,
+    bound: int = 32,
+    drain_w: int = 16,
+    plan: FaultPlan | None = None,
+    plan_seed: int | None = None,
+    log=print,
+) -> dict:
+    """Twin-run chaos at the FRONT DOOR (ISSUE 20): the same seeded pod
+    storm is pushed through the full Enqueue boundary (SchedulerClient
+    -> gRPC -> IngestGate -> bounded DeviceQueue) twice — fault-free,
+    then with drop/error shots at the ``ingest.enqueue`` site — while a
+    drain loop pops windows like the solve loop would. The storm is
+    deliberately over its admission budget (burst < batch, drain_w <
+    batch, tight queue bound) so all three shed reasons fire: rate
+    (token drought), capacity (queue full), fault (injected drop).
+
+    Convergence is the claim under test: every shed pod is re-offered
+    (driver requeue for partial sheds; the PR 3 client retry contract
+    for RESOURCE_EXHAUSTED full sheds and UNAVAILABLE error shots)
+    until admitted, and gate-side name dedup makes retries idempotent —
+    so the chaos arm must drain EXACTLY the fault-free arm's pod set:
+    zero lost, zero duplicated, or the harness fails loudly."""
+    import grpc
+
+    from tpusched.rpc.client import SchedulerClient
+
+    rng = np.random.default_rng(seed)
+    storm = [dict(name=f"ing-{i:05d}",
+                  priority=float(rng.uniform(10.0, 100.0)),
+                  slo_target=float(rng.uniform(0.5, 0.999)))
+             for i in range(n_pods)]
+    batches = [storm[i:i + batch] for i in range(0, n_pods, batch)]
+    all_names = {p["name"] for p in storm}
+
+    def run_arm(faults: "FaultPlan | None") -> dict:
+        side = _Sidecar(
+            ingest=dict(capacity=max(2 * bound, 64), bound=bound,
+                        rate=rate, burst=burst),
+            faults=faults,
+        )
+        client = SchedulerClient(f"127.0.0.1:{side.port}",
+                                 retry_seed=seed)
+        gate = side.svc.ingest
+        drained: list = []
+        offers = rpc_sheds = 0
+        try:
+            t0 = time.perf_counter()
+            outstanding = list(batches)
+            requeue: list = []
+            idle = 0
+            while outstanding or requeue or gate.queue.depth:
+                if requeue:
+                    cur, requeue = requeue[:batch], requeue[batch:]
+                elif outstanding:
+                    cur = outstanding.pop(0)
+                else:
+                    cur = []
+                if cur:
+                    offers += 1
+                    try:
+                        res = client.enqueue(cur)
+                        shed = set(res.shed_pods)
+                    except grpc.RpcError as e:
+                        # The client already retried inside its deadline
+                        # budget; a surviving RESOURCE_EXHAUSTED /
+                        # UNAVAILABLE means the whole batch is still
+                        # unadmitted — requeue it like any other shed.
+                        if e.code() not in (
+                                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                grpc.StatusCode.UNAVAILABLE):
+                            raise
+                        rpc_sheds += 1
+                        shed = {p["name"] for p in cur}
+                    requeue.extend(p for p in cur if p["name"] in shed)
+                took = gate.take_window(w=drain_w)
+                drained.extend(took)
+                idle = idle + 1 if not cur and not took else 0
+                if idle > 200:
+                    raise RuntimeError(
+                        "ingest chaos run failed to drain: "
+                        f"{len(requeue)} requeued, depth "
+                        f"{gate.queue.depth}")
+                if cur and not took and requeue:
+                    time.sleep(0.002)   # token drought: let refill run
+            wall = time.perf_counter() - t0
+            stats = gate.stats()
+            retries = client.retries
+        finally:
+            client.close()
+            side.close()
+        return dict(drained=drained, stats=stats, retries=retries,
+                    offers=offers, rpc_sheds=rpc_sheds, wall=wall)
+
+    base = run_arm(None)
+    log(f"[chaos-ingest] fault-free: {len(base['drained'])} drained in "
+        f"{base['wall']:.2f}s ({base['offers']} offers, sheds "
+        f"rate={base['stats']['shed_rate']} "
+        f"capacity={base['stats']['shed_capacity']})")
+
+    plan = plan if plan is not None else make_ingest_plan(seed=plan_seed)
+    chaos = run_arm(plan)
+    log(f"[chaos-ingest] chaos: {len(chaos['drained'])} drained in "
+        f"{chaos['wall']:.2f}s ({chaos['offers']} offers, "
+        f"{chaos['retries']} client retries, sheds "
+        f"rate={chaos['stats']['shed_rate']} "
+        f"capacity={chaos['stats']['shed_capacity']} "
+        f"fault={chaos['stats']['shed_fault']})")
+
+    base_set = set(base["drained"])
+    chaos_set = set(chaos["drained"])
+    lost = sorted(base_set - chaos_set)
+    extra = sorted(chaos_set - base_set)
+    dup = len(chaos["drained"]) - len(chaos_set)
+    missing = sorted(all_names - base_set)
+    identical = not (lost or extra or missing
+                     or dup or len(base["drained"]) - len(base_set))
+    report = dict(
+        pods=n_pods, batch=batch, seed=seed, rate=rate, burst=burst,
+        bound=bound, drain_w=drain_w,
+        baseline=dict(
+            drained=len(base["drained"]), offers=base["offers"],
+            client_retries=base["retries"], wall_s=round(base["wall"], 3),
+            gate=base["stats"],
+        ),
+        chaos=dict(
+            drained=len(chaos["drained"]), offers=chaos["offers"],
+            client_retries=chaos["retries"],
+            rpc_level_sheds=chaos["rpc_sheds"],
+            wall_s=round(chaos["wall"], 3),
+            gate=chaos["stats"],
+        ),
+        injected=plan.report(),
+        end_state=dict(
+            identical=identical, lost=lost, extra=extra,
+            missing_from_storm=missing, duplicated=dup,
+        ),
+    )
+    log(f"[chaos-ingest] end state identical: {identical} "
+        f"(lost={len(lost)} extra={len(extra)} duplicated={dup} "
+        f"injected={len(report['injected']['fired'])})")
+    return report
+
+
 def run_chaos_fleet(
     n_pods: int = 120,
     n_nodes: int = 12,
@@ -651,11 +830,21 @@ def main() -> int:
                     help="fleet experiment only: boot replicas with "
                          "explicit buckets + shape-class prewarm and "
                          "ASSERT compile-free serving and failover")
+    ap.add_argument("--ingest", action="store_true",
+                    help="run the FRONT-DOOR experiment instead: a "
+                         "shed-heavy Enqueue storm with drop/error "
+                         "shots at ingest.enqueue must converge to the "
+                         "fault-free drain set (zero lost/duplicated)")
     ap.add_argument("--json", default=None,
                     help="write the full report to this path")
     args = ap.parse_args()
     err = lambda *a: print(*a, file=sys.stderr, flush=True)  # noqa: E731
-    if args.replicas is not None:
+    if args.ingest:
+        report = run_chaos_ingest(
+            n_pods=args.pods, batch=args.batch or 24, seed=args.seed,
+            plan_seed=args.plan_seed, log=err,
+        )
+    elif args.replicas is not None:
         report = run_chaos_fleet(
             n_pods=args.pods, n_nodes=args.nodes, seed=args.seed,
             batch_size=args.batch, replicas=args.replicas,

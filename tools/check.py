@@ -384,6 +384,65 @@ def stage_prewarm() -> "tuple[str, str]":
     return ("ok" if rc == 0 else "FAIL"), out
 
 
+_INGEST_CODE = """
+import json, os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import grpc
+from tpusched.rpc.client import SchedulerClient
+from tpusched.rpc.server import make_server
+
+# A tiny bounded gate: burst 2 admits two pods, the rest of the batch
+# sheds; bound 4 keeps the queue capacity-shed path reachable too.
+server, port, svc = make_server(
+    "127.0.0.1:0",
+    ingest=dict(capacity=8, bound=4, rate=0.5, burst=2.0))
+server.start()
+try:
+    with SchedulerClient(f"127.0.0.1:{port}", timeout=5.0) as client:
+        pods = [dict(name=f"p{i}", priority=float(i)) for i in range(5)]
+        resp = client.enqueue(pods, tenant=0)
+        assert resp.admitted >= 1 and resp.shed >= 1, resp
+        assert resp.retry_after_s > 0, resp
+        assert set(resp.shed_pods).isdisjoint({"p0", "p1"}), resp
+        # A fully shed batch surfaces as RESOURCE_EXHAUSTED once the
+        # client's own retry budget (which re-drives it) is exhausted —
+        # the refill rate (one token per 2s) outlasts the 0.2s budget.
+        client2 = SchedulerClient(f"127.0.0.1:{port}", timeout=0.2)
+        try:
+            client2.enqueue([dict(name="q0"), dict(name="q1")])
+            code = None
+        except grpc.RpcError as e:
+            code = e.code()
+        finally:
+            client2.close()
+        assert code == grpc.StatusCode.RESOURCE_EXHAUSTED, code
+        sz = json.loads(client.statusz().statusz_json)
+        metrics_text = client.metrics_text()
+finally:
+    server.stop(0)
+    svc.close()
+panel = sz.get("ingest")
+assert panel and panel["admitted"] >= 1 and panel["shed_rate"] >= 1, panel
+assert panel["queue_bound"] == 4, panel
+assert "# TYPE scheduler_ingest_queue_depth gauge" in metrics_text
+assert "# TYPE scheduler_ingest_shed_frac gauge" in metrics_text
+assert 'scheduler_ingest_pods_total{outcome="admitted"}' in metrics_text
+print(json.dumps(dict(admitted=panel["admitted"],
+                      shed=panel["shed_rate"] + panel["shed_capacity"],
+                      depth=panel["queue_depth"])))
+"""
+
+
+def stage_ingest() -> "tuple[str, str]":
+    try:
+        import grpc  # noqa: F401
+        import jax  # noqa: F401
+    except ImportError:
+        return "skip", "jax/grpc not installed on this image"
+    rc, out = _run([sys.executable, "-c", _INGEST_CODE])
+    return ("ok" if rc == 0 else "FAIL"), out
+
+
 STAGES = (
     ("regen", stage_regen),
     ("lint", stage_lint),
@@ -398,6 +457,7 @@ STAGES = (
     ("statusz", stage_statusz),
     ("wirez", stage_wirez),
     ("prewarm", stage_prewarm),
+    ("ingest", stage_ingest),
 )
 
 

@@ -366,6 +366,67 @@ def _harnesses() -> List[Harness]:
 
     out.append(Harness("ring_counts", ("ring_sig_counts", "sig_counts"),
                        run_ring))
+
+    # 9. The device-resident pending queue (ISSUE 20): full ranking,
+    # the top-kb window slice, and the numpy host oracle. Padding IS
+    # the table's natural regime — a bigger pow2 capacity means more
+    # invalid slots — so the pad multiple grows Q while the P real
+    # rows stay fixed. Real-row pop order is pad-independent because
+    # invalid slots are ineligible (k_elig=1) and the sort is stable:
+    # filtering the order array to real indices must be bitwise stable
+    # across widths, and the top-kb window (kb <= eligible reals, all
+    # of which outrank any pad slot) must be identical outright.
+    def run_queue(mult: int) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+        from tpusched.kernels import queue as kq
+        P, kb, now, gain = 40, 8, 300.0, 1000.0
+        rng = np.random.default_rng(7)
+        t = kq.empty_table(64 * mult)
+        valid = np.asarray(t.valid).copy()
+        valid[:P] = True
+        base = np.asarray(t.base_priority).copy()
+        base[:P] = rng.uniform(10.0, 100.0, P).astype(np.float32)
+        slo = np.asarray(t.slo_target).copy()
+        slo[:P] = rng.choice(
+            np.asarray([0.0, 0.9, 0.99], np.float32), P)
+        sub = np.asarray(t.submitted).copy()
+        sub[:P] = rng.uniform(0.0, 250.0, P).astype(np.float32)
+        run = np.asarray(t.run_seconds).copy()
+        run[:P] = rng.uniform(0.0, 40.0, P).astype(np.float32)
+        park = np.asarray(t.parked_until).copy()
+        park[:P][rng.random(P) < 0.25] = np.float32(now + 60.0)
+        seq = np.asarray(t.seq).copy()
+        seq[:P] = rng.permutation(P).astype(np.uint32)
+        host = t._replace(valid=valid, base_priority=base,
+                          slo_target=slo, submitted=sub,
+                          run_seconds=run, parked_until=park, seq=seq)
+        dev = jax.tree.map(jnp.asarray, host)
+        order, prio, n_elig, depth = kq.rank_full(
+            dev, jnp.float32(now), jnp.float32(gain))
+        order = np.asarray(order)
+        win, wprio, _n2, _d2 = kq.window_select(
+            dev, now, gain, kb)
+        ref_order, ref_prio, _re, _rd = kq.rank_reference(host, now, gain)
+        ref_order = np.asarray(ref_order)
+        return {
+            "order_real": order[order < P],
+            "prio": np.asarray(prio)[:P],
+            "win": np.asarray(win),
+            "win_prio": np.asarray(wprio),
+            "ref_order_real": ref_order[ref_order < P],
+            "ref_prio": np.asarray(ref_prio)[:P],
+            "counts": np.asarray([int(n_elig), int(depth)]),
+        }
+
+    out.append(Harness(
+        "queue_rank",
+        ("rank_full", "_window_body", "rank_reference"),
+        run_queue,
+        sanity=lambda o: "" if (
+            0 < o["counts"][0] < o["counts"][1]) else
+        "parked slots never held (or nothing eligible)",
+    ))
     return out
 
 

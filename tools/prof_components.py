@@ -17,10 +17,19 @@ trail for the compaction claim the same way `--warm` validated the
 tableau: late rounds carry tiny frontiers, so their wall should track
 the [cap, N] view, not [P, N]. preset: pairwise (default) | preempt.
 
+Round 25 (ISSUE 20, device queue): `--queue` profiles the pending-queue
+cost model — DeviceQueue.window() host wall vs backlog depth Q next to
+the host-sorted baseline's O(Q log Q) recompute+sort, plus a cProfile
+pass showing WHERE each arm's sort work lives: the device arm's only
+Python-level sort is the O(arrivals) dirty-index sort in _flush; the
+queue re-sort itself is absent from the host profile (it runs
+in-kernel over the bounded table).
+
     python tools/prof_components.py 10000 5000
     python tools/prof_components.py 10000 5000 --warm
     python tools/prof_components.py 2000 500 --rounds preempt
     PROF_CPU=1 python tools/prof_components.py 2000 1000 --warm
+    PROF_CPU=1 python tools/prof_components.py --queue
 """
 import os
 import sys
@@ -187,10 +196,104 @@ def prof_rounds(pods: int, nodes: int, preset: str = "pairwise",
             break
 
 
+def prof_queue(depths=(1024, 4096, 16384), w: int = 256,
+               batch: int = 256, reps: int = 5):
+    """Pending-queue cost-model profile (see module docstring). Walls
+    are host-blocking time per window() call: the device arm pays a
+    near-flat dispatch+transfer cost (the rank/sort runs in-kernel),
+    the host-sorted baseline pays the O(Q) recompute + O(Q log Q)
+    sort every cycle."""
+    import cProfile
+    import pstats
+
+    from bench import _HostSortedQueue
+    from tpusched.device_state import DeviceQueue
+
+    def fill(q, n):
+        r = np.random.default_rng(5)
+        for i in range(n):
+            q.upsert(f"q{i:06d}",
+                     base_priority=float(r.uniform(10.0, 100.0)),
+                     slo_target=float(r.uniform(0.5, 0.999)),
+                     submitted=float(i) * 1e-3)
+
+    def tmin(fn):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return min(ts) * 1e3
+
+    now = float(max(depths)) * 1e-3 + 60.0
+    print(f"w={w} arrivals/cycle={batch} (walls are min of {reps}; "
+          f"arrive+win = {batch} upserts + scatter + window, the real "
+          "per-cycle host bill)")
+    print(f"{'Q':>7} {'dev_window_ms':>14} {'dev_arrive+win_ms':>18} "
+          f"{'host_sort_ms':>13} {'host/dev':>9}")
+    last = None
+    for Q in depths:
+        dq = DeviceQueue(capacity=Q)
+        fill(dq, Q - batch)
+        dq.window(now, w)      # compile + settle at this capacity
+        t_win = tmin(lambda: dq.window(now, w))
+
+        k = [0]
+
+        def cycle():
+            k[0] += 1
+            names = [f"a{k[0]:03d}-{j:04d}" for j in range(batch)]
+            for j, nm in enumerate(names):
+                dq.upsert(nm, base_priority=50.0, slo_target=0.9,
+                          submitted=now - float(j))
+            dq.window(now, w)
+            dq.remove(names)
+
+        cycle()                # settle the arrival shapes
+        t_cyc = tmin(cycle)
+
+        hq = _HostSortedQueue(bound=None)
+        fill(hq, Q)
+        t_host = tmin(lambda: hq.window(now, w))
+        print(f"{Q:>7} {t_win:>14.2f} {t_cyc:>18.2f} {t_host:>13.2f} "
+              f"{t_host / max(t_cyc, 1e-9):>9.2f}")
+        last = (dq, hq, cycle)
+
+    # -- where does the sort live? ----------------------------------------
+    dq, hq, cycle = last
+
+    def sort_rows(fn, n=5):
+        pr = cProfile.Profile()
+        pr.enable()
+        for _ in range(n):
+            fn()
+        pr.disable()
+        rows = []
+        for (f, _l, name), (cc, nc, tt, ct, _cal) in \
+                pstats.Stats(pr).stats.items():
+            if "sort" in name:
+                rows.append((nc, tt * 1e3, name))
+        return sorted(rows, key=lambda r: -r[1])
+
+    Q = depths[-1]
+    print(f"\ncProfile over 5 cycles at Q={Q}: Python-level sort work")
+    for arm, rows in (("device", sort_rows(cycle)),
+                      ("hostsort", sort_rows(lambda: hq.window(now, w)))):
+        if not rows:
+            print(f"  {arm}: none")
+        for nc, tt, name in rows:
+            print(f"  {arm}: {name}  calls={nc} tottime={tt:.2f}ms")
+    print("the device arm's only sort is the O(arrivals) dirty-index "
+          "sort in _flush; the O(Q log Q) backlog re-sort exists only "
+          "in the hostsort arm's profile")
+
+
 def main():
-    argv = [a for a in sys.argv[1:] if a not in ("--warm", "--rounds")]
+    argv = [a for a in sys.argv[1:]
+            if a not in ("--warm", "--rounds", "--queue")]
     warm = "--warm" in sys.argv[1:]
     rounds_mode = "--rounds" in sys.argv[1:]
+    queue_mode = "--queue" in sys.argv[1:]
     # Integer operands are the shape; float operands (only meaningful
     # with --warm) override the churn sweep levels; a bare word after
     # --rounds picks the preset.
@@ -205,6 +308,10 @@ def main():
                 words.append(a)
     pods = ints[0] if len(ints) > 0 else 10_000
     nodes = ints[1] if len(ints) > 1 else 5_000
+    if queue_mode:
+        # Integer operands become the depth sweep (default 1k/4k/16k).
+        prof_queue(depths=tuple(ints) or (1024, 4096, 16384))
+        return
     if rounds_mode:
         prof_rounds(pods, nodes, preset=(words[0] if words else "pairwise"))
         return

@@ -113,6 +113,24 @@ MESSAGE_ADDS = {
     "StatuszResponse": [
         ("statusz_json", 1, F.TYPE_STRING, "statuszJson"),
     ],
+    # PR 20 (ISSUE 20): admission-controlled ingest — the bounded
+    # Enqueue front door ahead of the device-resident pending queue.
+    "EnqueueRequest": [
+        ("pods", 1, F.TYPE_MESSAGE, "pods", F.LABEL_REPEATED,
+         ".tpusched.PendingPod"),
+        ("tenant", 2, F.TYPE_INT32, "tenant"),
+        ("request_id", 3, F.TYPE_STRING, "requestId"),
+        ("submitted", 4, F.TYPE_DOUBLE, "submitted"),
+        ("parent_span", 5, F.TYPE_UINT64, "parentSpan"),
+    ],
+    "EnqueueResponse": [
+        ("admitted", 1, F.TYPE_INT32, "admitted"),
+        ("shed", 2, F.TYPE_INT32, "shed"),
+        ("shed_pods", 3, F.TYPE_STRING, "shedPods", F.LABEL_REPEATED,
+         ""),
+        ("queue_depth", 4, F.TYPE_INT32, "queueDepth"),
+        ("retry_after_s", 5, F.TYPE_DOUBLE, "retryAfterS"),
+    ],
 }
 
 # New unary service methods: service name -> [(method, input, output)].
@@ -125,6 +143,8 @@ METHOD_ADDS = {
          ".tpusched.ExplainzResponse"),
         ("Statusz", ".tpusched.StatuszRequest",
          ".tpusched.StatuszResponse"),
+        ("Enqueue", ".tpusched.EnqueueRequest",
+         ".tpusched.EnqueueResponse"),
     ],
 }
 

@@ -43,6 +43,7 @@ Invariants (the delta-vs-rebuild parity tests pin these):
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import logging
 import time
 import traceback
@@ -54,6 +55,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from tpusched import trace as tracing
 from tpusched.config import Buckets, EngineConfig
+from tpusched.kernels import queue as queue_kernels
 from tpusched.kernels.assign import permute_rows, scatter_rows
 from tpusched.mesh import snapshot_shardings
 from tpusched.qos import pressure_of
@@ -217,6 +219,21 @@ class DeviceSnapshot:
         # the joining thread — the same single-caller serialization
         # discipline apply() relies on.
         self._carry = None  # (pod_names, node_names, assign np, chosen np)
+        # Device-resident pending queue (ISSUE 20): attached lazily so
+        # lineages that never ingest pay nothing. Lives on the lineage
+        # because its lifetime (and failover story) is the lineage's.
+        self.pending: "DeviceQueue | None" = None
+
+    def attach_pending(self, capacity: int = 1024,
+                       bound: int | None = None) -> "DeviceQueue":
+        """Create (or return) this lineage's device pending queue. The
+        queue inherits the lineage's qos_gain so in-kernel priorities
+        match what the solver would compute host-side."""
+        if self.pending is None:
+            self.pending = DeviceQueue(
+                capacity=capacity, bound=bound,
+                qos_gain=float(self.config.qos.qos_gain))
+        return self.pending
 
     # -- views --------------------------------------------------------------
 
@@ -993,3 +1010,201 @@ class DeviceSnapshot:
             perm[i] = old_pos.get(nm, i)
         pads = list(range(len(new_order), len(old_order)))
         return perm, pads
+
+
+# ---------------------------------------------------------------------------
+# Device-resident pending queue (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+class DeviceQueue:
+    """The persistent [Q] pending table: host mirror + device twin.
+
+    The host keeps a numpy struct-of-arrays mirror plus the name<->slot
+    map; every mutation (upsert / remove / park) touches ONLY the
+    mirror and marks the slot dirty, and `window()` ships the dirty
+    rows in one pow2-padded scatter (`_pad_pow2` + `scatter_rows`, the
+    PR 2 delta discipline) before ranking — so per-cycle device traffic
+    is O(mutations) and per-cycle host work never re-reads or re-sorts
+    the pending set. Ranking, availability decay, and the top-W window
+    slice all run in-kernel (kernels.queue.window_select).
+
+    Times are rebased against the first-submit epoch so wall clocks
+    survive the float32 table (f32 resolution at time.time() magnitudes
+    is ~256s; rebased sim/wall offsets are exact to well past a sim
+    day). `bound` caps admission: upsert of a NEW name into a full
+    bounded queue returns False and the caller sheds (RESOURCE_EXHAUSTED
+    at the rpc layer); unbounded queues grow by pow2 doubling, which
+    drops the device twin for one full re-upload (bounded compile set:
+    one (Q, kb) bucket pair per capacity).
+
+    Not thread-safe: the ingest gate serializes access under its own
+    lock; HostScheduler drives it single-threaded from the cycle loop.
+    """
+
+    def __init__(self, capacity: int = 1024, bound: int | None = None,
+                 qos_gain: float = 1000.0):
+        cap = 1 << max(int(capacity) - 1, 0).bit_length()
+        self.bound = int(bound) if bound else None
+        self.qos_gain = float(qos_gain)
+        self._host = queue_kernels.empty_table(cap)
+        self._dev = None                    # device twin; None = stale
+        self._slot: dict[str, int] = {}     # name -> slot index
+        self._names: list[str | None] = [None] * cap
+        self._free: list[int] = list(range(cap))  # min-heap (lowest first)
+        heapq.heapify(self._free)
+        self._dirty: set[int] = set()
+        self._epoch: float | None = None    # first-submit time rebase
+        self._seq = 0                       # arrival sequence stamp
+        # Profiling counters (tools/prof_components.py --queue and the
+        # ingest bench read these).
+        self.scatters = 0
+        self.scatter_rows_total = 0
+        self.windows = 0
+
+    # -- inspection ------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        return len(self._names)
+
+    @property
+    def depth(self) -> int:
+        return len(self._slot)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._slot
+
+    def names(self) -> list[str]:
+        return list(self._slot)
+
+    def _rebase(self, t: float) -> np.float32:
+        if self._epoch is None:
+            self._epoch = float(t)
+        return np.float32(t - self._epoch)
+
+    # -- mutation (host mirror only; O(1) each) --------------------------
+
+    def upsert(self, name: str, *, base_priority: float = 0.0,
+               slo_target: float = 0.0, submitted: float = 0.0,
+               run_seconds: float = 0.0, parked_until: float = 0.0,
+               tenant: int = 0, seq: int | None = None) -> bool:
+        """Insert or update one pending row. Returns False (and changes
+        nothing) when the queue is bounded and full and `name` is new —
+        the admission-shed signal."""
+        slot = self._slot.get(name)
+        if slot is None:
+            if self.bound is not None and len(self._slot) >= self.bound:
+                return False
+            if not self._free:
+                self._grow()
+            slot = heapq.heappop(self._free)
+            self._slot[name] = slot
+            self._names[slot] = name
+        if seq is None:
+            seq = self._seq
+        self._seq = max(self._seq, int(seq)) + 1
+        h = self._host
+        h.valid[slot] = True
+        h.base_priority[slot] = np.float32(base_priority)
+        h.slo_target[slot] = np.float32(slo_target)
+        h.submitted[slot] = self._rebase(submitted)
+        h.run_seconds[slot] = np.float32(run_seconds)
+        h.parked_until[slot] = self._rebase(parked_until) \
+            if parked_until else np.float32(0.0)
+        h.tenant[slot] = np.int32(tenant)
+        h.seq[slot] = np.uint32(seq)
+        self._dirty.add(slot)
+        return True
+
+    def remove(self, names: Iterable[str]) -> int:
+        """Invalidate slots (bind/delete). Unknown names are ignored —
+        removal is idempotent like FakeApiServer.delete_pod."""
+        n = 0
+        for name in names:
+            slot = self._slot.pop(name, None)
+            if slot is None:
+                continue
+            self._host.valid[slot] = False
+            self._names[slot] = None
+            heapq.heappush(self._free, slot)
+            self._dirty.add(slot)
+            n += 1
+        return n
+
+    def park(self, name: str, until: float) -> bool:
+        """Backoff-park one pod: ineligible until `until` (absolute
+        time, same clock as upsert/window). The row keeps its place,
+        priority keeps decaying — parking masks eligibility only."""
+        slot = self._slot.get(name)
+        if slot is None:
+            return False
+        self._host.parked_until[slot] = self._rebase(until)
+        self._dirty.add(slot)
+        return True
+
+    # -- device sync + window -------------------------------------------
+
+    def _grow(self) -> None:
+        old = self._host
+        old_cap = len(self._names)
+        new_cap = old_cap * 2
+        self._host = queue_kernels.empty_table(new_cap)
+        for f, arr in zip(self._host._fields, self._host):
+            arr[:old_cap] = getattr(old, f)
+        self._names.extend([None] * old_cap)
+        for s in range(old_cap, new_cap):
+            heapq.heappush(self._free, s)
+        self._dev = None            # full re-upload on next flush
+
+    def _flush(self) -> None:
+        """Ship dirty mirror rows to the device twin: one pow2-padded
+        scatter per cycle (or a full device_put after growth)."""
+        if self._dev is None:
+            self._dev = jax.device_put(
+                queue_kernels.QueueTable(*[np.asarray(a) for a in self._host]))
+            self._dirty.clear()
+            return
+        if not self._dirty:
+            return
+        rows = sorted(self._dirty)
+        idx = _pad_pow2(rows)
+        row_data = queue_kernels.QueueTable(
+            *[np.ascontiguousarray(a[idx]) for a in self._host])
+        self._dev = scatter_rows(self._dev, idx, row_data)
+        self.scatters += 1
+        self.scatter_rows_total += len(rows)
+        self._dirty.clear()
+
+    def window(self, now: float, w: int):
+        """Extract the top-`w` solve window ON DEVICE: flush dirty
+        rows, rank the whole table in-kernel, slice the pow2 window
+        bucket, and map the returned slots back to names. Returns
+        (names in pop order, n_eligible, depth) with
+        len(names) == min(w, n_eligible)."""
+        self._flush()
+        if self._epoch is None:
+            return [], 0, 0
+        cap = self.capacity
+        kb = queue_kernels.k_bucket(min(max(int(w), 1), cap), cap)
+        win, _prio, n_elig, depth = queue_kernels.window_select(
+            self._dev, self._rebase(now), self.qos_gain, kb)
+        self.windows += 1
+        n_elig = int(n_elig)
+        take = min(int(w), n_elig, kb)
+        names = []
+        for s in np.asarray(win)[:take]:
+            nm = self._names[int(s)]
+            if nm is not None:
+                names.append(nm)
+        return names, n_elig, int(depth)
+
+    def stats(self) -> dict:
+        return {
+            "depth": self.depth,
+            "capacity": self.capacity,
+            "bound": self.bound,
+            "scatters": self.scatters,
+            "scatter_rows_total": self.scatter_rows_total,
+            "windows": self.windows,
+        }

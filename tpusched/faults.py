@@ -43,6 +43,14 @@ contract the chaos harness and tests rely on):
                        refuses the takeover with UNAVAILABLE, the
                        split-brain-attempt guard scenario: the client
                        rotates to the next endpoint and retries
+  ``ingest.enqueue``   top of every IngestGate.offer (ingest.py) —
+                       ``drop`` sheds the whole batch (the caller
+                       retries, the chaos arm proves exactly-once
+                       convergence), ``delay`` stalls admission (the
+                       latency quantiles see it), ``error`` raises
+                       out of the gate; the Enqueue rpc maps it to
+                       UNAVAILABLE so the PR 3 client retry contract
+                       re-drives it
 
 One plan instance may be shared across components (server + engine +
 informer): counters are per-site and thread-safe, and ``fired`` records

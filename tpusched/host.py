@@ -22,6 +22,7 @@ import dataclasses
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
+from typing import Iterable
 
 import numpy as np
 
@@ -36,7 +37,7 @@ from tpusched.config import (
     EngineConfig,
     clamp01,
 )
-from tpusched.device_state import DeviceSnapshot
+from tpusched.device_state import DeviceQueue, DeviceSnapshot
 from tpusched.engine import Engine
 from tpusched.qos import observed_availability, slack_of
 from tpusched.rpc.codec import decode_snapshot, snapshot_to_proto
@@ -73,6 +74,11 @@ class FakeApiServer:
         # Last computed observed_avail each pod was served with — the
         # drift baseline for read-time re-hinting (see _with_avail).
         self._avail_served: dict[str, float] = {}
+        # Monotone arrival stamp (ISSUE 20): the device queue's
+        # deterministic tie-break. Re-queued pods restamp, matching
+        # their new dict-insertion position, so seq order == the dict
+        # iteration order the host-sorted path batches in.
+        self._arrival_seq = 0
 
     # -- cluster setup ------------------------------------------------------
 
@@ -101,6 +107,8 @@ class FakeApiServer:
             rec = dict(spec, name=name, phase="Pending", node=None)
             rec.setdefault("submitted", self._clock())
             rec.setdefault("run_seconds", 0.0)
+            rec["arrival_seq"] = self._arrival_seq
+            self._arrival_seq += 1
             self._pods[name] = rec
             self._changed.add(name)
 
@@ -206,6 +214,21 @@ class FakeApiServer:
             return [self._with_avail(p, now) for p in self._pods.values()
                     if p["phase"] == "Pending"]
 
+    def pods_named(self, names: Iterable[str]) -> list[dict]:
+        """O(len(names)) read of specific pending pods, with the same
+        availability accounting / re-hint side effects as
+        pending_pods(). Skips names that are gone or no longer Pending
+        — the device-queue cycle (ISSUE 20) reads ONLY its extracted
+        window through this, never the full pending set."""
+        with self._lock:
+            now = self._clock()
+            out = []
+            for name in names:
+                p = self._pods.get(name)
+                if p is not None and p["phase"] == "Pending":
+                    out.append(self._with_avail(p, now))
+            return out
+
     def bound_pods(self) -> list[dict]:
         with self._lock:
             now = self._clock()
@@ -288,6 +311,8 @@ class HostScheduler:
         tracer=None,
         warm: "bool | str" = False,
         ledger=None,
+        device_queue: bool = False,
+        queue_capacity: int = 1024,
     ):
         """explain (round 12, ISSUE 8): optional
         tpusched.explain.ExplainCollector; None falls back to the
@@ -332,7 +357,21 @@ class HostScheduler:
         the XLA cache misses the cycle paid (ledger.COMPILES delta).
         The record's `ts` rides this host's clock, so virtual-time
         drivers emit virtual timestamps; `ledger_source` tags the
-        emitter ("host"; the sim driver re-tags its host "sim")."""
+        emitter ("host"; the sim driver re-tags its host "sim").
+
+        device_queue (ISSUE 20): keep the pending set in a
+        device-resident DeviceQueue instead of re-reading and
+        re-filtering `pending_pods()` every cycle. Change hints drive
+        O(churn) queue upserts/removals, the top-W solve window is
+        extracted on device (availability-decay priority recomputed
+        in-kernel), and only the window's W records are read back
+        through `pods_named` — per-cycle host work is O(arrivals),
+        not O(pending). The queue chooses batch MEMBERSHIP only; the
+        window is re-ordered by arrival_seq before the solve, so
+        whenever every eligible pod fits the batch the solver sees the
+        EXACT batch the host-sorted path would have built (the
+        pressure_skew bit-parity contract); under overload the window
+        is the highest-pressure W instead of the first W by age."""
         self.api = api
         self.tracer = tracer
         self.config = config or EngineConfig()
@@ -440,6 +479,18 @@ class HostScheduler:
             else explaining.DEFAULT
         self.ledger = ledger
         self.ledger_source = "host"
+        # Device-resident pending queue (ISSUE 20). The side tables map
+        # backoff keys to resident member names so gang parking and
+        # backoff-book pruning stay O(churn) — the host-sorted path
+        # derives both from the full pending read the queue exists to
+        # avoid.
+        self._devqueue = None
+        self._dq_members: dict[str, set[str]] = {}   # backoff key -> names
+        self._dq_key_of: dict[str, str] = {}         # name -> backoff key
+        if device_queue:
+            self._devqueue = DeviceQueue(
+                capacity=queue_capacity,
+                qos_gain=float(self.config.qos.qos_gain))
 
     def _io(self) -> ThreadPoolExecutor:
         """Lazy pool for concurrent API-server writes (binds/deletes)."""
@@ -468,12 +519,111 @@ class HostScheduler:
         return f"gang\x00{g}" if g else f"pod\x00{p['name']}"
 
     def _restore_hints(self, changed) -> None:
-        """Un-drain change hints a cycle consumed but never shipped."""
+        """Un-drain change hints a cycle consumed but never shipped.
+        Device-queue mutations already applied from these hints are
+        safe to replay — upsert/remove/park are idempotent."""
         if self._delta is not None or self._pipeline is not None \
-                or self._warm:
+                or self._warm or self._devqueue is not None:
             restore = getattr(self.api, "restore_changed", None)
             if restore is not None:
                 restore(changed)
+
+    # -- device-resident pending queue (ISSUE 20) ----------------------------
+
+    def _dq_now(self) -> float:
+        """The queue's single timebase: the API SERVER's clock (pod
+        `submitted` stamps ride it), NOT this host's backoff clock —
+        mixing the two in one table would corrupt in-kernel ages. Sim
+        drivers inject one VirtualClock into both, so there the bases
+        coincide."""
+        clk = getattr(self.api, "_clock", None)
+        return float(clk()) if callable(clk) else time.time()
+
+    def _dq_upsert(self, p: dict) -> None:
+        name = p["name"]
+        key = self._backoff_key(p)
+        old = self._dq_key_of.get(name)
+        if old is not None and old != key:
+            self._dq_members.get(old, set()).discard(name)
+        self._dq_key_of[name] = key
+        self._dq_members.setdefault(key, set()).add(name)
+        gain = float(self.config.qos.qos_gain)
+        pinned = p.get("observed_avail")
+        if pinned is not None:
+            # Pinned availability (annotation write-back / tests): no
+            # in-kernel decay — fold the whole effective priority into
+            # the base and zero the SLO leg so the kernel's pressure
+            # term vanishes. Re-pins arrive as change hints.
+            base = float(p.get("priority", 0.0)) + gain * clamp01(
+                float(p.get("slo_target", 0.0)) - float(pinned))
+            slo = 0.0
+        else:
+            base = float(p.get("priority", 0.0))
+            slo = float(p.get("slo_target", 0.0))
+        retry_at, _ = self._backoff.get(key, (0.0, 0))
+        rem = retry_at - self._clock()
+        self._devqueue.upsert(
+            name, base_priority=base, slo_target=slo,
+            submitted=float(p.get("submitted", 0.0)),
+            run_seconds=float(p.get("run_seconds", 0.0)),
+            parked_until=self._dq_now() + rem if rem > 0 else 0.0,
+            seq=p.get("arrival_seq"))
+
+    def _dq_remove(self, names: list[str]) -> None:
+        for name in names:
+            key = self._dq_key_of.pop(name, None)
+            if key is None:
+                continue
+            members = self._dq_members.get(key)
+            if members is not None:
+                members.discard(name)
+                if not members:
+                    # Last resident member gone: the backoff book entry
+                    # is dead too (the host-sorted path prunes these
+                    # against the full pending read).
+                    del self._dq_members[key]
+                    self._backoff.pop(key, None)
+        self._devqueue.remove(names)
+
+    def _dq_sync(self, changed: "set[str] | None") -> None:
+        """Reconcile the device queue with the api: O(churn) per cycle.
+        changed=None (first cycle / informer re-list) is the one full
+        O(pending) resync; every other cycle touches only the hinted
+        names. Hint names are processed in sorted order so internally
+        stamped arrival seqs (records without arrival_seq) stay
+        deterministic under set-iteration randomization."""
+        if changed is None:
+            live = self.api.pending_pods()
+            live_names = {p["name"] for p in live}
+            self._dq_remove([n for n in list(self._dq_key_of)
+                             if n not in live_names])
+            for p in live:
+                self._dq_upsert(p)
+            return
+        for name in sorted(changed):
+            p = self.api.get_pod(name)
+            if p is None or p.get("phase") != "Pending":
+                if name in self._dq_key_of:
+                    self._dq_remove([name])
+                continue
+            self._dq_upsert(p)
+
+    def _dq_repark(self, failed_keys: dict) -> None:
+        """Mirror this cycle's backoff-book updates into the queue's
+        parking bits: failed keys park every resident member until the
+        key's retry time, cleared keys unpark them (a gang whose member
+        placed re-enters the active window NOW, exactly like the
+        host-sorted path's book-driven filter)."""
+        dq_now = self._dq_now()
+        host_now = self._clock()
+        for key, fail in failed_keys.items():
+            if fail:
+                retry_at, _ = self._backoff.get(key, (0.0, 0))
+                until = dq_now + max(retry_at - host_now, 0.0)
+            else:
+                until = 0.0
+            for nm in self._dq_members.get(key, ()):
+                self._devqueue.park(nm, until)
 
     @staticmethod
     def _result_names(meta, res):
@@ -649,7 +799,7 @@ class HostScheduler:
         if self._warm and not warm_cycle and self._warm_ds is not None:
             self._warm_reset("explain_enabled")
         if self._delta is not None or self._pipeline is not None \
-                or warm_cycle:
+                or warm_cycle or self._devqueue is not None:
             drain = getattr(self.api, "drain_changed", None)
             epoch_fn = getattr(self.api, "relist_epoch", None)
             if epoch_fn is not None:
@@ -662,23 +812,51 @@ class HostScheduler:
         # the drain but before the send would otherwise lose the hints —
         # DeltaSession's base only advances on success, so the next
         # delta would trust a stale base for those records forever.
+        window_s = 0.0
+        queue_depth = 0
         try:
-            all_pending = self.api.pending_pods()
-            # Prune backoff state for pods that vanished (deleted, or
-            # bound by another actor) so the book can't grow unbounded.
-            live_keys = {self._backoff_key(p) for p in all_pending}
-            for k in [k for k in self._backoff if k not in live_keys]:
-                del self._backoff[k]
-            pending = [
-                p for p in all_pending
-                if self._backoff.get(self._backoff_key(p), (0.0, 0))[0] <= now
-            ]
+            if self._devqueue is not None:
+                # Device-queue path (ISSUE 20): O(churn) hint-driven
+                # sync, in-kernel ranking, O(W) window read-back. The
+                # full pending set is never read after the first cycle.
+                t0 = time.perf_counter()
+                self._dq_sync(changed)
+                win_names, _n_elig, queue_depth = self._devqueue.window(
+                    self._dq_now(), self.batch_size)
+                window_s = time.perf_counter() - t0
+                reader = getattr(self.api, "pods_named", None)
+                if reader is not None:
+                    pending = reader(win_names)
+                else:
+                    want = set(win_names)
+                    pending = [p for p in self.api.pending_pods()
+                               if p["name"] in want]
+                # The queue chose MEMBERSHIP; arrival order feeds the
+                # solver so the batch is byte-identical to the
+                # host-sorted path's whenever everything eligible fit.
+                pending.sort(key=lambda p: p.get("arrival_seq", 0))
+                backlog = queue_depth
+            else:
+                all_pending = self.api.pending_pods()
+                queue_depth = len(all_pending)
+                # Prune backoff state for pods that vanished (deleted,
+                # or bound by another actor) so the book can't grow
+                # unbounded.
+                live_keys = {self._backoff_key(p) for p in all_pending}
+                for k in [k for k in self._backoff if k not in live_keys]:
+                    del self._backoff[k]
+                pending = [
+                    p for p in all_pending
+                    if self._backoff.get(
+                        self._backoff_key(p), (0.0, 0))[0] <= now
+                ]
+                pending = pending[: self.batch_size]
+                backlog = len(all_pending)
             if not pending:
                 # Nothing ships this cycle: un-drain the hints or the
                 # next delta would trust a stale base for those records.
                 self._restore_hints(changed)
                 return None
-            pending = pending[: self.batch_size]
             t0 = time.perf_counter()
             if warm_cycle:
                 # Record-dialect snapshot (the DeviceSnapshot input);
@@ -706,7 +884,7 @@ class HostScheduler:
                 try:
                     res, meta, warm_path = self._warm_cycle_solve(
                         nodes_r, pods_r, running_r, changed,
-                        backlog=len(all_pending),
+                        backlog=backlog,
                     )
                 except BaseException:
                     self._warm_reset("cycle_error")
@@ -824,6 +1002,8 @@ class HostScheduler:
             if delay < self.backoff_max:
                 attempts += 1
             self._backoff[key] = (now + delay, attempts)
+        if self._devqueue is not None:
+            self._dq_repark(failed_keys)
         bind_s = time.perf_counter() - t0
         stats = CycleStats(
             batch_size=len(pending), placed=placed, evicted=len(evicted),
@@ -852,9 +1032,13 @@ class HostScheduler:
                 churn=len(changed) if changed else 0,
                 frontier=frontier, rounds=rounds, warm_path=warm_path,
                 solve_s=solve_s,
-                stages=dict(build=build_s, solve=solve_s, bind=bind_s),
+                stages=(dict(build=build_s, solve=solve_s, bind=bind_s,
+                             window=window_s)
+                        if self._devqueue is not None else
+                        dict(build=build_s, solve=solve_s, bind=bind_s)),
                 compiles=c1 - comp0[0],
                 compile_s=round(s1 - comp0[1], 6),
+                queue_depth=int(queue_depth),
             ))
         return stats
 

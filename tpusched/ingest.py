@@ -1,0 +1,260 @@
+"""Admission-controlled ingest: the front door ahead of the device
+queue (ISSUE 20 tentpole part 2).
+
+The paper's setting is "heavy traffic from millions of users" hitting a
+scheduler whose solve capacity is fixed: arrival rate is unbounded,
+queue capacity is not. This module is the admission layer between the
+two — a token-bucket gate with per-tenant rate shares over
+`tenants.zipf_weights` (THE tenant-skew definition, shared with the sim
+generators, so "tenant 0 gets X% of admission" means the same thing in
+a trace replay and on the serving path) in front of a bounded
+DeviceQueue. A pod that clears its tenant's bucket AND fits the queue
+is admitted (an upsert, O(1) host work); everything else is SHED with a
+retry-after hint. The Enqueue rpc surfaces a fully shed batch as
+RESOURCE_EXHAUSTED, which the PR 3 client retry contract
+(rpc/client.py RETRYABLE_CODES) already backs off and re-drives — load
+shedding and retry needed zero new client machinery.
+
+Exactly-once across shed/retry: admission dedups by name (an offer of
+a name already resident updates its row; with `dedup=True` an offer of
+a name already admitted-and-drained acks idempotently instead of
+re-enqueueing), so the chaos arm's shed-then-retry storm converges to
+the fault-free end state with zero lost or duplicated pods.
+
+Locking: the gate owns ONE lock ("ingest") serializing offer/drain
+against concurrent Enqueue rpcs. It never calls into another locked
+subsystem while held — it is a leaf in tools/lock_hierarchy.json.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tpusched import ledger as ledgering
+from tpusched import metrics as pm
+from tpusched.faults import NO_FAULTS
+from tpusched.tenants import zipf_weights
+
+#: Retry-after hint on a shed: the worst-case token drought is one
+#: token at the tenant's refill rate, capped so a hot tenant's clients
+#: poll at a bounded rate rather than thundering back instantly.
+MAX_RETRY_AFTER_S = 5.0
+
+
+class TokenBucket:
+    """Classic token bucket on an injected clock: `rate` tokens/s
+    refill up to `burst`. take() is all-or-nothing per pod."""
+
+    def __init__(self, rate: float, burst: float, now: float = 0.0):
+        self.rate = float(rate)
+        self.burst = max(float(burst), 1.0)
+        self.tokens = self.burst
+        self._last = float(now)
+
+    def _refill(self, now: float) -> None:
+        dt = now - self._last
+        if dt > 0:
+            self.tokens = min(self.burst, self.tokens + dt * self.rate)
+        self._last = max(self._last, now)
+
+    def take(self, now: float) -> bool:
+        self._refill(now)
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+    def retry_after(self, now: float) -> float:
+        """Seconds until one token exists (0 when one already does)."""
+        self._refill(now)
+        if self.tokens >= 1.0:
+            return 0.0
+        if self.rate <= 0:
+            return MAX_RETRY_AFTER_S
+        return min((1.0 - self.tokens) / self.rate, MAX_RETRY_AFTER_S)
+
+
+class IngestGate:
+    """Token-bucket admission in front of a (usually bounded)
+    DeviceQueue.
+
+    `rate` is the TOTAL admission rate (pods/s) split across `tenants`
+    by zipf_weights(tenants, skew); `burst` is the total burst depth,
+    split the same way. tenant ids outside [0, tenants) clamp onto the
+    last (coldest) share rather than erroring — a misconfigured client
+    gets throttled, not crashed.
+
+    Every offer() fires the ``ingest.enqueue`` fault site (faults.py
+    site contract). Admission latency per pod is measured from its
+    FIRST offer to the offer that admits it, so a pod shed through N
+    retry rounds carries its full front-door wait into
+    `admission_latency_*` — the bench quantiles price the shedding,
+    not just the happy path.
+    """
+
+    def __init__(self, queue, rate: float = 10000.0, burst: float = 1024.0,
+                 tenants: int = 1, skew: float = 0.0, clock=None,
+                 faults=None, registry=None, ledger=None,
+                 dedup: bool = False):
+        self.queue = queue
+        self.clock = clock if clock is not None else time.time
+        self.faults = faults if faults is not None else NO_FAULTS
+        self.ledger = ledger
+        self.dedup = bool(dedup)
+        n = max(int(tenants), 1)
+        now = float(self.clock())
+        shares = zipf_weights(n, skew)
+        self.buckets = [TokenBucket(rate * float(w), burst * float(w), now)
+                        for w in shares]
+        self._lock = threading.Lock()   # the "ingest" lock (leaf)
+        self._first_offer: dict[str, float] = {}
+        self._admitted_names: "set[str] | None" = set() if dedup else None
+        # Running stats (statusz + the bench read these).
+        self.offered = 0
+        self.admitted = 0
+        self.shed_rate = 0          # sheds for want of tokens
+        self.shed_capacity = 0      # sheds for want of queue slots
+        self.shed_fault = 0         # sheds from an injected drop
+        self.drained = 0
+        self.admission_latency_s: list[float] = []
+        self._m = None
+        if registry is not None:
+            self._m = pm.Counter(
+                "scheduler_ingest_pods_total",
+                "enqueue outcomes through the ingest gate",
+                labelnames=("outcome",), registry=registry)
+            pm.CallbackGauge(
+                "scheduler_ingest_queue_depth",
+                "pods resident in the device pending queue",
+                callback=lambda: float(self.queue.depth),
+                registry=registry)
+            pm.CallbackGauge(
+                "scheduler_ingest_shed_frac",
+                "lifetime fraction of offers shed",
+                callback=self._shed_frac, registry=registry)
+
+    def _shed_frac(self) -> float:
+        total = self.offered
+        if total <= 0:
+            return 0.0
+        return (self.shed_rate + self.shed_capacity + self.shed_fault) \
+            / total
+
+    def _count(self, outcome: str, n: int = 1) -> None:
+        if self._m is not None and n:
+            self._m.labels(outcome).inc(n)
+
+    # -- front door ------------------------------------------------------
+
+    def offer(self, pods: "list[dict]", tenant: int = 0,
+              now: "float | None" = None) -> dict:
+        """Offer a batch of pending-pod records (builder-style dicts:
+        name / priority / slo_target / submitted / run_seconds) for
+        admission. Returns {admitted: [names], shed: [names],
+        queue_depth, retry_after_s}; `retry_after_s` > 0 iff something
+        was shed. Raises FaultError when an injected error-rule fires
+        (the rpc layer maps it to UNAVAILABLE)."""
+        if now is None:
+            now = float(self.clock())
+        # Fault site OUTSIDE the lock: an injected delay is a stalled
+        # front door, and it must not wedge a concurrent drain.
+        shot = self.faults.fire("ingest.enqueue")
+        with self._lock:
+            self.offered += len(pods)
+            if shot == "drop":
+                self.shed_fault += len(pods)
+                self._count("shed_fault", len(pods))
+                for p in pods:
+                    self._first_offer.setdefault(p["name"], now)
+                return dict(admitted=[], shed=[p["name"] for p in pods],
+                            queue_depth=self.queue.depth,
+                            retry_after_s=min(1.0, MAX_RETRY_AFTER_S))
+            ti = min(max(int(tenant), 0), len(self.buckets) - 1)
+            bucket = self.buckets[ti]
+            admitted, shed = [], []
+            retry_after = 0.0
+            for p in pods:
+                name = p["name"]
+                if self._admitted_names is not None \
+                        and name in self._admitted_names \
+                        and name not in self.queue:
+                    # Already admitted AND drained: a retry of an acked
+                    # batch (the chaos storm). Idempotent success — no
+                    # second enqueue, no token spent.
+                    admitted.append(name)
+                    continue
+                self._first_offer.setdefault(name, now)
+                if name not in self.queue and not bucket.take(now):
+                    shed.append(name)
+                    self.shed_rate += 1
+                    self._count("shed_rate")
+                    retry_after = max(retry_after, bucket.retry_after(now))
+                    continue
+                ok = self.queue.upsert(
+                    name,
+                    base_priority=float(p.get("priority", 0.0)),
+                    slo_target=float(p.get("slo_target", 0.0)),
+                    submitted=float(p.get("submitted", now)),
+                    run_seconds=float(p.get("run_seconds", 0.0)),
+                    tenant=ti,
+                )
+                if not ok:
+                    shed.append(name)
+                    self.shed_capacity += 1
+                    self._count("shed_capacity")
+                    # Capacity frees on drain, not on refill: hint one
+                    # solve cadence out.
+                    retry_after = max(retry_after, 1.0)
+                    continue
+                admitted.append(name)
+                if self._admitted_names is not None:
+                    self._admitted_names.add(name)
+                first = self._first_offer.pop(name, now)
+                self.admission_latency_s.append(now - first)
+            self.admitted += len(admitted)
+            self._count("admitted", len(admitted))
+            return dict(admitted=admitted, shed=shed,
+                        queue_depth=self.queue.depth,
+                        retry_after_s=retry_after)
+
+    # -- back door (the solve loop) --------------------------------------
+
+    def take_window(self, now: "float | None" = None,
+                    w: int = 256) -> "list[str]":
+        """Drain the top-`w` window: extract on device, remove the
+        taken rows, and ledger one source="ingest" CycleRecord (the
+        bench's queue-depth quantiles read these). Returns the drained
+        names in pop order."""
+        if now is None:
+            now = float(self.clock())
+        with self._lock:
+            names, _n_elig, depth = self.queue.window(now, w)  # tpl: disable=TPL102(the gate's lock IS the DeviceQueue's only serialization — the queue is not thread-safe, and the dirty-slot flush inside window() must not interleave with a concurrent offer()'s upserts)
+            self.queue.remove(names)
+            self.drained += len(names)
+        lg = self.ledger
+        if lg is not None and lg.enabled:
+            lg.observe(ledgering.CycleRecord(
+                ts=float(now), source="ingest",
+                pods=len(names), queue_depth=int(depth),
+                stages=dict(window=0.0),
+            ))
+        return names
+
+    # -- introspection ---------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            lat = self.admission_latency_s
+            return dict(
+                offered=self.offered, admitted=self.admitted,
+                drained=self.drained,
+                shed_rate=self.shed_rate,
+                shed_capacity=self.shed_capacity,
+                shed_fault=self.shed_fault,
+                shed_frac=round(self._shed_frac(), 6),
+                queue_depth=self.queue.depth,
+                queue_capacity=self.queue.capacity,
+                queue_bound=self.queue.bound,
+                admission_latency_samples=len(lat),
+            )

@@ -1,0 +1,233 @@
+"""Device-resident pending-queue kernels (ISSUE 20 tentpole part 1).
+
+The paper's dynamic-priority queue — priority = distance to the
+availability SLO, recomputed as observed availability decays — used to
+live on the host: every cycle re-read the whole pending set and the
+selection window rode dict order. These kernels relocate that loop onto
+the device: a persistent [Q] pending table (struct-of-arrays, pow2
+capacity) holds each waiting pod's QoS terms, and `rank_window` re-
+derives every slot's availability / pressure / effective priority
+in-kernel each cycle and extracts the top-W solve window with ONE
+lexicographic device sort — so per-cycle host work is O(arrivals), not
+O(pending).
+
+Ordering contract (pinned bit-for-bit by tests/test_devqueue.py against
+`rank_reference`, the numpy host oracle below):
+
+    (eligible first,  effective_priority DESC,  arrival seq ASC)
+
+Floats don't lexicographic-sort as bits, so the priority key is the
+classic monotone float32 -> uint32 embedding (`sortable_u32`: flip all
+bits of negatives, set the sign bit of non-negatives), inverted for the
+descending leg. The arrival sequence is a uint32 the api server stamps
+at submission — the deterministic tie-break (same role as
+qos.tie_hash for pop order), so two pods at identical pressure pop in
+arrival order on every backend.
+
+The availability/pressure math is qos.observed_availability /
+qos.pressure_of relocated verbatim (same clip bounds, same
+MIN_OBSERVED_AGE_S grace, same never-observed fallback); pending slots
+have no live bind, so the `bound_at` leg is structurally zero.
+
+Shape discipline: the table capacity Q and the window bucket kb are
+both pow2 (config.Buckets style), so the jit cache stays bounded the
+same way the engine's `_k_bucket` top-k does.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusched.config import DEFAULT_OBSERVED_AVAIL
+from tpusched.qos import MIN_OBSERVED_AGE_S
+
+
+class QueueTable(NamedTuple):
+    """The [Q] device pending table. Times are float32 seconds RELATIVE
+    to the owning DeviceQueue's epoch (wall epochs don't fit f32);
+    `parked_until` is the backoff mask bit in time form — a slot is
+    eligible iff valid and parked_until <= now."""
+
+    valid: jax.Array          # bool[Q]   slot occupied
+    base_priority: jax.Array  # f32[Q]    static pod.spec priority
+    slo_target: jax.Array     # f32[Q]    availability SLO
+    submitted: jax.Array      # f32[Q]    submit time (epoch-relative)
+    run_seconds: jax.Array    # f32[Q]    banked run time across requeues
+    parked_until: jax.Array   # f32[Q]    backoff parking; 0 = eligible
+    tenant: jax.Array         # i32[Q]    ingest tenant id
+    seq: jax.Array            # u32[Q]    arrival sequence (tie-break)
+
+
+N_FIELDS = len(QueueTable._fields)
+
+
+def k_bucket(k: int, n: int) -> int:
+    """Pow2 compile bucket for a window of k out of n slots, clamped to
+    n — the engine's `_k_bucket` discipline, shared here so the queue
+    window and the score top-k bucket identically."""
+    kb = 1 << (max(int(k), 1) - 1).bit_length()
+    return min(kb, int(n))
+
+
+def empty_table(capacity: int) -> QueueTable:
+    """Host-side (numpy) empty table; callers device_put it."""
+    q = int(capacity)
+    return QueueTable(
+        valid=np.zeros(q, bool),
+        base_priority=np.zeros(q, np.float32),
+        slo_target=np.zeros(q, np.float32),
+        submitted=np.zeros(q, np.float32),
+        run_seconds=np.zeros(q, np.float32),
+        parked_until=np.zeros(q, np.float32),
+        tenant=np.zeros(q, np.int32),
+        seq=np.zeros(q, np.uint32),
+    )
+
+
+def sortable_u32(prio):
+    """Monotone float32 -> uint32 key embedding: a < b in float order
+    iff sortable_u32(a) < sortable_u32(b) in unsigned order (finite
+    inputs; priorities are finite by construction). Works on jnp and np
+    arrays alike — the same pure-uint32 polymorphism as qos.tie_hash,
+    so the host reference and the kernel share one definition."""
+    xp = jnp if isinstance(prio, jax.Array) else np
+    if xp is jnp:
+        u = jax.lax.bitcast_convert_type(prio, jnp.uint32)
+    else:
+        u = np.ascontiguousarray(prio, dtype=np.float32).view(np.uint32)
+    sign = xp.uint32(0x80000000)
+    return xp.where(u >= sign, ~u, u | sign)
+
+
+def _rank(table: QueueTable, now, qos_gain):
+    """Shared ranking body: per-slot availability-decay priority plus
+    the three lexicographic sort keys. `now`/`qos_gain` are traced f32
+    scalars (no recompile per cycle)."""
+    age = now - table.submitted
+    never = age < jnp.float32(MIN_OBSERVED_AGE_S)
+    # Reduction site: run/age clamp; never-observed slots take the
+    # DEFAULT_OBSERVED_AVAIL grace exactly like qos.observed_availability
+    # (the where-guard keeps the dead lane's 0/0 out of the output).
+    avail = jnp.where(
+        never,
+        jnp.float32(DEFAULT_OBSERVED_AVAIL),
+        jnp.clip(table.run_seconds / jnp.where(never, jnp.float32(1.0), age),
+                 0.0, 1.0),
+    )
+    pressure = jnp.clip(table.slo_target - avail, 0.0, 1.0)
+    # XLA CPU contracts this mul+add into an FMA at the LLVM level
+    # (even past an optimization_barrier — contraction happens after
+    # HLO); reference_priorities emulates the same single-rounding in
+    # f64, which is why the two stay bit-identical.
+    prio = table.base_priority + qos_gain * pressure
+    eligible = table.valid & (table.parked_until <= now)
+    k_elig = jnp.where(eligible, jnp.uint32(0), jnp.uint32(1))
+    k_prio = ~sortable_u32(prio)        # ascending sort => priority DESC
+    return prio, eligible, k_elig, k_prio
+
+
+@jax.jit
+def rank_full(table: QueueTable, now, qos_gain):
+    """Full-table pop order (parity tests, small tables): every slot's
+    index in (eligible, priority desc, seq asc) order, plus the
+    per-slot priorities and the depth/eligible counts."""
+    prio, eligible, k_elig, k_prio = _rank(table, now, qos_gain)
+    idx = jnp.arange(table.valid.shape[0], dtype=jnp.int32)
+    _, _, _, order = jax.lax.sort(
+        (k_elig, k_prio, table.seq, idx), num_keys=3)
+    n_eligible = jnp.sum(eligible.astype(jnp.int32))
+    depth = jnp.sum(table.valid.astype(jnp.int32))
+    return order, prio, n_eligible, depth
+
+
+def window_select(table: QueueTable, now, qos_gain, kb: int):
+    """Top-kb solve window on device: one lexicographic sort over the
+    [Q] table, sliced to the pow2 window bucket BEFORE leaving the
+    device — the host transfers O(kb) indices, never the table. The
+    kb-prefix of the full ranking IS the top-kb (total order), so
+    bucketed windows share compiles the way the engine's bucketed
+    top-k does. Returns (idx[kb], prio[kb], n_eligible, depth)."""
+    return _window_static(kb)(table, jnp.float32(now),
+                              jnp.float32(qos_gain))
+
+
+_WINDOW_CACHE: dict = {}
+
+
+def _pow2_bucket(kb: int) -> int:
+    """Idempotent pow2 round-up: callers already pass k_bucket values,
+    but re-deriving the memo key here makes the compile-set bound
+    (log2(Q) entries max) local to the cache it protects."""
+    return 1 << (max(int(kb), 1) - 1).bit_length()
+
+
+def _window_static(kb: int):
+    kb = _pow2_bucket(kb)
+    fn = _WINDOW_CACHE.get(kb)
+    if fn is None:
+        fn = jax.jit(lambda t, now, g, _kb=kb: _window_body(t, now, g, _kb))
+        _WINDOW_CACHE[kb] = fn
+    return fn
+
+
+def _window_body(table: QueueTable, now, qos_gain, kb: int):
+    prio, eligible, k_elig, k_prio = _rank(table, now, qos_gain)
+    idx = jnp.arange(table.valid.shape[0], dtype=jnp.int32)
+    _, _, _, order = jax.lax.sort(
+        (k_elig, k_prio, table.seq, idx), num_keys=3)
+    win = jax.lax.slice_in_dim(order, 0, kb)
+    n_eligible = jnp.sum(eligible.astype(jnp.int32))
+    depth = jnp.sum(table.valid.astype(jnp.int32))
+    return win, prio[win], n_eligible, depth
+
+
+# ---------------------------------------------------------------------------
+# Host oracle — the "host-sorted reference" the parity tests (and the
+# bench's host-sorted baseline arm) compare against, numpy end to end.
+# ---------------------------------------------------------------------------
+
+
+def reference_priorities(table: QueueTable, now: float,
+                         qos_gain: float) -> np.ndarray:
+    """Numpy twin of the in-kernel priority recompute, float32 op for
+    op (divide, clip, multiply, add in the same order) so the sortable
+    keys match the device bit-for-bit."""
+    submitted = np.asarray(table.submitted, np.float32)
+    run = np.asarray(table.run_seconds, np.float32)
+    slo = np.asarray(table.slo_target, np.float32)
+    base = np.asarray(table.base_priority, np.float32)
+    age = np.float32(now) - submitted
+    never = age < np.float32(MIN_OBSERVED_AGE_S)
+    avail = np.where(
+        never,
+        np.float32(DEFAULT_OBSERVED_AVAIL),
+        np.clip(run / np.where(never, np.float32(1.0), age),
+                np.float32(0.0), np.float32(1.0)),
+    ).astype(np.float32)
+    pressure = np.clip(slo - avail, np.float32(0.0),
+                       np.float32(1.0)).astype(np.float32)
+    # FMA emulation: the product of two f32s is exact in f64, so
+    # f64(base) + f64(gain)*f64(pressure) rounded once to f32 is the
+    # fused mul-add XLA CPU actually emits (see _rank).
+    fused = (base.astype(np.float64)
+             + np.float64(qos_gain) * pressure.astype(np.float64))
+    return fused.astype(np.float32)
+
+
+def rank_reference(table: QueueTable, now: float, qos_gain: float):
+    """Full host-sorted ranking under the identical ordering contract:
+    np.lexsort (stable, last key primary) over the same three keys.
+    Returns (order[Q], prio[Q], n_eligible, depth)."""
+    prio = reference_priorities(table, now, qos_gain)
+    valid = np.asarray(table.valid, bool)
+    eligible = valid & (np.asarray(table.parked_until, np.float32)
+                        <= np.float32(now))
+    k_elig = np.where(eligible, np.uint32(0), np.uint32(1))
+    k_prio = ~sortable_u32(prio)
+    seq = np.asarray(table.seq, np.uint32)
+    order = np.lexsort((seq, k_prio, k_elig)).astype(np.int32)
+    return order, prio, int(eligible.sum()), int(valid.sum())

@@ -101,6 +101,7 @@ class CycleRecord:
     stages: "dict[str, float]" = dataclasses.field(default_factory=dict)
     compiles: int = 0          # XLA cache misses paid inside the cycle
     compile_s: float = 0.0     # their compile wall time
+    queue_depth: int = 0       # pending-queue depth at cycle start
     cycle: int = 0
     anomaly: str = ""
 
@@ -123,6 +124,7 @@ SCHEMA: "dict[str, tuple[type, ...]]" = {
     "stages": (dict,),
     "compiles": (int,),
     "compile_s": (int, float),
+    "queue_depth": (int,),
     "anomaly": (str,),
 }
 

@@ -244,6 +244,7 @@ class SchedulerClient:
         self._replicate = _MethodRef(self, "Replicate")
         self._explainz = _MethodRef(self, "Explainz")
         self._statusz = _MethodRef(self, "Statusz")
+        self._enqueue = _MethodRef(self, "Enqueue")
 
     _RPCS = (
         ("ScoreBatch", pb.ScoreRequest, pb.ScoreResponse),
@@ -254,6 +255,7 @@ class SchedulerClient:
         ("Replicate", pb.ReplicateRequest, pb.ReplicateResponse),
         ("Explainz", pb.ExplainzRequest, pb.ExplainzResponse),
         ("Statusz", pb.StatuszRequest, pb.StatuszResponse),
+        ("Enqueue", pb.EnqueueRequest, pb.EnqueueResponse),
     )
 
     def _connect(self) -> None:
@@ -565,6 +567,27 @@ class SchedulerClient:
             self._statusz,
             pb.StatuszRequest(max_records=int(max_records)),
         )
+
+    def enqueue(self, pods, tenant: int = 0,
+                submitted: float = 0.0) -> pb.EnqueueResponse:
+        """Offer a batch through the admission-controlled front door
+        (PR 20, ISSUE 20). `pods` is a list of pb.PendingPod messages
+        or builder-style dicts (name / priority / slo_target). A
+        FULLY shed batch is RESOURCE_EXHAUSTED — already in
+        RETRYABLE_CODES, so this call backs off and re-offers inside
+        its deadline budget without new machinery; the server dedups
+        admitted names so the retry is exactly-once. A partial shed
+        returns OK with resp.shed_pods for the caller to re-offer."""
+        req = pb.EnqueueRequest(tenant=int(tenant),
+                                submitted=float(submitted))
+        for p in pods:
+            if isinstance(p, pb.PendingPod):
+                req.pods.add().CopyFrom(p)
+            else:
+                req.pods.add(name=p["name"],
+                             priority=float(p.get("priority", 0.0)),
+                             slo_target=float(p.get("slo_target", 0.0)))
+        return self._call(self._enqueue, req, rpc="Enqueue")
 
     def close(self):
         self._channel.close()

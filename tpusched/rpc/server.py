@@ -141,7 +141,8 @@ from tpusched import wire as wiring
 from tpusched.faults import NO_FAULTS
 from tpusched.mesh import make_mesh
 from tpusched.config import Buckets, EngineConfig
-from tpusched.device_state import DeviceSnapshot
+from tpusched.device_state import DeviceQueue, DeviceSnapshot
+from tpusched.ingest import IngestGate
 from tpusched.replicate import ReplicationLog
 from tpusched.engine import Engine
 from tpusched.faults import FaultError
@@ -723,6 +724,7 @@ class SchedulerService:
         prewarm: bool = False,
         wire: "wiring.WireLedger | None" = None,
         wire_profile_dir: "str | None" = None,
+        ingest=None,
     ):
         """audit_stream: optional file-like; when set, every Assign
         emits one JSON record PER POD (pod, node, score, commit_key —
@@ -806,7 +808,19 @@ class SchedulerService:
         WireRecord from the shared span ring. wire_profile_dir: when
         set, a wire anomaly arms a one-shot jax.profiler device-trace
         capture of the next serving cycle (WireLedger.maybe_profile),
-        written under this directory."""
+        written under this directory.
+
+        ingest (PR 20, ISSUE 20): the admission-controlled front door
+        served by the Enqueue rpc. None (default) leaves Enqueue
+        UNIMPLEMENTED. An IngestGate instance is used as-is; any other
+        truthy value builds a gate over a fresh DeviceQueue — pass a
+        dict of knobs (capacity/bound for the queue; rate/burst/
+        tenants/skew for tpusched.ingest.IngestGate) or True for the
+        defaults. A built gate registers its families in THIS server's
+        metrics registry, ledgers its drain records into THIS server's
+        cycle ledger (source="ingest"), shares the fault plan (the
+        ``ingest.enqueue`` site), and dedups admitted names so a
+        shed-then-retried batch converges exactly-once."""
         self.config = config or EngineConfig()
         # Floor buckets pin compile shapes across requests (a feature
         # first appearing mid-serving would otherwise trigger a full
@@ -922,6 +936,25 @@ class SchedulerService:
             self.wire = wiring.WireLedger(
                 registry=self.metrics.registry, flight=self.flight,
                 tracer=self._trace, profile_dir=wire_profile_dir)
+        # Admission-controlled ingest (PR 20, ISSUE 20): token-bucket
+        # front door over a device-resident pending queue, served by
+        # the Enqueue rpc (docstring above). Gauges/counters land in
+        # THIS registry so Metrics renders queue depth and shed rate.
+        if ingest is None:
+            self.ingest = None
+        elif isinstance(ingest, IngestGate):
+            self.ingest = ingest
+        else:
+            spec = dict(ingest) if isinstance(ingest, dict) else {}
+            queue = DeviceQueue(
+                capacity=int(spec.pop("capacity", 4096)),
+                bound=spec.pop("bound", None),
+                qos_gain=float(self.config.qos.qos_gain),
+            )
+            self.ingest = IngestGate(
+                queue, faults=self._faults,
+                registry=self.metrics.registry, ledger=self.ledger,
+                dedup=True, **spec)
         # Live device/store memory surface (ROADMAP item 1 feeds on
         # this): rendered at scrape time from the authoritative maps.
         pm.CallbackGauge(
@@ -2285,6 +2318,10 @@ class SchedulerService:
         # offset, coverage, and last-N WireRecords (tpusched.wire
         # SCHEMA). Raw bucket counts ride along for the fleet merge.
         payload["wire"] = self.wire.statusz(last=n)
+        # Ingest panel (PR 20, ISSUE 20): front-door admission counters
+        # plus live queue depth/capacity, when this server has a gate.
+        if self.ingest is not None:
+            payload["ingest"] = self.ingest.stats()
         lad = self._ladder.snapshot()
         payload["role"] = self.role
         payload["serving_path"] = lad["level"]
@@ -2320,6 +2357,44 @@ class SchedulerService:
             payload["who_evicted"] = col.who_evicted(request.victim)
         return pb.ExplainzResponse(explain_json=json.dumps(payload))
 
+    def Enqueue(self, request: pb.EnqueueRequest,
+                context) -> pb.EnqueueResponse:
+        """The bounded front door (PR 20, ISSUE 20): offer a batch of
+        pending pods to the ingest gate. A partially shed batch is a
+        SUCCESS carrying the shed names + retry-after hint (the caller
+        re-offers just those); a FULLY shed batch aborts
+        RESOURCE_EXHAUSTED, which rpc/client.py's RETRYABLE_CODES
+        already backs off and re-drives — the PR 3 retry contract is
+        the load-shedding protocol. An injected ``ingest.enqueue``
+        error surfaces as UNAVAILABLE (same contract). Admission is
+        exactly-once across those retries: the gate dedups by name."""
+        if self.ingest is None:
+            self._abort(context, grpc.StatusCode.UNIMPLEMENTED,
+                        "this server has no ingest gate "
+                        "(make_server ingest=...)")
+        submitted = float(request.submitted) or time.time()
+        pods = [
+            dict(name=p.name, priority=float(p.priority),
+                 slo_target=float(p.slo_target), submitted=submitted)
+            for p in request.pods
+        ]
+        try:
+            res = self.ingest.offer(pods, tenant=int(request.tenant))
+        except FaultError as e:
+            self._abort(context, grpc.StatusCode.UNAVAILABLE,
+                        f"ingest fault: {e}")
+        if pods and not res["admitted"]:
+            self._abort(
+                context, grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"ingest shed all {len(pods)} pods; retry after "
+                f"{res['retry_after_s']:.3f}s")
+        return pb.EnqueueResponse(
+            admitted=len(res["admitted"]), shed=len(res["shed"]),
+            shed_pods=res["shed"],
+            queue_depth=int(res["queue_depth"]),
+            retry_after_s=float(res["retry_after_s"]),
+        )
+
 
 def make_server(
     address: str = "127.0.0.1:0",
@@ -2344,6 +2419,7 @@ def make_server(
     prewarm: bool = False,
     wire: "wiring.WireLedger | None" = None,
     wire_profile_dir: "str | None" = None,
+    ingest=None,
 ):
     """Build (grpc.Server, bound_port, service). Unlimited message size:
     a 10k-pod snapshot exceeds the 4 MB default. max_workers default 8:
@@ -2368,7 +2444,11 @@ def make_server(
     wire/wire_profile_dir: the wire ledger + its optional anomaly-armed
     device-trace capture directory (round 19, ISSUE 19 — clients
     constructed with wire=svc.wire feed the server's Statusz `wire`
-    panel; SchedulerService docstring)."""
+    panel; SchedulerService docstring); ingest: the admission-
+    controlled front door served by the Enqueue rpc (PR 20, ISSUE 20 —
+    None leaves Enqueue UNIMPLEMENTED; an IngestGate, a dict of
+    queue/gate knobs, or True builds one; SchedulerService
+    docstring)."""
     svc = SchedulerService(config, buckets, log_stream=log_stream,
                            audit_stream=audit_stream,
                            device_sessions=device_sessions,
@@ -2378,7 +2458,8 @@ def make_server(
                            explain=explain, explain_k=explain_k,
                            warm=warm, ledger=ledger,
                            ledger_jsonl=ledger_jsonl, prewarm=prewarm,
-                           wire=wire, wire_profile_dir=wire_profile_dir)
+                           wire=wire, wire_profile_dir=wire_profile_dir,
+                           ingest=ingest)
 
     def handler(fn, req_cls):
         return grpc.unary_unary_rpc_method_handler(
@@ -2396,6 +2477,7 @@ def make_server(
         "Replicate": handler(svc.Replicate, pb.ReplicateRequest),
         "Explainz": handler(svc.Explainz, pb.ExplainzRequest),
         "Statusz": handler(svc.Statusz, pb.StatuszRequest),
+        "Enqueue": handler(svc.Enqueue, pb.EnqueueRequest),
     }
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),

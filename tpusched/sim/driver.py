@@ -134,6 +134,8 @@ class SimDriver:
         explain=None,
         setup: "SimSetup | None" = None,
         ledger=None,
+        device_queue: bool = False,
+        ingest=None,
     ):
         """explain (round 12): optional ExplainCollector threaded into
         the in-process HostScheduler — every cycle records a
@@ -154,7 +156,21 @@ class SimDriver:
         live serving (tests/test_ledger.py pins the twin), with
         source="sim" and ts on the virtual clock, so a recorded
         workload's flight ledger is directly comparable to the
-        production one it replays."""
+        production one it replays.
+
+        device_queue (ISSUE 20): thread the device-resident pending
+        queue into the HostScheduler — batch membership comes from the
+        in-kernel availability-decay ranking instead of the per-cycle
+        host re-read. Whenever every eligible pod fits the batch the
+        run is event-for-event identical to the host-sorted path
+        (tests pin the pressure_skew twin hash).
+
+        ingest (ISSUE 20): optional tpusched.ingest.IngestGate —
+        arrivals pass through token-bucket admission before reaching
+        the api server; shed pods are re-offered every tick until
+        admitted (the sim twin of the rpc client's
+        RESOURCE_EXHAUSTED retry loop), so a run under admission
+        pressure still converges to the same end state."""
         if setup is not None:
             if scenario is not None and scenario is not setup.scenario:
                 raise ValueError(
@@ -192,7 +208,13 @@ class SimDriver:
             explain=explain,
             refresh_frac=self.sim.pipeline_refresh_frac,
             ledger=ledger,
+            device_queue=device_queue,
         )
+        self.ingest = ingest
+        # The gate sheds into this retry buffer; _ingest_tick re-offers
+        # each tick (deliveries stay exactly-once: admission dedups by
+        # name). Always present so callers may attach a gate post-init.
+        self._shed_retry: list[str] = []
         # Re-tag the host's ledger records: a virtual-time replay's
         # cycles must be distinguishable from live host cycles while
         # keeping the identical schema (the twin contract).
@@ -221,12 +243,18 @@ class SimDriver:
             name = ev.data["pod"]
             spec = self.setup.specs[name]
             meta = self.setup.meta[name]
-            self.api.add_pod(name, **spec)
             self.life.on_submit(name, now, slo_target=meta["slo"])
             self._remaining[name] = meta["duration_s"]
             self._gen[name] = 0
             self._arrived.append(name)
             self.q.note(ev.time, "arrival", pod=name)
+            if self.ingest is None:
+                self.api.add_pod(name, **spec)
+            else:
+                # Admission-gated arrival (ISSUE 20): the pod reaches
+                # the api server only when the gate drains it
+                # (_ingest_tick); sheds go to the retry buffer.
+                self._offer_pod(name, now)
         elif ev.kind == "complete":
             name = ev.data["pod"]
             if ev.data["gen"] != self._gen.get(name):
@@ -391,6 +419,44 @@ class SimDriver:
             self._interrupt(name, now, reason="preempted")
             self.q.note(now, "evict", pod=name)
 
+    def _offer_pod(self, name: str, now: float) -> None:
+        """One pod through the ingest gate. An injected enqueue fault
+        (ingest.enqueue error-rule) behaves exactly like a shed here —
+        the sim IS the retrying client — and lands in the event log so
+        the fault schedule stays part of the hashed timeline."""
+        spec = self.setup.specs[name]
+        meta = self.setup.meta[name]
+        life = self.life.pods[name]
+        rec = dict(name=name, priority=spec.get("priority", 0.0),
+                   slo_target=meta["slo"], submitted=life.submitted,
+                   run_seconds=life.run_seconds)
+        try:
+            res = self.ingest.offer([rec], tenant=meta.get("tenant", 0),
+                                    now=now)
+        except FaultError:
+            self._shed_retry.append(name)
+            self.q.note(now, "ingest_fault", pod=name)
+            return
+        if res["shed"]:
+            self._shed_retry.extend(res["shed"])
+            self.q.note(now, "ingest_shed", pod=name)
+
+    def _ingest_tick(self, now: float) -> None:
+        """Per-tick front-door pump: re-offer everything shed (the
+        RESOURCE_EXHAUSTED retry loop, virtual-time edition), then
+        drain the gate's admitted window into the api server with
+        lifecycle history preserved — convergence to the ungated end
+        state is what the chaos arm pins."""
+        retry, self._shed_retry = self._shed_retry, []
+        for name in retry:
+            self._offer_pod(name, now)
+        for name in self.ingest.take_window(now, w=self.sim.batch_size):
+            life = self.life.pods[name]
+            self.api.add_pod(
+                name, **self.setup.specs[name],
+                submitted=life.submitted, run_seconds=life.run_seconds,
+            )
+
     def _sample_pressure(self, now: float) -> None:
         pend = self.api.pending_pods()
         if not pend:
@@ -421,6 +487,8 @@ class SimDriver:
                 due = self.q.pop_until(now)
                 for event in due:
                     self._apply(event)
+                if self.ingest is not None:
+                    self._ingest_tick(now)
                 if ticks % sim.resolve_every == 0:
                     self._cycle(now)
                 self._sample_pressure(now)
@@ -500,6 +568,7 @@ def run_scenario(
     explain=None,
     setup: "SimSetup | None" = None,
     ledger=None,
+    device_queue: bool = False,
 ) -> SimResult:
     """One sim run. backend="grpc" spins an in-process sidecar and
     drives the full host -> gRPC path (AssignPipeline transport);
@@ -526,7 +595,7 @@ def run_scenario(
         return SimDriver(scenario, seed, config=config, sim=sim,
                          engine=engine, faults=faults, tracer=tracer,
                          explain=explain, setup=setup,
-                         ledger=ledger).run()
+                         ledger=ledger, device_queue=device_queue).run()
     if backend != "grpc":
         raise ValueError(f"backend={backend!r}: want inprocess|grpc")
     from tpusched.rpc.client import SchedulerClient  # tpl: disable=TPL001(grpc backend is optional; the in-process sim must import without grpc)
@@ -583,6 +652,7 @@ def twin_run(
     explain: bool = False,
     setup_factory=None,
     faults_factory=None,
+    device_queue: bool = False,
 ) -> dict:
     """The headline experiment: same scenario, same seed, QoS-driven vs
     static-priority baseline. Returns both summaries plus
@@ -638,6 +708,7 @@ def twin_run(
             explain=col, setup=arm_setup,
             faults=(faults_factory() if faults_factory is not None
                     else None),
+            device_queue=device_queue,
         )
         results[arm] = report.summarize(res)
         if col is not None:
